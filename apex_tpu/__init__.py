@@ -1,0 +1,57 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability surface of
+NVIDIA Apex (reference: guolinke/apex):
+
+- :mod:`apex_tpu.amp` — mixed precision (O0–O5 policies, functional loss
+  scaling, fp32 master weights).
+- :mod:`apex_tpu.optimizers` — fused optimizers (Adam, LAMB, SGD,
+  NovoGrad, Adagrad, mixed-precision LAMB) as Pallas kernels behind
+  optax-compatible transformations.
+- :mod:`apex_tpu.parallel` — data parallelism (gradient sync with DDP
+  knob parity, SyncBatchNorm, LARC, ZeRO-sharded optimizers).
+- :mod:`apex_tpu.transformer` — Megatron-style tensor/pipeline model
+  parallelism over a ``jax.sharding.Mesh``.
+- :mod:`apex_tpu.normalization`, :mod:`apex_tpu.ops` — fused layers and
+  Pallas kernels (LayerNorm, scaled-masked softmax, fused cross-entropy,
+  flash attention).
+- :mod:`apex_tpu.parallel_state` — the mesh-axis registry.
+
+No CUDA, no torch: compute lowers to XLA/Pallas; collectives ride the
+ICI/DCN mesh.
+"""
+import logging as _logging
+
+from . import parallel_state  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+class RankInfoFormatter(_logging.Formatter):
+    """Stamp topology info on every record
+    (ref: apex/__init__.py:29-42 RankInfoFormatter)."""
+
+    def format(self, record):
+        record.rank_info = parallel_state.get_rank_info() \
+            if parallel_state.model_parallel_is_initialized() else "-"
+        return super().format(record)
+
+
+_logger = _logging.getLogger("apex_tpu")
+if not _logger.handlers:
+    _handler = _logging.StreamHandler()
+    _handler.setFormatter(RankInfoFormatter(
+        "%(asctime)s [%(levelname)s|%(rank_info)s] %(name)s: %(message)s"))
+    _logger.addHandler(_handler)
+    _logger.setLevel(_logging.WARNING)
+
+
+def __getattr__(name):
+    # Lazy subpackage imports keep `import apex_tpu` light.
+    import importlib
+    if name in ("amp", "optimizers", "ops", "normalization", "parallel",
+                "transformer", "models", "utils", "contrib", "fp16_utils",
+                "mlp", "fused_dense", "reparameterization", "testing",
+                "pyprof"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

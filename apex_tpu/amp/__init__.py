@@ -1,0 +1,30 @@
+"""apex_tpu.amp — mixed-precision training (TPU-native apex.amp).
+
+Capability surface of the reference's precision stack
+(ref: apex/amp — frontend, _initialize, _process_optimizer, scaler,
+handle), redesigned functionally: policies are data, the scaler is a
+pytree, overflow-skip is a ``lax.cond``, and master weights live in
+optimizer state.  See SURVEY.md §2.1/§7.
+"""
+from . import scaler
+from .cast import (
+    cast_inputs,
+    cast_outputs,
+    cast_params,
+    convert_network,
+    master_copy,
+    restore_dtypes,
+    tree_cast,
+)
+from .mixed_precision import AmpOptimizer, AmpState, StepInfo, initialize
+from .policy import O0, O1, O2, O3, O4, O5, Policy, get_policy, opt_levels
+from .scaler import ScalerState, all_finite, scale_loss, unscale
+
+__all__ = [
+    "AmpOptimizer", "AmpState", "StepInfo", "initialize",
+    "Policy", "get_policy", "opt_levels",
+    "O0", "O1", "O2", "O3", "O4", "O5",
+    "ScalerState", "scaler", "scale_loss", "unscale", "all_finite",
+    "cast_params", "cast_inputs", "cast_outputs", "convert_network",
+    "master_copy", "restore_dtypes", "tree_cast",
+]

@@ -1,0 +1,100 @@
+"""Parameter/input casting utilities.
+
+Functional replacement for the reference's network conversion
+(ref: apex/fp16_utils/fp16util.py:7-187 ``convert_network`` /
+``BN_convert_float``, used live by amp O2/O5 at
+apex/amp/_initialize.py:176-182) and the patched ``model.forward``
+input/output casting (ref: apex/amp/_initialize.py:190-201).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+# Heuristic for "is this leaf part of a batch-norm layer": matches flax's
+# default module naming ("BatchNorm_0") and common hand-rolled names.  The
+# reference identifies BN structurally via isinstance checks
+# (ref: apex/fp16_utils/fp16util.py:30-42); a functional pytree only has
+# key paths, so the predicate is name-based and user-overridable.
+_BN_PAT = re.compile(r"(batch_?norm|(^|[^a-z])bn([^a-z]|$))", re.IGNORECASE)
+
+
+def default_bn_predicate(path) -> bool:
+    keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    return any(_BN_PAT.search(str(k)) for k in keys)
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    """Cast every floating leaf to ``dtype`` (non-float leaves untouched)."""
+    def _cast(x):
+        x = jnp.asarray(x)
+        return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def convert_network(params: Any, dtype,
+                    keep_batchnorm_fp32: bool = True,
+                    bn_predicate: Optional[Callable] = None) -> Any:
+    """Cast a parameter pytree to ``dtype``, optionally keeping batch-norm
+    leaves fp32 (ref: apex/fp16_utils/fp16util.py ``convert_network``;
+    BN exemption per apex/amp/_initialize.py:176-182)."""
+    pred = bn_predicate or default_bn_predicate
+
+    def _cast(path, x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if keep_batchnorm_fp32 and pred(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def cast_params(params: Any, policy) -> Any:
+    """Apply a :class:`~apex_tpu.amp.Policy`'s model cast to params."""
+    if policy.cast_model_type is None:
+        return params
+    keep_bn = policy.keep_batchnorm_fp32
+    if keep_bn is None:
+        keep_bn = True
+    return convert_network(params, policy.cast_model_type, keep_bn)
+
+
+def cast_inputs(args: Any, policy) -> Any:
+    """Cast model inputs to the model dtype, the patched-``forward``
+    entry cast (ref: apex/amp/_initialize.py:190-199)."""
+    if policy.cast_model_type is None:
+        return args
+    return tree_cast(args, policy.cast_model_type)
+
+
+def cast_outputs(outputs: Any, policy) -> Any:
+    """Cast model outputs (default fp32 for O2/O5-style policies,
+    ref: apex/amp/_initialize.py:199-201)."""
+    out_dtype = policy.cast_model_outputs
+    if out_dtype is None and policy.cast_model_type is not None:
+        out_dtype = jnp.float32
+    if out_dtype is None:
+        return outputs
+    return tree_cast(outputs, out_dtype)
+
+
+def master_copy(params: Any) -> Any:
+    """fp32 master copy of a (possibly low-precision) param tree
+    (ref: apex/amp/_process_optimizer.py:28-91
+    ``lazy_init_with_master_weights``)."""
+    return tree_cast(params, jnp.float32)
+
+
+def restore_dtypes(src: Any, like: Any) -> Any:
+    """Cast ``src`` leaf-wise to the dtypes of ``like`` (master -> model
+    writeback, ref: apex/fp16_utils/fp16util.py
+    ``master_params_to_model_params``)."""
+    return jax.tree_util.tree_map(
+        lambda s, l: s.astype(l.dtype) if jnp.issubdtype(
+            jnp.asarray(l).dtype, jnp.floating) else s,
+        src, like)

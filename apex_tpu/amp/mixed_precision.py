@@ -1,0 +1,198 @@
+"""Mixed-precision optimizer wrapper: master weights + scaler + skip-on-inf.
+
+This is the functional equivalent of the reference's optimizer surgery
+(ref: apex/amp/_process_optimizer.py:28-256 — master-weight swap, patched
+``step``/``zero_grad``, ``_post_amp_backward`` unscale) combined with the
+``scale_loss`` exit path (ref: apex/amp/handle.py:118-158).  Instead of
+monkey-patching a stateful optimizer, the whole per-step pipeline —
+unscale, fused finite-check, conditional update, master->model writeback,
+scale adjustment — is one pure function compiled into the train step.
+Overflow skip is a ``lax.cond`` (both branches compiled once, no recompile
+churn, no host sync).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from . import cast as _cast
+from . import scaler as _scaler
+from .policy import Policy, get_policy
+
+
+class AmpState(NamedTuple):
+    """Everything amp owns for one optimizer (a pytree).
+
+    ``scalers`` is one :class:`ScalerState` per loss
+    (ref: apex/amp/_initialize.py:227-231 creates ``num_losses`` scalers);
+    masters and inner optimizer state are shared across losses, exactly as
+    the reference shares one optimizer across ``loss_id``s.
+    """
+
+    inner_state: optax.OptState
+    # fp32 master copy of params when the policy asks for master weights,
+    # else None (inner optimizer then steps the model params directly).
+    master_params: Optional[Any]
+    scalers: Tuple[_scaler.ScalerState, ...]
+
+    @property
+    def scaler(self) -> _scaler.ScalerState:
+        return self.scalers[0]
+
+
+class StepInfo(NamedTuple):
+    grads_finite: jnp.ndarray
+    loss_scale: jnp.ndarray
+    steps_skipped: jnp.ndarray
+
+
+class AmpOptimizer:
+    """Pairs an optax ``GradientTransformation`` with a precision policy.
+
+    Functional analogue of ``amp.initialize(model, optimizer, ...)``
+    (ref: apex/amp/frontend.py:258): parameters stay in the policy's model
+    dtype; fp32 masters live in :class:`AmpState`; gradients arriving at
+    :meth:`apply_gradients` are the *scaled* gradients of a loss produced by
+    :func:`scale_loss`.
+    """
+
+    def __init__(self, tx: optax.GradientTransformation, policy: Policy,
+                 num_losses: int = 1):
+        self.tx = tx
+        self.policy = policy
+        self.num_losses = int(num_losses)
+        self.use_masters = bool(policy.master_weights)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self, params: Any) -> AmpState:
+        """Build amp state.  Pass the *original* (highest-precision) params
+        here, not the already-cast copy — masters are snapshotted exactly
+        from them (the reference likewise clones masters from the fp32
+        model before it is cast, ref: apex/amp/_process_optimizer.py:28-44).
+        """
+        if self.use_masters:
+            masters = _cast.master_copy(params)
+            inner = self.tx.init(masters)
+        else:
+            masters = None
+            # Inner state dtypes must match what will actually be stepped
+            # (the cast model params, e.g. fp16 under O3).
+            inner = self.tx.init(_cast.cast_params(params, self.policy))
+        return AmpState(
+            inner_state=inner,
+            master_params=masters,
+            scalers=tuple(
+                _scaler.init(self.policy.effective_loss_scale)
+                for _ in range(self.num_losses)
+            ),
+        )
+
+    # -- per-iteration hooks ------------------------------------------------
+
+    def scale_loss(self, loss: jnp.ndarray, state: AmpState,
+                   loss_id: int = 0) -> jnp.ndarray:
+        """``with amp.scale_loss(..., loss_id=i)`` entry
+        (ref: apex/amp/handle.py:16)."""
+        return _scaler.scale_loss(loss, state.scalers[loss_id])
+
+    def apply_gradients(
+        self, scaled_grads: Any, state: AmpState, params: Any,
+        loss_id: int = 0,
+    ) -> Tuple[Any, AmpState, StepInfo]:
+        """Unscale, check, conditionally step, writeback, update scale.
+
+        Returns ``(new_params, new_state, info)``.  The skipped branch
+        returns params/state unchanged (the reference's patched-no-op
+        ``optimizer.step``, ref: apex/amp/handle.py:128-154).  With
+        multiple losses, call once per loss with the matching ``loss_id``;
+        masters/inner state advance each call, scalers independently.
+        """
+        grads32 = _scaler.unscale(scaled_grads, state.scalers[loss_id])
+        finite = _scaler.all_finite(grads32)
+
+        stepped = state.master_params if self.use_masters else params
+
+        def do_step(operand):
+            grads32_, inner_, stepped_ = operand
+            updates, new_inner = self.tx.update(
+                _grads_like(grads32_, stepped_), inner_, stepped_)
+            new_stepped = optax.apply_updates(stepped_, updates)
+            return new_stepped, new_inner
+
+        def skip_step(operand):
+            _, inner_, stepped_ = operand
+            return stepped_, inner_
+
+        new_stepped, new_inner = jax.lax.cond(
+            finite, do_step, skip_step, (grads32, state.inner_state, stepped))
+
+        if self.use_masters:
+            # Master -> model writeback: emit params in the model dtype
+            # (ref: apex/amp/_process_optimizer.py:14-25 step postlude).
+            new_params = _cast.restore_dtypes(new_stepped, params)
+            new_masters = new_stepped
+        else:
+            new_params = new_stepped
+            new_masters = None
+
+        new_scaler = _scaler.update(state.scalers[loss_id], finite)
+        new_scalers = tuple(
+            new_scaler if i == loss_id else s
+            for i, s in enumerate(state.scalers)
+        )
+        new_state = AmpState(new_inner, new_masters, new_scalers)
+        return new_params, new_state, StepInfo(
+            grads_finite=finite,
+            loss_scale=new_scaler.loss_scale,
+            steps_skipped=new_scaler.steps_skipped,
+        )
+
+    # -- checkpointing (ref: apex/amp/frontend.py:428-454) ------------------
+
+    def state_dict(self, state: AmpState) -> dict:
+        """Serialize every loss scaler (ref: apex/amp/frontend.py:428-437
+        loops over ``_amp_state.loss_scalers``)."""
+        d = {"scalers": [_scaler.state_dict(s) for s in state.scalers]}
+        d["scaler"] = d["scalers"][0]  # convenience alias
+        return d
+
+    def load_state_dict(self, state: AmpState, d: dict) -> AmpState:
+        if "scalers" in d:
+            return state._replace(scalers=tuple(
+                _scaler.load_state_dict(sd) for sd in d["scalers"]))
+        return state._replace(
+            scalers=(_scaler.load_state_dict(d["scaler"]),))
+
+
+def _grads_like(grads32: Any, ref_tree: Any) -> Any:
+    """Cast fp32 grads to match the stepped tree's leaf dtypes (inner
+    optimizers expect updates in param dtype)."""
+    return jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.asarray(p).dtype), grads32, ref_tree)
+
+
+def initialize(
+    params: Any,
+    optimizer: optax.GradientTransformation,
+    opt_level: str = "O5",
+    num_losses: int = 1,
+    **overrides,
+) -> Tuple[Any, AmpOptimizer, Any]:
+    """The two-line setup entry, mirroring
+    ``model, opt = amp.initialize(model, opt, opt_level=...)``
+    (ref: apex/amp/frontend.py:258).
+
+    Returns ``(cast_params, amp_optimizer, amp_state)``.  The state holds
+    ``num_losses`` independent scalers (ref: apex/amp/_initialize.py:227-231)
+    over one shared master copy + inner optimizer state; masters are
+    snapshotted from the original ``params`` *before* the low-precision
+    cast, so no precision is lost at initialization.
+    """
+    policy = get_policy(opt_level, **overrides)
+    cast = _cast.cast_params(params, policy)
+    amp_opt = AmpOptimizer(optimizer, policy, num_losses=num_losses)
+    return cast, amp_opt, amp_opt.init(params)

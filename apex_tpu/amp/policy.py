@@ -1,0 +1,139 @@
+"""Precision policies: the O0–O5 opt levels as data.
+
+TPU-native redesign of the reference's opt-level frontend
+(ref: apex/amp/frontend.py:7-246).  The reference encodes a policy as a
+mutable ``Properties`` object plus global monkey-patching; here a policy is
+an immutable dataclass threaded explicitly through the training step.  The
+fork's bf16 levels O4/O5 (ref: apex/amp/frontend.py:207-246) are the
+TPU-preferred defaults: bf16 compute, fp32 master weights (O5), loss scale
+pinned to 1.0.
+
+Level table (ref: apex/amp/frontend.py:118-246):
+
+=====  ===========  ============  ==========  =======  ===========
+level  cast_model   autocast ops  keep_bn32   masters  loss_scale
+=====  ===========  ============  ==========  =======  ===========
+O0     —            —             (fp32)      no       1.0
+O1     —            fp16 lists    yes         no       dynamic
+O2     fp16         —             yes         yes      dynamic
+O3     fp16         —             no          no       1.0
+O4     —            bf16 lists    yes         no       1.0
+O5     bf16         —             yes         yes      1.0
+=====  ===========  ============  ==========  =======  ===========
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax.numpy as jnp
+
+DTypeLike = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Immutable precision policy (the reference's ``Properties``,
+    ref: apex/amp/frontend.py:7-113, as a frozen dataclass)."""
+
+    opt_level: str = "O5"
+    # Dtype model params are stored/computed in (None = leave fp32).
+    # Reference: ``cast_model_type`` (frontend.py:36-46).
+    cast_model_type: Optional[DTypeLike] = None
+    # Op-level autocasting per whitelist/blacklist (the functional
+    # replacement for ``patch_torch_functions``, frontend.py:48-57).
+    cast_ops: bool = False
+    # Dtype used by the op-level autocaster for whitelisted ops
+    # (``patch_type`` fp16-vs-bf16, ref: apex/amp/amp.py:76-107).
+    cast_ops_type: Optional[DTypeLike] = None
+    # Keep batch-norm layers in fp32 while casting the rest
+    # (frontend.py:59-76; applied via convert_network,
+    # apex/amp/_initialize.py:176-182).
+    keep_batchnorm_fp32: Optional[bool] = None
+    # fp32 master copies of low-precision params, held in optimizer state
+    # (ref: apex/amp/_process_optimizer.py:28-91).
+    master_weights: Optional[bool] = None
+    # "dynamic", a float, or None (=1.0).
+    loss_scale: Union[str, float, None] = None
+    # Cast model outputs to this dtype (``cast_model_outputs``,
+    # frontend.py initialize kwarg).
+    cast_model_outputs: Optional[DTypeLike] = None
+
+    def __post_init__(self):
+        # Consistency validation in the spirit of Properties' setters
+        # (ref: apex/amp/frontend.py:59-113).
+        if self.cast_ops and self.cast_model_type is not None:
+            raise ValueError(
+                "cast_ops (O1/O4-style) and cast_model_type (O2/O5-style) "
+                "are mutually exclusive, as in the reference "
+                "(apex/amp/frontend.py:59-67)."
+            )
+        if self.cast_ops and self.cast_ops_type is None:
+            object.__setattr__(self, "cast_ops_type", jnp.bfloat16)
+        if self.master_weights and self.cast_model_type is None:
+            raise ValueError(
+                "master_weights=True requires a low-precision "
+                "cast_model_type."
+            )
+
+    # -- derived views ------------------------------------------------------
+
+    @property
+    def param_dtype(self):
+        return self.cast_model_type or jnp.float32
+
+    @property
+    def compute_dtype(self):
+        if self.cast_model_type is not None:
+            return self.cast_model_type
+        if self.cast_ops:
+            return self.cast_ops_type
+        return jnp.float32
+
+    @property
+    def effective_loss_scale(self) -> Union[str, float]:
+        return self.loss_scale if self.loss_scale is not None else 1.0
+
+    def replace(self, **kw) -> "Policy":
+        return dataclasses.replace(self, **kw)
+
+
+# --- opt-level presets (ref: apex/amp/frontend.py:118-246) ------------------
+
+O0 = Policy(opt_level="O0", keep_batchnorm_fp32=None, master_weights=False,
+            loss_scale=1.0)
+O1 = Policy(opt_level="O1", cast_ops=True, cast_ops_type=jnp.float16,
+            keep_batchnorm_fp32=None, master_weights=False,
+            loss_scale="dynamic")
+O2 = Policy(opt_level="O2", cast_model_type=jnp.float16,
+            keep_batchnorm_fp32=True, master_weights=True,
+            loss_scale="dynamic")
+O3 = Policy(opt_level="O3", cast_model_type=jnp.float16,
+            keep_batchnorm_fp32=False, master_weights=False, loss_scale=1.0)
+# Fork-added bf16 levels (ref: apex/amp/frontend.py:207-246): loss scale
+# pinned to 1.0 — bf16 has fp32's exponent range, no scaling needed.
+O4 = Policy(opt_level="O4", cast_ops=True, cast_ops_type=jnp.bfloat16,
+            keep_batchnorm_fp32=None, master_weights=False, loss_scale=1.0)
+O5 = Policy(opt_level="O5", cast_model_type=jnp.bfloat16,
+            keep_batchnorm_fp32=True, master_weights=True, loss_scale=1.0)
+
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3, "O4": O4, "O5": O5}
+
+
+def get_policy(opt_level: Union[str, Policy] = "O5", **overrides) -> Policy:
+    """Look up a preset and apply user overrides, the
+    ``amp.initialize(opt_level=..., **kwargs)`` entry semantics
+    (ref: apex/amp/frontend.py:258-420)."""
+    if isinstance(opt_level, Policy):
+        policy = opt_level
+    else:
+        try:
+            policy = opt_levels[opt_level]
+        except KeyError:
+            raise ValueError(
+                f"Unexpected opt_level {opt_level!r}; expected one of "
+                f"{sorted(opt_levels)} (ref: apex/amp/frontend.py:346-351)"
+            ) from None
+    if overrides:
+        policy = policy.replace(**overrides)
+    return policy

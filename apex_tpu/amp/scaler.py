@@ -1,0 +1,160 @@
+"""Functional loss scaling.
+
+TPU-native redesign of the reference's ``LossScaler``
+(ref: apex/amp/scaler.py:42-226).  The reference keeps mutable Python state
+and performs one device->host sync per iteration to learn whether gradients
+overflowed (ref: apex/amp/scaler.py:206-224, ``update_scale``'s
+``.item()``).  Here the scaler is a pytree (``ScalerState``) updated inside
+the jitted train step; overflow handling is a ``lax.cond`` over the whole
+optimizer update, so a step never leaves the device — zero host syncs.
+
+Dynamic-scaling schedule matches the reference: on overflow multiply the
+scale by ``backoff_factor`` (0.5) and reset the growth counter; after
+``growth_interval`` (2000) consecutive finite steps multiply by
+``growth_factor`` (2.0) (ref: apex/amp/scaler.py:206-224, "DYNAMIC_SCALE_*"
+constants at apex/amp/_amp_state.py).  Static scaling is the same state with
+growth disabled.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+DYNAMIC_INIT_SCALE = 2.0 ** 16  # ref: apex/amp/scaler.py:49
+GROWTH_FACTOR = 2.0
+BACKOFF_FACTOR = 0.5
+GROWTH_INTERVAL = 2000  # ref: apex/amp/scaler.py:219
+
+
+class ScalerState(NamedTuple):
+    """Loss-scaler state carried through the jitted step (a pytree)."""
+
+    loss_scale: jnp.ndarray          # f32 scalar
+    growth_tracker: jnp.ndarray      # i32 scalar: consecutive finite steps
+    steps_skipped: jnp.ndarray       # i32 scalar: total overflow skips
+    # Static (non-traced) configuration:
+    dynamic: bool = True
+    min_loss_scale: float = 1.0
+    max_loss_scale: float = 2.0 ** 24  # ref: apex/amp/frontend.py Properties
+    growth_interval: int = GROWTH_INTERVAL
+
+
+# Static config fields must not be treated as pytree leaves.
+jax.tree_util.register_pytree_node(
+    ScalerState,
+    lambda s: (
+        (s.loss_scale, s.growth_tracker, s.steps_skipped),
+        (s.dynamic, s.min_loss_scale, s.max_loss_scale, s.growth_interval),
+    ),
+    lambda aux, leaves: ScalerState(*leaves, *aux),
+)
+
+
+def init(loss_scale: Union[str, float, int, None] = "dynamic",
+         min_loss_scale: float = 1.0,
+         max_loss_scale: float = 2.0 ** 24) -> ScalerState:
+    """Create scaler state.
+
+    ``loss_scale`` follows the reference's convention
+    (ref: apex/amp/frontend.py:118-246): ``"dynamic"`` for dynamic scaling,
+    a number for static scaling, ``None`` for 1.0 (the bf16 O4/O5 regime,
+    ref: apex/amp/frontend.py:213,223,245 pins loss_scale=1).
+    """
+    dynamic = loss_scale == "dynamic"
+    scale = DYNAMIC_INIT_SCALE if dynamic else float(loss_scale or 1.0)
+    return ScalerState(
+        loss_scale=jnp.float32(scale),
+        growth_tracker=jnp.int32(0),
+        steps_skipped=jnp.int32(0),
+        dynamic=dynamic,
+        min_loss_scale=float(min_loss_scale),
+        max_loss_scale=float(max_loss_scale),
+    )
+
+
+def scale_loss(loss: jnp.ndarray, state: ScalerState) -> jnp.ndarray:
+    """``loss.float() * loss_scale`` (ref: apex/amp/handle.py:113)."""
+    return loss.astype(jnp.float32) * state.loss_scale
+
+
+def all_finite(tree: Any) -> jnp.ndarray:
+    """Single fused finite-check over a gradient pytree.
+
+    Replaces the overflow flag threaded through
+    ``amp_C.multi_tensor_scale`` (ref: apex/amp/scaler.py:103-159); XLA
+    fuses the per-leaf reductions.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.stack(
+        [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    ).all()
+
+
+def unscale(tree: Any, state: ScalerState, out_dtype=jnp.float32) -> Any:
+    """Multiply grads by 1/scale, casting to ``out_dtype`` (fp32 by default,
+    matching master-grad materialization, ref: apex/amp/scaler.py:161-193)."""
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) * inv if out_dtype == jnp.float32
+        else (g.astype(jnp.float32) * inv).astype(out_dtype),
+        tree,
+    )
+
+
+def update(state: ScalerState, grads_finite: jnp.ndarray) -> ScalerState:
+    """Advance scaler state given this step's finite flag.
+
+    Pure function of (state, flag); the caller pairs it with a ``lax.cond``
+    (or ``jnp.where`` on the update) that skips the optimizer step when
+    ``grads_finite`` is False — the monkey-patched-``optimizer.step`` skip
+    of the reference (ref: apex/amp/handle.py:128-154) expressed
+    functionally.
+    """
+    if not state.dynamic:
+        return state._replace(
+            steps_skipped=state.steps_skipped + jnp.where(grads_finite, 0, 1)
+        )
+    tracker = jnp.where(grads_finite, state.growth_tracker + 1, 0)
+    grow = tracker >= state.growth_interval
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(grow, state.loss_scale * GROWTH_FACTOR, state.loss_scale),
+        state.loss_scale * BACKOFF_FACTOR,
+    )
+    new_scale = jnp.clip(new_scale, state.min_loss_scale,
+                         state.max_loss_scale)
+    return state._replace(
+        loss_scale=new_scale,
+        growth_tracker=jnp.where(grow, 0, tracker),
+        steps_skipped=state.steps_skipped + jnp.where(grads_finite, 0, 1),
+    )
+
+
+def state_dict(state: ScalerState) -> dict:
+    """Serializable view (ref: amp.state_dict, apex/amp/frontend.py:428-437)."""
+    return {
+        "loss_scale": float(state.loss_scale),
+        "growth_tracker": int(state.growth_tracker),
+        "steps_skipped": int(state.steps_skipped),
+        "dynamic": state.dynamic,
+        "min_loss_scale": state.min_loss_scale,
+        "max_loss_scale": state.max_loss_scale,
+        "growth_interval": state.growth_interval,
+    }
+
+
+def load_state_dict(d: dict) -> ScalerState:
+    """Inverse of :func:`state_dict` (ref: apex/amp/frontend.py:440+)."""
+    return ScalerState(
+        loss_scale=jnp.float32(d["loss_scale"]),
+        growth_tracker=jnp.int32(d["growth_tracker"]),
+        steps_skipped=jnp.int32(d.get("steps_skipped", 0)),
+        dynamic=bool(d["dynamic"]),
+        min_loss_scale=float(d.get("min_loss_scale", 1.0)),
+        max_loss_scale=float(d.get("max_loss_scale", 2.0 ** 24)),
+        growth_interval=int(d.get("growth_interval", GROWTH_INTERVAL)),
+    )

@@ -1,0 +1,1 @@
+"""Placeholder — populated by the build plan (SURVEY.md §7)."""

@@ -1,0 +1,216 @@
+"""Model/data-parallel topology registry over a ``jax.sharding.Mesh``.
+
+TPU-native replacement for the reference's process-group registry
+(ref: apex/transformer/parallel_state.py:58-230).  Where the reference
+factorizes world ranks into NCCL process groups (TP x PP x DP), here the
+factorization is a named device mesh; XLA emits the collectives.  Rank
+layout follows the reference's ordering contract
+(ref: apex/transformer/parallel_state.py:68-83): tensor-parallel ranks are
+adjacent devices (innermost mesh axis -> nearest ICI neighbours), data
+parallel next, pipeline outermost (the axis that can tolerate DCN hops).
+
+Usage::
+
+    parallel_state.initialize_model_parallel(tensor_model_parallel_size=2,
+                                             pipeline_model_parallel_size=2)
+    mesh = parallel_state.get_mesh()
+    with mesh:
+        ...  # pjit / shard_map code using axis names 'data', 'pipe', 'tensor'
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical mesh-axis names.  Everything in apex_tpu refers to these.
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+TENSOR_AXIS = "tensor"
+# Device-order convention: ('pipe', 'data', 'tensor') — tensor innermost.
+MESH_AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, TENSOR_AXIS)
+
+
+@dataclasses.dataclass
+class _ParallelState:
+    mesh: Optional[Mesh] = None
+    tensor_model_parallel_size: int = 1
+    pipeline_model_parallel_size: int = 1
+    data_parallel_size: int = 1
+    virtual_pipeline_model_parallel_size: Optional[int] = None
+    virtual_pipeline_model_parallel_rank: Optional[int] = None
+
+
+_STATE = _ParallelState()
+
+
+class ParallelStateError(RuntimeError):
+    pass
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build and register the global mesh.
+
+    Mirrors ``initialize_model_parallel``
+    (ref: apex/transformer/parallel_state.py:58-167) with devices instead of
+    ranks: world_size must be divisible by tp*pp; the remainder is the data
+    parallel size.
+    """
+    if devices is None:
+        devices = jax.devices()
+    world_size = len(devices)
+    tp = int(tensor_model_parallel_size)
+    pp = int(pipeline_model_parallel_size)
+    if tp < 1 or pp < 1:
+        raise ParallelStateError(
+            f"parallel sizes must be >=1, got tp={tp} pp={pp}"
+        )
+    if world_size % (tp * pp) != 0:
+        raise ParallelStateError(
+            f"world size ({world_size}) is not divisible by "
+            f"tensor_model_parallel_size ({tp}) x "
+            f"pipeline_model_parallel_size ({pp})"
+        )
+    dp = world_size // (tp * pp)
+    if virtual_pipeline_model_parallel_size is not None and pp <= 2:
+        # Same constraint as the reference: interleaving needs >2 stages.
+        # (ref: apex/transformer/parallel_state.py:101-108)
+        raise ParallelStateError(
+            "virtual (interleaved) pipeline requires "
+            "pipeline_model_parallel_size > 2"
+        )
+
+    device_grid = np.asarray(devices, dtype=object).reshape(pp, dp, tp)
+    mesh = Mesh(device_grid, MESH_AXIS_ORDER)
+
+    _STATE.mesh = mesh
+    _STATE.tensor_model_parallel_size = tp
+    _STATE.pipeline_model_parallel_size = pp
+    _STATE.data_parallel_size = dp
+    _STATE.virtual_pipeline_model_parallel_size = (
+        virtual_pipeline_model_parallel_size
+    )
+    _STATE.virtual_pipeline_model_parallel_rank = (
+        0 if virtual_pipeline_model_parallel_size is not None else None
+    )
+    return mesh
+
+
+def model_parallel_is_initialized() -> bool:
+    return _STATE.mesh is not None
+
+
+def get_mesh() -> Mesh:
+    if _STATE.mesh is None:
+        raise ParallelStateError(
+            "parallel state is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _STATE.mesh
+
+
+def destroy_model_parallel() -> None:
+    """Drop the registered mesh (ref: parallel_state.py destroy at bottom)."""
+    global _STATE
+    _STATE = _ParallelState()
+
+
+# --- world sizes (static; usable outside traced code) ----------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _STATE.tensor_model_parallel_size if _STATE.mesh is not None else 1
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _STATE.pipeline_model_parallel_size if _STATE.mesh is not None else 1
+
+
+def get_data_parallel_world_size() -> int:
+    return _STATE.data_parallel_size if _STATE.mesh is not None else 1
+
+
+def get_world_size() -> int:
+    return (
+        get_tensor_model_parallel_world_size()
+        * get_pipeline_model_parallel_world_size()
+        * get_data_parallel_world_size()
+    )
+
+
+# --- ranks (traced; only valid inside shard_map/pjit over the mesh) --------
+
+def get_tensor_model_parallel_rank():
+    """Traced TP rank of the current shard (inside shard_map only)."""
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (ref: parallel_state.py:188-205 semantics).
+
+    NOTE: the virtual-pipeline component is read from Python state at
+    *trace* time — call this only where a changed virtual rank forces a
+    retrace (the pipeline schedules pass chunk indices explicitly instead
+    of relying on this inside one compiled step)."""
+    if not ignore_virtual and _STATE.virtual_pipeline_model_parallel_size:
+        if get_virtual_pipeline_model_parallel_rank() != 0:
+            return False
+    return jax.lax.axis_index(PIPE_AXIS) == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _STATE.virtual_pipeline_model_parallel_size:
+        vpp = _STATE.virtual_pipeline_model_parallel_size
+        if get_virtual_pipeline_model_parallel_rank() != vpp - 1:
+            return False
+    return (
+        jax.lax.axis_index(PIPE_AXIS)
+        == get_pipeline_model_parallel_world_size() - 1
+    )
+
+
+# --- virtual (interleaved) pipeline bookkeeping ----------------------------
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _STATE.virtual_pipeline_model_parallel_size
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _STATE.virtual_pipeline_model_parallel_rank
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    _STATE.virtual_pipeline_model_parallel_rank = rank
+
+
+# --- logging / observability ----------------------------------------------
+
+def get_rank_info() -> str:
+    """Topology summary for log records (ref: parallel_state.py:169-179).
+
+    JAX is single-controller: there is no per-process TP/PP/DP rank to stamp;
+    instead we stamp the topology and the process index (multi-host)."""
+    if _STATE.mesh is None:
+        return "uninitialized"
+    return (
+        f"proc={jax.process_index()} "
+        f"tp={_STATE.tensor_model_parallel_size} "
+        f"pp={_STATE.pipeline_model_parallel_size} "
+        f"dp={_STATE.data_parallel_size}"
+    )

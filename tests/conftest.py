@@ -1,0 +1,35 @@
+"""Test substrate: run every "distributed" test on a virtual 8-device CPU mesh.
+
+The reference's distributed tests require >=2 physical GPUs + NCCL
+(ref: tests/distributed/*, tests/L0/run_transformer/*); here every DP/TP/PP
+test is a host-only unit test via XLA's host-platform device-count override.
+This must run before jax is imported anywhere in the test process.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# jax may already have been imported at interpreter startup (site hooks
+# registering accelerator plugins capture JAX_PLATFORMS then) — override
+# through the config API as well so tests always get the 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_parallel_state():
+    """Reset the global mesh registry between tests (mirrors the reference's
+    destroy_model_parallel teardown in tests/L0/run_transformer)."""
+    yield
+    from apex_tpu import parallel_state
+
+    parallel_state.destroy_model_parallel()

@@ -1,0 +1,204 @@
+"""Precision-core tests.
+
+Models the reference's L0 run_amp suite: opt-level property table
+(ref: tests/L0/run_amp/test_basic_casts.py), dynamic-scaler schedule
+(ref: apex/amp/scaler.py:206-224 semantics), master-weight consistency
+(ref: tests/distributed/amp_master_params), checkpoint round-trip
+(ref: tests/L0/run_amp/test_checkpointing.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+# --- policy table -----------------------------------------------------------
+
+def test_opt_level_table():
+    assert amp.O0.cast_model_type is None and amp.O0.loss_scale == 1.0
+    assert amp.O1.cast_ops and amp.O1.cast_ops_type == jnp.float16
+    assert amp.O1.loss_scale == "dynamic"
+    assert amp.O2.cast_model_type == jnp.float16 and amp.O2.master_weights
+    assert amp.O2.keep_batchnorm_fp32 is True
+    assert amp.O3.cast_model_type == jnp.float16
+    assert not amp.O3.master_weights and amp.O3.loss_scale == 1.0
+    # Fork's bf16 levels pin loss_scale to 1 (ref: apex/amp/frontend.py:213,223,245)
+    assert amp.O4.cast_ops_type == jnp.bfloat16 and amp.O4.loss_scale == 1.0
+    assert amp.O5.cast_model_type == jnp.bfloat16 and amp.O5.master_weights
+    assert amp.O5.loss_scale == 1.0
+
+
+def test_policy_overrides_and_validation():
+    p = amp.get_policy("O2", loss_scale=128.0)
+    assert p.loss_scale == 128.0
+    with pytest.raises(ValueError):
+        amp.get_policy("O7")
+    with pytest.raises(ValueError):
+        amp.Policy(cast_ops=True, cast_model_type=jnp.bfloat16)
+
+
+def test_convert_network_keeps_bn_fp32():
+    params = {
+        "Dense_0": {"kernel": jnp.ones((4, 4), jnp.float32)},
+        "BatchNorm_0": {"scale": jnp.ones((4,), jnp.float32)},
+        "step": jnp.int32(3),
+    }
+    cast = amp.convert_network(params, jnp.bfloat16, keep_batchnorm_fp32=True)
+    assert cast["Dense_0"]["kernel"].dtype == jnp.bfloat16
+    assert cast["BatchNorm_0"]["scale"].dtype == jnp.float32
+    assert cast["step"].dtype == jnp.int32  # non-float untouched
+
+
+# --- scaler dynamics --------------------------------------------------------
+
+def test_dynamic_scaler_backoff_and_growth():
+    s = amp.scaler.init("dynamic", min_loss_scale=1.0)
+    assert float(s.loss_scale) == 2.0 ** 16
+    # overflow halves and resets tracker
+    s1 = amp.scaler.update(s, jnp.bool_(False))
+    assert float(s1.loss_scale) == 2.0 ** 15
+    assert int(s1.growth_tracker) == 0
+    assert int(s1.steps_skipped) == 1
+    # growth_interval consecutive finite steps double the scale
+    s2 = s1._replace(growth_interval=3)
+    for _ in range(3):
+        s2 = amp.scaler.update(s2, jnp.bool_(True))
+    assert float(s2.loss_scale) == 2.0 ** 16
+    assert int(s2.growth_tracker) == 0
+
+
+def test_static_scaler_never_moves():
+    s = amp.scaler.init(128.0)
+    s = amp.scaler.update(s, jnp.bool_(False))
+    s = amp.scaler.update(s, jnp.bool_(True))
+    assert float(s.loss_scale) == 128.0
+    assert int(s.steps_skipped) == 1
+
+
+def test_scaler_checkpoint_roundtrip():
+    s = amp.scaler.init("dynamic")
+    s = amp.scaler.update(s, jnp.bool_(False))
+    d = amp.scaler.state_dict(s)
+    s2 = amp.scaler.load_state_dict(d)
+    assert float(s2.loss_scale) == float(s.loss_scale)
+    assert s2.dynamic == s.dynamic
+
+
+def test_all_finite():
+    good = {"a": jnp.ones(3), "b": jnp.zeros((2, 2))}
+    bad = {"a": jnp.ones(3), "b": jnp.array([1.0, jnp.inf])}
+    nan = {"a": jnp.array([jnp.nan])}
+    assert bool(amp.all_finite(good))
+    assert not bool(amp.all_finite(bad))
+    assert not bool(amp.all_finite(nan))
+
+
+# --- end-to-end mixed-precision step ---------------------------------------
+
+def _toy_params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "w": jax.random.normal(k, (8, 8), jnp.float32),
+        "b": jnp.zeros((8,), jnp.float32),
+    }
+
+
+def _loss_fn(params, x):
+    y = x @ params["w"] + params["b"]
+    return jnp.mean(y.astype(jnp.float32) ** 2)
+
+
+def test_o5_master_weights_step():
+    params = _toy_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    cast, opt, state = amp.initialize(params, optax.sgd(0.1), opt_level="O5",
+                                      keep_batchnorm_fp32=False)
+    assert cast["w"].dtype == jnp.bfloat16
+    assert state.master_params["w"].dtype == jnp.float32
+
+    @jax.jit
+    def step(p, s, x):
+        def scaled_loss(p_):
+            return opt.scale_loss(_loss_fn(p_, x.astype(p_["w"].dtype)), s)
+        grads = jax.grad(scaled_loss)(p)
+        return opt.apply_gradients(grads, s, p)
+
+    new_params, new_state, info = step(cast, state, x)
+    assert bool(info.grads_finite)
+    assert new_params["w"].dtype == jnp.bfloat16
+    # master moved in fp32 and model params track the cast master
+    assert not np.allclose(np.asarray(new_state.master_params["w"]),
+                           np.asarray(state.master_params["w"]))
+    np.testing.assert_array_equal(
+        np.asarray(new_params["w"]),
+        np.asarray(new_state.master_params["w"].astype(jnp.bfloat16)))
+
+
+def test_overflow_skips_step_and_backs_off():
+    params = _toy_params()
+    opt = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O2"))
+    state = opt.init(params)
+    inf_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.inf), params)
+    new_params, new_state, info = jax.jit(opt.apply_gradients)(
+        inf_grads, state, params)
+    assert not bool(info.grads_finite)
+    # skipped: params and masters unchanged
+    np.testing.assert_array_equal(np.asarray(new_params["w"]),
+                                  np.asarray(params["w"]))
+    assert float(new_state.scaler.loss_scale) == 2.0 ** 15
+    assert int(info.steps_skipped) == 1
+
+
+def test_o0_passthrough_matches_plain_optax():
+    params = _toy_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    cast, opt, state = amp.initialize(params, optax.sgd(0.1), opt_level="O0")
+    assert cast["w"].dtype == jnp.float32
+
+    grads = jax.grad(_loss_fn)(params, x)
+    new_params, _, _ = opt.apply_gradients(grads, state, cast)
+
+    tx = optax.sgd(0.1)
+    updates, _ = tx.update(grads, tx.init(params), params)
+    expected = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(new_params["w"]),
+                               np.asarray(expected["w"]), rtol=1e-6)
+
+
+def test_multi_loss_scalers_share_masters():
+    # num_losses>1 yields per-loss scalers over ONE shared master copy
+    # (ref: apex/amp/_initialize.py:227-231; one optimizer, many scalers).
+    params = _toy_params()
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    cast, opt, state = amp.initialize(params, optax.sgd(0.1),
+                                      opt_level="O2", num_losses=2)
+    assert len(state.scalers) == 2
+
+    grads = jax.grad(_loss_fn)(params, x)
+    scaled0 = jax.tree_util.tree_map(
+        lambda g: g * state.scalers[0].loss_scale, grads)
+    p1, s1, _ = opt.apply_gradients(scaled0, state, cast, loss_id=0)
+    # Overflow on loss 1: only scaler 1 backs off; masters keep loss-0 step.
+    inf_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.inf), cast)
+    p2, s2, info = opt.apply_gradients(inf_grads, s1, p1, loss_id=1)
+    assert not bool(info.grads_finite)
+    assert float(s2.scalers[1].loss_scale) == 2.0 ** 15
+    assert float(s2.scalers[0].loss_scale) == 2.0 ** 16
+    np.testing.assert_array_equal(np.asarray(s2.master_params["w"]),
+                                  np.asarray(s1.master_params["w"]))
+
+
+def test_masters_snapshot_before_cast():
+    # Masters must come from the original fp32 params, not the bf16 cast —
+    # otherwise fine-tuning quantizes every weight at step 0.
+    params = {"w": jnp.full((4,), 1.0 + 1e-4, jnp.float32)}
+    cast, opt, state = amp.initialize(params, optax.sgd(0.1), opt_level="O5")
+    np.testing.assert_array_equal(np.asarray(state.master_params["w"]),
+                                  np.asarray(params["w"]))
+    assert np.any(np.asarray(cast["w"].astype(jnp.float32))
+                  != np.asarray(params["w"]))

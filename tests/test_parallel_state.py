@@ -1,0 +1,70 @@
+"""Mesh-registry tests (ref test: tests/L0/run_transformer/run_initialize_test
+exercises initialize_model_parallel rank math on real GPUs; here it's a
+host-only unit test over the 8-device CPU mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state as ps
+
+
+def test_initialize_factorization():
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2,
+                                        pipeline_model_parallel_size=2)
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert ps.get_world_size() == 8
+    assert mesh.axis_names == ("pipe", "data", "tensor")
+
+
+def test_indivisible_world_raises():
+    with pytest.raises(ps.ParallelStateError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_virtual_pp_requires_deep_pipeline():
+    with pytest.raises(ps.ParallelStateError):
+        ps.initialize_model_parallel(pipeline_model_parallel_size=2,
+                                     virtual_pipeline_model_parallel_size=2)
+
+
+def test_tensor_ranks_are_adjacent_devices():
+    # TP ranks must be ICI neighbours: innermost mesh axis => consecutive
+    # device ids (the analogue of the reference's contiguous TP groups,
+    # ref: apex/transformer/parallel_state.py:68-83).
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids.shape == (1, 2, 4)
+    assert list(ids[0, 0]) == [0, 1, 2, 3]
+    assert list(ids[0, 1]) == [4, 5, 6, 7]
+
+
+def test_traced_ranks_inside_shard_map():
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2,
+                                        pipeline_model_parallel_size=2)
+
+    def body():
+        return (ps.get_tensor_model_parallel_rank()[None],
+                ps.get_pipeline_model_parallel_rank()[None],
+                ps.get_data_parallel_rank()[None])
+
+    tp, pp, dp = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(),
+        out_specs=(P(("pipe", "data", "tensor")),) * 3))()
+    # Flattened over 8 shards in (pipe, data, tensor) order.
+    assert list(np.ravel(tp)) == [0, 1, 0, 1, 0, 1, 0, 1]
+    assert list(np.ravel(pp)) == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert list(np.ravel(dp)) == [0, 0, 1, 1, 0, 0, 1, 1]
+
+
+def test_destroy():
+    ps.initialize_model_parallel()
+    ps.destroy_model_parallel()
+    assert not ps.model_parallel_is_initialized()
+    with pytest.raises(ps.ParallelStateError):
+        ps.get_mesh()
