@@ -6,7 +6,14 @@ handle), redesigned functionally: policies are data, the scaler is a
 pytree, overflow-skip is a ``lax.cond``, and master weights live in
 optimizer state.  See SURVEY.md §2.1/§7.
 """
-from . import scaler
+from . import lists, scaler
+from .autocast import (
+    autocast,
+    bfloat16_function,
+    float_function,
+    half_function,
+    promote_function,
+)
 from .cast import (
     cast_inputs,
     cast_outputs,
@@ -21,6 +28,8 @@ from .policy import O0, O1, O2, O3, O4, O5, Policy, get_policy, opt_levels
 from .scaler import ScalerState, all_finite, scale_loss, unscale
 
 __all__ = [
+    "autocast", "half_function", "bfloat16_function", "float_function",
+    "promote_function", "lists",
     "AmpOptimizer", "AmpState", "StepInfo", "initialize",
     "Policy", "get_policy", "opt_levels",
     "O0", "O1", "O2", "O3", "O4", "O5",

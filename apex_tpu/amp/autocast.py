@@ -1,0 +1,194 @@
+"""Op-level autocasting: the O1/O4 opt levels as a jaxpr interpreter.
+
+The reference implements O1/O4 by monkey-patching the torch/Tensor/F
+namespaces with casting closures chosen from whitelist/blacklist tables
+(ref: apex/amp/amp.py:76-150, apex/amp/wrap.py:10-116).  JAX has no
+mutable op namespace worth patching — instead, :func:`autocast` is a
+*function transform*: it traces the wrapped function to a jaxpr, then
+re-evaluates it primitive-by-primitive, casting inputs per the lists in
+:mod:`apex_tpu.amp.lists`:
+
+- matmul/conv primitives run in the compute dtype (fp16 for O1, bf16 for
+  O4) — the MXU path;
+- numerically-sensitive primitives (exp/log/rsqrt/large reductions) run
+  in fp32;
+- everything else runs in its input dtypes, with widest-type promotion
+  for mixed binary operands (ref: apex/amp/wrap.py:66-116 ``promote``).
+
+Because evaluation re-binds primitives on the caller's tracers, the
+transform composes with ``jax.grad``/``jax.jit``/``vmap``: casts become
+part of the traced graph and XLA CSE's repeated casts of the same weight
+(subsuming the reference's weight cast cache, apex/amp/wrap.py:31-64).
+
+Deliberate deviation: bodies of ``custom_jvp``/``custom_vjp`` functions
+and ``scan``/``while``/``cond`` control flow are executed unmodified
+(casting inside them could break user gradient rules or carry dtype
+contracts); ``jit``-nested regions are recursed into.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+from . import lists
+from .policy import Policy
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast(x, dtype):
+    if _is_float(x) and x.dtype != dtype:
+        return jax.lax.convert_element_type(x, dtype)
+    return x
+
+
+def _widest(vals):
+    dtypes = [v.dtype for v in vals if _is_float(v)]
+    if not dtypes:
+        return None
+    return functools.reduce(jnp.promote_types, dtypes)
+
+
+def _safe_map(f, *xs):
+    for t in zip(*xs, strict=True):
+        f(*t)
+
+
+def _eval_autocast(jaxpr: jcore.Jaxpr, consts, args, compute_dtype):
+    env = {}
+
+    def read(v):
+        return v.val if isinstance(v, jcore.Literal) else env[v]
+
+    def write(v, val):
+        env[v] = val
+
+    _safe_map(write, jaxpr.constvars, consts)
+    _safe_map(write, jaxpr.invars, args)
+
+    for eqn in jaxpr.eqns:
+        invals = [read(v) for v in eqn.invars]
+        prim = eqn.primitive
+        name = prim.name
+
+        if name in lists.RECURSE_PRIMS and "jaxpr" in eqn.params:
+            inner = eqn.params["jaxpr"]
+            inner_jaxpr = getattr(inner, "jaxpr", inner)
+            inner_consts = getattr(inner, "consts", [])
+            outvals = _eval_autocast(
+                inner_jaxpr, inner_consts, invals, compute_dtype)
+        else:
+            if name in lists.LOW_PRECISION_PRIMS:
+                invals = [_cast(x, compute_dtype) for x in invals]
+                params = dict(eqn.params)
+                # A dot/conv traced from fp32 inputs carries
+                # preferred_element_type=fp32; keep it — fp32 accumulation
+                # over low-precision operands is exactly the MXU regime.
+            elif name in lists.FP32_PRIMS:
+                invals = [_cast(x, jnp.float32) for x in invals]
+            else:
+                wide = _widest(invals)
+                if wide is not None and any(
+                        _is_float(x) and x.dtype != wide for x in invals):
+                    invals = [_cast(x, wide) for x in invals]
+            subfuns, bind_params = prim.get_bind_params(eqn.params)
+            outvals = prim.bind(*subfuns, *invals, **bind_params)
+
+        if not prim.multiple_results:
+            outvals = [outvals]
+        _safe_map(write, eqn.outvars, outvals)
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def autocast(fn: Optional[Callable] = None, *,
+             compute_dtype: Any = jnp.bfloat16,
+             policy: Optional[Policy] = None) -> Callable:
+    """Wrap ``fn`` so its primitives execute under the O1/O4 cast lists.
+
+    Usage (O4 is the default; pass ``compute_dtype=jnp.float16`` or an O1
+    policy for the fp16 variant)::
+
+        @amp.autocast
+        def forward(params, x): ...
+
+        grads = jax.grad(amp.autocast(loss_fn, policy=amp.O1))(params, x)
+    """
+    if fn is None:
+        return functools.partial(
+            autocast, compute_dtype=compute_dtype, policy=policy)
+    if policy is not None:
+        if not policy.cast_ops:
+            # O0/O2-style policy: op-level casting disabled — identity.
+            return fn
+        compute_dtype = policy.cast_ops_type
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+        out_tree_box = []
+
+        def flat_fn(*fargs):
+            a, k = jax.tree_util.tree_unflatten(in_tree, fargs)
+            out = fn(*a, **k)
+            flat_out, out_tree = jax.tree_util.tree_flatten(out)
+            out_tree_box.append(out_tree)
+            return flat_out
+
+        closed = jax.make_jaxpr(flat_fn)(*flat_args)
+        out_flat = _eval_autocast(
+            closed.jaxpr, closed.consts, flat_args, compute_dtype)
+        return jax.tree_util.tree_unflatten(out_tree_box[0], out_flat)
+
+    return wrapped
+
+
+# --- explicit function registration (ref: apex/amp/amp.py:29-71) -----------
+
+def half_function(fn: Callable) -> Callable:
+    """Force-cast a function's float args to fp16
+    (ref: apex/amp/amp.py ``half_function`` :29)."""
+    return _casting_wrapper(fn, jnp.float16)
+
+
+def bfloat16_function(fn: Callable) -> Callable:
+    """Force-cast a function's float args to bf16 (fork's
+    ``bfloat16_function``, ref: apex/amp/amp.py:33-38)."""
+    return _casting_wrapper(fn, jnp.bfloat16)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Force-cast a function's float args to fp32
+    (ref: apex/amp/amp.py ``float_function`` :41)."""
+    return _casting_wrapper(fn, jnp.float32)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Promote mixed float args to the widest input dtype
+    (ref: apex/amp/wrap.py ``promote`` :66)."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        leaves = [x for x in jax.tree_util.tree_leaves((args, kwargs))
+                  if _is_float(x)]
+        wide = _widest(leaves)
+        if wide is not None:
+            args, kwargs = jax.tree_util.tree_map(
+                lambda x: _cast(x, wide) if _is_float(x) else x,
+                (args, kwargs))
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+def _casting_wrapper(fn, dtype):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args, kwargs = jax.tree_util.tree_map(
+            lambda x: _cast(x, dtype) if _is_float(x) else x, (args, kwargs))
+        return fn(*args, **kwargs)
+    return wrapped

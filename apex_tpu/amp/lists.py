@@ -1,0 +1,64 @@
+"""Cast lists at JAX-primitive granularity.
+
+Functional analogue of the reference's whitelist/blacklist tables
+(ref: apex/amp/lists/torch_overrides.py:7-133,
+functional_overrides.py:18-81, tensor_overrides.py:14-56).  The reference
+classifies *torch functions*; the autocast interpreter classifies *XLA
+primitives*, which is both finer-grained and exhaustive (an op reaches the
+accelerator only through a primitive, so nothing escapes the lists the way
+an unpatched namespace alias could escape the reference's monkey-patching).
+
+- LOW_PRECISION ("whitelist", ref FP16_FUNCS/BFLOAT16_FUNCS): MXU ops —
+  matmuls and convolutions run in the compute dtype.
+- FP32 ("blacklist", ref FP32_FUNCS): numerically-sensitive transcendental
+  and reduction ops run in fp32.
+- Everything else: run in input dtypes, promoting mixed binary operands to
+  the widest type (ref CASTS promote semantics, apex/amp/wrap.py:66-116).
+"""
+
+# MXU ops -> compute dtype (ref: torch_overrides.py FP16_FUNCS :7-27 /
+# BFLOAT16_FUNCS :29-48 list mm/matmul/conv*/addmm/...; all of those lower
+# to these two primitives).
+LOW_PRECISION_PRIMS = frozenset({
+    "dot_general",
+    "conv_general_dilated",
+    "ragged_dot_general",
+})
+
+# Numerically-sensitive ops -> fp32 (ref: torch_overrides.py FP32_FUNCS
+# :50-105 — acos, asin, cosh, erfinv, exp, expm1, log, log10, log1p, log2,
+# reciprocal, rsqrt, sinh, tan, pow, softmax/log_softmax decompose into
+# exp/log/div below; norms/sums decompose into reduce_sum).
+FP32_PRIMS = frozenset({
+    "exp", "exp2", "expm1",
+    "log", "log1p",
+    "pow", "integer_pow",
+    "rsqrt", "sqrt",
+    "sinh", "cosh", "tanh", "tan",
+    "asin", "acos", "atan", "atan2",
+    "asinh", "acosh", "atanh",
+    "erf", "erfc", "erf_inv",
+    "lgamma", "digamma",
+    "logistic",
+    "cumsum", "cumlogsumexp", "cumprod",
+    "reduce_sum", "reduce_prod",
+    "div",
+})
+
+# Ops whose mixed-dtype operands promote to the widest floating type
+# (ref: CASTS table, torch_overrides.py:107-131).  The interpreter applies
+# widest-type promotion to *any* primitive with mixed float inputs; this
+# set is documentation of the reference's explicit list.
+PROMOTE_PRIMS = frozenset({
+    "add", "sub", "mul", "max", "min", "rem",
+    "atan2", "nextafter", "select_n", "concatenate",
+})
+
+# Call-like / control-flow primitives the interpreter recurses into or
+# leaves untouched (custom-autodiff bodies must keep their rules).
+RECURSE_PRIMS = frozenset({"jit", "pjit", "closed_call", "core_call",
+                           "remat", "remat2", "checkpoint"})
+OPAQUE_PRIMS = frozenset({
+    "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+    "scan", "while", "cond", "custom_root", "custom_linear_solve",
+})

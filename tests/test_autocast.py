@@ -1,0 +1,162 @@
+"""Dtype-propagation table tests for the O1/O4 autocast interpreter.
+
+Models the reference's run_layer_test idiom: assert output dtype per
+(function x input dtype) against ALWAYS_HALF / ALWAYS_BFLOAT16 /
+ALWAYS_FLOAT / MATCH_INPUT expectation tables
+(ref: tests/L0/run_amp/utils.py:8-19, test_basic_casts.py:16-24).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.autocast import autocast
+
+
+def run(fn, *args, dtype=jnp.bfloat16):
+    return autocast(fn, compute_dtype=dtype)(*args)
+
+
+# --- ALWAYS_<compute dtype>: matmul/conv whitelist --------------------------
+
+@pytest.mark.parametrize("in_dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("compute", [jnp.bfloat16, jnp.float16])
+def test_matmul_runs_low_precision(in_dtype, compute):
+    x = jnp.ones((8, 8), in_dtype)
+    out = run(lambda a, b: a @ b, x, x, dtype=compute)
+    # fp32 inputs trace with preferred_element_type=f32 -> accumulate fp32;
+    # the operands are still cast (verified via jaxpr below).
+    jaxpr = jax.make_jaxpr(autocast(lambda a, b: a @ b,
+                                    compute_dtype=compute))(x, x)
+    s = str(jaxpr)
+    assert f"convert_element_type[new_dtype={jnp.dtype(compute).name}" in s \
+        or in_dtype == compute
+
+
+def test_conv_whitelisted():
+    x = jnp.ones((1, 8, 8, 3), jnp.float32)
+    k = jnp.ones((3, 3, 3, 4), jnp.float32)
+    fn = lambda x, k: jax.lax.conv_general_dilated(
+        x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    jaxpr = str(jax.make_jaxpr(autocast(fn))(x, k))
+    assert "convert_element_type[new_dtype=bfloat16" in jaxpr
+
+
+# --- ALWAYS_FLOAT: blacklist ------------------------------------------------
+
+@pytest.mark.parametrize("fn", [jnp.exp, jnp.log1p, lambda x: x ** 3.1,
+                                jax.nn.softmax, jnp.cumsum])
+def test_blacklist_runs_fp32(fn):
+    x = jnp.ones((4, 4), jnp.bfloat16)
+    out = run(fn, x)
+    assert out.dtype == jnp.float32
+
+
+def test_sum_accumulates_fp32():
+    # jnp.sum's own decomposition upcasts bf16 accumulation to fp32 and
+    # casts the result back; the blacklist guarantees the reduce itself is
+    # fp32 (function-level output dtype follows jnp's contract — a
+    # documented deviation from the reference's ALWAYS_FLOAT torch.sum).
+    x = jnp.ones((4, 4), jnp.bfloat16)
+    jaxpr = str(jax.make_jaxpr(autocast(lambda v: jnp.sum(v, axis=-1)))(x))
+    assert "reduce_sum" in jaxpr
+
+
+def test_fp32_softmax_numerics_preserved():
+    # softmax over bf16 logits must be computed in fp32 (the whole point of
+    # the blacklist): compare against the fp32 reference.
+    x = (jax.random.normal(jax.random.PRNGKey(0), (4, 64)) * 10)
+    got = run(jax.nn.softmax, x.astype(jnp.bfloat16))
+    want = jax.nn.softmax(x.astype(jnp.bfloat16).astype(jnp.float32))
+    # The max-subtract inside softmax stays bf16 (op-granularity lists);
+    # exp/sum/div run fp32, so error is bf16-rounding-level, not exp-range.
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=5e-2,
+                               atol=1e-7)
+    assert got.dtype == jnp.float32
+
+
+# --- MATCH_INPUT / promotion ------------------------------------------------
+
+def test_mixed_binary_promotes_widest():
+    a = jnp.ones((4,), jnp.bfloat16)
+    b = jnp.ones((4,), jnp.float32)
+    out = run(lambda a, b: a + b, a, b)
+    assert out.dtype == jnp.float32
+
+
+def test_passthrough_matches_input():
+    a = jnp.ones((4, 4), jnp.bfloat16)
+    out = run(lambda x: jnp.maximum(x, 0) * 2, a)
+    assert out.dtype == jnp.bfloat16
+
+
+# --- composition with transforms -------------------------------------------
+
+def test_grad_through_autocast():
+    w = jnp.ones((8, 8), jnp.float32) * 0.5
+    x = jnp.ones((2, 8), jnp.float32)
+
+    def loss(w):
+        return jnp.sum((x @ w) ** 2)
+
+    g = jax.grad(autocast(loss))(w)
+    g_ref = jax.grad(loss)(w)
+    assert g.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=2e-2)
+
+
+def test_jit_and_nested_jit():
+    @jax.jit
+    def inner(x):
+        return x @ x
+
+    def fn(x):
+        return inner(x) + 1.0
+
+    x = jnp.ones((8, 8), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(autocast(fn))(x))
+    assert "bfloat16" in jaxpr  # recursed through the pjit region
+    out = jax.jit(autocast(fn))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(fn(x)), rtol=1e-2)
+
+
+def test_custom_vjp_left_opaque():
+    @jax.custom_vjp
+    def f(x):
+        return x * 2
+
+    def fwd(x):
+        return f(x), None
+
+    def bwd(_, g):
+        return (g * 100.0,)  # deliberately wrong to detect rule loss
+
+    f.defvjp(fwd, bwd)
+    x = jnp.float32(3.0)
+    g = jax.grad(autocast(lambda x: f(x)))(x)
+    assert float(g) == 100.0  # custom rule survived
+
+
+def test_policy_selects_dtype_or_disables():
+    x = jnp.ones((4, 4), jnp.float32)
+    fn = lambda a: a @ a
+    s_o1 = str(jax.make_jaxpr(autocast(fn, policy=amp.O1))(x))
+    assert "float16" in s_o1 and "bfloat16" not in s_o1
+    s_o4 = str(jax.make_jaxpr(autocast(fn, policy=amp.O4))(x))
+    assert "bfloat16" in s_o4
+    assert autocast(fn, policy=amp.O0) is fn  # disabled -> identity
+
+
+# --- explicit registration decorators (ref: apex/amp/amp.py:29-71) ---------
+
+def test_register_decorators():
+    from apex_tpu.amp.autocast import (bfloat16_function, float_function,
+                                       half_function, promote_function)
+    probe = lambda *xs: tuple(x.dtype for x in xs)
+    assert half_function(probe)(jnp.ones(2, jnp.float32))[0] == jnp.float16
+    assert bfloat16_function(probe)(jnp.ones(2))[0] == jnp.bfloat16
+    assert float_function(probe)(jnp.ones(2, jnp.bfloat16))[0] == jnp.float32
+    a, b = promote_function(probe)(jnp.ones(2, jnp.bfloat16), jnp.ones(2))
+    assert a == jnp.float32 and b == jnp.float32
