@@ -1,0 +1,164 @@
+"""Pallas fused optimizer kernels over packed flat buffers.
+
+TPU-native equivalents of the ``amp_C`` multi-tensor optimizer kernels
+(ref: csrc/multi_tensor_adam.cu:24-110, multi_tensor_adagrad.cu,
+multi_tensor_sgd_kernel.cu).  Each kernel makes ONE pass over
+params+grads+state packed as contiguous (rows, 128) fp32/bf16 buffers —
+the TPU analogue of the reference's pointer-table multi-tensor-apply: the
+win is memory-traffic shaping (single fused read-modify-write stream
+through VMEM) rather than launch-count amortization.
+
+Math is fp32 regardless of storage dtype (``MATH_T=float``,
+ref: csrc/multi_tensor_adam.cu:29).  Kernels emit the *update delta*
+(optax convention) rather than new params, so they compose with
+``optax.apply_updates`` and the amp master-weight machinery.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per buffer per block
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad_rows(n_rows: int) -> int:
+    return -(-n_rows // BLOCK_ROWS) * BLOCK_ROWS
+
+
+def _elementwise_call(kernel, hyp: jnp.ndarray,
+                      inputs: Sequence[jnp.ndarray],
+                      out_dtypes: Sequence,
+                      interpret=None):
+    """Run an elementwise update kernel over equal-length 1-D buffers.
+
+    ``kernel(hyp_ref, *in_refs, *out_refs)`` sees (BLOCK_ROWS, 128) VMEM
+    blocks; ``hyp`` is a small fp32 vector in SMEM (the reference passes
+    hyperparameters as kernel arguments, csrc/multi_tensor_adam.cu:118-131).
+    """
+    n = inputs[0].shape[0]
+    assert n % LANE == 0, f"flat buffer length {n} not a multiple of {LANE}"
+    rows = n // LANE
+    prows = _pad_rows(rows)
+    grid = prows // BLOCK_ROWS
+
+    views = []
+    for x in inputs:
+        v = x.reshape(rows, LANE)
+        if prows != rows:
+            v = jnp.pad(v, ((0, prows - rows), (0, 0)))
+        views.append(v)
+
+    blockspec = pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [blockspec] * len(views),
+        out_specs=[blockspec] * len(out_dtypes),
+        out_shape=[jax.ShapeDtypeStruct((prows, LANE), d)
+                   for d in out_dtypes],
+        interpret=_interpret() if interpret is None else interpret,
+    )(hyp.astype(jnp.float32), *views)
+    return [o[:rows].reshape(n) for o in outs]
+
+
+# --- Adam (ref: csrc/multi_tensor_adam.cu AdamFunctor :24-110) -------------
+
+def _adam_kernel(adam_w_mode: bool, hyp_ref, g_ref, p_ref, m_ref, v_ref,
+                 delta_ref, m_out_ref, v_out_ref):
+    lr, b1, b2, eps, wd, bc1, bc2 = (hyp_ref[i] for i in range(7))
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        # ADAM_MODE_0: L2 regularization folds decay into the gradient
+        # (ref: multi_tensor_adam.cu:60-78).
+        g = g + wd * p
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    mhat = m / bc1
+    vhat = v / bc2
+    update = mhat / (jnp.sqrt(vhat) + eps)
+    if adam_w_mode:
+        # ADAM_MODE_1: decoupled AdamW decay (ref: multi_tensor_adam.cu:80-108).
+        update = update + wd * p
+    delta_ref[:] = (-lr * update).astype(delta_ref.dtype)
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+
+
+def adam_update(g, p, m, v, *, lr, beta1, beta2, eps, weight_decay,
+                bias_correction1, bias_correction2, adam_w_mode=True,
+                interpret=None):
+    """One fused Adam pass over flat buffers -> (delta, new_m, new_v)."""
+    hyp = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(beta1),
+        jnp.float32(beta2), jnp.float32(eps), jnp.float32(weight_decay),
+        jnp.asarray(bias_correction1, jnp.float32),
+        jnp.asarray(bias_correction2, jnp.float32)])
+    kernel = functools.partial(_adam_kernel, adam_w_mode)
+    return _elementwise_call(kernel, hyp, [g, p, m, v],
+                             [p.dtype, jnp.float32, jnp.float32],
+                             interpret=interpret)
+
+
+# --- Adagrad (ref: csrc/multi_tensor_adagrad.cu) ---------------------------
+
+def _adagrad_kernel(hyp_ref, g_ref, p_ref, h_ref, delta_ref, h_out_ref):
+    lr, eps, wd = hyp_ref[0], hyp_ref[1], hyp_ref[2]
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    # ADAGRAD_MODE_0 (L2): grad-side decay (ref: multi_tensor_adagrad.cu:46).
+    g = g + wd * p
+    h = h_ref[:] + g * g
+    delta_ref[:] = (-lr * g / (jnp.sqrt(h) + eps)).astype(delta_ref.dtype)
+    h_out_ref[:] = h
+
+
+def adagrad_update(g, p, h, *, lr, eps, weight_decay, interpret=None):
+    hyp = jnp.stack([jnp.asarray(lr, jnp.float32), jnp.float32(eps),
+                     jnp.float32(weight_decay)])
+    return _elementwise_call(_adagrad_kernel, hyp, [g, p, h],
+                             [p.dtype, jnp.float32], interpret=interpret)
+
+
+# --- SGD with momentum (ref: csrc/multi_tensor_sgd_kernel.cu:24-140) -------
+
+def _sgd_kernel(nesterov: bool, wd_after_momentum: bool, hyp_ref,
+                g_ref, p_ref, mom_ref, delta_ref, mom_out_ref):
+    lr, momentum, dampening, wd, first_run = (hyp_ref[i] for i in range(5))
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    if not wd_after_momentum:
+        g = g + wd * p
+    # first_run selects torch semantics: buf <- grad on the first step
+    # (ref: multi_tensor_sgd_kernel.cu first_run handling).
+    mom = jnp.where(first_run > 0.5, g,
+                    momentum * mom_ref[:] + (1.0 - dampening) * g)
+    upd = g + momentum * mom if nesterov else mom
+    if wd_after_momentum:
+        upd = upd + wd * p
+    delta_ref[:] = (-lr * upd).astype(delta_ref.dtype)
+    mom_out_ref[:] = mom
+
+
+def sgd_update(g, p, mom, *, lr, momentum, dampening, weight_decay,
+               nesterov=False, wd_after_momentum=False, first_run,
+               interpret=None):
+    hyp = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(momentum),
+        jnp.float32(dampening), jnp.float32(weight_decay),
+        jnp.asarray(first_run, jnp.float32)])
+    kernel = functools.partial(_sgd_kernel, nesterov, wd_after_momentum)
+    return _elementwise_call(kernel, hyp, [g, p, mom],
+                             [p.dtype, jnp.float32], interpret=interpret)

@@ -1,0 +1,182 @@
+"""Multi-tensor ops over flat buffer views of parameter pytrees.
+
+TPU-native replacement for the ``amp_C`` multi-tensor-apply machinery
+(ref: csrc/multi_tensor_apply.cuh:16-115 packs tensor pointer tables into
+kernel launches; apex/multi_tensor_apply/multi_tensor_apply.py:3-29
+dispatches).  On GPU the win is amortizing launch overhead across hundreds
+of small tensors; on TPU the equivalent is shaping memory traffic: leaves
+are packed (per dtype) into one contiguous 1-D buffer so a single Pallas
+kernel makes one pass over params+state.  Packing metadata is static, so
+XLA lowers pack/unpack to pure data movement that fuses with neighbours.
+
+Ops mirroring the exported ``amp_C`` list (ref: csrc/amp_C_frontend.cpp:148-173):
+``scale`` (multi_tensor_scale), ``axpby`` (multi_tensor_axpby),
+``l2norm`` (multi_tensor_l2norm, incl. per-tensor), ``l2norm_scale``.
+The overflow-buffer convention becomes a returned finite flag.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# TPU lane/sublane tile for fp32; flat buffers are padded to this so Pallas
+# kernels can view them as (rows, 128) without remainder handling.
+LANE = 128
+_PAD_TO = 8 * LANE
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatMeta:
+    """Static packing metadata for one dtype group."""
+
+    treedef: Any
+    leaf_indices: Tuple[int, ...]      # positions in the flat leaf list
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    total: int                          # unpadded element count
+    padded: int                         # padded to _PAD_TO
+    dtype: Any
+
+
+def _group_leaves(leaves) -> dict:
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    return groups
+
+
+def compute_metas(tree: Any) -> List[FlatMeta]:
+    """Static packing metadata (shapes/dtypes only — works on tracers)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    metas = []
+    for dtype, idxs in _group_leaves(leaves).items():
+        shapes = tuple(tuple(jnp.asarray(leaves[i]).shape) for i in idxs)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        offsets, off = [], 0
+        for s in sizes:
+            offsets.append(off)
+            off += s
+        total = off
+        padded = max(_PAD_TO, -(-total // _PAD_TO) * _PAD_TO)
+        metas.append(FlatMeta(treedef, tuple(idxs), shapes, sizes,
+                              tuple(offsets), total, padded, dtype))
+    return metas
+
+
+def pack(tree: Any, metas: Sequence[FlatMeta],
+         dtype=None) -> List[jnp.ndarray]:
+    """Pack ``tree``'s leaves into flat buffers following ``metas``' layout
+    (use params' metas to pack grads so group assignment matches)."""
+    leaves = jax.tree_util.tree_flatten(tree)[0]
+    out = []
+    for meta in metas:
+        pieces = [jnp.ravel(leaves[i]) for i in meta.leaf_indices]
+        if meta.padded > meta.total:
+            pieces.append(jnp.zeros((meta.padded - meta.total,),
+                                    pieces[0].dtype if pieces else meta.dtype))
+        flat = jnp.concatenate(pieces)
+        out.append(flat.astype(dtype) if dtype is not None else flat)
+    return out
+
+
+def pack_groups(tree: Any) -> Tuple[List[jnp.ndarray], List[FlatMeta]]:
+    """Pack a pytree into one padded 1-D buffer per leaf dtype.
+
+    The per-dtype grouping mirrors the reference's
+    ``split_half_float_double_bfloat16`` bucketing
+    (ref: apex/parallel/distributed.py:60-76)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    buffers, metas = [], []
+    for dtype, idxs in _group_leaves(leaves).items():
+        shapes = tuple(tuple(jnp.asarray(leaves[i]).shape) for i in idxs)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        offsets, off = [], 0
+        for s in sizes:
+            offsets.append(off)
+            off += s
+        total = off
+        padded = max(_PAD_TO, -(-total // _PAD_TO) * _PAD_TO)
+        flat = jnp.concatenate(
+            [jnp.ravel(leaves[i]) for i in idxs]
+            + ([jnp.zeros((padded - total,), dtype)] if padded > total
+               else []))
+        buffers.append(flat)
+        metas.append(FlatMeta(treedef, tuple(idxs), shapes, sizes,
+                              tuple(offsets), total, padded, dtype))
+    return buffers, metas
+
+
+def unpack_groups(buffers: Sequence[jnp.ndarray],
+                  metas: Sequence[FlatMeta],
+                  out_dtypes: Optional[Sequence[Any]] = None) -> Any:
+    """Rebuild the pytree from packed buffers (inverse of pack_groups)."""
+    n_leaves = sum(len(m.leaf_indices) for m in metas)
+    leaves: List[Optional[jnp.ndarray]] = [None] * n_leaves
+    for buf, meta in zip(buffers, metas):
+        for k, leaf_idx in enumerate(meta.leaf_indices):
+            piece = jax.lax.dynamic_slice_in_dim(
+                buf, meta.offsets[k], meta.sizes[k]).reshape(meta.shapes[k])
+            if out_dtypes is not None:
+                piece = piece.astype(out_dtypes[leaf_idx])
+            leaves[leaf_idx] = piece
+    return jax.tree_util.tree_unflatten(metas[0].treedef, leaves)
+
+
+def segment_ids(meta: FlatMeta) -> jnp.ndarray:
+    """Per-element tensor index for a packed buffer (padding gets the id
+    ``len(sizes)``); used for per-tensor norms (LAMB/NovoGrad)."""
+    ids = np.full((meta.padded,), len(meta.sizes), np.int32)
+    for k, (o, s) in enumerate(zip(meta.offsets, meta.sizes)):
+        ids[o:o + s] = k
+    return jnp.asarray(ids)
+
+
+# --- amp_C-parity ops ------------------------------------------------------
+
+def scale(tree: Any, scale_factor) -> Tuple[Any, jnp.ndarray]:
+    """Multiply every leaf by ``scale_factor``; returns (scaled, finite_flag)
+    (ref: multi_tensor_scale_kernel.cu — scale + overflow check fused)."""
+    scaled = jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.float32) * scale_factor).astype(x.dtype),
+        tree)
+    finite = jnp.stack([
+        jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))
+        for leaf in jax.tree_util.tree_leaves(scaled)]).all() \
+        if jax.tree_util.tree_leaves(scaled) else jnp.bool_(True)
+    return scaled, finite
+
+
+def axpby(a, x_tree: Any, b, y_tree: Any, out_dtype=None) -> Any:
+    """``a*x + b*y`` leafwise in fp32
+    (ref: multi_tensor_axpby_kernel.cu, used for fused unscale+copy,
+    apex/amp/scaler.py:161-193)."""
+    def _axpby(x, y):
+        r = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return r.astype(out_dtype or x.dtype)
+    return jax.tree_util.tree_map(_axpby, x_tree, y_tree)
+
+
+def l2norm(tree: Any, per_tensor: bool = False):
+    """Global L2 norm, optionally also per-leaf norms
+    (ref: multi_tensor_l2norm_kernel.cu; LAMB phase 1,
+    apex/optimizers/fused_lamb.py)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    sq = [jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves]
+    total = jnp.sqrt(jnp.sum(jnp.stack(sq))) if sq else jnp.float32(0)
+    if per_tensor:
+        return total, jnp.sqrt(jnp.stack(sq))
+    return total
+
+
+def l2norm_scale(tree: Any, max_norm, per_tensor: bool = False) -> Any:
+    """Scale the whole tree by ``min(1, max_norm/global_norm)`` — fused
+    norm+clip (ref: multi_tensor_l2norm_scale_kernel.cu semantics)."""
+    norm = l2norm(tree)
+    factor = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda x: (x.astype(jnp.float32) * factor).astype(x.dtype), tree)

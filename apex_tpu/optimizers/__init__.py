@@ -1,1 +1,22 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.optimizers — fused optimizers (TPU-native apex.optimizers).
+
+All are optax-compatible ``GradientTransformation`` factories whose hot
+path is a single fused Pallas pass over packed parameter buffers
+(Adam/SGD/Adagrad) or per-leaf XLA-fused math where per-tensor reductions
+dominate (LAMB/NovoGrad).  See SURVEY.md §2.4.
+"""
+from ..parallel.LARC import LARC, larc
+from .fused_adagrad import FusedAdagrad, FusedAdagradState, fused_adagrad
+from .fused_adam import FusedAdam, FusedAdamState, fused_adam
+from .fused_lamb import FusedLAMB, FusedLAMBState, fused_lamb
+from .fused_novograd import FusedNovoGrad, FusedNovoGradState, fused_novograd
+from .fused_sgd import FusedSGD, FusedSGDState, fused_sgd
+
+__all__ = [
+    "fused_adam", "FusedAdam", "FusedAdamState",
+    "fused_sgd", "FusedSGD", "FusedSGDState",
+    "fused_adagrad", "FusedAdagrad", "FusedAdagradState",
+    "fused_lamb", "FusedLAMB", "FusedLAMBState",
+    "fused_novograd", "FusedNovoGrad", "FusedNovoGradState",
+    "larc", "LARC",
+]

@@ -1,0 +1,58 @@
+"""FusedAdagrad (ref: apex/optimizers/fused_adagrad.py:1-121)."""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import fused_optim, multi_tensor
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    h: Tuple[jnp.ndarray, ...]   # accumulated squared gradients (fp32)
+
+
+def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
+                  eps: float = 1e-10,
+                  weight_decay: float = 0.0,
+                  use_pallas: bool = True) -> optax.GradientTransformation:
+    def init(params):
+        metas = multi_tensor.compute_metas(params)
+        return FusedAdagradState(
+            count=jnp.zeros((), jnp.int32),
+            h=tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params in update()")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        metas = multi_tensor.compute_metas(params)
+        gbufs = multi_tensor.pack(grads, metas)
+        pbufs = multi_tensor.pack(params, metas)
+        deltas, new_h = [], []
+        for i, meta in enumerate(metas):
+            if use_pallas:
+                d, h = fused_optim.adagrad_update(
+                    gbufs[i], pbufs[i], state.h[i],
+                    lr=lr, eps=eps, weight_decay=weight_decay)
+            else:
+                g = gbufs[i].astype(jnp.float32) \
+                    + weight_decay * pbufs[i].astype(jnp.float32)
+                h = state.h[i] + g * g
+                d = (-lr * g / (jnp.sqrt(h) + eps)).astype(meta.dtype)
+            deltas.append(d)
+            new_h.append(h)
+        leaves = jax.tree_util.tree_leaves(params)
+        updates = multi_tensor.unpack_groups(
+            deltas, metas, out_dtypes=[l.dtype for l in leaves])
+        return updates, FusedAdagradState(count, tuple(new_h))
+
+    return optax.GradientTransformation(init, update)
+
+
+FusedAdagrad = fused_adagrad

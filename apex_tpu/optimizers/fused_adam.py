@@ -1,0 +1,109 @@
+"""FusedAdam — Adam/AdamW with a single fused Pallas pass.
+
+Capability parity with the reference's ``FusedAdam``
+(ref: apex/optimizers/fused_adam.py:4-173): ``adam_w_mode`` switching
+Adam-L2 vs AdamW, ``bias_correction``, bf16/fp16/fp32 params
+(ref: fused_adam.py:134 bf16 support), one fused kernel launch per dtype
+group (ref: fused_adam.py:147-170 multi_tensor_applier calls).
+
+Exposed as an optax-compatible ``GradientTransformation``: update deltas
+come back in param dtype; ``m``/``v`` state lives in packed fp32 flat
+buffers so the Pallas kernel streams params+grads+state in one pass
+(see apex_tpu/ops/fused_optim.py).  Set ``use_pallas=False`` for the
+per-leaf pure-jnp path (identical math; XLA-fused per leaf).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import fused_optim, multi_tensor
+
+ScalarOrSchedule = Union[float, jnp.ndarray, Callable]
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    m: Tuple[jnp.ndarray, ...]   # fp32 flat buffer per dtype group
+    v: Tuple[jnp.ndarray, ...]
+
+
+def _lr_at(lr: ScalarOrSchedule, count):
+    return lr(count) if callable(lr) else lr
+
+
+def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
+               beta1: float = 0.9,
+               beta2: float = 0.999,
+               eps: float = 1e-8,
+               weight_decay: float = 0.0,
+               adam_w_mode: bool = True,
+               bias_correction: bool = True,
+               use_pallas: bool = True) -> optax.GradientTransformation:
+    """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4)."""
+
+    def init(params):
+        metas = multi_tensor.compute_metas(params)
+        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
+        return FusedAdamState(count=jnp.zeros((), jnp.int32),
+                              m=zeros, v=tuple(jnp.zeros_like(z)
+                                               for z in zeros))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params in update()")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.float32(beta1) ** cf
+            bc2 = 1.0 - jnp.float32(beta2) ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        metas = multi_tensor.compute_metas(params)
+        gbufs = multi_tensor.pack(grads, metas)
+        pbufs = multi_tensor.pack(params, metas)
+        deltas, new_m, new_v = [], [], []
+        for i, meta in enumerate(metas):
+            if use_pallas:
+                d, m, v = fused_optim.adam_update(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                    weight_decay=weight_decay,
+                    bias_correction1=bc1, bias_correction2=bc2,
+                    adam_w_mode=adam_w_mode)
+            else:
+                d, m, v = _adam_jnp(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    lr, beta1, beta2, eps, weight_decay, bc1, bc2,
+                    adam_w_mode)
+            deltas.append(d)
+            new_m.append(m)
+            new_v.append(v)
+        leaves = jax.tree_util.tree_leaves(params)
+        updates = multi_tensor.unpack_groups(
+            deltas, metas, out_dtypes=[l.dtype for l in leaves])
+        return updates, FusedAdamState(count, tuple(new_m), tuple(new_v))
+
+    return optax.GradientTransformation(init, update)
+
+
+def _adam_jnp(g, p, m, v, lr, b1, b2, eps, wd, bc1, bc2, adam_w_mode):
+    """Reference math in plain jnp (ref: csrc/multi_tensor_adam.cu:24-110)."""
+    g = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p32
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:
+        upd = upd + wd * p32
+    return (-lr * upd).astype(p.dtype), m, v
+
+
+FusedAdam = fused_adam

@@ -1,0 +1,90 @@
+"""FusedNovoGrad (ref: apex/optimizers/fused_novograd.py:1-214).
+
+NovoGrad keeps the second moment as ONE scalar per tensor — the moving
+average of the per-tensor gradient L2 norm (ref: fused_novograd.py
+``norm_type=2``, kernel csrc/multi_tensor_novograd.cu).  Options:
+``grad_averaging``, ``init_zero`` (v0 = 0 vs v0 = ||g1||^2),
+``adam_w_mode``-style decoupled decay, bias correction.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedNovoGradState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Updates          # per-element first moment (fp32)
+    v: optax.Updates          # per-tensor scalar second moment (fp32)
+
+
+def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
+                   beta1: float = 0.95,
+                   beta2: float = 0.98,
+                   eps: float = 1e-8,
+                   weight_decay: float = 0.0,
+                   grad_averaging: bool = True,
+                   init_zero: bool = False,
+                   bias_correction: bool = True,
+                   norm_type: int = 2) -> optax.GradientTransformation:
+    if norm_type != 2:
+        raise ValueError("only norm_type=2 is supported "
+                         "(ref: apex/optimizers/fused_novograd.py)")
+
+    def init(params):
+        return FusedNovoGradState(
+            count=jnp.zeros((), jnp.int32),
+            m=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            v=jax.tree_util.tree_map(
+                lambda p: jnp.zeros((), jnp.float32), params))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params in update()")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.float32(beta1) ** cf
+            bc2 = 1.0 - jnp.float32(beta2) ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = (1.0 - beta1) if grad_averaging else 1.0
+        first = state.count == 0
+
+        def leaf_update(g, p, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            gnorm_sq = jnp.sum(g * g)
+            if init_zero:
+                v_new = beta2 * v + (1.0 - beta2) * gnorm_sq
+            else:
+                # v0 = ||g1||^2 on the first step
+                # (ref: fused_novograd.py init_zero=False default).
+                v_new = jnp.where(first, gnorm_sq,
+                                  beta2 * v + (1.0 - beta2) * gnorm_sq)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            scaled = g / denom + weight_decay * p32
+            m_new = beta1 * m + beta3 * scaled
+            upd = m_new / bc1
+            return (-lr * upd).astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(leaf_update, grads, params,
+                                     state.m, state.v)
+        treedef = jax.tree_util.tree_structure(params)
+        flat = treedef.flatten_up_to(out)
+        updates = treedef.unflatten([t[0] for t in flat])
+        new_m = treedef.unflatten([t[1] for t in flat])
+        new_v = treedef.unflatten([t[2] for t in flat])
+        return updates, FusedNovoGradState(count, new_m, new_v)
+
+    return optax.GradientTransformation(init, update)
+
+
+FusedNovoGrad = fused_novograd

@@ -1,0 +1,102 @@
+"""FusedSGD — momentum SGD with a single fused Pallas pass.
+
+Parity with the reference's ``FusedSGD``
+(ref: apex/optimizers/fused_sgd.py:4-227): momentum, dampening, nesterov,
+``wd_after_momentum``, torch first-step momentum semantics
+(buf <- grad).  The reference's ``materialize_master_grads`` fusion of
+unscale+copy+step into one kernel (ref: fused_sgd.py:76-95,
+apex/amp/_process_optimizer.py:258+) is subsumed here by XLA fusing the
+amp unscale into the packed-gradient read.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import fused_optim, multi_tensor
+from .fused_adam import ScalarOrSchedule, _lr_at
+
+
+class FusedSGDState(NamedTuple):
+    count: jnp.ndarray
+    momentum: Tuple[jnp.ndarray, ...]
+
+
+def fused_sgd(learning_rate: ScalarOrSchedule,
+              momentum: float = 0.0,
+              dampening: float = 0.0,
+              weight_decay: float = 0.0,
+              nesterov: bool = False,
+              wd_after_momentum: bool = False,
+              use_pallas: bool = True) -> optax.GradientTransformation:
+    if nesterov and (momentum <= 0 or dampening != 0):
+        raise ValueError(
+            "Nesterov momentum requires a momentum and zero dampening "
+            "(ref: apex/optimizers/fused_sgd.py:61-62)")
+
+    def init(params):
+        metas = multi_tensor.compute_metas(params)
+        return FusedSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum=tuple(jnp.zeros((m.padded,), jnp.float32)
+                           for m in metas))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params in update()")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        first = (state.count == 0).astype(jnp.float32) if momentum else \
+            jnp.float32(0.0)
+        metas = multi_tensor.compute_metas(params)
+        gbufs = multi_tensor.pack(grads, metas)
+        pbufs = multi_tensor.pack(params, metas)
+        deltas, new_mom = [], []
+        for i, meta in enumerate(metas):
+            if momentum == 0.0:
+                # No momentum buffer: plain (optionally decayed) step.
+                g = gbufs[i].astype(jnp.float32)
+                p32 = pbufs[i].astype(jnp.float32)
+                g = g + weight_decay * p32
+                deltas.append((-lr * g).astype(meta.dtype))
+                new_mom.append(state.momentum[i])
+            elif use_pallas:
+                d, mom = fused_optim.sgd_update(
+                    gbufs[i], pbufs[i], state.momentum[i],
+                    lr=lr, momentum=momentum, dampening=dampening,
+                    weight_decay=weight_decay, nesterov=nesterov,
+                    wd_after_momentum=wd_after_momentum, first_run=first)
+                deltas.append(d)
+                new_mom.append(mom)
+            else:
+                d, mom = _sgd_jnp(gbufs[i], pbufs[i], state.momentum[i],
+                                  lr, momentum, dampening, weight_decay,
+                                  nesterov, wd_after_momentum, first)
+                deltas.append(d)
+                new_mom.append(mom)
+        leaves = jax.tree_util.tree_leaves(params)
+        updates = multi_tensor.unpack_groups(
+            deltas, metas, out_dtypes=[l.dtype for l in leaves])
+        return updates, FusedSGDState(count, tuple(new_mom))
+
+    return optax.GradientTransformation(init, update)
+
+
+def _sgd_jnp(g, p, mom, lr, momentum, dampening, wd, nesterov,
+             wd_after_momentum, first_run):
+    g = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if not wd_after_momentum:
+        g = g + wd * p32
+    mom = jnp.where(first_run > 0.5, g,
+                    momentum * mom + (1.0 - dampening) * g)
+    upd = g + momentum * mom if nesterov else mom
+    if wd_after_momentum:
+        upd = upd + wd * p32
+    return (-lr * upd).astype(p.dtype), mom
+
+
+FusedSGD = fused_sgd

@@ -1,0 +1,64 @@
+"""LARC — layerwise adaptive rate control/clipping.
+
+Parity with the reference's ``LARC`` optimizer wrapper
+(ref: apex/parallel/LARC.py:5-107): per-parameter adaptive LR
+``trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)``, either clipped
+against the base LR (``clip=True``) or used as a scale (``clip=False``),
+with weight decay folded into the gradient (ref: LARC.py:94-105).
+
+Expressed as an optax ``GradientTransformation`` to chain before the
+wrapped optimizer (the reference wraps ``optimizer.step``)::
+
+    tx = optax.chain(larc(learning_rate=0.1, clip=True), fused_sgd(0.1, ...))
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def larc(learning_rate=None,
+         trust_coefficient: float = 0.02,
+         clip: bool = True,
+         eps: float = 1e-8,
+         weight_decay: float = 0.0) -> optax.GradientTransformation:
+    if clip and learning_rate is None:
+        raise ValueError("clip mode needs the base learning_rate to clamp "
+                         "against (ref: apex/parallel/LARC.py:99-101)")
+
+    def init(params):
+        del params
+        return optax.ScaleState()
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params in update()")
+
+        count = getattr(state, "count", None)
+        lr = learning_rate(count) if callable(learning_rate) \
+            else learning_rate
+
+        def leaf(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            adaptive_lr = trust_coefficient * p_norm / (
+                g_norm + weight_decay * p_norm + eps)
+            if clip:
+                # ``min(adaptive_lr/lr, 1)`` (ref: LARC.py:99-101).
+                adaptive_lr = jnp.minimum(adaptive_lr / lr, 1.0)
+            g32 = g32 + weight_decay * p32
+            g32 = g32 * adaptive_lr
+            # Zero-norm params/grads keep the raw gradient
+            # (ref: LARC.py:92 ``if param_norm != 0 and grad_norm != 0``).
+            keep = (p_norm != 0) & (g_norm != 0)
+            return jnp.where(keep, g32, g.astype(jnp.float32)).astype(g.dtype)
+
+        return jax.tree_util.tree_map(leaf, grads, params), state
+
+    return optax.GradientTransformation(init, update)
+
+
+LARC = larc

@@ -1,0 +1,271 @@
+"""Fused-optimizer parity tests.
+
+Models the reference's kernel-vs-reference pattern: step the fused
+optimizer and a stock implementation on identical inputs and compare
+(ref: tests/L0/run_optimizers/test_fused_optimizer.py).  The Pallas path
+runs in interpreter mode on CPU; it must agree with the pure-jnp path
+bit-for-bit-ish and with optax within fp32 tolerance.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import optimizers as opt
+from apex_tpu.ops import multi_tensor as mt
+
+
+def tree_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32),
+            rtol=rtol, atol=atol), a, b)
+
+
+def make_params(seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    return {
+        "dense": {"kernel": jax.random.normal(ks[0], (17, 33), dtype),
+                  "bias": jax.random.normal(ks[1], (33,), dtype)},
+        "out": {"kernel": jax.random.normal(ks[2], (33, 5), dtype)},
+        "scalar": jax.random.normal(ks[3], (), dtype),
+    }
+
+
+def make_grads(params, seed=100):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    ks = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(k, l.shape, l.dtype)
+                  for k, l in zip(ks, leaves)])
+
+
+def run_steps(tx, params, n=3, seed=7):
+    state = tx.init(params)
+    p = params
+    for i in range(n):
+        g = make_grads(p, seed + i)
+        updates, state = tx.update(g, state, p)
+        p = optax.apply_updates(p, updates)
+    return p
+
+
+# --- multi-tensor ops -------------------------------------------------------
+
+def test_pack_unpack_roundtrip():
+    params = make_params()
+    bufs, metas = mt.pack_groups(params)
+    leaves = jax.tree_util.tree_leaves(params)
+    rebuilt = mt.unpack_groups(bufs, metas,
+                               out_dtypes=[l.dtype for l in leaves])
+    tree_close(params, rebuilt, rtol=0, atol=0)
+
+
+def test_pack_mixed_dtypes_groups():
+    tree = {"a": jnp.ones((5,), jnp.bfloat16), "b": jnp.ones((7,)),
+            "c": jnp.ones((3, 3), jnp.bfloat16)}
+    bufs, metas = mt.pack_groups(tree)
+    assert len(bufs) == 2
+    rebuilt = mt.unpack_groups(
+        bufs, metas, out_dtypes=[l.dtype for l in
+                                 jax.tree_util.tree_leaves(tree)])
+    assert rebuilt["a"].dtype == jnp.bfloat16
+    assert rebuilt["b"].dtype == jnp.float32
+
+
+def test_l2norm_and_scale():
+    tree = {"a": jnp.full((10,), 3.0), "b": jnp.full((4,), 4.0)}
+    total, per = mt.l2norm(tree, per_tensor=True)
+    np.testing.assert_allclose(float(total), np.sqrt(90 + 64), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(per),
+                               [np.sqrt(90), np.sqrt(64)], rtol=1e-6)
+    scaled, finite = mt.scale(tree, 0.5)
+    assert bool(finite)
+    np.testing.assert_allclose(np.asarray(scaled["a"]), np.full(10, 1.5))
+    bad, finite = mt.scale({"a": jnp.array([jnp.inf])}, 1.0)
+    assert not bool(finite)
+
+
+def test_axpby():
+    x = {"a": jnp.full((4,), 2.0)}
+    y = {"a": jnp.full((4,), 10.0)}
+    out = mt.axpby(0.5, x, 2.0, y)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.full(4, 21.0))
+
+
+# --- Adam -------------------------------------------------------------------
+
+@pytest.mark.parametrize("adam_w", [True, False])
+def test_fused_adam_pallas_matches_jnp(adam_w):
+    params = make_params()
+    p1 = run_steps(opt.fused_adam(1e-2, weight_decay=0.05,
+                                  adam_w_mode=adam_w, use_pallas=True),
+                   params)
+    p2 = run_steps(opt.fused_adam(1e-2, weight_decay=0.05,
+                                  adam_w_mode=adam_w, use_pallas=False),
+                   params)
+    # fp32 roundoff only (fma/ordering differences between paths)
+    tree_close(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_adamw_matches_optax():
+    params = make_params()
+    p1 = run_steps(opt.fused_adam(1e-2, weight_decay=0.05,
+                                  adam_w_mode=True), params)
+    p2 = run_steps(optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8,
+                               weight_decay=0.05), params)
+    tree_close(p1, p2, rtol=2e-5, atol=1e-6)
+
+
+def test_fused_adam_l2_matches_optax():
+    params = make_params()
+    p1 = run_steps(opt.fused_adam(1e-2, weight_decay=0.05,
+                                  adam_w_mode=False), params)
+    p2 = run_steps(optax.chain(optax.add_decayed_weights(0.05),
+                               optax.adam(1e-2)), params)
+    tree_close(p1, p2, rtol=2e-5, atol=1e-6)
+
+
+def test_fused_adam_bf16_params_fp32_state():
+    params = make_params(dtype=jnp.bfloat16)
+    tx = opt.fused_adam(1e-2)
+    state = tx.init(params)
+    assert state.m[0].dtype == jnp.float32
+    g = make_grads(params)
+    updates, state2 = tx.update(g, state, params)
+    assert jax.tree_util.tree_leaves(updates)[0].dtype == jnp.bfloat16
+    assert int(state2.count) == 1
+
+
+def test_fused_adam_under_jit_and_schedule():
+    params = make_params()
+    sched = lambda count: 1e-2 / (1.0 + 0.1 * count.astype(jnp.float32))
+    tx = opt.fused_adam(sched)
+    state = tx.init(params)
+    g = make_grads(params)
+
+    @jax.jit
+    def step(g, s, p):
+        u, s2 = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s2
+
+    p2, s2 = step(g, state, params)
+    assert int(s2.count) == 1
+
+
+# --- SGD --------------------------------------------------------------------
+
+def test_fused_sgd_matches_torch_semantics():
+    # torch SGD: buf <- g on first step; p -= lr*(g + momentum*buf) nesterov
+    # or p -= lr*buf. Compare against hand rollout.
+    params = {"w": jnp.array([1.0, -2.0, 3.0])}
+    tx = opt.fused_sgd(0.1, momentum=0.9, weight_decay=0.0)
+    state = tx.init(params)
+    g1 = {"w": jnp.array([0.5, 0.5, 0.5])}
+    u1, state = tx.update(g1, state, params)
+    p1 = optax.apply_updates(params, u1)
+    np.testing.assert_allclose(np.asarray(p1["w"]),
+                               np.asarray(params["w"]) - 0.1 * 0.5,
+                               rtol=1e-6)
+    g2 = {"w": jnp.array([1.0, 1.0, 1.0])}
+    u2, state = tx.update(g2, state, p1)
+    buf2 = 0.9 * 0.5 + 1.0
+    np.testing.assert_allclose(
+        np.asarray(optax.apply_updates(p1, u2)["w"]),
+        np.asarray(p1["w"]) - 0.1 * buf2, rtol=1e-6)
+
+
+def test_fused_sgd_pallas_matches_jnp():
+    params = make_params()
+    kw = dict(momentum=0.9, weight_decay=0.01, dampening=0.1)
+    p1 = run_steps(opt.fused_sgd(0.05, use_pallas=True, **kw), params)
+    p2 = run_steps(opt.fused_sgd(0.05, use_pallas=False, **kw), params)
+    tree_close(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_sgd_nesterov_validation():
+    with pytest.raises(ValueError):
+        opt.fused_sgd(0.1, nesterov=True)
+
+
+# --- Adagrad ----------------------------------------------------------------
+
+def test_fused_adagrad_matches_optax():
+    params = make_params()
+    p1 = run_steps(opt.fused_adagrad(0.05, eps=1e-10), params)
+    p2 = run_steps(optax.adagrad(0.05, initial_accumulator_value=0.0,
+                                 eps=1e-10), params)
+    # apex applies eps outside the sqrt (csrc/multi_tensor_adagrad.cu),
+    # optax inside — tolerance covers the eps-placement difference.
+    tree_close(p1, p2, rtol=2e-4, atol=1e-5)
+
+
+# --- LAMB -------------------------------------------------------------------
+
+def test_fused_lamb_trust_ratio_math():
+    params = {"w": jnp.full((64,), 2.0)}
+    tx = opt.fused_lamb(0.1, weight_decay=0.0, max_grad_norm=1e9,
+                        bias_correction=True, grad_averaging=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((64,), 0.1)}
+    u, _ = tx.update(g, state, params)
+    # After one step: m=(1-b1)g, v=(1-b2)g^2, bias-corrected -> upd = g/|g| elementwise
+    upd = np.full(64, 0.1) / np.sqrt(np.full(64, 0.01) + 0.0)  # ~1 each w/o eps
+    w_norm = np.sqrt(64 * 4.0)
+    u_norm = np.sqrt(np.sum(upd ** 2))
+    expect = -0.1 * (w_norm / u_norm) * upd
+    np.testing.assert_allclose(np.asarray(u["w"]), expect, rtol=1e-3)
+
+
+def test_fused_lamb_grad_clipping():
+    params = make_params()
+    tx = opt.fused_lamb(0.1, max_grad_norm=0.5)
+    state = tx.init(params)
+    g = make_grads(params)
+    gnorm = float(mt.l2norm(g))
+    assert gnorm > 0.5  # random grads exceed the clip
+    u, _ = tx.update(g, state, params)  # sanity: runs and stays finite
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(u))
+
+
+# --- NovoGrad ---------------------------------------------------------------
+
+def test_fused_novograd_per_tensor_v():
+    params = make_params()
+    tx = opt.fused_novograd(1e-2)
+    state = tx.init(params)
+    assert jax.tree_util.tree_leaves(state.v)[0].shape == ()
+    g = make_grads(params)
+    u, s2 = tx.update(g, state, params)
+    # first step: v = ||g||^2 per tensor (init_zero=False)
+    leaves_g = jax.tree_util.tree_leaves(g)
+    leaves_v = jax.tree_util.tree_leaves(s2.v)
+    for gl, vl in zip(leaves_g, leaves_v):
+        np.testing.assert_allclose(float(vl),
+                                   float(jnp.sum(gl.astype(jnp.float32)**2)),
+                                   rtol=1e-5)
+
+
+# --- LARC -------------------------------------------------------------------
+
+def test_larc_clip_caps_update():
+    params = {"w": jnp.full((32,), 1.0)}
+    g = {"w": jnp.full((32,), 100.0)}  # huge grads -> adaptive lr clips
+    tx = optax.chain(opt.larc(learning_rate=0.1, trust_coefficient=0.02),
+                     optax.sgd(0.1))
+    state = tx.init(params)
+    u, _ = tx.update(g, state, params)
+    # adaptive_lr = 0.02*|p|/(|g|) = 0.02*sqrt(32)/(100*sqrt(32)) = 2e-4
+    # clip: min(2e-4/0.1, 1) = 2e-3 -> g_eff = 0.2 -> delta = -0.1*0.2
+    np.testing.assert_allclose(np.asarray(u["w"]), np.full(32, -0.02),
+                               rtol=1e-4)
+
+
+def test_larc_zero_param_passthrough():
+    params = {"w": jnp.zeros((8,))}
+    g = {"w": jnp.full((8,), 2.0)}
+    tx = opt.larc(learning_rate=0.1)
+    u, _ = tx.update(g, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(u["w"]), np.full(8, 2.0))
