@@ -1,1 +1,1 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.contrib — contributed modules (ref: apex/contrib)."""

@@ -1,1 +1,5 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.fused_dense (ref: apex/fused_dense)."""
+from .fused_dense import (FusedDense, FusedDenseGeluDense,
+                          fused_dense_function)
+
+__all__ = ["FusedDense", "FusedDenseGeluDense", "fused_dense_function"]

@@ -1,0 +1,65 @@
+"""FusedDense / FusedDenseGeluDense.
+
+Parity with the reference (ref: apex/fused_dense/fused_dense.py:1-86 over
+fused_dense_cuda — cuBLASLt bias/gelu epilogues,
+csrc/fused_dense.cpp:187-190).  XLA performs the same epilogue fusion for
+``dot + bias + gelu`` chains, so these modules are the API surface; the
+GELU is the exact (erf) form the reference's cuBLASLt epilogue uses.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _dense(x, kernel, bias):
+    y = jax.lax.dot_general(x, kernel, (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    y = y.astype(x.dtype)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def fused_dense_function(x, weight, bias=None):
+    """Functional linear+bias (ref: fused_dense_function,
+    apex/fused_dense/fused_dense.py:70-76).  ``weight`` follows the
+    (in_features, out_features) layout."""
+    return _dense(x, weight, bias)
+
+
+class FusedDense(nn.Module):
+    """Linear + bias (ref: apex/fused_dense/fused_dense.py FusedDense)."""
+
+    features: int
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype) \
+            if self.use_bias else None
+        return _dense(x, kernel.astype(x.dtype),
+                      None if bias is None else bias)
+
+
+class FusedDenseGeluDense(nn.Module):
+    """linear -> bias -> GELU -> linear -> bias, one fused region
+    (ref: apex/fused_dense/fused_dense.py FusedDenseGeluDense)."""
+
+    intermediate_features: int
+    out_features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = FusedDense(self.intermediate_features,
+                       param_dtype=self.param_dtype, name="dense1")(x)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=False)
+        h = h.astype(x.dtype)
+        return FusedDense(self.out_features,
+                          param_dtype=self.param_dtype, name="dense2")(h)

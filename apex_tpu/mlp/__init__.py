@@ -1,1 +1,4 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.mlp — fused MLP (ref: apex/mlp)."""
+from .mlp import MLP
+
+__all__ = ["MLP"]

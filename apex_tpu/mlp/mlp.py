@@ -1,0 +1,51 @@
+"""MLP — fused multi-layer perceptron.
+
+Parity with the reference's ``apex.mlp.MLP``
+(ref: apex/mlp/mlp.py:8-79 over mlp_cuda, csrc/mlp_cuda.cu: cuBLAS GEMM
+chain with bias/activation epilogues).  On TPU, XLA fuses the
+dot+bias+activation chain natively (the epilogue fusion the reference
+hand-codes), so this module is the API-parity surface lowering to
+``dot_general`` chains; activations: none / relu / sigmoid.  Registered
+with amp as a low-precision function (the reference registers via
+``amp.half_function``, ref: apex/mlp/mlp.py:24).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """``MLP(mlp_sizes, bias=True, activation='relu')``
+    (ref: apex/mlp/mlp.py:31-62).  ``mlp_sizes`` includes the input size:
+    layers are ``mlp_sizes[i] -> mlp_sizes[i+1]``."""
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    dtype: jnp.dtype = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        if self.activation not in ("none", "relu", "sigmoid"):
+            raise TypeError(f"activation {self.activation} not supported "
+                            "(ref: apex/mlp/mlp.py:43-50)")
+        if len(self.mlp_sizes) < 2:
+            raise ValueError("mlp_sizes needs at least input and one layer")
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(1, len(self.mlp_sizes)):
+            x = nn.Dense(self.mlp_sizes[i], use_bias=self.bias,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         name=f"layer_{i - 1}")(x)
+            # Activation follows every GEMM, the last included
+            # (ref: csrc/mlp.cpp epilogue; tests/L0/run_mlp/test_mlp.py
+            # builds Linear+ReLU pairs for all layers).
+            if self.activation == "relu":
+                x = jnp.maximum(x, 0)
+            elif self.activation == "sigmoid":
+                x = 1.0 / (1.0 + jnp.exp(-x))
+        return x

@@ -1,1 +1,5 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.normalization — fused normalization layers."""
+from .fused_layer_norm import (FusedLayerNorm, MixedFusedLayerNorm,
+                               fused_layer_norm)
+
+__all__ = ["FusedLayerNorm", "MixedFusedLayerNorm", "fused_layer_norm"]

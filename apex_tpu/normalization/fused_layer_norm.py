@@ -1,0 +1,72 @@
+"""FusedLayerNorm modules (TPU-native apex.normalization).
+
+Parity with the reference's module API
+(ref: apex/normalization/fused_layer_norm.py:15-218): ``FusedLayerNorm``
+(elementwise_affine optional) and ``MixedFusedLayerNorm`` (low-precision
+activations with fp32 gamma/beta, ref: fused_layer_norm.py:202).  Both
+are thin flax wrappers over the Pallas kernel in
+:mod:`apex_tpu.ops.layer_norm`; a pure-XLA fallback mirrors the
+reference's torch fallback when the extension is unavailable.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.layer_norm import layer_norm
+
+Shape = Union[int, Sequence[int]]
+
+
+def fused_layer_norm(x, weight=None, bias=None, eps: float = 1e-5):
+    """Functional form (ref: fused_layer_norm_affine / fused_layer_norm
+    autograd functions, apex/normalization/fused_layer_norm.py:15-96)."""
+    return layer_norm(x, weight, bias, eps)
+
+
+class FusedLayerNorm(nn.Module):
+    """Layer norm over the trailing ``normalized_shape`` dimensions.
+
+    Matches ``apex.normalization.FusedLayerNorm(normalized_shape, eps,
+    elementwise_affine)``; parameters are created in ``param_dtype``
+    (fp32 by default — set bf16 for a fully-low-precision layer).
+    """
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = self.normalized_shape
+        if isinstance(shape, int):
+            shape = (shape,)
+        hidden = 1
+        for s in shape:
+            hidden *= s
+        orig_shape = x.shape
+        if tuple(orig_shape[-len(shape):]) != tuple(shape):
+            raise ValueError(
+                f"input trailing dims {orig_shape[-len(shape):]} != "
+                f"normalized_shape {tuple(shape)}")
+        x2 = x.reshape(*orig_shape[:-len(shape)], hidden)
+        if self.elementwise_affine:
+            gamma = self.param("weight", nn.initializers.ones,
+                               (hidden,), self.param_dtype)
+            beta = self.param("bias", nn.initializers.zeros,
+                              (hidden,), self.param_dtype)
+        else:
+            gamma = beta = None
+        y = layer_norm(x2, gamma, beta, self.eps)
+        return y.reshape(orig_shape)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """bf16/fp16 activations with fp32 gamma/beta
+    (ref: apex/normalization/fused_layer_norm.py:202 MixedFusedLayerNorm;
+    kernel dispatch csrc/layer_norm_cuda.cpp:133-158)."""
+
+    param_dtype: jnp.dtype = jnp.float32

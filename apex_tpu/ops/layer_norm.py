@@ -1,0 +1,206 @@
+"""Pallas fused LayerNorm: forward + backward, fp32 statistics.
+
+TPU-native equivalent of ``fused_layer_norm_cuda``
+(ref: csrc/layer_norm_cuda_kernel.cu — ``cuApplyLayerNorm`` :332,
+``cuComputePartGradGammaBeta`` :428, ``cuComputeGradInput`` :547; host
+dispatch incl. the mixed-dtype paths csrc/layer_norm_cuda.cpp:133-158).
+
+Layout: inputs are reshaped to (rows, hidden); the grid tiles rows, each
+block normalizes its rows entirely in VMEM.  Statistics are always fp32
+(``MATH_T`` float in the reference) while inputs/outputs may be
+bf16/fp16; weights may be fp32 over low-precision activations — the
+"mixed" variant (ref: apex/normalization/fused_layer_norm.py:202
+``MixedFusedLayerNorm``).  Gamma/beta gradients are produced as per-block
+partials (the reference's part-grad two-stage reduction) and summed by
+XLA outside the kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_rows(hidden: int, dtype_bytes: int = 4) -> int:
+    # Aim for ~2 MiB per buffer per block, rows multiple of 8.
+    target = 2 * 1024 * 1024 // max(1, hidden * dtype_bytes)
+    return max(8, min(1024, (target // 8) * 8))
+
+
+# --- forward ---------------------------------------------------------------
+
+def _ln_fwd_kernel(eps: float, affine: bool, x_ref, *rest):
+    if affine:
+        g_ref, b_ref, y_ref, mean_ref, rstd_ref = rest
+    else:
+        y_ref, mean_ref, rstd_ref = rest
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    if affine:
+        y = xhat * g_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    else:
+        y = xhat
+    y_ref[:] = y.astype(y_ref.dtype)
+    mean_ref[:] = mean
+    rstd_ref[:] = rstd
+
+
+def _ln_forward(x2d, gamma, beta, eps, interpret=None):
+    rows, hidden = x2d.shape
+    br = _block_rows(hidden)
+    prows = -(-rows // br) * br
+    xp = jnp.pad(x2d, ((0, prows - rows), (0, 0))) if prows != rows else x2d
+
+    row_spec = pl.BlockSpec((br, hidden), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    affine = gamma is not None
+    in_specs = [row_spec]
+    args = [xp]
+    if affine:
+        w_spec = pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+        in_specs += [w_spec, w_spec]
+        args += [gamma.reshape(1, hidden), beta.reshape(1, hidden)]
+    y, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps, affine),
+        grid=(prows // br,),
+        in_specs=in_specs,
+        out_specs=[row_spec, stat_spec, stat_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((prows, hidden), x2d.dtype),
+            jax.ShapeDtypeStruct((prows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((prows, 1), jnp.float32),
+        ],
+        interpret=_interpret() if interpret is None else interpret,
+    )(*args)
+    return y[:rows], mean[:rows], rstd[:rows]
+
+
+# --- backward --------------------------------------------------------------
+
+def _ln_bwd_kernel(affine: bool, x_ref, *rest):
+    if affine:
+        (g_ref, dy_ref, mean_ref, rstd_ref,
+         dx_ref, dgamma_ref, dbeta_ref) = rest
+    else:
+        dy_ref, mean_ref, rstd_ref, dx_ref = rest
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    mean = mean_ref[:]
+    rstd = rstd_ref[:]
+    xhat = (x - mean) * rstd
+    if affine:
+        gdy = dy * g_ref[:].astype(jnp.float32)
+    else:
+        gdy = dy
+    # dx = rstd * (gdy - mean(gdy) - xhat * mean(gdy * xhat))
+    # (ref: cuComputeGradInput, csrc/layer_norm_cuda_kernel.cu:547).
+    m1 = jnp.mean(gdy, axis=1, keepdims=True)
+    m2 = jnp.mean(gdy * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (gdy - m1 - xhat * m2)).astype(dx_ref.dtype)
+    if affine:
+        # Per-block partial reductions over rows, folded into 8 sublane
+        # rows to satisfy TPU (8, lane) tiling; XLA sums the partials
+        # (ref: cuComputePartGradGammaBeta :428 two-stage reduction).
+        br, hidden = dy.shape
+        dgamma_ref[0] = jnp.sum((dy * xhat).reshape(br // 8, 8, hidden),
+                                axis=0)
+        dbeta_ref[0] = jnp.sum(dy.reshape(br // 8, 8, hidden), axis=0)
+
+
+def _ln_backward(x2d, gamma, dy2d, mean, rstd, interpret=None):
+    rows, hidden = x2d.shape
+    br = _block_rows(hidden)
+    prows = -(-rows // br) * br
+    pad = prows - rows
+
+    def padr(a):
+        return jnp.pad(a, ((0, pad), (0, 0))) if pad else a
+
+    row_spec = pl.BlockSpec((br, hidden), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    part_spec = pl.BlockSpec((1, 8, hidden), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    affine = gamma is not None
+    nblocks = prows // br
+    if affine:
+        w_spec = pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+        dx, dgamma_p, dbeta_p = pl.pallas_call(
+            functools.partial(_ln_bwd_kernel, True),
+            grid=(nblocks,),
+            in_specs=[row_spec, w_spec, row_spec, stat_spec, stat_spec],
+            out_specs=[row_spec, part_spec, part_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((prows, hidden), x2d.dtype),
+                jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+                jax.ShapeDtypeStruct((nblocks, 8, hidden), jnp.float32),
+            ],
+            interpret=_interpret() if interpret is None else interpret,
+        )(padr(x2d), gamma.reshape(1, hidden), padr(dy2d),
+          padr(mean), padr(rstd))
+        dgamma = jnp.sum(dgamma_p, axis=(0, 1)).astype(gamma.dtype)
+        dbeta = jnp.sum(dbeta_p, axis=(0, 1)).astype(gamma.dtype)
+        return dx[:rows], dgamma, dbeta
+    dx, = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, False),
+        grid=(nblocks,),
+        in_specs=[row_spec, row_spec, stat_spec, stat_spec],
+        out_specs=[row_spec],
+        out_shape=[jax.ShapeDtypeStruct((prows, hidden), x2d.dtype)],
+        interpret=_interpret() if interpret is None else interpret,
+    )(padr(x2d), padr(dy2d), padr(mean), padr(rstd))
+    return dx[:rows], None, None
+
+
+# --- public functional API with custom_vjp ---------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x: jnp.ndarray,
+               gamma: Optional[jnp.ndarray],
+               beta: Optional[jnp.ndarray],
+               eps: float = 1e-5) -> jnp.ndarray:
+    """Fused layer norm over the last dimension.
+
+    ``gamma``/``beta`` may be fp32 while ``x`` is bf16/fp16 (the
+    mixed-dtype variant, ref: csrc/layer_norm_cuda.cpp:133-158), or None
+    for the non-affine form.
+    """
+    return _layer_norm_fwd(x, gamma, beta, eps)[0]
+
+
+def _layer_norm_fwd(x, gamma, beta, eps):
+    shape = x.shape
+    hidden = shape[-1]
+    x2d = x.reshape(-1, hidden)
+    y, mean, rstd = _ln_forward(x2d, gamma, beta, eps)
+    return y.reshape(shape), (x2d, gamma, mean, rstd, shape)
+
+
+def _layer_norm_bwd(eps, res, dy):
+    x2d, gamma, mean, rstd, shape = res
+    dy2d = dy.reshape(x2d.shape)
+    dx, dgamma, dbeta = _ln_backward(x2d, gamma, dy2d, mean, rstd)
+    return dx.reshape(shape), dgamma, dbeta
+
+
+layer_norm.defvjp(lambda x, g, b, eps: _layer_norm_fwd(x, g, b, eps),
+                  _layer_norm_bwd)

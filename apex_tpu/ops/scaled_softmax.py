@@ -1,0 +1,176 @@
+"""Pallas scaled masked softmax (causal and padding-mask variants).
+
+TPU-native equivalent of the Megatron fused softmax kernels
+(ref: csrc/megatron/scaled_upper_triang_masked_softmax.h,
+scaled_masked_softmax.h; python wrappers
+apex/transformer/functional/fused_softmax.py:21-93).  Scale, mask and a
+numerically-stable fp32 softmax are fused into one VMEM pass; inputs may
+be bf16/fp16, math is fp32, output matches the input dtype.
+
+Backward uses the saved probabilities:
+``dx = scale * y * (dy - sum(dy * y))`` (ref: the *_backward kernels in
+the same headers).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+_NEG = -1e30
+
+
+def _block_rows(sk: int) -> int:
+    target = (1 * 1024 * 1024) // max(1, sk * 4)
+    return max(8, min(256, (target // 8) * 8))
+
+
+# --- causal (upper-triangular masked) --------------------------------------
+
+def _causal_fwd_kernel(scale, br, x_ref, y_ref):
+    i = pl.program_id(1)  # q-row block index within the sequence
+    x = x_ref[0].astype(jnp.float32) * scale
+    rows = i * br + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x = jnp.where(cols <= rows, x, _NEG)
+    x = x - jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[0] = (e / jnp.sum(e, axis=1, keepdims=True)).astype(y_ref.dtype)
+
+
+def _softmax_bwd_kernel(scale, y_ref, dy_ref, dx_ref):
+    y = y_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    s = jnp.sum(y * dy, axis=1, keepdims=True)
+    dx_ref[0] = (scale * y * (dy - s)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
+                                       scale: float = 1.0) -> jnp.ndarray:
+    """Causal softmax over (..., sq, sk) attention scores
+    (ref: ScaledUpperTriangMaskedSoftmax,
+    apex/transformer/functional/fused_softmax.py:21-42)."""
+    return _causal_fwd(x, scale)[0]
+
+
+def _causal_fwd(x, scale):
+    *lead, sq, sk = x.shape
+    b3 = 1
+    for d in lead:
+        b3 *= d
+    x3 = x.reshape(b3, sq, sk)
+    br = _block_rows(sk)
+    psq = -(-sq // br) * br
+    xp = jnp.pad(x3, ((0, 0), (0, psq - sq), (0, 0))) if psq != sq else x3
+    spec = pl.BlockSpec((1, br, sk), lambda b, i: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    y = pl.pallas_call(
+        functools.partial(_causal_fwd_kernel, scale, br),
+        grid=(b3, psq // br),
+        in_specs=[spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, x.dtype),
+        interpret=_interpret(),
+    )(xp)
+    y = y[:, :sq].reshape(*lead, sq, sk)
+    return y, y
+
+
+def _causal_bwd(scale, y, dy):
+    return (_softmax_backward(y, dy, scale),)
+
+
+def _softmax_backward(y, dy, scale):
+    *lead, sq, sk = y.shape
+    b3 = 1
+    for d in lead:
+        b3 *= d
+    y3 = y.reshape(b3, sq, sk)
+    dy3 = dy.reshape(b3, sq, sk)
+    br = _block_rows(sk)
+    psq = -(-sq // br) * br
+
+    def padq(a):
+        return jnp.pad(a, ((0, 0), (0, psq - sq), (0, 0))) \
+            if psq != sq else a
+
+    spec = pl.BlockSpec((1, br, sk), lambda b, i: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    dx = pl.pallas_call(
+        functools.partial(_softmax_bwd_kernel, scale),
+        grid=(b3, psq // br),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((b3, psq, sk), y.dtype),
+        interpret=_interpret(),
+    )(padq(y3), padq(dy3))
+    return dx[:, :sq].reshape(*lead, sq, sk)
+
+
+scaled_upper_triang_masked_softmax.defvjp(
+    lambda x, scale: _causal_fwd(x, scale), _causal_bwd)
+
+
+# --- general padding mask ---------------------------------------------------
+
+def _masked_fwd_kernel(scale, x_ref, m_ref, y_ref):
+    x = x_ref[0, 0].astype(jnp.float32) * scale
+    masked = m_ref[0, 0] != 0
+    x = jnp.where(masked, _NEG, x)
+    x = x - jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x)
+    y_ref[0, 0] = (e / jnp.sum(e, axis=1, keepdims=True)).astype(y_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scaled_masked_softmax(x: jnp.ndarray, mask: jnp.ndarray,
+                          scale: float = 1.0) -> jnp.ndarray:
+    """Softmax over (b, np, sq, sk) with a boolean padding mask
+    (b, 1, sq, sk); True/nonzero entries are masked out
+    (ref: ScaledMaskedSoftmax,
+    apex/transformer/functional/fused_softmax.py:67-93)."""
+    return _masked_fwd(x, mask, scale)[0]
+
+
+def _masked_fwd(x, mask, scale):
+    b, np_, sq, sk = x.shape
+    br = _block_rows(sk)
+    psq = -(-sq // br) * br
+
+    def padq(a):
+        return jnp.pad(a, ((0, 0), (0, 0), (0, psq - sq), (0, 0))) \
+            if psq != sq else a
+
+    mask_i = mask.astype(jnp.int32)
+    x_spec = pl.BlockSpec((1, 1, br, sk), lambda bi, ni, si: (bi, ni, si, 0),
+                          memory_space=pltpu.VMEM)
+    m_spec = pl.BlockSpec((1, 1, br, sk), lambda bi, ni, si: (bi, 0, si, 0),
+                          memory_space=pltpu.VMEM)
+    y = pl.pallas_call(
+        functools.partial(_masked_fwd_kernel, scale),
+        grid=(b, np_, psq // br),
+        in_specs=[x_spec, m_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((b, np_, psq, sk), x.dtype),
+        interpret=_interpret(),
+    )(padq(x), padq(mask_i))
+    y = y[:, :, :sq]
+    return y, y
+
+
+def _masked_bwd(scale, y, dy):
+    return _softmax_backward(y, dy, scale), None
+
+
+scaled_masked_softmax.defvjp(
+    lambda x, m, scale: _masked_fwd(x, m, scale), _masked_bwd)

@@ -1,0 +1,4 @@
+"""apex_tpu.transformer.functional — fused transformer ops."""
+from .fused_softmax import FusedScaleMaskSoftmax
+
+__all__ = ["FusedScaleMaskSoftmax"]

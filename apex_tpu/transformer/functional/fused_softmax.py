@@ -1,0 +1,103 @@
+"""FusedScaleMaskSoftmax — dispatching wrapper around the Pallas kernels.
+
+Parity with the reference's module
+(ref: apex/transformer/functional/fused_softmax.py:95-199): chooses the
+fused kernel when eligible, else a plain XLA softmax optionally computed
+in fp32 (``softmax_in_fp32``/``input_in_float16`` handling).  The
+reference's eligibility window (fp16/bf16, 16 < sk <= 2048, sq % 4 == 0,
+b*np % 4 == 0 — ref :151-170) exists because its CUDA kernels are
+shape-specialized; the Pallas kernels handle any shape, so here
+eligibility only requires a low-precision input (the fused path's reason
+to exist), with the same ``is_kernel_available`` introspection surface.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ...ops.scaled_softmax import (scaled_masked_softmax,
+                                   scaled_upper_triang_masked_softmax)
+from ..enums import AttnMaskType
+
+
+class FusedScaleMaskSoftmax:
+    """fused operation: scaling + mask + softmax
+    (ref: apex/transformer/functional/fused_softmax.py:95-199).
+
+    Arguments mirror the reference: ``input_in_fp16``/``input_in_bf16``,
+    ``attn_mask_type`` (padding|causal), ``scaled_masked_softmax_fusion``,
+    ``mask_func`` for the unfused fallback, ``softmax_in_fp32``, ``scale``.
+    """
+
+    def __init__(self,
+                 input_in_fp16: bool,
+                 input_in_bf16: bool,
+                 attn_mask_type: AttnMaskType,
+                 scaled_masked_softmax_fusion: bool,
+                 mask_func: Optional[Callable],
+                 softmax_in_fp32: bool,
+                 scale: Optional[float]):
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError(
+                "both fp16 and bf16 flags cannot be active at the same "
+                "time (ref: fused_softmax.py:118-120)")
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError(
+                "softmax should be in fp32 when scaled "
+                "(ref: fused_softmax.py:128-130)")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Fused-path eligibility (ref: fused_softmax.py:151-170; the CUDA
+        shape window is not needed for Pallas)."""
+        return bool(self.scaled_masked_softmax_fusion
+                    and self.input_in_float16
+                    and sk > 1)
+
+    def __call__(self, inputs: jnp.ndarray,
+                 mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+        b, np_, sq, sk = inputs.shape
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            return self.forward_fused_softmax(inputs, mask)
+        return self.forward_jax_softmax(inputs, mask)
+
+    def forward_fused_softmax(self, inputs, mask):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            b, np_, sq, sk = inputs.shape
+            assert sq == sk, "causal mask is only for self attention"
+            probs = scaled_upper_triang_masked_softmax(
+                inputs.reshape(-1, sq, sk), scale)
+            return probs.reshape(b, np_, sq, sk)
+        if mask is not None:
+            return scaled_masked_softmax(inputs, mask, scale)
+        return scaled_masked_softmax(
+            inputs, jnp.zeros((b, 1, sq, sk), jnp.int32), scale)
+
+    def forward_jax_softmax(self, inputs, mask):
+        """Unfused fallback (ref: forward_torch_softmax,
+        fused_softmax.py:176-194)."""
+        orig_dtype = inputs.dtype
+        if self.input_in_float16 and self.softmax_in_fp32:
+            inputs = inputs.astype(jnp.float32)
+        if self.scale is not None:
+            inputs = inputs * self.scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            sq, sk = inputs.shape[-2:]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            inputs = jnp.where(causal, inputs, -10000.0)
+        elif mask is not None and self.mask_func is not None:
+            inputs = self.mask_func(inputs, mask)
+        probs = jnp.exp(inputs - jnp.max(inputs, -1, keepdims=True))
+        probs = probs / jnp.sum(probs, -1, keepdims=True)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(orig_dtype)
+        return probs

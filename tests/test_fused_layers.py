@@ -1,0 +1,189 @@
+"""Fused-layer parity tests: softmax, xentropy, MLP, FusedDense.
+
+Reference patterns: tests/L0/run_transformer/test_fused_softmax.py
+(kernel vs torch softmax), tests/L0/run_mlp/test_mlp.py (MLP vs
+nn.Sequential), contrib label-smoothing tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.fused_dense import FusedDense, FusedDenseGeluDense
+from apex_tpu.mlp import MLP
+from apex_tpu.ops.scaled_softmax import (scaled_masked_softmax,
+                                         scaled_upper_triang_masked_softmax)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+
+
+# --- scaled softmax kernels -------------------------------------------------
+
+def ref_causal_softmax(x, scale):
+    x = x.astype(jnp.float32) * scale
+    sq, sk = x.shape[-2:]
+    mask = jnp.tril(jnp.ones((sq, sk), bool))
+    x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_causal_softmax_parity(dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 24, 24), dtype) * 4
+    got = scaled_upper_triang_masked_softmax(x, 0.5)
+    want = ref_causal_softmax(x, 0.5).astype(dtype)
+    tol = 1e-6 if dtype == jnp.float32 else 1e-2
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32), atol=tol)
+    assert got.dtype == dtype
+
+
+def test_causal_softmax_grad():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16)) * 2
+
+    def f_fused(x):
+        return jnp.sum(scaled_upper_triang_masked_softmax(x, 2.0) ** 2)
+
+    def f_ref(x):
+        return jnp.sum(ref_causal_softmax(x, 2.0) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f_fused)(x)),
+                               np.asarray(jax.grad(f_ref)(x)),
+                               rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_masked_softmax_parity(dtype):
+    b, np_, sq, sk = 3, 4, 8, 40
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, np_, sq, sk), dtype)
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (b, 1, sq, sk))
+    # never mask everything in a row
+    mask = mask.at[..., 0].set(False)
+    got = scaled_masked_softmax(x, mask, 1.3)
+    xm = jnp.where(mask, -1e30, x.astype(jnp.float32) * 1.3)
+    want = jax.nn.softmax(xm, axis=-1)
+    tol = 1e-6 if dtype == jnp.float32 else 1e-2
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=tol)
+
+
+def test_masked_softmax_grad():
+    b, np_, sq, sk = 2, 2, 8, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, np_, sq, sk))
+    mask = jnp.zeros((b, 1, sq, sk), bool).at[..., -3:].set(True)
+
+    def f_fused(x):
+        return jnp.sum(jnp.cos(scaled_masked_softmax(x, mask, 1.0)))
+
+    def f_ref(x):
+        xm = jnp.where(mask, -1e30, x)
+        return jnp.sum(jnp.cos(jax.nn.softmax(xm, -1)))
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f_fused)(x)),
+                               np.asarray(jax.grad(f_ref)(x)),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fused_scale_mask_softmax_dispatch():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 16, 16),
+                          jnp.bfloat16)
+    m = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True,
+        attn_mask_type=AttnMaskType.causal,
+        scaled_masked_softmax_fusion=True, mask_func=None,
+        softmax_in_fp32=True, scale=2.0)
+    assert m.is_kernel_available(None, 2, 4, 16, 16)
+    out = m(x, None)
+    assert out.shape == x.shape and out.dtype == jnp.bfloat16
+    # fallback path agrees
+    m2 = FusedScaleMaskSoftmax(
+        input_in_fp16=False, input_in_bf16=True,
+        attn_mask_type=AttnMaskType.causal,
+        scaled_masked_softmax_fusion=False, mask_func=None,
+        softmax_in_fp32=True, scale=2.0)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(m2(x, None), np.float32),
+                               atol=2e-2)
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(True, True, AttnMaskType.causal, True, None,
+                              True, None)
+
+
+# --- xentropy ---------------------------------------------------------------
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_xentropy_parity(smoothing):
+    V = 50
+    logits = jax.random.normal(jax.random.PRNGKey(0), (12, V)) * 3
+    labels = jax.random.randint(jax.random.PRNGKey(1), (12,), 0, V)
+    got = softmax_cross_entropy_loss(logits, labels, smoothing)
+    logp = jax.nn.log_softmax(logits)
+    target = (1 - smoothing) * jax.nn.one_hot(labels, V) + smoothing / V
+    want = -jnp.sum(target * logp, -1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_xentropy_grad_matches_softmax_minus_target():
+    V = 20
+    logits = jax.random.normal(jax.random.PRNGKey(0), (5, V))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (5,), 0, V)
+    g = jax.grad(lambda l: jnp.sum(
+        softmax_cross_entropy_loss(l, labels, 0.1)))(logits)
+    target = 0.9 * jax.nn.one_hot(labels, V) + 0.1 / V
+    want = jax.nn.softmax(logits) - target
+    np.testing.assert_allclose(np.asarray(g), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_xentropy_bf16_half_to_float():
+    V = 30
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, V), jnp.bfloat16)
+    labels = jnp.zeros((4,), jnp.int32)
+    out = softmax_cross_entropy_loss(logits, labels, 0.0, half_to_float=True)
+    assert out.dtype == jnp.float32
+    out2 = softmax_cross_entropy_loss(logits, labels)
+    assert out2.dtype == jnp.bfloat16
+
+
+# --- MLP / FusedDense -------------------------------------------------------
+
+def test_mlp_matches_sequential_reference():
+    # ref: tests/L0/run_mlp/test_mlp.py — Linear+ReLU pairs for each layer.
+    sizes = [48, 64, 32, 1]
+    mlp = MLP(mlp_sizes=sizes)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (16, 48), minval=-1,
+                           maxval=1)
+    params = mlp.init(jax.random.PRNGKey(1), x)
+    got = mlp.apply(params, x)
+
+    h = x
+    for i in range(len(sizes) - 1):
+        lp = params["params"][f"layer_{i}"]
+        h = jnp.maximum(h @ lp["kernel"] + lp["bias"], 0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(h),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mlp_validation():
+    with pytest.raises(TypeError):
+        MLP(mlp_sizes=[4, 4], activation="tanh").init(
+            jax.random.PRNGKey(0), jnp.ones((2, 4)))
+
+
+def test_fused_dense_gelu_dense():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 32), jnp.bfloat16)
+    mod = FusedDenseGeluDense(intermediate_features=64, out_features=16)
+    params = mod.init(jax.random.PRNGKey(1), x)
+    y = mod.apply(params, x)
+    assert y.shape == (8, 16) and y.dtype == jnp.bfloat16
+
+    d1 = params["params"]["dense1"]
+    h = x.astype(jnp.float32) @ d1["kernel"] + d1["bias"]
+    h = jax.nn.gelu(h, approximate=False)
+    d2 = params["params"]["dense2"]
+    want = h.astype(jnp.bfloat16).astype(jnp.float32) @ d2["kernel"] \
+        + d2["bias"]
+    np.testing.assert_allclose(np.asarray(y, np.float32), want,
+                               rtol=5e-2, atol=5e-2)

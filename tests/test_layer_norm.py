@@ -1,0 +1,93 @@
+"""LayerNorm kernel-vs-reference parity (ref pattern:
+tests/L0/run_fused_layer_norm — fused vs torch.nn.LayerNorm)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import FusedLayerNorm, MixedFusedLayerNorm
+from apex_tpu.ops.layer_norm import layer_norm
+
+
+def ref_ln(x, g, b, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.var(x32, -1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    if g is not None:
+        y = y * g.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+@pytest.mark.parametrize("hidden", [128, 384, 1024])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_forward_parity(hidden, dtype):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(k1, (6, 17, hidden), dtype) * 3 + 1
+    g = jax.random.normal(k2, (hidden,), jnp.float32)
+    b = jax.random.normal(k3, (hidden,), jnp.float32)
+    got = layer_norm(x, g, b)
+    want = ref_ln(x, g, b)
+    tol = 1e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+    assert got.dtype == dtype
+
+
+def test_forward_no_affine():
+    x = jax.random.normal(jax.random.PRNGKey(0), (5, 256))
+    np.testing.assert_allclose(np.asarray(layer_norm(x, None, None)),
+                               np.asarray(ref_ln(x, None, None)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_grad_parity(dtype):
+    hidden = 256
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    x = jax.random.normal(ks[0], (4, 9, hidden), dtype)
+    g = jax.random.normal(ks[1], (hidden,), jnp.float32)
+    b = jax.random.normal(ks[2], (hidden,), jnp.float32)
+
+    def loss_fused(x, g, b):
+        return jnp.sum(jnp.sin(layer_norm(x, g, b).astype(jnp.float32)))
+
+    def loss_ref(x, g, b):
+        return jnp.sum(jnp.sin(ref_ln(x, g, b).astype(jnp.float32)))
+
+    gx, gg, gb = jax.grad(loss_fused, argnums=(0, 1, 2))(x, g, b)
+    rx, rg, rb = jax.grad(loss_ref, argnums=(0, 1, 2))(x, g, b)
+    tol = 1e-4 if dtype == jnp.float32 else 5e-2
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32), rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(gg), np.asarray(rg),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=tol, atol=tol)
+    assert gx.dtype == dtype
+    assert gg.dtype == jnp.float32  # mixed: fp32 weight grads
+
+
+def test_module_and_mixed():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 10, 192), jnp.bfloat16)
+    mod = MixedFusedLayerNorm(normalized_shape=192)
+    params = mod.init(jax.random.PRNGKey(1), x)
+    assert params["params"]["weight"].dtype == jnp.float32
+    y = mod.apply(params, x)
+    assert y.shape == x.shape and y.dtype == jnp.bfloat16
+
+    mod2 = FusedLayerNorm(normalized_shape=192, elementwise_affine=False)
+    p2 = mod2.init(jax.random.PRNGKey(1), x)
+    assert not p2.get("params")
+    assert mod2.apply(p2, x).shape == x.shape
+
+
+def test_module_multidim_normalized_shape():
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 4, 8, 16))
+    mod = FusedLayerNorm(normalized_shape=(8, 16))
+    params = mod.init(jax.random.PRNGKey(1), x)
+    y = mod.apply(params, x)
+    # rows normalized over the flattened (8,16) trailing dims
+    np.testing.assert_allclose(
+        np.asarray(jnp.mean(y.reshape(3, 4, -1), -1)), 0.0, atol=1e-5)
