@@ -1,0 +1,340 @@
+"""Pallas flash attention (forward + backward), bf16-first.
+
+TPU-native successor to the reference's fused attention kernels: FMHA
+(ref: apex/contrib/csrc/fmha — sm80, seqlen <= 512, head dim 64) and the
+fast_multihead_attn family (ref: apex/contrib/csrc/multihead_attn).
+Blockwise online-softmax attention removes both the O(s^2)
+materialization (the reference's core attention materializes
+[b, np, sq, sk], ref: apex/transformer/testing/standalone_gpt.py) and
+the shape caps: any sq/sk (padded to block multiples), head dim 64-256,
+causal or full attention.
+
+Layout: q (b, h, sq, d), k/v (b, h, sk, d).  Grid (b*h, q-blocks,
+k-blocks), k innermost: VMEM scratch carries the running max, sum and
+accumulator across k-blocks (TPU grids iterate sequentially, so scratch
+is a legal carry).  Matmuls hit the MXU in the input dtype with fp32
+accumulation; softmax math is fp32.
+
+Backward is the standard two-kernel flash backward: a dq pass (grid over
+q-blocks, accumulate over k) and a dk/dv pass (grid over k-blocks,
+accumulate over q), both recomputing probabilities from the saved
+per-row logsumexp.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+# Tuned on v5e (causal, s=2048, d=64): large blocks amortize grid and
+# bookkeeping overhead; (512, 1024) balances VMEM against the best
+# measured (1024, 1024) configuration.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot(a, b, trans_b=False):
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims,
+                               preferred_element_type=jnp.float32)
+
+
+# --- forward ---------------------------------------------------------------
+
+def _fwd_kernel(scale, causal, sq, sk, bq, bk,
+                q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_sc, l_sc):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[:] = jnp.zeros_like(acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = _dot(q, k, trans_b=True) * scale          # (bq, bk) fp32
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < sk
+        if causal:
+            mask &= q_pos >= k_pos
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_sc[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
+        m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        lse = m_sc[:, :1] + jnp.log(l)
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                         lse_ref.shape[2:])
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, max(8, sq))
+    bk = min(block_k, max(128, sk))
+    q3 = _pad_to(q.reshape(b * h, sq, d), 1, bq)
+    k3 = _pad_to(k.reshape(b * h, sk, d), 1, bk)
+    v3 = _pad_to(v.reshape(b * h, sk, d), 1, bk)
+    bh, psq, _ = q3.shape
+    psk = k3.shape[1]
+    nq, nk = psq // bq, psk // bk
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0),
+                          memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    o, lse8 = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale, causal, sq, sk, bq, bk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec],
+        out_specs=[q_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
+    return o[:, :sq].reshape(b, h, sq, d), lse
+
+
+# --- backward --------------------------------------------------------------
+
+def _bwd_dq_kernel(scale, causal, sq, sk, bq, bk,
+                   q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = _dot(q, k, trans_b=True) * scale
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < sk
+        if causal:
+            mask &= q_pos >= k_pos
+        lse = lse_ref[0, 0, 0, :][:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = _dot(do_ref[0], v_ref[0], trans_b=True)
+        delta = delta_ref[0, 0, 0, :][:, None]
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += _dot(ds.astype(k.dtype), k)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(scale, causal, sq, sk, bq, bk,
+                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc):
+    i = pl.program_id(1)   # k block
+    j = pl.program_id(2)   # q block
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (j * bq + bq - 1 >= i * bk) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = _dot(q, k, trans_b=True) * scale          # (bq, bk)
+        q_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (k_pos < sk) & (q_pos < sq)
+        if causal:
+            mask &= q_pos >= k_pos
+        lse = lse_ref[0, 0, 0, :][:, None]
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        do = do_ref[0]
+        dv_acc[:] += _dot(p.astype(do.dtype).T, do)
+        dp = _dot(do, v_ref[0], trans_b=True)
+        delta = delta_ref[0, 0, 0, :][:, None]
+        ds = p * (dp - delta) * scale                 # (bq, bk)
+        dk_acc[:] += _dot(ds.astype(q.dtype).T, q)
+
+    @pl.when(j == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _rows8(x2d, bq):
+    """(bh, rows) -> (bh, rows/bq, 8, bq) sublane-replicated view."""
+    bh, rows = x2d.shape
+    return jnp.broadcast_to(
+        x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, max(8, sq))
+    bk = min(block_k, max(128, sk))
+    q3 = _pad_to(q.reshape(b * h, sq, d), 1, bq)
+    k3 = _pad_to(k.reshape(b * h, sk, d), 1, bk)
+    v3 = _pad_to(v.reshape(b * h, sk, d), 1, bk)
+    do3 = _pad_to(do.reshape(b * h, sq, d), 1, bq)
+    bh, psq, _ = q3.shape
+    psk = k3.shape[1]
+    nq, nk = psq // bq, psk // bk
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(bh, sq)
+    delta = _pad_to(delta, 1, bq)
+    lse_p = _pad_to(lse, 1, bq)
+    lse8 = _rows8(lse_p, bq)
+    delta8 = _rows8(delta, bq)
+
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec_j = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0),
+                            memory_space=pltpu.VMEM)
+    r_spec_i = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale, causal, sq, sk, bq, bk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
+                  r_spec_i],
+        out_specs=q_spec_i,
+        out_shape=jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse8, delta8)
+
+    q_spec_j = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, j, 0),
+                            memory_space=pltpu.VMEM)
+    k_spec_i = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, i, 0),
+                            memory_space=pltpu.VMEM)
+    r_spec_j = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, j, 0, 0),
+                            memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale, causal, sq, sk, bq, bk),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
+                  r_spec_j],
+        out_specs=[k_spec_i, k_spec_i],
+        out_shape=[jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, psk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse8, delta8)
+
+    return (dq[:, :sq].reshape(b, h, sq, d),
+            dk[:, :sk].reshape(b, h, sk, d),
+            dv[:, :sk].reshape(b, h, sk, d))
+
+
+# --- public API ------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    scale: Optional[float] = None,
+                    causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+    """Fused attention: softmax(q k^T * scale [causal-masked]) v.
+
+    Shapes: q (b, h, sq, d); k, v (b, h, sk, d).  ``scale`` defaults to
+    1/sqrt(d).  Supersedes the reference's FMHA (seqlen<=512 cap,
+    ref: setup.py:408-424) and fast_multihead_attn kernels.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)[0]
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    if scale is None:
+        scale = res[0].shape[-1] ** -0.5
+    return _flash_bwd(scale, causal, block_q, block_k, res, do)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def mha_reference(q, k, v, scale=None, causal=False):
+    """Unfused reference (the [b,h,sq,sk]-materializing baseline the
+    reference's standalone GPT uses) — for parity tests and benchmarks."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,1 +1,4 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.testing — test/bench harness (ref: apex/transformer/testing)."""
+from .timing import bench_chained
+
+__all__ = ["bench_chained"]
