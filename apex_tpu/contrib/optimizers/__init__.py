@@ -1,0 +1,8 @@
+"""ZeRO-style distributed fused optimizers (ref: apex/contrib/optimizers)."""
+from .distributed_fused_adam import (DistributedFusedAdam,
+                                     distributed_fused_adam)
+from .distributed_fused_lamb import (DistributedFusedLAMB,
+                                     distributed_fused_lamb)
+
+__all__ = ["distributed_fused_adam", "DistributedFusedAdam",
+           "distributed_fused_lamb", "DistributedFusedLAMB"]

@@ -1,1 +1,16 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.parallel — data parallelism (TPU-native apex.parallel).
+
+Gradient sync with apex-DDP knob parity, SyncBatchNorm with psum'd
+Welford statistics, LARC, multi-host bootstrap.  See SURVEY.md §2.3.
+"""
+from .distributed import (DistributedDataParallel, allreduce_params,
+                          sync_gradients)
+from .LARC import LARC, larc
+from .multiproc import initialize_distributed
+from .sync_batchnorm import SyncBatchNorm, convert_syncbn_model
+
+__all__ = [
+    "DistributedDataParallel", "sync_gradients", "allreduce_params",
+    "SyncBatchNorm", "convert_syncbn_model", "LARC", "larc",
+    "initialize_distributed",
+]

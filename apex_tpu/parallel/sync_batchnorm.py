@@ -1,0 +1,122 @@
+"""SyncBatchNorm — cross-device batch normalization over the data axis.
+
+TPU-native equivalent of the reference's two implementations
+(ref: apex/parallel/sync_batchnorm.py:9-134 python fallback;
+apex/parallel/optimized_sync_batchnorm.py:85 +
+optimized_sync_batchnorm_kernel.py:10-119 CUDA Welford path, kernels
+csrc/welford.cu).  Statistics are merged across devices with a single
+``psum`` of (count, sum, sum-of-squares) — algebraically identical to
+the reference's Welford-merge (``welford_parallel``) but in XLA's
+preferred reduction form; the backward's (sum_dy, sum_dy_xmu)
+all-reduce (ref: optimized_sync_batchnorm_kernel.py:94-111) falls out
+of autodiff transposing the psum.
+
+Channels-last is the native TPU layout (the reference's opt-in
+``channel_last=True``); ``fuse_relu`` matches the kernel's fused
+activation epilogue.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+
+
+def _maybe_psum(x, axis_name):
+    """psum when the axis is bound; local value otherwise (module init and
+    single-device evaluation run outside shard_map — the reference's
+    SyncBN likewise degrades to local BN without torch.distributed)."""
+    try:
+        return jax.lax.psum(x, axis_name)
+    except NameError:
+        return x
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in ``BatchNorm`` whose batch statistics span the data axis.
+
+    Matches ``apex.parallel.SyncBatchNorm(num_features, eps, momentum,
+    affine, track_running_stats, process_group, channel_last,
+    fuse_relu)``; ``axis_name=None`` degrades to local batch norm (the
+    reference outside ``torch.distributed`` init).  Input layout is
+    channels-last (..., C).
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = parallel_state.DATA_AXIS
+    fuse_relu: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        if x.shape[-1] != self.num_features:
+            raise ValueError(
+                f"expected trailing channel dim {self.num_features}, got "
+                f"{x.shape}")
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((self.num_features,),
+                                                  jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((self.num_features,),
+                                                jnp.float32))
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            x32 = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            count = jnp.float32(1.0)
+            for a in axes:
+                count = count * x.shape[a]
+            s1 = jnp.sum(x32, axes)
+            s2 = jnp.sum(x32 * x32, axes)
+            if self.axis_name is not None:
+                # Chan merge of per-device Welford stats == psum of raw
+                # moments (ref: welford_parallel, csrc/welford.cu:597).
+                count = _maybe_psum(count, self.axis_name)
+                s1 = _maybe_psum(s1, self.axis_name)
+                s2 = _maybe_psum(s2, self.axis_name)
+            mean = s1 / count
+            var = s2 / count - mean * mean  # biased, as in the forward
+            if self.track_running_stats and not self.is_initializing():
+                # unbiased var for the running estimate
+                # (ref: optimized_sync_batchnorm_kernel.py:53-56).
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            weight = self.param("weight", nn.initializers.ones,
+                                (self.num_features,), self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.num_features,), self.param_dtype)
+            y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(norm_factory=None, axis_name=parallel_state.DATA_AXIS):
+    """Return a norm-layer factory producing :class:`SyncBatchNorm`.
+
+    The reference walks a live module tree replacing ``BatchNorm*``
+    instances (ref: apex/parallel/__init__.py:42-95); flax modules are
+    declarative, so conversion happens at model construction: models in
+    :mod:`apex_tpu.models` accept a ``norm_factory`` and this helper
+    supplies the synchronized one.
+    """
+    del norm_factory
+
+    def factory(num_features, **kw):
+        kw.setdefault("axis_name", axis_name)
+        return SyncBatchNorm(num_features=num_features, **kw)
+
+    return factory
