@@ -50,7 +50,7 @@ def distributed_fused_adam(
         bias_correction: bool = True,
         axis_name: str = "data",
         grad_average: bool = True,
-        use_pallas: bool = True) -> optax.GradientTransformation:
+        use_pallas: bool = None) -> optax.GradientTransformation:
     """Build the sharded transformation.  ``update`` receives *local*
     (unreduced) gradients — the reduce is fused into the scatter."""
 
@@ -65,6 +65,8 @@ def distributed_fused_adam(
             m=shards, v=tuple(jnp.zeros_like(s) for s in shards))
 
     def update(grads, state, params=None):
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("distributed_fused_adam requires params")
         world = jax.lax.axis_size(axis_name)
@@ -98,7 +100,7 @@ def distributed_fused_adam(
             if padded != meta.padded:
                 p = jnp.pad(p, (0, padded - meta.padded))
             p_shard = jax.lax.dynamic_slice_in_dim(p, rank * shard, shard)
-            if use_pallas:
+            if fused:
                 d, m, v = fused_optim.adam_update(
                     g_shard, p_shard, state.m[i], state.v[i],
                     lr=lr, beta1=beta1, beta2=beta2, eps=eps,

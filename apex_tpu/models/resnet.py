@@ -1,0 +1,100 @@
+"""ResNet family (v1.5) — the reference's canonical amp workload.
+
+The reference drives torchvision's ResNet-50 through amp + DDP + SyncBN
+(ref: examples/imagenet/main_amp.py); this is the equivalent flax model,
+channels-last (native TPU layout), with an injectable ``norm_factory``
+so ``apex_tpu.parallel.convert_syncbn_model`` can swap synchronized
+batch norm in at construction (the reference converts the module tree,
+ref: apex/parallel/__init__.py:42-95).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _default_norm(num_features: int, **kw):
+    # Local (non-synchronized) batch norm in fp32.
+    from ..parallel.sync_batchnorm import SyncBatchNorm
+    kw.setdefault("axis_name", None)
+    return SyncBatchNorm(num_features=num_features, **kw)
+
+
+class Bottleneck(nn.Module):
+    """ResNet v1.5 bottleneck: stride lives in the 3x3 conv."""
+
+    features: int
+    stride: int = 1
+    downsample: bool = False
+    norm_factory: Callable = _default_norm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = self.norm_factory(self.features)(
+            y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.stride, self.stride),
+                 name="conv2")(y)
+        y = self.norm_factory(self.features)(
+            y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = self.norm_factory(self.features * 4)(
+            y, use_running_average=not train)
+        if self.downsample:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.stride, self.stride),
+                            name="downsample_conv")(x)
+            residual = self.norm_factory(self.features * 4)(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    norm_factory: Callable = _default_norm
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = self.norm_factory(self.width)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            features = self.width * (2 ** stage)
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                y = Bottleneck(features=features, stride=stride,
+                               downsample=(block == 0),
+                               norm_factory=self.norm_factory,
+                               dtype=self.dtype,
+                               name=f"stage{stage + 1}_block{block}")(
+                    y, train=train)
+        y = jnp.mean(y, axis=(1, 2))
+        # Classifier head in fp32 for a stable loss.
+        y = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="fc")(y.astype(jnp.float32))
+        return y
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), **kw)

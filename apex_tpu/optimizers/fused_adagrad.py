@@ -19,7 +19,7 @@ class FusedAdagradState(NamedTuple):
 def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
                   eps: float = 1e-10,
                   weight_decay: float = 0.0,
-                  use_pallas: bool = True) -> optax.GradientTransformation:
+                  use_pallas: bool = None) -> optax.GradientTransformation:
     def init(params):
         metas = multi_tensor.compute_metas(params)
         return FusedAdagradState(
@@ -27,6 +27,8 @@ def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
             h=tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas))
 
     def update(grads, state, params=None):
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_adagrad requires params in update()")
         count = state.count + 1
@@ -36,7 +38,7 @@ def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
         pbufs = multi_tensor.pack(params, metas)
         deltas, new_h = [], []
         for i, meta in enumerate(metas):
-            if use_pallas:
+            if fused:
                 d, h = fused_optim.adagrad_update(
                     gbufs[i], pbufs[i], state.h[i],
                     lr=lr, eps=eps, weight_decay=weight_decay)

@@ -42,7 +42,7 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
                weight_decay: float = 0.0,
                adam_w_mode: bool = True,
                bias_correction: bool = True,
-               use_pallas: bool = True) -> optax.GradientTransformation:
+               use_pallas: bool = None) -> optax.GradientTransformation:
     """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4)."""
 
     def init(params):
@@ -53,6 +53,8 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
                                                for z in zeros))
 
     def update(grads, state, params=None):
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_adam requires params in update()")
         count = state.count + 1
@@ -69,7 +71,7 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
         pbufs = multi_tensor.pack(params, metas)
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            if use_pallas:
+            if fused:
                 d, m, v = fused_optim.adam_update(
                     gbufs[i], pbufs[i], state.m[i], state.v[i],
                     lr=lr, beta1=beta1, beta2=beta2, eps=eps,

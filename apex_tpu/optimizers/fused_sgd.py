@@ -31,7 +31,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
               weight_decay: float = 0.0,
               nesterov: bool = False,
               wd_after_momentum: bool = False,
-              use_pallas: bool = True) -> optax.GradientTransformation:
+              use_pallas: bool = None) -> optax.GradientTransformation:
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError(
             "Nesterov momentum requires a momentum and zero dampening "
@@ -45,6 +45,8 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
                            for m in metas))
 
     def update(grads, state, params=None):
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_sgd requires params in update()")
         count = state.count + 1
@@ -63,7 +65,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
                 g = g + weight_decay * p32
                 deltas.append((-lr * g).astype(meta.dtype))
                 new_mom.append(state.momentum[i])
-            elif use_pallas:
+            elif fused:
                 d, mom = fused_optim.sgd_update(
                     gbufs[i], pbufs[i], state.momentum[i],
                     lr=lr, momentum=momentum, dampening=dampening,

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 O5 (bf16 + fp32 masters) training
+throughput on the local accelerator.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+``vs_baseline`` is measured images/sec divided by 2500 — a published
+A100 ResNet-50 AMP training throughput (NVIDIA NGC resnet50 v1.5
+benchmarks, single A100, mixed precision), the north-star comparison
+point in BASELINE.json ("within 10% of A100 images/sec/chip").
+
+The train step is the full framework path: apex_tpu.amp O5 policy,
+fused SGD (Pallas), SyncBatchNorm stats, fused cross-entropy.
+Iterations are naturally chained through params, and completion is
+forced with a value fetch (async dispatch under-reports otherwise).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp, parallel_state
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.models.resnet import ResNet50
+from apex_tpu.optimizers import fused_sgd
+
+A100_BASELINE_IPS = 2500.0
+
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+IMAGE = 224
+WARMUP = 3
+ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+
+
+def main():
+    if not parallel_state.model_parallel_is_initialized():
+        parallel_state.initialize_model_parallel()
+    n_dev = parallel_state.get_world_size()
+
+    policy = amp.get_policy("O5")
+    model = ResNet50(num_classes=1000, dtype=policy.compute_dtype)
+    key = jax.random.PRNGKey(0)
+    variables = jax.jit(model.init, static_argnames="train")(
+        key, jnp.zeros((2, IMAGE, IMAGE, 3), policy.compute_dtype),
+        train=True)
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_sgd(0.1, momentum=0.9,
+                                       weight_decay=1e-4),
+        opt_level=policy)
+    batch_stats = variables["batch_stats"]
+
+    images = jax.random.normal(jax.random.PRNGKey(1),
+                               (BATCH, IMAGE, IMAGE, 3),
+                               policy.compute_dtype)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
+
+    @jax.jit
+    def train_step(params, batch_stats, amp_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = jnp.mean(softmax_cross_entropy_loss(
+                logits, labels, half_to_float=True))
+            return amp_opt.scale_loss(loss, amp_state), (loss, mutated)
+
+        grads, (loss, mutated) = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_amp_state, _ = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, mutated["batch_stats"], new_amp_state, loss
+
+    mesh = parallel_state.get_mesh()
+    with mesh:
+        p, bs, st = params, batch_stats, amp_state
+        for _ in range(WARMUP):
+            p, bs, st, loss = train_step(p, bs, st, images, labels)
+        float(loss)  # force completion of warmup
+        t0 = time.time()
+        for _ in range(ITERS):
+            p, bs, st, loss = train_step(p, bs, st, images, labels)
+        float(loss)  # force completion
+        dt = time.time() - t0
+
+    ips = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": f"resnet50_o5_train_images_per_sec_{n_dev}chip",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / A100_BASELINE_IPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
