@@ -1,1 +1,38 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Tensor (model) parallelism — Megatron-parity layers over a mesh axis.
+
+TPU-native re-design of ``apex.transformer.tensor_parallel``: the
+collective algebra (ref: mappings.py), sharded layers (ref: layers.py),
+vocab-parallel cross entropy (ref: cross_entropy.py), RNG domains
+(ref: random.py), and supporting utilities — expressed as GSPMD
+partitioning metadata + explicit ``shard_map`` collectives instead of
+NCCL process groups.
+"""
+from .cross_entropy import vocab_parallel_cross_entropy
+from .data import broadcast_data
+from .layers import (ColumnParallelLinear, RowParallelLinear,
+                     VocabParallelEmbedding, param_sharding_specs)
+from .mappings import (copy_to_tensor_model_parallel_region,
+                       gather_from_tensor_model_parallel_region,
+                       reduce_from_tensor_model_parallel_region,
+                       scatter_to_tensor_model_parallel_region)
+from .memory import MemoryBuffer, RingMemBuffer
+from .random import (CHECKPOINT_POLICIES, RNGStatesTracker, checkpoint,
+                     get_rng_tracker, model_parallel_rng_key,
+                     model_parallel_seed)
+from .utils import (VocabUtility, divide, ensure_divisibility,
+                    split_tensor_along_last_dim)
+
+__all__ = [
+    "vocab_parallel_cross_entropy", "broadcast_data",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "param_sharding_specs",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "MemoryBuffer", "RingMemBuffer",
+    "CHECKPOINT_POLICIES", "RNGStatesTracker", "checkpoint",
+    "get_rng_tracker", "model_parallel_rng_key", "model_parallel_seed",
+    "VocabUtility", "divide", "ensure_divisibility",
+    "split_tensor_along_last_dim",
+]

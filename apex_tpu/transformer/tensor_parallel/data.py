@@ -1,0 +1,42 @@
+"""Tensor-parallel data broadcast.
+
+Parity with the reference's ``broadcast_data``
+(ref: apex/transformer/tensor_parallel/data.py:77-113), which moves the
+batch from TP-rank-0 to all TP ranks so every shard of a layer sees the
+same tokens.  JAX is single-controller/SPMD: one logical batch array is
+*already* visible to every shard, so the broadcast is the identity — what
+remains useful is the reference's validation (consistent keys, one dtype)
+and the dtype coercion, which are kept so user code ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+
+
+def _check_data_types(keys: Sequence[str], data: Dict, target_dtype) -> None:
+    """ref: data.py:17-23 — every broadcast tensor must share one dtype.
+
+    Checked on the *input* values (numpy view) so the outcome does not
+    depend on the jax_enable_x64 config silently downcasting 64-bit
+    inputs before the comparison."""
+    import numpy as np
+
+    for key in keys:
+        got = np.asarray(data[key]).dtype
+        if got != target_dtype:
+            raise ValueError(
+                f"{key} has data type {got} which is different than "
+                f"{target_dtype}")
+
+
+def broadcast_data(keys: Sequence[str], data: Dict, dtype) -> Dict:
+    """Return ``{key: jnp.asarray(data[key], dtype)}`` for each requested
+    key (ref: data.py:77-113).  Size/numel bookkeeping that the reference
+    ships over NCCL (ref :86-104) is unnecessary under one controller."""
+    missing = [k for k in keys if k not in data]
+    if missing:
+        raise KeyError(f"broadcast_data: missing keys {missing}")
+    _check_data_types(keys, data, jnp.dtype(dtype))
+    return {key: jnp.asarray(data[key], dtype) for key in keys}

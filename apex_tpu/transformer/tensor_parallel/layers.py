@@ -1,0 +1,229 @@
+"""Tensor-parallel layers: Column/Row-parallel linear, vocab-parallel embedding.
+
+Parity with the reference's Megatron layers
+(ref: apex/transformer/tensor_parallel/layers.py:127,243,365) with a
+TPU-native dual personality controlled by ``axis_name``:
+
+* ``axis_name=None`` (default) — **GSPMD mode**: parameters are full
+  logical arrays carrying flax partitioning metadata
+  (kernel ``(None, 'tensor')`` for column, ``('tensor', None)`` for row,
+  embedding ``('tensor', None)``); run under ``pjit`` over the registered
+  mesh and XLA inserts the collectives the reference issues by hand.
+* ``axis_name='tensor'`` — **explicit mode** for use inside
+  ``jax.shard_map``: each shard holds the local parameter partition and
+  the collective algebra from :mod:`.mappings` is applied exactly as the
+  reference's autograd Functions are (copy -> local matmul -> gather /
+  reduce).
+
+The reference's per-parameter TP attributes
+(``is_tensor_model_parallel``, ``partition_dim`` —
+ref: layers.py:44-75) are carried by the flax ``Partitioned`` metadata
+boxes; :func:`param_sharding_specs` recovers a ``PartitionSpec`` pytree
+for ``pjit`` in_shardings.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...parallel_state import TENSOR_AXIS
+from .mappings import (copy_to_tensor_model_parallel_region,
+                       gather_from_tensor_model_parallel_region,
+                       reduce_from_tensor_model_parallel_region,
+                       scatter_to_tensor_model_parallel_region)
+from .utils import VocabUtility, divide, masked_local_index
+
+Dtype = Any
+Initializer = Callable[..., jnp.ndarray]
+
+
+def _ranked_init(init: Initializer, axis_name: str) -> Initializer:
+    """Fold the shard index into the init RNG so each rank draws an
+    independent partition (the reference initializes the full master
+    weight and scatters — ref: layers.py:78-124; folding the rank is the
+    functional equivalent with identical independence guarantees)."""
+
+    def wrapped(key, shape, dtype):
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        return init(key, shape, dtype)
+
+    return wrapped
+
+
+def _constrain(x, spec: P):
+    """Best-effort sharding hint; a no-op when no mesh is registered."""
+    from ... import parallel_state
+
+    if not parallel_state.model_parallel_is_initialized():
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(parallel_state.get_mesh(), spec))
+    except (ValueError, RuntimeError):
+        # Outside jit / mesh mismatch: hints are advisory only.
+        return x
+
+
+def param_sharding_specs(tree):
+    """PartitionSpec pytree from flax Partitioned metadata (replicated for
+    plain leaves) — the pjit-side view of the reference's TP attributes
+    (ref: layers.py:44-75)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.get_partition_spec()
+        if isinstance(leaf, nn.Partitioned) else P(),
+        tree, is_leaf=lambda leaf: isinstance(leaf, nn.Partitioned))
+
+
+class ColumnParallelLinear(nn.Module):
+    """Linear with output-dim partitioning, Y = XA + b with A split by
+    columns (ref: apex/transformer/tensor_parallel/layers.py:243-363).
+
+    ``gather_output`` mirrors the reference: True yields the full Y on
+    every shard; False leaves Y partitioned for a following
+    RowParallelLinear (ref: layers.py:257-262).
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    gather_output: bool = True
+    init_method: Initializer = nn.initializers.lecun_normal()
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        if self.axis_name is not None:
+            world = jax.lax.axis_size(self.axis_name)
+            local_out = divide(self.output_size, world)
+            kernel = self.param(
+                "kernel", _ranked_init(self.init_method, self.axis_name),
+                (self.input_size, local_out), self.param_dtype)
+            bias = self.param(
+                "bias", _ranked_init(nn.initializers.zeros, self.axis_name),
+                (local_out,), self.param_dtype) if self.use_bias else None
+            x = copy_to_tensor_model_parallel_region(x, self.axis_name)
+            y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+            if bias is not None:
+                y = y + bias.astype(self.dtype)
+            if self.gather_output:
+                y = gather_from_tensor_model_parallel_region(
+                    y, self.axis_name)
+            return y
+
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.init_method, (None, TENSOR_AXIS)),
+            (self.input_size, self.output_size), self.param_dtype)
+        bias = self.param(
+            "bias", nn.with_partitioning(nn.initializers.zeros,
+                                         (TENSOR_AXIS,)),
+            (self.output_size,), self.param_dtype) if self.use_bias else None
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        if bias is not None:
+            y = y + bias.astype(self.dtype)
+        spec = (P(*([None] * (y.ndim - 1)), None) if self.gather_output
+                else P(*([None] * (y.ndim - 1)), TENSOR_AXIS))
+        return _constrain(y, spec)
+
+
+class RowParallelLinear(nn.Module):
+    """Linear with input-dim partitioning, Y = XA + b with A split by
+    rows (ref: apex/transformer/tensor_parallel/layers.py:365-477).
+
+    ``input_is_parallel``: True when X arrives already split (the usual
+    pairing after ColumnParallelLinear(gather_output=False),
+    ref: layers.py:380-384); the bias is added after the reduction so it
+    is applied exactly once (ref: layers.py:472-477).
+    """
+
+    input_size: int
+    output_size: int
+    use_bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Initializer = nn.initializers.lecun_normal()
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        if self.axis_name is not None:
+            world = jax.lax.axis_size(self.axis_name)
+            local_in = divide(self.input_size, world)
+            kernel = self.param(
+                "kernel", _ranked_init(self.init_method, self.axis_name),
+                (local_in, self.output_size), self.param_dtype)
+            bias = self.param(
+                "bias", nn.initializers.zeros,
+                (self.output_size,), self.param_dtype) if self.use_bias \
+                else None
+            if not self.input_is_parallel:
+                x = scatter_to_tensor_model_parallel_region(
+                    x, self.axis_name)
+            y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+            y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
+            if bias is not None:
+                y = y + bias.astype(self.dtype)
+            return y
+
+        kernel = self.param(
+            "kernel",
+            nn.with_partitioning(self.init_method, (TENSOR_AXIS, None)),
+            (self.input_size, self.output_size), self.param_dtype)
+        bias = self.param(
+            "bias", nn.initializers.zeros,
+            (self.output_size,), self.param_dtype) if self.use_bias else None
+        x = _constrain(x, P(*([None] * (x.ndim - 1)), TENSOR_AXIS))
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        y = _constrain(y, P(*([None] * y.ndim)))
+        if bias is not None:
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+class VocabParallelEmbedding(nn.Module):
+    """Embedding partitioned along the vocabulary dimension
+    (ref: apex/transformer/tensor_parallel/layers.py:127-206).
+
+    Explicit mode reproduces the reference's masked lookup: ids outside
+    this shard's [first, last) range read row 0 and are zeroed, then a
+    psum combines the per-shard partial embeddings (ref: layers.py:176-205).
+    """
+
+    num_embeddings: int
+    features: int
+    init_method: Initializer = nn.initializers.normal(stddev=0.02)
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, ids):
+        if self.axis_name is not None:
+            world = jax.lax.axis_size(self.axis_name)
+            per_part = divide(self.num_embeddings, world)
+            table = self.param(
+                "embedding", _ranked_init(self.init_method, self.axis_name),
+                (per_part, self.features), self.param_dtype)
+            rank = jax.lax.axis_index(self.axis_name)
+            first, _last = (
+                VocabUtility.vocab_range_from_per_partition_vocab_size(
+                    per_part, rank, world))
+            local_ids, in_range = masked_local_index(ids, first, per_part)
+            out = jnp.take(table.astype(self.dtype), local_ids, axis=0)
+            out = jnp.where(in_range[..., None], out,
+                            jnp.zeros((), self.dtype))
+            return reduce_from_tensor_model_parallel_region(
+                out, self.axis_name)
+
+        table = self.param(
+            "embedding",
+            nn.with_partitioning(self.init_method, (TENSOR_AXIS, None)),
+            (self.num_embeddings, self.features), self.param_dtype)
+        return jnp.take(table.astype(self.dtype), ids, axis=0)

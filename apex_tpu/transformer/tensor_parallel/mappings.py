@@ -1,0 +1,76 @@
+"""Tensor-parallel collective mappings — the Megatron collective algebra.
+
+Parity with the reference's autograd Functions
+(ref: apex/transformer/tensor_parallel/mappings.py:23-143), expressed over
+a named mesh axis for use inside ``jax.shard_map``:
+
+    copy_to_tensor_model_parallel_region     fwd identity   bwd all-reduce
+    reduce_from_tensor_model_parallel_region fwd all-reduce bwd identity
+    scatter_to_tensor_model_parallel_region  fwd split      bwd all-gather
+    gather_from_tensor_model_parallel_region fwd all-gather bwd split
+
+The reference hand-writes each backward with torch.distributed calls
+(ref: mappings.py:77-143) because each GPU runs autograd independently.
+Under ``shard_map`` with varying-mesh-axes tracking (``check_vma=True``),
+JAX's transpose rules derive exactly those backwards from the forward
+collectives — reverse-mode AD is linear in cotangents, so the boundary
+spec transposition inserts the psum/split the reference writes by hand.
+These functions therefore stay plain (no ``custom_vjp``): the documented
+fwd/bwd pairing above is what JAX derives, verified by
+tests/test_tensor_parallel.py gradient checks.
+
+``gather`` is implemented as a masked psum (pad the local chunk into the
+full extent, then all-reduce) rather than ``lax.all_gather``: the result
+is *invariant* over the axis, matching the reference's contract that
+every rank holds the full tensor — and letting it cross a ``shard_map``
+boundary with replicated out_specs.  XLA folds the pad+psum into an
+all-gather-shaped collective on ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel_state import TENSOR_AXIS
+
+
+def copy_to_tensor_model_parallel_region(x, axis_name: str = TENSOR_AXIS):
+    """Identity forward; gradients psum over the axis (ref: mappings.py:77-90).
+
+    The psum-in-backward arises from transposition: every shard consumes
+    the same ``x``, so the cotangents from all shards sum."""
+    del axis_name
+    return x
+
+
+def reduce_from_tensor_model_parallel_region(x,
+                                             axis_name: str = TENSOR_AXIS):
+    """All-reduce forward; identity backward (ref: mappings.py:93-106)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def scatter_to_tensor_model_parallel_region(x,
+                                            axis_name: str = TENSOR_AXIS):
+    """Keep this shard's chunk of the last dim (ref: mappings.py:109-122)."""
+    size = jax.lax.axis_size(axis_name)
+    if x.shape[-1] % size != 0:
+        raise ValueError(
+            f"last dim {x.shape[-1]} not divisible by axis size {size}")
+    chunk = x.shape[-1] // size
+    rank = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
+                                        axis=x.ndim - 1)
+
+
+def gather_from_tensor_model_parallel_region(x,
+                                             axis_name: str = TENSOR_AXIS):
+    """All-gather along the last dim; every shard receives the full tensor
+    (ref: mappings.py:125-138)."""
+    size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[-1]
+    full_shape = x.shape[:-1] + (chunk * size,)
+    start = (0,) * (x.ndim - 1) + (rank * chunk,)
+    padded = jax.lax.dynamic_update_slice(
+        jnp.zeros(full_shape, x.dtype), x, start)
+    return jax.lax.psum(padded, axis_name)

@@ -1,0 +1,67 @@
+"""Preallocated activation memory buffers.
+
+Parity with the reference's ``MemoryBuffer``/``RingMemBuffer``
+(ref: apex/transformer/tensor_parallel/memory.py), which hand out views
+into one large preallocated CUDA tensor to avoid allocator churn for
+checkpointed activations.  On TPU, XLA owns HBM and donation/aliasing
+make manual pooling unnecessary for compiled code; this functional
+equivalent exists for API parity and for *host-side* staging buffers
+(e.g. microbatch assembly), where reuse still saves allocations.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class MemoryBuffer:
+    """One flat preallocated buffer handing out reshaped views
+    (ref: memory.py — ``allocate``/``get``)."""
+
+    def __init__(self, name: str, numel: int, dtype):
+        self.name = name
+        self.numel = numel
+        self.dtype = jnp.dtype(dtype)
+        self.data = np.zeros((numel,), dtype=self.dtype)
+        self._start = 0
+
+    def deallocate_all(self):
+        self._start = 0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def get(self, shape: Sequence[int]):
+        """Carve the next view of ``shape`` out of the flat buffer."""
+        numel = int(np.prod(shape))
+        if self._start + numel > self.numel:
+            raise MemoryError(
+                f"memory buffer {self.name}: out of space "
+                f"({self._start}+{numel} > {self.numel})")
+        view = self.data[self._start:self._start + numel].reshape(shape)
+        self._start += numel
+        return view
+
+
+class RingMemBuffer:
+    """Ring of N full-size MemoryBuffers (ref: memory.py RingMemBuffer:
+    each slot is an independent ``numel``-element buffer, and handing out
+    a buffer that is still in use is an error, not a silent recycle)."""
+
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            MemoryBuffer(f"{name} {i}", numel, dtype)
+            for i in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        if buf.is_in_use():
+            raise RuntimeError(
+                f"memory buffer {buf.name} is already in use; "
+                f"deallocate_all() it before recycling")
+        return buf

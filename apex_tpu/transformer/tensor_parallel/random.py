@@ -1,0 +1,130 @@
+"""Tensor-parallel RNG and activation checkpointing.
+
+TPU-native replacement for the reference's CUDA RNG-state tracker and
+checkpoint machinery (ref: apex/transformer/tensor_parallel/random.py):
+
+* The reference forks a ``model-parallel-rng`` CUDA state seeded
+  ``seed + 2718 + tp_rank`` so dropout differs across TP shards while
+  data-parallel replicas stay identical (ref: random.py:193-224).  In JAX
+  the same contract is a deterministic key derivation:
+  ``fold_in(fold_in(key, _MODEL_PARALLEL_OFFSET), axis_index('tensor'))``
+  — no mutable device state to save/restore.
+* The reference's ``CheckpointFunction`` re-runs forward with saved RNG
+  states (ref: random.py:224-290).  ``jax.checkpoint`` already replays
+  with identical keys because keys are *values*; ``checkpoint`` below
+  adds the reference's API shape plus TPU-appropriate remat policies.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_policies as _policies
+
+from ...parallel_state import TENSOR_AXIS
+
+# The reference's magic offset for the model-parallel RNG domain
+# (ref: apex/transformer/tensor_parallel/random.py:205: seed + 2718 + rank).
+_MODEL_PARALLEL_OFFSET = 2718
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+def model_parallel_rng_key(key: jax.Array,
+                           axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """Per-TP-shard key: same across DP replicas, distinct across TP ranks
+    (the contract documented at ref: random.py:193-204)."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET),
+        jax.lax.axis_index(axis_name))
+
+
+class RNGStatesTracker:
+    """API-parity tracker for named RNG domains
+    (ref: ``CudaRNGStatesTracker``, random.py:113-190).
+
+    JAX keys are immutable values, so "saving/restoring device RNG state"
+    degenerates to bookkeeping: each named domain holds a key; ``fork``
+    yields a fresh subkey and advances the domain.  Use outside jit to
+    derive the rng dict passed into ``model.apply(..., rngs=...)``.
+    """
+
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self._states)
+
+    def set_states(self, states: Dict[str, jax.Array]):
+        self._states = dict(states)
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name} already present")
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        if name not in self._states:
+            raise ValueError(f"rng state {name} is not added")
+        key, next_key = jax.random.split(self._states[name])
+        self._states[name] = next_key
+        yield key
+
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    """ref: get_cuda_rng_tracker (random.py:186-190)."""
+    return _GLOBAL_TRACKER
+
+
+def model_parallel_seed(seed: int) -> None:
+    """Initialize the default domains from one global seed
+    (ref: model_parallel_cuda_manual_seed, random.py:193-224).  The
+    tensor-parallel offset is applied later, inside traced code, via
+    :func:`model_parallel_rng_key` (rank is a mesh coordinate, not a
+    process property)."""
+    _GLOBAL_TRACKER.reset()
+    _GLOBAL_TRACKER.add(_MODEL_PARALLEL_RNG, seed + _MODEL_PARALLEL_OFFSET)
+
+
+# --- activation checkpointing ----------------------------------------------
+
+#: Remat policies, TPU-tuned: ``dots_saveable`` keeps MXU outputs (the
+#: sweet spot for transformer blocks — recompute elementwise, keep
+#: matmuls); ``nothing_saveable`` is the reference's full-recompute
+#: behavior (ref: random.py:224-290 recomputes the whole block).
+CHECKPOINT_POLICIES = {
+    "full": _policies.nothing_saveable,
+    "dots": _policies.dots_saveable,
+    "dots_with_no_batch_dims": _policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def checkpoint(fn, *args, policy: Optional[str] = "full",
+               prevent_cse: bool = True):
+    """Activation checkpointing with deterministic RNG replay
+    (ref: CheckpointFunction, random.py:224-290).
+
+    Dual calling convention: ``checkpoint(fn)`` returns the rematerialized
+    function (decorator style); ``checkpoint(fn, *args)`` runs it
+    immediately, matching the reference's executor signature
+    (ref: random.py ``checkpoint(function, *args)``).  ``policy`` and
+    ``prevent_cse`` are keyword-only so positional activation arguments
+    can never bind to them.
+
+    The reference stashes and restores CPU+CUDA RNG states around the
+    replay; with JAX keys-as-values the replay is bitwise-identical by
+    construction, so this reduces to ``jax.checkpoint`` with a policy.
+    """
+    pol = CHECKPOINT_POLICIES[policy] if isinstance(policy, str) else policy
+    wrapped = jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse)
+    if args:
+        return wrapped(*args)
+    return wrapped

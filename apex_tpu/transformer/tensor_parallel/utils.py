@@ -1,0 +1,77 @@
+"""Shared tensor-parallel arithmetic helpers.
+
+Parity with the reference's utilities
+(ref: apex/transformer/tensor_parallel/utils.py:20-54): last-dim splitting
+and the vocab range bookkeeping used by VocabParallelEmbedding and the
+vocab-parallel cross entropy.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(
+            f"{numerator} is not divisible by {denominator}"
+        )
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Exact integer division (ref: tensor_parallel/utils.py semantics)."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(
+    tensor: jnp.ndarray, num_partitions: int
+) -> Sequence[jnp.ndarray]:
+    """Split a tensor along its last dimension
+    (ref: apex/transformer/tensor_parallel/utils.py:20-37).
+
+    JAX arrays are immutable so the reference's ``contiguous_split_chunks``
+    flag is moot — every chunk is already a standalone array.
+    """
+    divide(tensor.shape[-1], num_partitions)
+    return jnp.split(tensor, num_partitions, axis=-1)
+
+
+def masked_local_index(global_ids, first, per_partition: int):
+    """Map global vocab ids to this shard's local indices.
+
+    Returns ``(local_ids, in_range)`` where out-of-range ids are clamped
+    to 0 and flagged False — the masked-lookup contract shared by
+    VocabParallelEmbedding (ref: layers.py:176-205) and the
+    vocab-parallel cross entropy (ref: cross_entropy.py:38-62); keeping
+    it here ties both to VocabUtility's partitioning scheme.
+    """
+    local_ids = global_ids - first
+    in_range = (local_ids >= 0) & (local_ids < per_partition)
+    return jnp.where(in_range, local_ids, 0), in_range
+
+
+class VocabUtility:
+    """Vocab range math (ref: apex/transformer/tensor_parallel/utils.py:40-54).
+
+    The vocabulary is partitioned into contiguous per-rank ranges
+    [first, last); both class methods mirror the reference's signatures.
+    """
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        index_f = rank * per_partition_vocab_size
+        index_l = index_f + per_partition_vocab_size
+        return index_f, index_l
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per_partition_vocab_size = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size, rank, world_size
+        )
