@@ -1,1 +1,34 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Pipeline (inter-layer) parallelism over the ``pipe`` mesh axis.
+
+TPU-native re-design of ``apex.transformer.pipeline_parallel``: the
+reference's per-process 1F1B/interleaved schedules + NCCL p2p become one
+compiled program — a ``lax.scan`` over pipeline ticks with ``ppermute``
+stage hand-offs inside ``shard_map`` (see schedules.py for the full
+design rationale).
+"""
+from . import p2p_communication
+from .schedules import (build_stage_params, forward_backward_no_pipelining,
+                        forward_backward_pipelining_with_interleaving,
+                        forward_backward_pipelining_without_interleaving,
+                        get_forward_backward_func, pipeline_forward)
+from .utils import (average_losses_across_data_parallel_group,
+                    get_current_global_batch_size, get_kth_microbatch,
+                    get_ltor_masks_and_position_ids, get_micro_batch_size,
+                    get_num_microbatches, get_timers, listify_model,
+                    param_l2_norm, print_rank_0, print_rank_last,
+                    setup_microbatch_calculator,
+                    split_batch_into_microbatches, update_num_microbatches)
+
+__all__ = [
+    "p2p_communication", "build_stage_params",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func", "pipeline_forward",
+    "average_losses_across_data_parallel_group",
+    "get_current_global_batch_size", "get_kth_microbatch",
+    "get_ltor_masks_and_position_ids", "get_micro_batch_size",
+    "get_num_microbatches", "get_timers", "listify_model", "param_l2_norm",
+    "print_rank_0", "print_rank_last", "setup_microbatch_calculator",
+    "split_batch_into_microbatches", "update_num_microbatches",
+]

@@ -1,0 +1,89 @@
+"""Stage-to-stage activation hand-off over the pipeline mesh axis.
+
+TPU-native replacement for the reference's NCCL point-to-point layer
+(ref: apex/transformer/pipeline_parallel/p2p_communication.py:31-404).
+The reference batches isend/irecv pairs between pipeline neighbours and
+hard-synchronizes after each exchange (ref :163-164).  Under SPMD there
+is no per-rank send/recv: the equivalent primitive is ``lax.ppermute``
+over the ``pipe`` axis — every stage simultaneously passes its activation
+to a neighbour, XLA schedules it on ICI, and "no peer" slots receive
+zeros (non-participating edges of the permutation), which the schedules
+mask out exactly where the reference skips the p2p call on first/last
+stages (ref :183-232).
+
+The reference's scatter-gather optimization (split the activation
+1/tp_size across TP ranks in flight, allgather after —
+ref :116-121,166-179) is a bandwidth trick XLA performs natively when
+activations carry a sharding over the tensor axis; no code is needed.
+
+All nine public combinators (ref :183-404) are provided; the *_recv_*
+fused variants are single ppermutes (the fusion the reference builds
+from batched isend/irecv falls out of the collective formulation).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...parallel_state import PIPE_AXIS
+
+
+def _shift(x, axis_name: str, forward: bool):
+    size = jax.lax.axis_size(axis_name)
+    if forward:
+        perm = [(i, i + 1) for i in range(size - 1)]
+    else:
+        perm = [(i + 1, i) for i in range(size - 1)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward(output_tensor, axis_name: str = PIPE_AXIS):
+    """Pass activations one stage forward; stage 0 receives zeros
+    (ref: p2p_communication.py:333-356)."""
+    return _shift(output_tensor, axis_name, forward=True)
+
+
+def send_backward_recv_backward(input_tensor_grad,
+                                axis_name: str = PIPE_AXIS):
+    """Pass gradients one stage backward; the last stage receives zeros
+    (ref: p2p_communication.py:357-380)."""
+    return _shift(input_tensor_grad, axis_name, forward=False)
+
+
+def send_forward(output_tensor, axis_name: str = PIPE_AXIS):
+    """ref: p2p_communication.py:233-258.  Collective SPMD pairs every
+    send with the matching receive; this is the same ppermute as
+    :func:`send_forward_recv_forward` — the value is meaningful on
+    stages > 0 and zeros on stage 0."""
+    return _shift(output_tensor, axis_name, forward=True)
+
+
+def recv_forward(output_tensor, axis_name: str = PIPE_AXIS):
+    """ref: p2p_communication.py:183-208.  Alias of :func:`send_forward`
+    viewed from the receiving stage."""
+    return _shift(output_tensor, axis_name, forward=True)
+
+
+def send_backward(input_tensor_grad, axis_name: str = PIPE_AXIS):
+    """ref: p2p_communication.py:259-282."""
+    return _shift(input_tensor_grad, axis_name, forward=False)
+
+
+def recv_backward(input_tensor_grad, axis_name: str = PIPE_AXIS):
+    """ref: p2p_communication.py:209-232."""
+    return _shift(input_tensor_grad, axis_name, forward=False)
+
+
+def send_forward_recv_backward(output_tensor, input_tensor_grad,
+                               axis_name: str = PIPE_AXIS):
+    """Fused 1F1B steady-state exchange (ref: p2p_communication.py:283-307):
+    activations go forward while gradients come backward.  Two disjoint
+    ppermutes XLA can overlap on opposite ICI directions."""
+    return (_shift(output_tensor, axis_name, forward=True),
+            _shift(input_tensor_grad, axis_name, forward=False))
+
+
+def send_backward_recv_forward(input_tensor_grad, output_tensor,
+                               axis_name: str = PIPE_AXIS):
+    """ref: p2p_communication.py:308-332."""
+    return (_shift(input_tensor_grad, axis_name, forward=False),
+            _shift(output_tensor, axis_name, forward=True))

@@ -1,0 +1,305 @@
+"""Pipeline-parallel utilities: microbatch bookkeeping, timers, helpers.
+
+Parity with the reference
+(ref: apex/transformer/pipeline_parallel/utils.py:41-307).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ... import parallel_state
+from ..microbatches import (NumMicroBatchesCalculator,
+                            build_num_microbatches_calculator)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR: Optional[NumMicroBatchesCalculator] = \
+    None
+_GLOBAL_TIMERS = None
+_GLOBAL_AUTORESUME = None
+
+
+def listify_model(model: Union[Any, List[Any]]) -> List[Any]:
+    """ref: utils.py:41-46."""
+    if isinstance(model, list):
+        return model
+    return [model]
+
+
+def _ensure_var_is_initialized(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized.")
+
+
+def _ensure_var_is_not_initialized(var, name):
+    if var is not None:
+        raise RuntimeError(f"{name} is already initialized.")
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size,
+                                global_batch_size: int,
+                                micro_batch_size: int,
+                                data_parallel_size: int) -> None:
+    """ref: utils.py:57-70."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_not_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                                   "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _reconfigure_microbatch_calculator(rank: int, rampup_batch_size,
+                                       global_batch_size: int,
+                                       micro_batch_size: int,
+                                       data_parallel_size: int) -> None:
+    """ref: utils.py:71-85 — replace without the already-init check."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_micro_batch_size() -> int:
+    """ref: utils.py:87-89."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_num_microbatches() -> int:
+    """ref: utils.py:91-93."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    """ref: utils.py:95-97."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR. \
+        get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    """ref: utils.py:99-102."""
+    _ensure_var_is_initialized(_GLOBAL_NUM_MICROBATCHES_CALCULATOR,
+                               "num microbatches calculator")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples,
+                                               consistency_check)
+
+
+def split_batch_into_microbatches(batch, micro_batch_size: int):
+    """Reshape a global-batch pytree into [M, micro, ...] leaves
+    (ref: utils.py:104-128 _split_batch_into_microbatch /
+    get_kth_microbatch — slicing becomes one reshape under SPMD)."""
+    def split(x):
+        b = x.shape[0]
+        if b % micro_batch_size != 0:
+            raise ValueError(
+                f"batch dim {b} not divisible by micro batch size "
+                f"{micro_batch_size}")
+        return x.reshape((b // micro_batch_size, micro_batch_size)
+                         + x.shape[1:])
+    return jax.tree.map(split, batch)
+
+
+def get_kth_microbatch(batch, k: int):
+    """ref: utils.py:121-128."""
+    return jax.tree.map(lambda x: x[k], batch)
+
+
+def get_autoresume():
+    """Vestigial ADLR autoresume hook (ref: utils.py:131-133)."""
+    return _GLOBAL_AUTORESUME
+
+
+# --- timers ----------------------------------------------------------------
+
+class _Timer:
+    """Host-side timer with device-sync elapsed
+    (ref: pipeline_parallel/_timers.py:6-40 — cuda synchronize becomes
+    block_until_ready on a sentinel, or plain wall time)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._elapsed = 0.0
+        self._started = False
+        self._start_time = None
+
+    def start(self):
+        import time
+        if self._started:
+            raise RuntimeError("timer has already been started")
+        jax.effects_barrier()
+        self._start_time = time.perf_counter()
+        self._started = True
+
+    def stop(self):
+        import time
+        if not self._started:
+            raise RuntimeError("timer is not started")
+        jax.effects_barrier()
+        self._elapsed += time.perf_counter() - self._start_time
+        self._started = False
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self._started
+        if started:
+            self.stop()
+        total = self._elapsed
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return total
+
+
+class Timers:
+    """Named timer group (ref: _timers.py:43-70)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names: Sequence[str], writer, iteration: int,
+              normalizer: float = 1.0, reset: bool = False):
+        """ref: _timers.py:55-62 — writer is any object with add_scalar."""
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", value, iteration)
+
+    def log(self, names: Sequence[str], normalizer: float = 1.0,
+            reset: bool = True):
+        """ref: _timers.py:63-70."""
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            elapsed_time = (self.timers[name].elapsed(reset=reset) * 1000.0
+                            / normalizer)
+            string += f" | {name}: {elapsed_time:.2f}"
+        print_rank_last(string)
+
+
+def _set_timers():
+    global _GLOBAL_TIMERS
+    _ensure_var_is_not_initialized(_GLOBAL_TIMERS, "timers")
+    _GLOBAL_TIMERS = Timers()
+
+
+def get_timers() -> Timers:
+    """ref: utils.py:142-146."""
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _set_timers()
+    return _GLOBAL_TIMERS
+
+
+# --- printing / ranks -------------------------------------------------------
+
+def print_rank_0(message: str) -> None:
+    """ref: utils.py:148-155 — single-controller: process_index 0."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def is_last_rank() -> bool:
+    """ref: utils.py:157-159."""
+    return jax.process_index() == jax.process_count() - 1
+
+
+def print_rank_last(message: str) -> None:
+    """ref: utils.py:161-168."""
+    if is_last_rank():
+        print(message, flush=True)
+
+
+# --- norms / loss averaging -------------------------------------------------
+
+def param_l2_norm(params) -> jnp.ndarray:
+    """Global l2 norm over a parameter pytree
+    (ref: utils.py:189-216 calc_params_l2_norm — the reference's
+    multi_tensor_l2norm over TP-owned params; under pjit the global norm
+    over sharded params is one jnp expression, XLA inserts the psum)."""
+    leaves = jax.tree.leaves(params)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis_name: Optional[str] =
+                                              None):
+    """ref: utils.py:218-227 — pmean inside shard_map, identity (already
+    global) under plain pjit."""
+    stacked = jnp.stack([jnp.asarray(l) for l in losses])
+    if axis_name is not None:
+        return jax.lax.pmean(stacked, axis_name)
+    return stacked
+
+
+def report_memory(name: str) -> None:
+    """ref: utils.py:229-239 — TPU HBM stats via device memory_stats."""
+    stats = []
+    for d in jax.local_devices():
+        s = d.memory_stats() or {}
+        inuse = s.get("bytes_in_use", 0) / (1024 ** 2)
+        limit = s.get("bytes_limit", 0) / (1024 ** 2)
+        stats.append(f"{d} in-use {inuse:.0f}MB limit {limit:.0f}MB")
+    print_rank_0(f"[{name}] memory: " + "; ".join(stats))
+
+
+def get_ltor_masks_and_position_ids(data: jnp.ndarray,
+                                    eod_token: Optional[int] = None,
+                                    reset_position_ids: bool = False,
+                                    reset_attention_mask: bool = False,
+                                    eod_mask_loss: bool = False):
+    """Left-to-right (causal) masks + position ids for GPT batches
+    (ref: utils.py:279-307).  Returns (attention_mask, loss_mask,
+    position_ids).  The eod-reset variants require per-sequence scans;
+    the common (False) paths are vectorized.
+    """
+    micro_batch_size, seq_length = data.shape
+    attention_mask = jnp.tril(
+        jnp.ones((seq_length, seq_length), dtype=bool))[None, None]
+    loss_mask = jnp.ones(data.shape, dtype=jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+    position_ids = jnp.broadcast_to(
+        jnp.arange(seq_length, dtype=jnp.int32), data.shape)
+    if (reset_position_ids or reset_attention_mask) and eod_token is not \
+            None:
+        # Per-document resets: position ids restart after each EOD and
+        # attention cannot cross document boundaries.
+        doc_id = jnp.cumsum((data == eod_token).astype(jnp.int32), axis=1)
+        prev_doc = jnp.concatenate(
+            [jnp.zeros((micro_batch_size, 1), jnp.int32), doc_id[:, :-1]],
+            axis=1)
+        if reset_position_ids:
+            seg_start = jnp.concatenate(
+                [jnp.zeros((micro_batch_size, 1), jnp.int32),
+                 jnp.where(data[:, :-1] == eod_token,
+                           jnp.arange(1, seq_length, dtype=jnp.int32)[None],
+                           0)], axis=1)
+            start_of_seg = jax.lax.cummax(seg_start, axis=1)
+            position_ids = (jnp.arange(seq_length, dtype=jnp.int32)[None]
+                            - start_of_seg)
+        if reset_attention_mask:
+            same_doc = prev_doc[:, :, None] == prev_doc[:, None, :]
+            attention_mask = attention_mask & same_doc[:, None]
+    return attention_mask, loss_mask, position_ids

@@ -41,15 +41,21 @@ Dtype = Any
 Initializer = Callable[..., jnp.ndarray]
 
 
-def _ranked_init(init: Initializer, axis_name: str) -> Initializer:
-    """Fold the shard index into the init RNG so each rank draws an
-    independent partition (the reference initializes the full master
-    weight and scatters — ref: layers.py:78-124; folding the rank is the
-    functional equivalent with identical independence guarantees)."""
+def _sliced_init(init: Initializer, axis_name: str, full_shape,
+                 partition_dim: int) -> Initializer:
+    """Draw the FULL logical weight and keep this shard's slice — the
+    reference's master-weight-then-scatter initialization
+    (ref: layers.py:78-124).  This preserves the initializer's
+    distribution exactly (fan-in/fan-out computed from the full shape,
+    not the shard), so weight statistics are identical across TP degrees
+    and identical to GSPMD mode."""
 
     def wrapped(key, shape, dtype):
-        key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-        return init(key, shape, dtype)
+        full = init(key, full_shape, dtype)
+        rank = jax.lax.axis_index(axis_name)
+        chunk = shape[partition_dim]
+        return jax.lax.dynamic_slice_in_dim(full, rank * chunk, chunk,
+                                            axis=partition_dim)
 
     return wrapped
 
@@ -102,10 +108,14 @@ class ColumnParallelLinear(nn.Module):
             world = jax.lax.axis_size(self.axis_name)
             local_out = divide(self.output_size, world)
             kernel = self.param(
-                "kernel", _ranked_init(self.init_method, self.axis_name),
+                "kernel",
+                _sliced_init(self.init_method, self.axis_name,
+                             (self.input_size, self.output_size), 1),
                 (self.input_size, local_out), self.param_dtype)
             bias = self.param(
-                "bias", _ranked_init(nn.initializers.zeros, self.axis_name),
+                "bias",
+                _sliced_init(nn.initializers.zeros, self.axis_name,
+                             (self.output_size,), 0),
                 (local_out,), self.param_dtype) if self.use_bias else None
             x = copy_to_tensor_model_parallel_region(x, self.axis_name)
             y = x.astype(self.dtype) @ kernel.astype(self.dtype)
@@ -157,7 +167,9 @@ class RowParallelLinear(nn.Module):
             world = jax.lax.axis_size(self.axis_name)
             local_in = divide(self.input_size, world)
             kernel = self.param(
-                "kernel", _ranked_init(self.init_method, self.axis_name),
+                "kernel",
+                _sliced_init(self.init_method, self.axis_name,
+                             (self.input_size, self.output_size), 0),
                 (local_in, self.output_size), self.param_dtype)
             bias = self.param(
                 "bias", nn.initializers.zeros,
@@ -209,7 +221,9 @@ class VocabParallelEmbedding(nn.Module):
             world = jax.lax.axis_size(self.axis_name)
             per_part = divide(self.num_embeddings, world)
             table = self.param(
-                "embedding", _ranked_init(self.init_method, self.axis_name),
+                "embedding",
+                _sliced_init(self.init_method, self.axis_name,
+                             (self.num_embeddings, self.features), 0),
                 (per_part, self.features), self.param_dtype)
             rank = jax.lax.axis_index(self.axis_name)
             first, _last = (

@@ -1,0 +1,386 @@
+"""Pipeline-parallel tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's schedule tests
+(ref: tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py,
+run_megatron_gpt_pipeline.py): every schedule is checked against a
+sequential single-device execution of the same stacked layers, forward
+and backward.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state
+from apex_tpu.transformer import microbatches as mb
+from apex_tpu.transformer import pipeline_parallel as pp
+
+PIPE = parallel_state.PIPE_AXIS
+
+
+@pytest.fixture(autouse=True)
+def _clean_microbatch_calculator():
+    yield
+    pp.utils.destroy_microbatch_calculator()
+
+
+def pp_mesh(pp_size=4):
+    return parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size=pp_size)
+
+
+def stage_fn(params, x):
+    # params leaves carry the local stage dim of size 1 (shard_map slices,
+    # it does not strip)
+    w, b = params["w"][0], params["b"][0]
+    return jnp.tanh(x @ w + b)
+
+
+def make_params(key, nblocks, width):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (nblocks, width, width)) * 0.5,
+        "b": jax.random.normal(kb, (nblocks, width)) * 0.1,
+    }
+
+
+def sequential_ref(params, x, nblocks):
+    for i in range(nblocks):
+        x = jnp.tanh(x @ params["w"][i] + params["b"][i])
+    return x
+
+
+class TestPipelineForward:
+    def test_matches_sequential(self):
+        mesh = pp_mesh(4)
+        key = jax.random.PRNGKey(0)
+        width, m, mbsz = 8, 6, 2
+        params = make_params(key, 4, width)
+        xs = jax.random.normal(jax.random.fold_in(key, 1), (m, mbsz, width))
+
+        def run(params, xs):
+            return pp.pipeline_forward(stage_fn, params, xs)
+
+        out = jax.shard_map(run, mesh=mesh,
+                            in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
+                            out_specs=P())(params, xs)
+        ref = jax.vmap(lambda x: sequential_ref(params, x, 4))(xs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pytree_activations(self):
+        mesh = pp_mesh(2)
+        key = jax.random.PRNGKey(3)
+        width, m = 4, 3
+        params = make_params(key, 2, width)
+        xs = {"h": jax.random.normal(jax.random.fold_in(key, 1),
+                                     (m, 2, width))}
+
+        def tree_stage(params, x):
+            return {"h": stage_fn(params, x["h"])}
+
+        def run(params, xs):
+            return pp.pipeline_forward(tree_stage, params, xs)
+
+        out = jax.shard_map(run, mesh=mesh,
+                            in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
+                            out_specs=P())(params, xs)
+        ref = jax.vmap(lambda x: sequential_ref(params, x, 2))(xs["h"])
+        np.testing.assert_allclose(np.asarray(out["h"]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_shape_mismatch_raises(self):
+        mesh = pp_mesh(2)
+        params = make_params(jax.random.PRNGKey(0), 2, 4)
+        xs = jnp.ones((2, 2, 4))
+
+        def bad_stage(params, x):
+            return jnp.concatenate([x, x], axis=-1)
+
+        def run(params, xs):
+            return pp.pipeline_forward(bad_stage, params, xs)
+
+        with pytest.raises(ValueError, match="preserve activation shape"):
+            jax.shard_map(run, mesh=mesh,
+                          in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
+                          out_specs=P())(params, xs)
+
+
+class TestSchedules:
+    def _setup(self, pp_size, m=4, width=8, mbsz=2, nblocks=None, seed=0):
+        mesh = pp_mesh(pp_size)
+        key = jax.random.PRNGKey(seed)
+        nblocks = nblocks or pp_size
+        params = make_params(key, nblocks, width)
+        xs = jax.random.normal(jax.random.fold_in(key, 1), (m, mbsz, width))
+        ys = jax.random.normal(jax.random.fold_in(key, 2), (m, mbsz, width))
+        return mesh, params, xs, ys
+
+    def test_1f1b_loss_and_grads_match_sequential(self):
+        mesh, params, xs, ys = self._setup(4)
+
+        def run(params, xs, ys):
+            def loss_fn(out_mb, k):
+                y = jax.lax.dynamic_index_in_dim(ys, k, 0, keepdims=False)
+                return jnp.mean((out_mb - y) ** 2)
+            return pp.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, params, xs)
+
+        loss, grads = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=({"w": P(PIPE), "b": P(PIPE)}, P(), P()),
+            out_specs=(P(), {"w": P(PIPE), "b": P(PIPE)}))(params, xs, ys)
+
+        def ref_loss(params):
+            out = jax.vmap(lambda x: sequential_ref(params, x, 4))(xs)
+            return jnp.mean(jax.vmap(
+                lambda o, y: jnp.mean((o - y) ** 2))(out, ys))
+
+        rloss, rgrads = jax.value_and_grad(ref_loss)(params)
+        np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(grads[k]),
+                                       np.asarray(rgrads[k]),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_1f1b_forward_only(self):
+        mesh, params, xs, ys = self._setup(4)
+
+        def run(params, xs, ys):
+            def loss_fn(out_mb, k):
+                y = jax.lax.dynamic_index_in_dim(ys, k, 0, keepdims=False)
+                return jnp.mean((out_mb - y) ** 2)
+            loss, grads = pp.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, params, xs, forward_only=True)
+            assert grads is None
+            return loss
+
+        loss = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=({"w": P(PIPE), "b": P(PIPE)}, P(), P()),
+            out_specs=P())(params, xs, ys)
+        assert np.isfinite(float(loss))
+
+    def test_interleaved_matches_sequential(self):
+        """vpp=2 chunks x 4 stages = 8 blocks, round-robin assignment
+        (ref: fwd_bwd_pipelining_with_interleaving.py:100-108)."""
+        mesh, params, xs, ys = self._setup(4, nblocks=8)
+        # reshape to [vpp=2, stage=4, ...]
+        vparams = jax.tree.map(
+            lambda x: x.reshape((2, 4) + x.shape[1:]), params)
+
+        def run(vparams, xs, ys):
+            def loss_fn(out_mb, k):
+                y = jax.lax.dynamic_index_in_dim(ys, k, 0, keepdims=False)
+                return jnp.mean((out_mb - y) ** 2)
+            return pp.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, vparams, xs)
+
+        loss, grads = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=({"w": P(None, PIPE), "b": P(None, PIPE)}, P(), P()),
+            out_specs=(P(), {"w": P(None, PIPE), "b": P(None, PIPE)}))(
+                vparams, xs, ys)
+
+        def ref_loss(params):
+            out = jax.vmap(lambda x: sequential_ref(params, x, 8))(xs)
+            return jnp.mean(jax.vmap(
+                lambda o, y: jnp.mean((o - y) ** 2))(out, ys))
+
+        rloss, rgrads = jax.value_and_grad(ref_loss)(params)
+        np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-5)
+        flat = jax.tree.map(
+            lambda g: g.reshape((8,) + g.shape[2:]), grads)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(flat[k]),
+                                       np.asarray(rgrads[k]),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_no_pipelining_grad_accumulation(self):
+        key = jax.random.PRNGKey(5)
+        params = {"w": jax.random.normal(key, (4, 4))}
+        xs = jax.random.normal(jax.random.fold_in(key, 1), (3, 2, 4))
+
+        def loss_fn(params, mb):
+            return jnp.mean((mb @ params["w"]) ** 2)
+
+        loss, grads = pp.forward_backward_no_pipelining(loss_fn, params, xs)
+
+        def full_loss(params):
+            return jnp.mean(jax.vmap(
+                lambda mb: loss_fn(params, mb))(xs))
+
+        rloss, rgrads = jax.value_and_grad(full_loss)(params)
+        np.testing.assert_allclose(float(loss), float(rloss), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["w"]),
+                                   np.asarray(rgrads["w"]), rtol=1e-4,
+                                   atol=1e-7)
+        # forward_only
+        loss2, g2 = pp.forward_backward_no_pipelining(loss_fn, params, xs,
+                                                      forward_only=True)
+        assert g2 is None
+        np.testing.assert_allclose(float(loss2), float(rloss), rtol=1e-6)
+
+    def test_selector(self):
+        assert pp.get_forward_backward_func(None, 1) is \
+            pp.forward_backward_no_pipelining
+        assert pp.get_forward_backward_func(None, 4) is \
+            pp.forward_backward_pipelining_without_interleaving
+        assert pp.get_forward_backward_func(2, 4) is \
+            pp.forward_backward_pipelining_with_interleaving
+
+    def test_build_stage_params(self):
+        def init_one(key):
+            return {"w": jax.random.normal(key, (3, 3))}
+
+        stacked = pp.build_stage_params(init_one, jax.random.PRNGKey(0), 4)
+        assert stacked["w"].shape == (4, 3, 3)
+        v = pp.build_stage_params(init_one, jax.random.PRNGKey(0), 4,
+                                  virtual_chunks=2)
+        assert v["w"].shape == (2, 4, 3, 3)
+        # independent draws per stage
+        assert not np.allclose(stacked["w"][0], stacked["w"][1])
+
+
+class TestP2P:
+    def test_forward_shift(self):
+        mesh = pp_mesh(4)
+
+        def f(x):
+            r = jax.lax.axis_index(PIPE).astype(jnp.float32)
+            got = pp.p2p_communication.send_forward_recv_forward(
+                jnp.full((2,), r + 1.0))
+            return got[None]
+
+        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+                            out_specs=P(PIPE))(jnp.zeros((4,)))
+        # stage 0 receives zeros; stage k receives k (value k-1+1)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], [0., 1., 2., 3.])
+
+    def test_backward_shift(self):
+        mesh = pp_mesh(4)
+
+        def f(x):
+            r = jax.lax.axis_index(PIPE).astype(jnp.float32)
+            got = pp.p2p_communication.send_backward_recv_backward(
+                jnp.full((2,), r + 1.0))
+            return got[None]
+
+        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+                            out_specs=P(PIPE))(jnp.zeros((4,)))
+        # last stage receives zeros; stage k receives k+2
+        np.testing.assert_allclose(np.asarray(out)[:, 0], [2., 3., 4., 0.])
+
+    def test_fused_exchange(self):
+        mesh = pp_mesh(2)
+
+        def f(x):
+            r = jax.lax.axis_index(PIPE).astype(jnp.float32)
+            fwd, bwd = pp.p2p_communication.send_forward_recv_backward(
+                jnp.full((1,), r + 1.0), jnp.full((1,), r + 10.0))
+            return jnp.stack([fwd, bwd])[None]
+
+        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+                            out_specs=P(PIPE))(jnp.zeros((2,)))
+        arr = np.asarray(out)
+        np.testing.assert_allclose(arr[0, :, 0], [0., 11.])  # stage 0
+        np.testing.assert_allclose(arr[1, :, 0], [1., 0.])   # stage 1
+
+
+class TestMicrobatchCalculators:
+    def test_constant(self):
+        calc = mb.ConstantNumMicroBatches(64, 2, 4)
+        assert calc.get() == 8
+        assert calc.get_current_global_batch_size() == 64
+        calc.update(1000, True)  # no-op
+        assert calc.get() == 8
+        with pytest.raises(ValueError):
+            mb.ConstantNumMicroBatches(63, 2, 4)
+
+    def test_rampup(self):
+        calc = mb.RampupBatchsizeNumMicroBatches(
+            start_batch_size=16, batch_size_increment=16,
+            ramup_samples=160, global_batch_size=64,
+            micro_batch_size=2, data_parallel_size=2)
+        assert calc.get_current_global_batch_size() == 16
+        calc.update(0, True)
+        assert calc.get_current_global_batch_size() == 16
+        calc.update(80, True)   # halfway: 16 + 1*16 = 32 (2 increments over 160)
+        assert calc.get_current_global_batch_size() in (32, 48)
+        calc.update(200, True)  # past ramp
+        assert calc.get_current_global_batch_size() == 64
+        assert calc.get() == 64 // (2 * 2)
+
+    def test_rampup_validation(self):
+        with pytest.raises(ValueError):
+            mb.RampupBatchsizeNumMicroBatches(0, 16, 160, 64, 2, 2)
+        with pytest.raises(ValueError):
+            mb.RampupBatchsizeNumMicroBatches(16, 15, 160, 64, 2, 2)
+        with pytest.raises(ValueError):
+            mb.RampupBatchsizeNumMicroBatches(128, 16, 160, 64, 2, 2)
+
+    def test_build_selector(self):
+        c = mb.build_num_microbatches_calculator(0, None, 32, 2, 2)
+        assert isinstance(c, mb.ConstantNumMicroBatches)
+        r = mb.build_num_microbatches_calculator(1, (16, 16, 100), 64, 2, 2)
+        assert isinstance(r, mb.RampupBatchsizeNumMicroBatches)
+        with pytest.raises(ValueError):
+            mb.build_num_microbatches_calculator(0, (16, 16), 64, 2, 2)
+
+
+class TestUtils:
+    def test_global_calculator(self):
+        pp.setup_microbatch_calculator(0, None, 32, 2, 2)
+        assert pp.get_num_microbatches() == 8
+        assert pp.get_micro_batch_size() == 2
+        assert pp.get_current_global_batch_size() == 32
+        pp.update_num_microbatches(100)
+        with pytest.raises(RuntimeError):
+            pp.setup_microbatch_calculator(0, None, 32, 2, 2)
+
+    def test_split_and_kth_microbatch(self):
+        batch = {"x": jnp.arange(12.0).reshape(6, 2)}
+        split = pp.split_batch_into_microbatches(batch, 2)
+        assert split["x"].shape == (3, 2, 2)
+        kth = pp.get_kth_microbatch(split, 1)
+        np.testing.assert_allclose(np.asarray(kth["x"]),
+                                   np.asarray(batch["x"][2:4]))
+        with pytest.raises(ValueError):
+            pp.split_batch_into_microbatches({"x": jnp.ones((5, 2))}, 2)
+
+    def test_timers(self):
+        timers = pp.get_timers()
+        t = timers("fwd")
+        t.start()
+        t.stop()
+        assert t.elapsed(reset=False) >= 0.0
+        with pytest.raises(RuntimeError):
+            t.stop()
+        timers.log(["fwd"])
+
+    def test_param_l2_norm(self):
+        params = {"a": jnp.ones((2, 2)), "b": jnp.ones((3,))}
+        np.testing.assert_allclose(float(pp.param_l2_norm(params)),
+                                   np.sqrt(7.0), rtol=1e-6)
+
+    def test_ltor_masks(self):
+        data = jnp.array([[5, 1, 2, 0, 3, 4]])  # eod = 0
+        attn, loss_mask, pos = pp.get_ltor_masks_and_position_ids(
+            data, eod_token=0, eod_mask_loss=True)
+        assert attn.shape == (1, 1, 6, 6)
+        assert bool(attn[0, 0, 3, 2]) and not bool(attn[0, 0, 2, 3])
+        np.testing.assert_allclose(np.asarray(loss_mask[0]),
+                                   [1, 1, 1, 0, 1, 1])
+        np.testing.assert_allclose(np.asarray(pos[0]), np.arange(6))
+
+    def test_ltor_masks_reset(self):
+        data = jnp.array([[5, 0, 2, 3]])  # doc boundary after pos 1
+        attn, _, pos = pp.get_ltor_masks_and_position_ids(
+            data, eod_token=0, reset_position_ids=True,
+            reset_attention_mask=True)
+        # position ids restart after the eod token
+        np.testing.assert_allclose(np.asarray(pos[0]), [0, 1, 0, 1])
+        # token 2 (pos 2) cannot attend to doc-0 tokens
+        assert not bool(attn[0, 0, 2, 0])
+        assert bool(attn[0, 0, 3, 2])
