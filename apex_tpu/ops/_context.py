@@ -1,0 +1,19 @@
+"""Execution-context helpers shared by the Pallas op wrappers."""
+from __future__ import annotations
+
+from jax._src import core as _jax_core
+
+
+def in_manual_axis_context() -> bool:
+    """True when tracing inside ``shard_map`` manual axes.
+
+    Pallas calls cannot yet express varying-mesh-axis (VMA) types on
+    their outputs, so inside ``shard_map(check_vma=True)`` every fused op
+    routes to its XLA-fusion reference implementation — same math, XLA
+    still fuses it per shard.  Outside (plain jit / pjit / GSPMD) the
+    Pallas kernels run.
+    """
+    try:
+        return bool(_jax_core.get_axis_env().axis_sizes)
+    except Exception:  # pragma: no cover - private-API drift safety
+        return False

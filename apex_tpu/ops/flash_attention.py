@@ -292,6 +292,16 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
 # --- public API ------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_fused(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           scale: Optional[float] = None,
+                           causal: bool = False,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)[0]
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     scale: Optional[float] = None,
                     causal: bool = False,
@@ -301,11 +311,15 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     Shapes: q (b, h, sq, d); k, v (b, h, sk, d).  ``scale`` defaults to
     1/sqrt(d).  Supersedes the reference's FMHA (seqlen<=512 cap,
-    ref: setup.py:408-424) and fast_multihead_attn kernels.
+    ref: setup.py:408-424) and fast_multihead_attn kernels.  Inside
+    shard_map manual axes the XLA reference path runs (Pallas calls
+    cannot yet carry VMA types).
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)[0]
+    from ._context import in_manual_axis_context
+
+    if in_manual_axis_context():
+        return mha_reference(q, k, v, scale=scale, causal=causal)
+    return _flash_attention_fused(q, k, v, scale, causal, block_q, block_k)
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -321,7 +335,7 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
     return _flash_bwd(scale, causal, block_q, block_k, res, do)
 
 
-flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_attention_fused.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def mha_reference(q, k, v, scale=None, causal=False):

@@ -174,16 +174,10 @@ def _ln_backward(x2d, gamma, dy2d, mean, rstd, interpret=None):
 # --- public functional API with custom_vjp ---------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def layer_norm(x: jnp.ndarray,
-               gamma: Optional[jnp.ndarray],
-               beta: Optional[jnp.ndarray],
-               eps: float = 1e-5) -> jnp.ndarray:
-    """Fused layer norm over the last dimension.
-
-    ``gamma``/``beta`` may be fp32 while ``x`` is bf16/fp16 (the
-    mixed-dtype variant, ref: csrc/layer_norm_cuda.cpp:133-158), or None
-    for the non-affine form.
-    """
+def _layer_norm_fused(x: jnp.ndarray,
+                      gamma: Optional[jnp.ndarray],
+                      beta: Optional[jnp.ndarray],
+                      eps: float = 1e-5) -> jnp.ndarray:
     return _layer_norm_fwd(x, gamma, beta, eps)[0]
 
 
@@ -202,5 +196,35 @@ def _layer_norm_bwd(eps, res, dy):
     return dx.reshape(shape), dgamma, dbeta
 
 
-layer_norm.defvjp(lambda x, g, b, eps: _layer_norm_fwd(x, g, b, eps),
-                  _layer_norm_bwd)
+_layer_norm_fused.defvjp(lambda x, g, b, eps: _layer_norm_fwd(x, g, b, eps),
+                         _layer_norm_bwd)
+
+
+def _layer_norm_reference(x, gamma, beta, eps):
+    """XLA-fusion path: identical math (fp32 statistics, mixed-dtype
+    affine), used inside shard_map manual contexts."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if gamma is not None:
+        y = y * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def layer_norm(x: jnp.ndarray,
+               gamma: Optional[jnp.ndarray],
+               beta: Optional[jnp.ndarray],
+               eps: float = 1e-5) -> jnp.ndarray:
+    """Fused layer norm over the last dimension.
+
+    ``gamma``/``beta`` may be fp32 while ``x`` is bf16/fp16 (the
+    mixed-dtype variant, ref: csrc/layer_norm_cuda.cpp:133-158), or None
+    for the non-affine form.  Inside shard_map manual axes the XLA
+    reference path runs (Pallas calls cannot yet carry VMA types).
+    """
+    from ._context import in_manual_axis_context
+
+    if in_manual_axis_context():
+        return _layer_norm_reference(x, gamma, beta, eps)
+    return _layer_norm_fused(x, gamma, beta, eps)

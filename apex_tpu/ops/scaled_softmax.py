@@ -55,12 +55,27 @@ def _softmax_bwd_kernel(scale, y_ref, dy_ref, dx_ref):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scaled_upper_triang_masked_softmax_fused(x: jnp.ndarray,
+                                              scale: float = 1.0
+                                              ) -> jnp.ndarray:
+    return _causal_fwd(x, scale)[0]
+
+
 def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
                                        scale: float = 1.0) -> jnp.ndarray:
     """Causal softmax over (..., sq, sk) attention scores
     (ref: ScaledUpperTriangMaskedSoftmax,
-    apex/transformer/functional/fused_softmax.py:21-42)."""
-    return _causal_fwd(x, scale)[0]
+    apex/transformer/functional/fused_softmax.py:21-42).  Inside
+    shard_map manual axes the XLA reference path runs."""
+    from ._context import in_manual_axis_context
+
+    if in_manual_axis_context():
+        sq, sk = x.shape[-2:]
+        s = x.astype(jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(mask, s, jnp.float32(-10000.0))
+        return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    return _scaled_upper_triang_masked_softmax_fused(x, scale)
 
 
 def _causal_fwd(x, scale):
@@ -117,7 +132,7 @@ def _softmax_backward(y, dy, scale):
     return dx[:, :sq].reshape(*lead, sq, sk)
 
 
-scaled_upper_triang_masked_softmax.defvjp(
+_scaled_upper_triang_masked_softmax_fused.defvjp(
     lambda x, scale: _causal_fwd(x, scale), _causal_bwd)
 
 
@@ -133,13 +148,25 @@ def _masked_fwd_kernel(scale, x_ref, m_ref, y_ref):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scaled_masked_softmax_fused(x: jnp.ndarray, mask: jnp.ndarray,
+                                 scale: float = 1.0) -> jnp.ndarray:
+    return _masked_fwd(x, mask, scale)[0]
+
+
 def scaled_masked_softmax(x: jnp.ndarray, mask: jnp.ndarray,
                           scale: float = 1.0) -> jnp.ndarray:
     """Softmax over (b, np, sq, sk) with a boolean padding mask
     (b, 1, sq, sk); True/nonzero entries are masked out
     (ref: ScaledMaskedSoftmax,
-    apex/transformer/functional/fused_softmax.py:67-93)."""
-    return _masked_fwd(x, mask, scale)[0]
+    apex/transformer/functional/fused_softmax.py:67-93).  Inside
+    shard_map manual axes the XLA reference path runs."""
+    from ._context import in_manual_axis_context
+
+    if in_manual_axis_context():
+        s = x.astype(jnp.float32) * scale
+        s = jnp.where(mask, jnp.float32(-10000.0), s)
+        return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    return _scaled_masked_softmax_fused(x, mask, scale)
 
 
 def _masked_fwd(x, mask, scale):
@@ -172,5 +199,5 @@ def _masked_bwd(scale, y, dy):
     return _softmax_backward(y, dy, scale), None
 
 
-scaled_masked_softmax.defvjp(
+_scaled_masked_softmax_fused.defvjp(
     lambda x, m, scale: _masked_fwd(x, m, scale), _masked_bwd)

@@ -1,0 +1,215 @@
+"""Standalone GPT: the flagship transformer exercising TP x PP x DP x amp.
+
+Parity with the reference's test model
+(ref: apex/transformer/testing/standalone_gpt.py — embedding, parallel
+transformer layers with fused softmax / checkpointing, tied LM head,
+vocab-parallel loss, pipeline stage wiring via pre_process/post_process),
+re-designed for one-program SPMD:
+
+* ``GPTModel`` — full model for TP-only / single-chip runs.
+* ``GPTEmbedding`` / ``GPTStage`` / ``GPTHead`` — the pipeline split:
+  embedding and head live *outside* the pipelined region (the
+  reference's pre/post_process flags, ref: schedules/common.py:18-107);
+  each pipeline stage is a uniform block of layers.
+* ``gpt_forward_pipelined`` — the assembled TP+PP forward: embed ->
+  microbatch -> pipeline_forward over the pipe axis -> head ->
+  vocab-parallel CE.  Called inside ``shard_map`` over the full
+  (pipe, data, tensor) mesh; gradient sync across data/tensor emerges
+  from boundary transposition (replicated params sum their cotangents).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .. import parallel_state
+from ..transformer.enums import AttnMaskType
+from ..transformer.layers import ParallelTransformer, ParallelTransformerLayer
+from ..normalization import FusedLayerNorm
+from ..transformer.tensor_parallel.cross_entropy import \
+    vocab_parallel_cross_entropy
+from ..transformer.tensor_parallel.layers import VocabParallelEmbedding
+
+Dtype = Any
+
+
+class GPTEmbedding(nn.Module):
+    """Token + learned position embeddings
+    (ref: standalone_gpt.py Embedding)."""
+
+    vocab_size: int
+    hidden_size: int
+    max_sequence_length: int
+    embedding_dropout: float = 0.1
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.word_embeddings = VocabParallelEmbedding(
+            self.vocab_size, self.hidden_size, dtype=self.dtype,
+            axis_name=self.axis_name, name="word_embeddings")
+        self.position_embeddings = nn.Embed(
+            self.max_sequence_length, self.hidden_size,
+            embedding_init=nn.initializers.normal(stddev=0.02),
+            dtype=self.dtype, name="position_embeddings")
+
+    def __call__(self, tokens, deterministic: bool = True):
+        s = tokens.shape[-1]
+        h = self.word_embeddings(tokens)
+        h = h + self.position_embeddings(jnp.arange(s, dtype=jnp.int32))
+        if not deterministic and self.embedding_dropout > 0.0:
+            key = self.make_rng("dropout")
+            keep = jax.random.bernoulli(
+                key, 1.0 - self.embedding_dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - self.embedding_dropout),
+                          jnp.zeros((), h.dtype))
+        return h
+
+    def attend(self, x):
+        return self.word_embeddings.attend(x)
+
+
+class GPTModel(nn.Module):
+    """Full (non-pipelined) GPT: embedding -> transformer -> tied head.
+    Returns vocab(-sharded in explicit mode) logits
+    (ref: standalone_gpt.py GPTModel / post_language_model_processing)."""
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_attention_heads: int
+    max_sequence_length: int
+    ffn_hidden_size: Optional[int] = None
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    use_flash: bool = True
+    checkpoint_activations: bool = False
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.embedding = GPTEmbedding(
+            self.vocab_size, self.hidden_size, self.max_sequence_length,
+            embedding_dropout=self.hidden_dropout, dtype=self.dtype,
+            axis_name=self.axis_name, name="embedding")
+        self.transformer = ParallelTransformer(
+            num_layers=self.num_layers, hidden_size=self.hidden_size,
+            num_attention_heads=self.num_attention_heads,
+            ffn_hidden_size=self.ffn_hidden_size,
+            attn_mask_type=AttnMaskType.causal,
+            attention_dropout=self.attention_dropout,
+            hidden_dropout=self.hidden_dropout, use_flash=self.use_flash,
+            checkpoint_activations=self.checkpoint_activations,
+            dtype=self.dtype, axis_name=self.axis_name, name="transformer")
+
+    def __call__(self, tokens, deterministic: bool = True):
+        h = self.embedding(tokens, deterministic)
+        h = self.transformer(h, None, deterministic)
+        return self.embedding.attend(h)
+
+
+class GPTStage(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` uniform transformer layers
+    (activation-shape preserving, as pipeline_forward requires)."""
+
+    layers_per_stage: int
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: Optional[int] = None
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    use_flash: bool = True
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        for i in range(self.layers_per_stage):
+            x = ParallelTransformerLayer(
+                self.hidden_size, self.num_attention_heads,
+                ffn_hidden_size=self.ffn_hidden_size,
+                attn_mask_type=AttnMaskType.causal,
+                attention_dropout=self.attention_dropout,
+                hidden_dropout=self.hidden_dropout,
+                use_flash=self.use_flash, dtype=self.dtype,
+                axis_name=self.axis_name, name=f"layer_{i}")(
+                    x, None, deterministic)
+        return x
+
+
+class GPTHead(nn.Module):
+    """Final layernorm before the tied head
+    (ref: standalone_gpt.py final_layernorm + logits)."""
+
+    hidden_size: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return FusedLayerNorm(self.hidden_size,
+                              name="final_layernorm")(x).astype(self.dtype)
+
+
+def gpt_loss(logits, labels, axis_name: Optional[str] = None,
+             label_smoothing: float = 0.0):
+    """Per-token mean LM loss over (possibly vocab-sharded) logits."""
+    if axis_name is not None:
+        losses = vocab_parallel_cross_entropy(
+            logits, labels, label_smoothing=label_smoothing,
+            axis_name=axis_name)
+    else:
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lf, axis=-1)
+        nll = lse - jnp.take_along_axis(
+            lf, labels[..., None], axis=-1)[..., 0]
+        if label_smoothing > 0.0:
+            smooth = lse - jnp.mean(lf, axis=-1)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+        losses = nll
+    return jnp.mean(losses)
+
+
+def gpt_forward_pipelined(embed_mod, stage_mod, head_mod,
+                          embed_params, stage_params, head_params,
+                          tokens, labels, *, num_microbatches: int,
+                          tensor_axis: Optional[str],
+                          pipe_axis: str = parallel_state.PIPE_AXIS,
+                          data_axis: Optional[str] =
+                          parallel_state.DATA_AXIS,
+                          checkpoint_policy: Optional[str] = "full",
+                          deterministic: bool = True):
+    """TP+PP+DP GPT loss — call inside shard_map over the full mesh.
+
+    ``tokens``/``labels`` arrive data-sharded [local_batch, seq];
+    ``stage_params`` arrive pipe-sharded (leading stage dim of 1, as
+    shard_map slices).  Returns the pmean (over data) scalar loss;
+    differentiate *outside* the shard_map so boundary transposition
+    performs the DP/TP gradient reductions.
+    """
+    from ..transformer.pipeline_parallel.schedules import pipeline_forward
+
+    b, s = tokens.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"local batch {b} not divisible by "
+                         f"num_microbatches {num_microbatches}")
+    h = embed_mod.apply(embed_params, tokens, deterministic)
+    mb = b // num_microbatches
+    h_mb = h.reshape(num_microbatches, mb, s, h.shape[-1])
+
+    def stage_fn(params, x):
+        local = jax.tree.map(lambda p: p[0], params)
+        return stage_mod.apply(local, x, deterministic)
+
+    h_out = pipeline_forward(stage_fn, stage_params, h_mb,
+                             axis_name=pipe_axis,
+                             checkpoint_policy=checkpoint_policy)
+    h_full = h_out.reshape(b, s, h.shape[-1])
+    h_full = head_mod.apply(head_params, h_full)
+    logits = embed_mod.apply(embed_params, h_full, method="attend")
+    loss = gpt_loss(logits, labels, axis_name=tensor_axis)
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+    return loss

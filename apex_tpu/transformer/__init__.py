@@ -1,1 +1,18 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""apex_tpu.transformer — Megatron-style model parallelism, TPU-native.
+
+Parity surface of ``apex.transformer`` (ref: apex/transformer/__init__.py):
+tensor parallelism, pipeline parallelism, parallel transformer building
+blocks, fused softmax, microbatch calculators, enums — over a
+``jax.sharding.Mesh`` instead of NCCL process groups.
+"""
+from . import functional, microbatches, pipeline_parallel, tensor_parallel
+from .enums import AttnMaskType, AttnType, LayerType
+from .layers import (ParallelMLP, ParallelSelfAttention,
+                     ParallelTransformer, ParallelTransformerLayer)
+
+__all__ = [
+    "functional", "microbatches", "pipeline_parallel", "tensor_parallel",
+    "AttnMaskType", "AttnType", "LayerType",
+    "ParallelMLP", "ParallelSelfAttention", "ParallelTransformer",
+    "ParallelTransformerLayer",
+]

@@ -1,0 +1,233 @@
+"""Parallel transformer building blocks: MLP, self-attention, layer, stack.
+
+Library form of the model components the reference assembles in its
+standalone test models (ref: apex/transformer/testing/standalone_gpt.py —
+ParallelMLP, ParallelAttention/CoreAttention, ParallelTransformerLayer,
+ParallelTransformer), built from the tensor-parallel layers and the
+Pallas fused ops:
+
+* MLP = ColumnParallelLinear(gather_output=False) -> gelu ->
+  RowParallelLinear(input_is_parallel=True) — the canonical Megatron
+  pairing (one psum per MLP).
+* Attention = column-parallel fused QKV (heads sharded over the tensor
+  axis), core attention (Pallas flash attention for the causal path,
+  FusedScaleMaskSoftmax fallback for explicit masks), row-parallel
+  output projection.
+* LayerNorms run in fp32 regardless of compute dtype (the reference's
+  MixedFusedLayerNorm contract,
+  ref: apex/normalization/fused_layer_norm.py:202-218).
+
+Dropout follows the reference's RNG domains
+(ref: apex/transformer/tensor_parallel/random.py:193-224): attention
+dropout draws from a key folded with the tensor-parallel rank (sharded
+heads need independent masks); hidden dropout after the row-parallel
+psum uses the unfolded key (activations are replicated across the tensor
+axis, so the mask must be too).
+
+``axis_name`` selects explicit shard_map mode ('tensor') or GSPMD mode
+(None), exactly as in :mod:`.tensor_parallel.layers`.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..normalization import FusedLayerNorm
+from ..ops.flash_attention import flash_attention
+from .enums import AttnMaskType
+from .functional.fused_softmax import FusedScaleMaskSoftmax
+from .tensor_parallel.layers import (ColumnParallelLinear,
+                                     RowParallelLinear)
+from .tensor_parallel.random import model_parallel_rng_key
+from .tensor_parallel.utils import divide
+
+Dtype = Any
+
+
+def _maybe_axis_size(axis_name: Optional[str]) -> int:
+    return 1 if axis_name is None else jax.lax.axis_size(axis_name)
+
+
+class ParallelMLP(nn.Module):
+    """h -> ffn_hidden -> h with tensor-parallel split on the ffn dim
+    (ref: standalone_gpt.py ParallelMLP)."""
+
+    hidden_size: int
+    ffn_hidden_size: Optional[int] = None
+    activation: Callable = jax.nn.gelu
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        ffn = self.ffn_hidden_size or 4 * self.hidden_size
+        h = ColumnParallelLinear(self.hidden_size, ffn, gather_output=False,
+                                 dtype=self.dtype, axis_name=self.axis_name,
+                                 name="dense_h_to_4h")(x)
+        h = self.activation(h)
+        return RowParallelLinear(ffn, self.hidden_size,
+                                 input_is_parallel=True, dtype=self.dtype,
+                                 axis_name=self.axis_name,
+                                 name="dense_4h_to_h")(h)
+
+
+class ParallelSelfAttention(nn.Module):
+    """Multi-head self-attention with heads sharded over the tensor axis
+    (ref: standalone_gpt.py ParallelAttention + CoreAttention).
+
+    ``use_flash`` routes the causal no-explicit-mask path through the
+    Pallas flash attention kernel (supersedes the reference's fmhalib /
+    fast_multihead_attn extensions); otherwise scores materialize
+    [b, heads, sq, sk] through FusedScaleMaskSoftmax, the reference's
+    core-attention structure.
+    """
+
+    hidden_size: int
+    num_attention_heads: int
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    attention_dropout: float = 0.1
+    use_flash: bool = True
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        b, s, _ = x.shape
+        world = _maybe_axis_size(self.axis_name)
+        heads_local = divide(self.num_attention_heads, world)
+        head_dim = divide(self.hidden_size, self.num_attention_heads)
+
+        qkv = ColumnParallelLinear(self.hidden_size, 3 * self.hidden_size,
+                                   gather_output=False, dtype=self.dtype,
+                                   axis_name=self.axis_name,
+                                   name="query_key_value")(x)
+        qkv = qkv.reshape(b, s, heads_local, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (b, heads, s, d)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+
+        causal = self.attn_mask_type == AttnMaskType.causal
+        scale = head_dim ** -0.5
+        if self.use_flash and attention_mask is None and causal \
+                and (deterministic or self.attention_dropout == 0.0):
+            ctx = flash_attention(q, k, v, scale=scale, causal=True)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            softmax = FusedScaleMaskSoftmax(
+                input_in_fp16=self.dtype == jnp.float16,
+                input_in_bf16=self.dtype == jnp.bfloat16,
+                attn_mask_type=self.attn_mask_type,
+                scaled_masked_softmax_fusion=True,
+                mask_func=None, softmax_in_fp32=True, scale=scale)
+            probs = softmax(scores.astype(self.dtype), attention_mask)
+            if not deterministic and self.attention_dropout > 0.0:
+                key = self.make_rng("dropout")
+                if self.axis_name is not None:
+                    # sharded heads draw independent masks per TP rank
+                    key = model_parallel_rng_key(key, self.axis_name)
+                keep = jax.random.bernoulli(
+                    key, 1.0 - self.attention_dropout, probs.shape)
+                probs = jnp.where(keep, probs / (1.0 - self.attention_dropout),
+                                  jnp.zeros((), probs.dtype))
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(self.dtype), v)
+
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s,
+                                                heads_local * head_dim)
+        return RowParallelLinear(self.hidden_size, self.hidden_size,
+                                 input_is_parallel=True, dtype=self.dtype,
+                                 axis_name=self.axis_name, name="dense")(ctx)
+
+
+class ParallelTransformerLayer(nn.Module):
+    """Pre-LN transformer layer (ref: standalone_gpt.py
+    ParallelTransformerLayer): LN -> attention -> residual -> LN -> MLP
+    -> residual, with fp32 layer norms and hidden dropout applied on the
+    replicated (post-psum) activations."""
+
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: Optional[int] = None
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    use_flash: bool = True
+    layernorm_epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    def _dropout(self, x, deterministic):
+        if deterministic or self.hidden_dropout == 0.0:
+            return x
+        # replicated across the tensor axis -> unfolded key (same mask on
+        # every TP rank, the reference's get_cuda_rng_tracker-free path)
+        key = self.make_rng("dropout")
+        keep = jax.random.bernoulli(key, 1.0 - self.hidden_dropout, x.shape)
+        return jnp.where(keep, x / (1.0 - self.hidden_dropout),
+                         jnp.zeros((), x.dtype))
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        ln1 = FusedLayerNorm(self.hidden_size,
+                             eps=self.layernorm_epsilon,
+                             name="input_layernorm")
+        attn_out = ParallelSelfAttention(
+            self.hidden_size, self.num_attention_heads,
+            attn_mask_type=self.attn_mask_type,
+            attention_dropout=self.attention_dropout,
+            use_flash=self.use_flash, dtype=self.dtype,
+            axis_name=self.axis_name, name="self_attention")(
+                ln1(x).astype(self.dtype), attention_mask, deterministic)
+        x = x + self._dropout(attn_out, deterministic).astype(x.dtype)
+        ln2 = FusedLayerNorm(self.hidden_size,
+                             eps=self.layernorm_epsilon,
+                             name="post_attention_layernorm")
+        mlp_out = ParallelMLP(self.hidden_size, self.ffn_hidden_size,
+                              dtype=self.dtype, axis_name=self.axis_name,
+                              name="mlp")(ln2(x).astype(self.dtype))
+        return x + self._dropout(mlp_out, deterministic).astype(x.dtype)
+
+
+class ParallelTransformer(nn.Module):
+    """Stack of layers (ref: standalone_gpt.py ParallelTransformer).
+    ``checkpoint_activations`` remats each layer (the reference's
+    activation checkpointing, ref: tensor_parallel/random.py:224-290)."""
+
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: Optional[int] = None
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    use_flash: bool = True
+    checkpoint_activations: bool = False
+    layernorm_epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+        layer_cls = ParallelTransformerLayer
+        if self.checkpoint_activations:
+            layer_cls = nn.checkpoint(ParallelTransformerLayer,
+                                      static_argnums=(3,))
+        for i in range(self.num_layers):
+            x = layer_cls(self.hidden_size, self.num_attention_heads,
+                          ffn_hidden_size=self.ffn_hidden_size,
+                          attn_mask_type=self.attn_mask_type,
+                          attention_dropout=self.attention_dropout,
+                          hidden_dropout=self.hidden_dropout,
+                          use_flash=self.use_flash,
+                          layernorm_epsilon=self.layernorm_epsilon,
+                          dtype=self.dtype, axis_name=self.axis_name,
+                          name=f"layer_{i}")(x, attention_mask,
+                                             deterministic)
+        return FusedLayerNorm(self.hidden_size,
+                              eps=self.layernorm_epsilon,
+                              name="final_layernorm")(x).astype(self.dtype)

@@ -81,10 +81,19 @@ def pipeline_forward(stage_fn: Callable, stage_params: Any, microbatches: Any,
         fn = jax.checkpoint(stage_fn, policy=pol)
 
     def _varying(tree):
-        # scan carries become axis-varying after the first ppermute/mask;
-        # the initial zeros must be marked varying for VMA type agreement
+        # scan carries become axis-varying after the first ppermute/mask
+        # (and inherit whatever varying axes the microbatch data carries,
+        # e.g. 'data' when the batch is data-sharded); the initial zeros
+        # must be marked identically for VMA type agreement
+        def mark(x, ref):
+            target = set(jax.typeof(ref).vma) | {axis_name}
+            missing = tuple(a for a in target if a not in jax.typeof(x).vma)
+            return jax.lax.pcast(x, missing, to="varying") if missing else x
+        ref_leaves = jax.tree.leaves(jax.tree.map(lambda m: m[0],
+                                                  microbatches))
         return jax.tree.map(
-            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), tree)
+            mark, tree,
+            jax.tree.unflatten(jax.tree.structure(tree), ref_leaves))
 
     first_mb = jax.tree.map(lambda x: x[0], microbatches)
     state0 = _varying(_tree_zeros_like(first_mb))

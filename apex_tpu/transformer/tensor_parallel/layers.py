@@ -34,7 +34,7 @@ from ...parallel_state import TENSOR_AXIS
 from .mappings import (copy_to_tensor_model_parallel_region,
                        gather_from_tensor_model_parallel_region,
                        reduce_from_tensor_model_parallel_region,
-                       scatter_to_tensor_model_parallel_region)
+                       scatter_to_tensor_model_parallel_region)  # noqa: F401 (scatter re-exported)
 from .utils import VocabUtility, divide, masked_local_index
 
 Dtype = Any
@@ -215,16 +215,28 @@ class VocabParallelEmbedding(nn.Module):
     param_dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
 
-    @nn.compact
-    def __call__(self, ids):
+    def setup(self):
         if self.axis_name is not None:
             world = jax.lax.axis_size(self.axis_name)
             per_part = divide(self.num_embeddings, world)
-            table = self.param(
+            self.embedding = self.param(
                 "embedding",
                 _sliced_init(self.init_method, self.axis_name,
                              (self.num_embeddings, self.features), 0),
                 (per_part, self.features), self.param_dtype)
+        else:
+            self.embedding = self.param(
+                "embedding",
+                nn.with_partitioning(self.init_method, (TENSOR_AXIS, None)),
+                (self.num_embeddings, self.features), self.param_dtype)
+
+    def __call__(self, ids):
+        table = self.embedding
+        if isinstance(table, nn.Partitioned):
+            table = table.unbox()
+        if self.axis_name is not None:
+            world = jax.lax.axis_size(self.axis_name)
+            per_part = divide(self.num_embeddings, world)
             rank = jax.lax.axis_index(self.axis_name)
             first, _last = (
                 VocabUtility.vocab_range_from_per_partition_vocab_size(
@@ -235,9 +247,19 @@ class VocabParallelEmbedding(nn.Module):
                             jnp.zeros((), self.dtype))
             return reduce_from_tensor_model_parallel_region(
                 out, self.axis_name)
-
-        table = self.param(
-            "embedding",
-            nn.with_partitioning(self.init_method, (TENSOR_AXIS, None)),
-            (self.num_embeddings, self.features), self.param_dtype)
         return jnp.take(table.astype(self.dtype), ids, axis=0)
+
+    def attend(self, x):
+        """Tied LM head: project hidden states onto the (sharded) vocab —
+        logits come back partitioned over the vocab dim in explicit mode
+        (column-parallel semantics, feeding vocab_parallel_cross_entropy),
+        the reference's embedding-weight reuse across first/last pipeline
+        stages (ref: parallel_state.py:148-167 embedding group; the tied
+        matmul itself is standalone_gpt.py's post_language_model_processing).
+        """
+        table = self.embedding
+        if isinstance(table, nn.Partitioned):
+            table = table.unbox()
+        if self.axis_name is not None:
+            x = copy_to_tensor_model_parallel_region(x, self.axis_name)
+        return x.astype(self.dtype) @ table.astype(self.dtype).T

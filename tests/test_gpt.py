@@ -1,0 +1,200 @@
+"""Standalone GPT tests: TP parity, TP+PP+DP pipelined training.
+
+Mirrors the reference's GPT convergence/parity tests
+(ref: tests/L0/run_transformer/run_megatron_gpt_pipeline.py,
+run_bert_minimal_test.py idioms): the sharded model must match a dense
+single-device execution bit-for-tolerance, and the full 3D-parallel
+train step must learn.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel_state
+from apex_tpu.testing.standalone_gpt import (GPTEmbedding, GPTHead, GPTModel,
+                                             GPTStage, gpt_forward_pipelined,
+                                             gpt_loss)
+from apex_tpu.transformer import tensor_parallel as tp
+
+TENSOR = parallel_state.TENSOR_AXIS
+PIPE = parallel_state.PIPE_AXIS
+DATA = parallel_state.DATA_AXIS
+
+VOCAB, HID, HEADS, SEQ = 64, 32, 4, 16
+
+
+def unbox(tree):
+    return jax.tree.map(
+        lambda l: l.unbox() if isinstance(l, nn.Partitioned) else l,
+        tree, is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
+def boxed_specs(tree, extra_leading=0):
+    """PartitionSpec tree from flax metadata, optionally prefixing
+    leading (e.g. stacked-stage) axes."""
+    def one(l):
+        if isinstance(l, nn.Partitioned):
+            spec = l.get_partition_spec()
+        else:
+            spec = P()
+        if extra_leading:
+            spec = P(*((PIPE,) + tuple(spec)))
+        return spec
+    return jax.tree.map(one, tree,
+                        is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
+class TestGPTTensorParallel:
+    def _models(self, use_flash=False):
+        kw = dict(vocab_size=VOCAB, hidden_size=HID, num_layers=2,
+                  num_attention_heads=HEADS, max_sequence_length=SEQ,
+                  attention_dropout=0.0, hidden_dropout=0.0,
+                  use_flash=use_flash)
+        dense = GPTModel(**kw, axis_name=None)
+        manual = GPTModel(**kw, axis_name=TENSOR)
+        return dense, manual
+
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_tp4_logits_match_dense(self, use_flash):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=4)
+        dense, manual = self._models(use_flash)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, SEQ), 0,
+                                    VOCAB)
+        variables = dense.init(jax.random.PRNGKey(0), tokens)
+        params = unbox(variables)
+        ref_logits = dense.apply(params, tokens)
+
+        specs = boxed_specs(variables)
+        out = jax.shard_map(
+            lambda p, t: manual.apply(p, t), mesh=mesh,
+            in_specs=(specs, P()),
+            out_specs=P(None, None, TENSOR))(params, tokens)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref_logits),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_tp4_loss_and_grads_match_dense(self):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=4)
+        dense, manual = self._models()
+        key = jax.random.PRNGKey(2)
+        tokens = jax.random.randint(key, (2, SEQ), 0, VOCAB)
+        labels = jax.random.randint(jax.random.fold_in(key, 1), (2, SEQ),
+                                    0, VOCAB)
+        variables = dense.init(jax.random.PRNGKey(0), tokens)
+        params = unbox(variables)
+        specs = boxed_specs(variables)
+
+        def tp_loss(params):
+            def f(p, t, l):
+                logits = manual.apply(p, t)
+                return gpt_loss(logits, l, axis_name=TENSOR)
+            return jax.shard_map(f, mesh=mesh,
+                                 in_specs=(specs, P(), P()),
+                                 out_specs=P())(params, tokens, labels)
+
+        def ref_loss(params):
+            return gpt_loss(dense.apply(params, tokens), labels)
+
+        lv, gv = jax.value_and_grad(tp_loss)(params)
+        rl, rg = jax.value_and_grad(ref_loss)(params)
+        np.testing.assert_allclose(float(lv), float(rl), rtol=1e-5)
+        flat_g = jax.tree.leaves(gv)
+        flat_r = jax.tree.leaves(rg)
+        for a, b in zip(flat_g, flat_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+
+class TestGPTPipelined:
+    def _build(self, pp=2, dp=2, tpsize=2, layers_per_stage=1):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=tpsize,
+            pipeline_model_parallel_size=pp)
+        kw = dict(hidden_size=HID, num_attention_heads=HEADS,
+                  attention_dropout=0.0, hidden_dropout=0.0,
+                  use_flash=False)
+        embed = GPTEmbedding(VOCAB, HID, SEQ, embedding_dropout=0.0,
+                             axis_name=None)
+        stage = GPTStage(layers_per_stage=layers_per_stage, **kw,
+                         axis_name=None)
+        head = GPTHead(HID)
+        key = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                    (4, SEQ), 0, VOCAB)
+        ev = embed.init(key, tokens)
+        x0 = embed.apply(unbox(ev), tokens)
+        svs = jax.vmap(lambda k: stage.init(k, x0))(
+            jax.random.split(jax.random.fold_in(key, 2), pp))
+        hv = head.init(jax.random.fold_in(key, 3), x0)
+        return (mesh, embed, stage, head, unbox(ev), unbox(svs),
+                unbox(hv), boxed_specs(ev), boxed_specs(svs, 1),
+                boxed_specs(hv), tokens, key)
+
+    def test_pipelined_loss_matches_sequential(self):
+        (mesh, embed, stage, head, ep, sp, hp, espec, sspec, hspec,
+         tokens, key) = self._build(pp=2, tpsize=2)
+        labels = jax.random.randint(jax.random.fold_in(key, 9),
+                                    tokens.shape, 0, VOCAB)
+        # manual-mode modules for inside shard_map
+        embed_m = embed.clone(axis_name=TENSOR)
+        stage_m = stage.clone(axis_name=TENSOR)
+
+        def f(ep, sp, hp, t, l):
+            return gpt_forward_pipelined(
+                embed_m, stage_m, head, ep, sp, hp, t, l,
+                num_microbatches=2, tensor_axis=TENSOR)
+
+        loss = jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(espec, sspec, hspec, P(DATA), P(DATA)),
+            out_specs=P())(ep, sp, hp, tokens, labels)
+
+        # sequential dense reference: embed -> stage0 -> stage1 -> head
+        h = embed.apply(ep, tokens)
+        for s in range(2):
+            one = jax.tree.map(lambda x, s=s: x[s], sp)
+            h = stage.apply(one, h)
+        h = head.apply(hp, h)
+        logits = embed.apply(ep, h, method="attend")
+        ref = gpt_loss(logits, labels)
+        np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    def test_pipelined_training_learns(self):
+        (mesh, embed, stage, head, ep, sp, hp, espec, sspec, hspec,
+         tokens, key) = self._build(pp=2, tpsize=2)
+        # next-token task on a fixed tiny batch: loss must fall
+        labels = jnp.roll(tokens, -1, axis=-1)
+        embed_m = embed.clone(axis_name=TENSOR)
+        stage_m = stage.clone(axis_name=TENSOR)
+
+        def shard_loss(params, t, l):
+            ep, sp, hp = params
+            def f(ep, sp, hp, t, l):
+                return gpt_forward_pipelined(
+                    embed_m, stage_m, head, ep, sp, hp, t, l,
+                    num_microbatches=2, tensor_axis=TENSOR)
+            return jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(espec, sspec, hspec, P(DATA), P(DATA)),
+                out_specs=P())(ep, sp, hp, t, l)
+
+        @jax.jit
+        def step(params):
+            loss, grads = jax.value_and_grad(shard_loss)(params, tokens,
+                                                         labels)
+            new = jax.tree.map(lambda p, g: p - 0.5 * g, params, grads)
+            return new, loss
+
+        params = (ep, sp, hp)
+        losses = []
+        for _ in range(15):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[0] > losses[-1], f"no learning: {losses}"
+        assert losses[-1] < 0.7 * losses[0], f"too slow: {losses}"
+        assert np.isfinite(losses).all()
