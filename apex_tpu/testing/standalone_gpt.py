@@ -36,6 +36,30 @@ from ..transformer.tensor_parallel.layers import VocabParallelEmbedding
 Dtype = Any
 
 
+def unbox(tree):
+    """Strip flax ``nn.Partitioned`` boxes, returning raw arrays."""
+    return jax.tree.map(
+        lambda l: l.unbox() if isinstance(l, nn.Partitioned) else l,
+        tree, is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
+def boxed_specs(tree, extra_leading: int = 0,
+                pipe_axis: str = parallel_state.PIPE_AXIS):
+    """PartitionSpec tree from flax metadata, optionally prefixing leading
+    (e.g. stacked-stage) axes with the pipe axis."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(l):
+        spec = (l.get_partition_spec()
+                if isinstance(l, nn.Partitioned) else P())
+        if extra_leading:
+            spec = P(*((pipe_axis,) + tuple(spec)))
+        return spec
+
+    return jax.tree.map(one, tree,
+                        is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
 class GPTEmbedding(nn.Module):
     """Token + learned position embeddings
     (ref: standalone_gpt.py Embedding)."""

@@ -6,7 +6,6 @@ run_bert_minimal_test.py idioms): the sharded model must match a dense
 single-device execution bit-for-tolerance, and the full 3D-parallel
 train step must learn.
 """
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -15,8 +14,9 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu import parallel_state
 from apex_tpu.testing.standalone_gpt import (GPTEmbedding, GPTHead, GPTModel,
-                                             GPTStage, gpt_forward_pipelined,
-                                             gpt_loss)
+                                             GPTStage, boxed_specs,
+                                             gpt_forward_pipelined, gpt_loss,
+                                             unbox)
 from apex_tpu.transformer import tensor_parallel as tp
 
 TENSOR = parallel_state.TENSOR_AXIS
@@ -24,27 +24,6 @@ PIPE = parallel_state.PIPE_AXIS
 DATA = parallel_state.DATA_AXIS
 
 VOCAB, HID, HEADS, SEQ = 64, 32, 4, 16
-
-
-def unbox(tree):
-    return jax.tree.map(
-        lambda l: l.unbox() if isinstance(l, nn.Partitioned) else l,
-        tree, is_leaf=lambda l: isinstance(l, nn.Partitioned))
-
-
-def boxed_specs(tree, extra_leading=0):
-    """PartitionSpec tree from flax metadata, optionally prefixing
-    leading (e.g. stacked-stage) axes."""
-    def one(l):
-        if isinstance(l, nn.Partitioned):
-            spec = l.get_partition_spec()
-        else:
-            spec = P()
-        if extra_leading:
-            spec = P(*((PIPE,) + tuple(spec)))
-        return spec
-    return jax.tree.map(one, tree,
-                        is_leaf=lambda l: isinstance(l, nn.Partitioned))
 
 
 class TestGPTTensorParallel:
