@@ -60,11 +60,16 @@ class AmpOptimizer:
     """
 
     def __init__(self, tx: optax.GradientTransformation, policy: Policy,
-                 num_losses: int = 1):
+                 num_losses: int = 1, axis_names=None):
         self.tx = tx
         self.policy = policy
         self.num_losses = int(num_losses)
         self.use_masters = bool(policy.master_weights)
+        # Model-parallel axes to reduce the found-inf flag over, so every
+        # shard takes the same skip-vs-step branch (ref:
+        # apex/transformer/amp/grad_scaler.py:25-36).  Only meaningful
+        # when apply_gradients runs inside shard_map over these axes.
+        self.axis_names = axis_names
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -101,7 +106,7 @@ class AmpOptimizer:
 
     def apply_gradients(
         self, scaled_grads: Any, state: AmpState, params: Any,
-        loss_id: int = 0,
+        loss_id: int = 0, axis_names=None,
     ) -> Tuple[Any, AmpState, StepInfo]:
         """Unscale, check, conditionally step, writeback, update scale.
 
@@ -110,9 +115,16 @@ class AmpOptimizer:
         ``optimizer.step``, ref: apex/amp/handle.py:128-154).  With
         multiple losses, call once per loss with the matching ``loss_id``;
         masters/inner state advance each call, scalers independently.
+        ``axis_names`` (default ``None`` = use the constructor's)
+        reduces the finite flag over those mesh axes before branching,
+        so model-parallel shards skip or step in lockstep.  Pass ``()``
+        to explicitly disable the reduction for this call (e.g. when
+        stepping the same optimizer outside shard_map).
         """
         grads32 = _scaler.unscale(scaled_grads, state.scalers[loss_id])
-        finite = _scaler.all_finite(grads32)
+        if axis_names is None:
+            axis_names = self.axis_names
+        finite = _scaler.all_finite(grads32, axis_names=axis_names)
 
         stepped = state.master_params if self.use_masters else params
 
@@ -180,6 +192,7 @@ def initialize(
     optimizer: optax.GradientTransformation,
     opt_level: str = "O5",
     num_losses: int = 1,
+    axis_names=None,
     **overrides,
 ) -> Tuple[Any, AmpOptimizer, Any]:
     """The two-line setup entry, mirroring
@@ -194,5 +207,6 @@ def initialize(
     """
     policy = get_policy(opt_level, **overrides)
     cast = _cast.cast_params(params, policy)
-    amp_opt = AmpOptimizer(optimizer, policy, num_losses=num_losses)
+    amp_opt = AmpOptimizer(optimizer, policy, num_losses=num_losses,
+                           axis_names=axis_names)
     return cast, amp_opt, amp_opt.init(params)

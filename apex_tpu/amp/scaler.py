@@ -79,19 +79,36 @@ def scale_loss(loss: jnp.ndarray, state: ScalerState) -> jnp.ndarray:
     return loss.astype(jnp.float32) * state.loss_scale
 
 
-def all_finite(tree: Any) -> jnp.ndarray:
+def all_finite(tree: Any, axis_names=None) -> jnp.ndarray:
     """Single fused finite-check over a gradient pytree.
 
     Replaces the overflow flag threaded through
     ``amp_C.multi_tensor_scale`` (ref: apex/amp/scaler.py:103-159); XLA
     fuses the per-leaf reductions.
+
+    ``axis_names`` (a mesh axis name or sequence of names) reduces the
+    flag over model-parallel shards so every rank agrees on skip-vs-step
+    — the reference's model-parallel ``GradScaler._maybe_opt_step``
+    MAX-allreduce of found-inf over the model-parallel group
+    (ref: apex/transformer/amp/grad_scaler.py:25-36).  Must only be
+    passed inside a ``shard_map``/``pmap`` over those axes; under
+    plain-GSPMD ``pjit`` the flag is computed on global values and is
+    already consistent.
     """
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
-        return jnp.bool_(True)
-    return jnp.stack(
-        [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
-    ).all()
+        finite = jnp.bool_(True)
+    else:
+        finite = jnp.stack(
+            [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+        ).all()
+    if axis_names:
+        if isinstance(axis_names, str):
+            axis_names = (axis_names,)
+        # inf anywhere on the model-parallel axes => everyone skips.
+        bad = jax.lax.psum((~finite).astype(jnp.int32), tuple(axis_names))
+        finite = bad == 0
+    return finite
 
 
 def unscale(tree: Any, state: ScalerState, out_dtype=jnp.float32) -> Any:

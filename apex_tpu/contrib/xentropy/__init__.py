@@ -16,18 +16,24 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def softmax_cross_entropy_loss(logits: jnp.ndarray,
                                labels: jnp.ndarray,
                                smoothing: float = 0.0,
-                               half_to_float: bool = False) -> jnp.ndarray:
-    """Per-example CE loss over (tokens, vocab) logits with label smoothing
-    (ref: SoftmaxCrossEntropyLoss.forward,
-    apex/contrib/xentropy/softmax_xentropy.py:8-24)."""
-    return _xent_fwd(logits, labels, smoothing, half_to_float)[0]
+                               half_to_float: bool = False,
+                               padding_idx: int | None = None) -> jnp.ndarray:
+    """Per-example CE loss over (tokens, vocab) logits with label smoothing.
+
+    Rows whose label equals ``padding_idx`` contribute zero loss and zero
+    gradient (ref: SoftmaxCrossEntropyLoss,
+    apex/contrib/xentropy/softmax_xentropy.py:9 ``losses.masked_fill_``
+    and :23 ``grad_loss.masked_fill_``).  ``None`` disables the mask.
+    """
+    return _xent_fwd(logits, labels, smoothing, half_to_float,
+                     padding_idx)[0]
 
 
-def _xent_fwd(logits, labels, smoothing, half_to_float):
+def _xent_fwd(logits, labels, smoothing, half_to_float, padding_idx):
     x = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(x, axis=-1)
     nll = lse - jnp.take_along_axis(
@@ -39,19 +45,24 @@ def _xent_fwd(logits, labels, smoothing, half_to_float):
         loss = (1.0 - smoothing) * nll + smoothing * smooth
     else:
         loss = nll
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
     if not half_to_float:
         loss = loss.astype(logits.dtype)
     return loss, (logits, labels, lse)
 
 
-def _xent_bwd(smoothing, half_to_float, res, dloss):
+def _xent_bwd(smoothing, half_to_float, padding_idx, res, dloss):
     logits, labels, lse = res
     x = logits.astype(jnp.float32)
     probs = jnp.exp(x - lse[..., None])
     vocab = logits.shape[-1]
     onehot = jax.nn.one_hot(labels, vocab, dtype=jnp.float32)
     target = (1.0 - smoothing) * onehot + smoothing / vocab
-    dx = (probs - target) * dloss.astype(jnp.float32)[..., None]
+    dloss = dloss.astype(jnp.float32)
+    if padding_idx is not None:
+        dloss = jnp.where(labels == padding_idx, 0.0, dloss)
+    dx = (probs - target) * dloss[..., None]
     return dx.astype(logits.dtype), None
 
 
@@ -64,6 +75,5 @@ class SoftmaxCrossEntropyLoss:
     @staticmethod
     def apply(logits, labels, smoothing=0.0, padding_idx=0,
               half_to_float=False):
-        del padding_idx  # the reference ignores it too in the fwd math
         return softmax_cross_entropy_loss(logits, labels, smoothing,
-                                          half_to_float)
+                                          half_to_float, padding_idx)

@@ -273,6 +273,11 @@ def get_ltor_masks_and_position_ids(data: jnp.ndarray,
     (ref: utils.py:279-307).  Returns (attention_mask, loss_mask,
     position_ids).  The eod-reset variants require per-sequence scans;
     the common (False) paths are vectorized.
+
+    Mask polarity matches the reference's final ``attention_mask < 0.5``
+    (ref: utils.py:305): **True = masked out** (may NOT attend) — the
+    convention expected by ``FusedScaleMaskSoftmax``'s padding path and
+    the -10000 additive fill.
     """
     micro_batch_size, seq_length = data.shape
     attention_mask = jnp.tril(
@@ -302,4 +307,5 @@ def get_ltor_masks_and_position_ids(data: jnp.ndarray,
         if reset_attention_mask:
             same_doc = prev_doc[:, :, None] == prev_doc[:, None, :]
             attention_mask = attention_mask & same_doc[:, None]
-    return attention_mask, loss_mask, position_ids
+    # Flip to True=masked (ref: utils.py:305 `attention_mask < 0.5`).
+    return ~attention_mask, loss_mask, position_ids

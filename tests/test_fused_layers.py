@@ -137,6 +137,30 @@ def test_xentropy_grad_matches_softmax_minus_target():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_xentropy_padding_idx_masks_loss_and_grad():
+    # ref: apex/contrib/xentropy/softmax_xentropy.py:9 (loss masked_fill)
+    # and :23 (grad masked_fill) — padded rows contribute neither.
+    V, PAD = 16, 0
+    logits = jax.random.normal(jax.random.PRNGKey(0), (6, V))
+    labels = jnp.array([3, PAD, 5, PAD, 1, 2])
+
+    loss = softmax_cross_entropy_loss(logits, labels, 0.1,
+                                      padding_idx=PAD)
+    assert np.asarray(loss)[1] == 0.0 and np.asarray(loss)[3] == 0.0
+    assert (np.asarray(loss)[[0, 2, 4, 5]] > 0).all()
+
+    g = jax.grad(lambda l: jnp.sum(softmax_cross_entropy_loss(
+        l, labels, 0.1, padding_idx=PAD)))(logits)
+    np.testing.assert_allclose(np.asarray(g)[[1, 3]], 0.0)
+    assert np.abs(np.asarray(g)[[0, 2, 4, 5]]).sum() > 0
+
+    # class-style shim defaults padding_idx=0 like the reference
+    from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
+    loss2 = SoftmaxCrossEntropyLoss.apply(logits, labels, 0.1)
+    np.testing.assert_allclose(np.asarray(loss2), np.asarray(loss),
+                               rtol=1e-6)
+
+
 def test_xentropy_bf16_half_to_float():
     V = 30
     logits = jax.random.normal(jax.random.PRNGKey(0), (4, V), jnp.bfloat16)

@@ -365,11 +365,19 @@ class TestUtils:
                                    np.sqrt(7.0), rtol=1e-6)
 
     def test_ltor_masks(self):
+        # Polarity contract: True = masked OUT (ref utils.py:305
+        # `attention_mask < 0.5`), matching FusedScaleMaskSoftmax's
+        # padding-mask convention.
         data = jnp.array([[5, 1, 2, 0, 3, 4]])  # eod = 0
         attn, loss_mask, pos = pp.get_ltor_masks_and_position_ids(
             data, eod_token=0, eod_mask_loss=True)
         assert attn.shape == (1, 1, 6, 6)
-        assert bool(attn[0, 0, 3, 2]) and not bool(attn[0, 0, 2, 3])
+        # past is visible (not masked); future is masked
+        assert not bool(attn[0, 0, 3, 2]) and bool(attn[0, 0, 2, 3])
+        # diagonal never masked; strictly-upper always masked
+        assert not np.asarray(attn[0, 0]).diagonal().any()
+        np.testing.assert_array_equal(
+            np.asarray(attn[0, 0]), np.triu(np.ones((6, 6), bool), 1))
         np.testing.assert_allclose(np.asarray(loss_mask[0]),
                                    [1, 1, 1, 0, 1, 1])
         np.testing.assert_allclose(np.asarray(pos[0]), np.arange(6))
@@ -381,6 +389,6 @@ class TestUtils:
             reset_attention_mask=True)
         # position ids restart after the eod token
         np.testing.assert_allclose(np.asarray(pos[0]), [0, 1, 0, 1])
-        # token 2 (pos 2) cannot attend to doc-0 tokens
-        assert not bool(attn[0, 0, 2, 0])
-        assert bool(attn[0, 0, 3, 2])
+        # token 2 (pos 2) cannot attend to doc-0 tokens (masked=True)
+        assert bool(attn[0, 0, 2, 0])
+        assert not bool(attn[0, 0, 3, 2])
