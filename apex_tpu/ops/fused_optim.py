@@ -132,6 +132,82 @@ def adagrad_update(g, p, h, *, lr, eps, weight_decay, interpret=None):
                              [p.dtype, jnp.float32], interpret=interpret)
 
 
+# --- LAMB phase 1 (ref: csrc/multi_tensor_lamb.cu:60-200 LAMBStage1) -------
+
+def _lamb_phase1_kernel(adam_w_mode: bool, hyp_ref, g_ref, p_ref, m_ref,
+                        v_ref, u_ref, m_out_ref, v_out_ref):
+    gscale, b1, b2, b3, eps, wd, bc1, bc2 = (hyp_ref[i] for i in range(8))
+    g = g_ref[:].astype(jnp.float32) * gscale
+    p = p_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        # MOMENT_MODE_0: L2 — decay folds into the (clipped) gradient
+        # (ref: multi_tensor_lamb.cu:123-140).
+        g = g + wd * p
+    m = b1 * m_ref[:] + b3 * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+    if adam_w_mode:
+        # MOMENT_MODE_1: decoupled decay joins the update
+        # (ref: multi_tensor_lamb.cu:160-180).
+        u = u + wd * p
+    u_ref[:] = u
+    m_out_ref[:] = m
+    v_out_ref[:] = v
+
+
+def lamb_phase1(g, p, m, v, *, grad_scale, beta1, beta2, beta3, eps,
+                weight_decay, bias_correction1, bias_correction2,
+                adam_w_mode=True, interpret=None):
+    """Fused LAMB stage 1 over flat buffers -> (update, new_m, new_v).
+
+    ``grad_scale`` is the combined ``inv_loss_scale * clip`` multiplier
+    (the reference passes inv_scale and clipped_global_grad_norm
+    separately into the kernel; fused here).  Stage 2 — the per-tensor
+    trust-ratio scaling (ref: multi_tensor_lamb.cu:230-330 LAMBStage2)
+    — is a gather+multiply XLA fuses into a single pass, so it stays
+    outside Pallas (see optimizers/fused_lamb.py).
+    """
+    hyp = jnp.stack([
+        jnp.asarray(grad_scale, jnp.float32), jnp.float32(beta1),
+        jnp.float32(beta2), jnp.asarray(beta3, jnp.float32),
+        jnp.float32(eps), jnp.float32(weight_decay),
+        jnp.asarray(bias_correction1, jnp.float32),
+        jnp.asarray(bias_correction2, jnp.float32)])
+    kernel = functools.partial(_lamb_phase1_kernel, adam_w_mode)
+    return _elementwise_call(kernel, hyp, [g, p, m, v],
+                             [jnp.float32, jnp.float32, jnp.float32],
+                             interpret=interpret)
+
+
+# --- NovoGrad (ref: csrc/multi_tensor_novograd.cu NovoGradFunctor) ---------
+
+def _novograd_kernel(hyp_ref, g_ref, p_ref, m_ref, denom_ref, delta_ref,
+                     m_out_ref):
+    lr, b1, b3, wd, bc1 = (hyp_ref[i] for i in range(5))
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    # Per-tensor denom (sqrt of the scalar second moment, bias-corrected)
+    # arrives pre-broadcast per element; grad is normalized then decayed
+    # (ref: multi_tensor_novograd.cu grad/denom + decay*param).
+    scaled = g / denom_ref[:] + wd * p
+    m = b1 * m_ref[:] + b3 * scaled
+    delta_ref[:] = (-lr * m / bc1).astype(delta_ref.dtype)
+    m_out_ref[:] = m
+
+
+def novograd_update(g, p, m, denom_elem, *, lr, beta1, beta3, weight_decay,
+                    bias_correction1, interpret=None):
+    """One fused NovoGrad pass over flat buffers -> (delta, new_m).
+    The per-tensor second moment (a scalar per tensor) is computed by a
+    segment reduction outside and broadcast into ``denom_elem``."""
+    hyp = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.float32(beta1),
+        jnp.asarray(beta3, jnp.float32), jnp.float32(weight_decay),
+        jnp.asarray(bias_correction1, jnp.float32)])
+    return _elementwise_call(_novograd_kernel, hyp, [g, p, m, denom_elem],
+                             [p.dtype, jnp.float32], interpret=interpret)
+
+
 # --- SGD with momentum (ref: csrc/multi_tensor_sgd_kernel.cu:24-140) -------
 
 def _sgd_kernel(nesterov: bool, wd_after_momentum: bool, hyp_ref,

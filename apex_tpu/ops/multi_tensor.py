@@ -50,8 +50,16 @@ def _group_leaves(leaves) -> dict:
     return groups
 
 
-def compute_metas(tree: Any) -> List[FlatMeta]:
-    """Static packing metadata (shapes/dtypes only — works on tracers)."""
+def compute_metas(tree: Any, align: int = 1) -> List[FlatMeta]:
+    """Static packing metadata (shapes/dtypes only — works on tracers).
+
+    ``align`` rounds each leaf's start offset up to a multiple of
+    ``align`` elements (zero-filled gaps).  LAMB/NovoGrad pack with
+    ``align=LANE`` so every 128-lane row of the packed buffer belongs to
+    exactly one tensor, making per-tensor segment reductions
+    row-friendly (the per-tensor-norm role of
+    csrc/multi_tensor_l2norm_kernel.cu's tensor-table bookkeeping).
+    """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     metas = []
     for dtype, idxs in _group_leaves(leaves).items():
@@ -60,7 +68,7 @@ def compute_metas(tree: Any) -> List[FlatMeta]:
         offsets, off = [], 0
         for s in sizes:
             offsets.append(off)
-            off += s
+            off += -(-s // align) * align
         total = off
         padded = max(_PAD_TO, -(-total // _PAD_TO) * _PAD_TO)
         metas.append(FlatMeta(treedef, tuple(idxs), shapes, sizes,
@@ -71,14 +79,23 @@ def compute_metas(tree: Any) -> List[FlatMeta]:
 def pack(tree: Any, metas: Sequence[FlatMeta],
          dtype=None) -> List[jnp.ndarray]:
     """Pack ``tree``'s leaves into flat buffers following ``metas``' layout
-    (use params' metas to pack grads so group assignment matches)."""
+    (use params' metas to pack grads so group assignment matches).
+    Alignment gaps between leaves are zero-filled."""
     leaves = jax.tree_util.tree_flatten(tree)[0]
     out = []
     for meta in metas:
-        pieces = [jnp.ravel(leaves[i]) for i in meta.leaf_indices]
-        if meta.padded > meta.total:
-            pieces.append(jnp.zeros((meta.padded - meta.total,),
-                                    pieces[0].dtype if pieces else meta.dtype))
+        pieces = []
+        pos = 0
+        for k, i in enumerate(meta.leaf_indices):
+            gap = meta.offsets[k] - pos
+            if gap:
+                pieces.append(jnp.zeros((gap,), meta.dtype))
+            pieces.append(jnp.ravel(leaves[i]))
+            pos = meta.offsets[k] + meta.sizes[k]
+        if meta.padded > pos:
+            pieces.append(jnp.zeros((meta.padded - pos,),
+                                    pieces[-1].dtype if pieces
+                                    else meta.dtype))
         flat = jnp.concatenate(pieces)
         out.append(flat.astype(dtype) if dtype is not None else flat)
     return out

@@ -1,14 +1,20 @@
 """apex_tpu.optimizers — fused optimizers (TPU-native apex.optimizers).
 
 All are optax-compatible ``GradientTransformation`` factories whose hot
-path is a single fused Pallas pass over packed parameter buffers
-(Adam/SGD/Adagrad) or per-leaf XLA-fused math where per-tensor reductions
-dominate (LAMB/NovoGrad).  See SURVEY.md §2.4.
+path is a single fused Pallas pass over packed parameter buffers, with
+per-tensor reductions (LAMB trust ratios, NovoGrad second moments) as
+segment reductions over the same LANE-aligned buffers.  See SURVEY.md
+§2.4.  ``FusedMixedPrecisionLamb`` is the scaler-aware master-weight
+variant (ref: apex/optimizers/fused_mixed_precision_lamb.py).
 """
 from ..parallel.LARC import LARC, larc
 from .fused_adagrad import FusedAdagrad, FusedAdagradState, fused_adagrad
 from .fused_adam import FusedAdam, FusedAdamState, fused_adam
 from .fused_lamb import FusedLAMB, FusedLAMBState, fused_lamb
+from .fused_mixed_precision_lamb import (FusedMixedPrecisionLamb,
+                                         MixedPrecisionLambState,
+                                         MPLambInfo,
+                                         fused_mixed_precision_lamb)
 from .fused_novograd import FusedNovoGrad, FusedNovoGradState, fused_novograd
 from .fused_sgd import FusedSGD, FusedSGDState, fused_sgd
 
@@ -18,5 +24,7 @@ __all__ = [
     "fused_adagrad", "FusedAdagrad", "FusedAdagradState",
     "fused_lamb", "FusedLAMB", "FusedLAMBState",
     "fused_novograd", "FusedNovoGrad", "FusedNovoGradState",
+    "fused_mixed_precision_lamb", "FusedMixedPrecisionLamb",
+    "MixedPrecisionLambState", "MPLambInfo",
     "larc", "LARC",
 ]

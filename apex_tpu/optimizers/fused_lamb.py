@@ -1,32 +1,41 @@
 """FusedLAMB — layerwise adaptive large-batch optimizer.
 
 Parity with the reference's two-phase ``FusedLAMB``
-(ref: apex/optimizers/fused_lamb.py:1-215): phase 1 computes per-tensor
-L2 norms (``multi_tensor_l2norm``) and the global-grad-norm clip; phase 2
-applies the trust-ratio update (``multi_tensor_lamb``,
-csrc/multi_tensor_lamb.cu:24-413).  Options: ``bias_correction``,
-``grad_averaging``, ``adam_w_mode``, ``max_grad_norm``, ``use_nvlamb``.
+(ref: apex/optimizers/fused_lamb.py:1-215): phase 1 computes the global
+grad norm (``multi_tensor_l2norm``) and the Adam-style update
+(``multi_tensor_lamb`` stage 1, csrc/multi_tensor_lamb.cu:60-200); phase 2
+applies per-tensor trust ratios (stage 2, :230-330).  Options:
+``bias_correction``, ``grad_averaging``, ``adam_w_mode``,
+``max_grad_norm``, ``use_nvlamb``.
 
-Per-tensor trust ratios make this a per-leaf computation; XLA fuses each
-leaf's elementwise chain, and the norm reductions are the only extra
-passes — same structure as the reference's two-kernel pipeline.
+TPU design: params/grads/state are packed into LANE-aligned flat fp32
+buffers per dtype group; stage 1 is one fused Pallas pass (4 reads /
+3 writes); per-tensor param/update norms are segment reductions over the
+packed buffer (the reference's per-tensor-norm kernel role); stage 2's
+ratio gather+multiply is left to XLA, which fuses it into a single
+elementwise pass — on TPU there is no launch overhead for a Pallas
+kernel to amortize there.
+
+Trust-ratio gating matches the reference exactly: the adaptive ratio is
+applied only when ``use_nvlamb`` or the group's weight decay is nonzero
+(ref: csrc/multi_tensor_lamb.cu:258 ``use_nvlamb || decay != 0.0``).
 """
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops import multi_tensor
+from ..ops import fused_optim, multi_tensor
 from .fused_adam import ScalarOrSchedule, _lr_at
 
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
-    m: optax.Updates
-    v: optax.Updates
+    m: Tuple[jnp.ndarray, ...]   # fp32 flat buffer per dtype group
+    v: Tuple[jnp.ndarray, ...]
 
 
 def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
@@ -38,17 +47,22 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                grad_averaging: bool = True,
                adam_w_mode: bool = True,
                max_grad_norm: float = 1.0,
-               use_nvlamb: bool = False) -> optax.GradientTransformation:
+               use_nvlamb: bool = False,
+               use_pallas: bool = None) -> optax.GradientTransformation:
+    LANE = multi_tensor.LANE
+
     def init(params):
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        metas = multi_tensor.compute_metas(params, align=LANE)
+        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
         return FusedLAMBState(count=jnp.zeros((), jnp.int32),
                               m=zeros,
-                              v=jax.tree_util.tree_map(jnp.zeros_like, zeros))
+                              v=tuple(jnp.zeros_like(z) for z in zeros))
 
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_lamb requires params in update()")
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)
@@ -59,46 +73,86 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
             bc1 = bc2 = jnp.float32(1.0)
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
 
-        # Phase 1: global grad norm + clip factor
-        # (ref: apex/optimizers/fused_lamb.py:163-185).
-        gnorm = multi_tensor.l2norm(grads)
-        clip = jnp.where(gnorm > max_grad_norm,
-                         max_grad_norm / jnp.maximum(gnorm, 1e-12), 1.0) \
-            if max_grad_norm is not None and max_grad_norm > 0 else 1.0
+        metas = multi_tensor.compute_metas(params, align=LANE)
+        gbufs = multi_tensor.pack(grads, metas)
+        pbufs = multi_tensor.pack(params, metas)
 
-        def leaf_update(g, p, m, v):
-            g = g.astype(jnp.float32) * clip
-            p32 = p.astype(jnp.float32)
-            if not adam_w_mode:
-                g = g + weight_decay * p32
-            m_new = beta1 * m + beta3 * g
-            v_new = beta2 * v + (1.0 - beta2) * g * g
-            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
-            if adam_w_mode:
-                upd = upd + weight_decay * p32
-            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
-            u_norm = jnp.sqrt(jnp.sum(upd * upd))
-            # Trust ratio (ref: csrc/multi_tensor_lamb.cu lamb stage 2):
-            # ratio = w_norm/u_norm when both > 0 else 1.  NVLamb skips the
-            # ratio for params excluded from decay; plain LAMB applies it
-            # everywhere (ref: fused_lamb.py use_nvlamb handling).
-            ratio = jnp.where(
-                (w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-            if not use_nvlamb and weight_decay == 0.0:
-                ratio = jnp.where(jnp.bool_(True), ratio, ratio)
-            return (-lr * ratio * upd).astype(p.dtype), m_new, v_new
+        # Phase 1a: global grad norm + clip factor over ALL groups
+        # (ref: apex/optimizers/fused_lamb.py:163-185 multi_tensor_l2norm
+        # over the union of fp16+fp32 grads; padding gaps are zero).
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in gbufs)
+        gnorm = jnp.sqrt(gsq)
+        if max_grad_norm is not None and max_grad_norm > 0:
+            clip = jnp.where(gnorm > max_grad_norm,
+                             max_grad_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        else:
+            clip = jnp.float32(1.0)
 
-        out = jax.tree_util.tree_map(leaf_update, grads, params,
-                                     state.m, state.v)
-        # tree of tuples -> three trees
-        treedef = jax.tree_util.tree_structure(params)
-        flat = treedef.flatten_up_to(out)
-        updates = treedef.unflatten([t[0] for t in flat])
-        new_m = treedef.unflatten([t[1] for t in flat])
-        new_v = treedef.unflatten([t[2] for t in flat])
-        return updates, FusedLAMBState(count, new_m, new_v)
+        deltas, new_m, new_v = [], [], []
+        for i, meta in enumerate(metas):
+            if fused:
+                u, m, v = fused_optim.lamb_phase1(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    grad_scale=clip, beta1=beta1, beta2=beta2, beta3=beta3,
+                    eps=eps, weight_decay=weight_decay,
+                    bias_correction1=bc1, bias_correction2=bc2,
+                    adam_w_mode=adam_w_mode)
+            else:
+                u, m, v = _lamb_phase1_jnp(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    clip, beta1, beta2, beta3, eps, weight_decay, bc1, bc2,
+                    adam_w_mode)
+            ratio_elem = _trust_ratio_elem(
+                meta, u, pbufs[i].astype(jnp.float32), use_nvlamb,
+                weight_decay)
+            deltas.append(-lr * ratio_elem * u)
+            new_m.append(m)
+            new_v.append(v)
+
+        leaves = jax.tree_util.tree_leaves(params)
+        updates = multi_tensor.unpack_groups(
+            deltas, metas, out_dtypes=[l.dtype for l in leaves])
+        return updates, FusedLAMBState(count, tuple(new_m), tuple(new_v))
 
     return optax.GradientTransformation(init, update)
+
+
+def _trust_ratio_elem(meta, u, p32, use_nvlamb, weight_decay):
+    """Phase 2 ratios: per-tensor param/update norms via segment
+    reductions over the packed buffer, broadcast back per element
+    (ref: multi_tensor_lamb.cu:230-330 LAMBStage2; per-tensor norms are
+    the l2norm kernel's per_tensor=True output).  LANE-aligned packing
+    interleaves the padding id between real segments, so the ids are
+    NOT sorted — no indices_are_sorted promise."""
+    seg = multi_tensor.segment_ids(meta)
+    n_seg = len(meta.sizes) + 1  # +1 for padding gaps
+    if use_nvlamb or weight_decay != 0.0:
+        p_nsq = jax.ops.segment_sum(p32 * p32, seg, n_seg)[:-1]
+        u_nsq = jax.ops.segment_sum(u * u, seg, n_seg)[:-1]
+        ratio = jnp.where((p_nsq > 0) & (u_nsq > 0),
+                          jnp.sqrt(p_nsq) / jnp.sqrt(
+                              jnp.maximum(u_nsq, 1e-24)), 1.0)
+    else:
+        # ref: multi_tensor_lamb.cu:258 — plain LAMB leaves zero-decay
+        # params un-adapted.
+        ratio = jnp.ones((n_seg - 1,), jnp.float32)
+    return jnp.concatenate([ratio, jnp.ones((1,), jnp.float32)])[seg]
+
+
+def _lamb_phase1_jnp(g, p, m, v, gscale, b1, b2, b3, eps, wd, bc1, bc2,
+                     adam_w_mode):
+    """Stage-1 math in plain jnp (ref: csrc/multi_tensor_lamb.cu:60-200)."""
+    g = g.astype(jnp.float32) * gscale
+    p32 = p.astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p32
+    m_new = b1 * m + b3 * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:
+        u = u + wd * p32
+    return u, m_new, v_new
 
 
 FusedLAMB = fused_lamb

@@ -1,0 +1,195 @@
+"""FusedMixedPrecisionLamb — LAMB stepping reduced-precision params with
+fp32 masters, scaler-aware.
+
+Parity with the reference
+(ref: apex/optimizers/fused_mixed_precision_lamb.py:8-256): params live
+in ``reduced_precision_dtype`` (bf16/fp16); the optimizer owns the fp32
+full-precision copy (``_setup_full_precision_params``, :118-127) plus
+fp32 m/v; ``step`` accepts a grad scaler (``_step_supports_amp_scaling``,
+:56) and performs unscale + found-inf check + conditional-skip *inside*
+the fused update (``multi_tensor_lamb_mp`` takes ``found_inf`` and
+``inv_scale``, :245-255); the step counter only advances on finite steps
+(:205 ``group['step'] += (overflow_buf != 1)``).
+
+TPU design: masters/m/v are LANE-aligned packed fp32 buffers; the whole
+step — unscale, global-norm clip (``max_grad_norm * scale`` because the
+norm is of scaled grads, :182-184), LAMB stage 1 (Pallas), per-tensor
+trust ratios, master update, reduced-precision emission — is one pure
+function; overflow skip is a ``jnp.where`` select, so the train step
+never syncs to host.  The reference's fp16 param-remainder trick
+(``multi_tensor_lamb_mp.cu``) is unnecessary here: masters are the
+source of truth and params are re-emitted as ``cast(master)`` each step,
+which is strictly more precise.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..amp import scaler as _scaler
+from ..ops import fused_optim, multi_tensor
+from .fused_adam import ScalarOrSchedule, _lr_at
+from .fused_lamb import _lamb_phase1_jnp, _trust_ratio_elem
+
+
+class MixedPrecisionLambState(NamedTuple):
+    count: jnp.ndarray
+    masters: Tuple[jnp.ndarray, ...]  # fp32 packed full-precision params
+    m: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+class MPLambInfo(NamedTuple):
+    grads_finite: jnp.ndarray
+    grad_norm: jnp.ndarray
+
+
+class FusedMixedPrecisionLamb:
+    """``opt = FusedMixedPrecisionLamb(lr=...); state = opt.init(params);
+    params, state, scaler, info = opt.step(grads, state, params, scaler)``.
+
+    ``params`` may mix reduced-precision and fp32 leaves; every leaf gets
+    an fp32 master (for fp32 leaves the master IS the param, matching the
+    reference's ``None`` full-precision slot, ref:
+    fused_mixed_precision_lamb.py:121-126).
+    """
+
+    def __init__(self,
+                 learning_rate: ScalarOrSchedule = 1e-3,
+                 beta1: float = 0.9,
+                 beta2: float = 0.999,
+                 eps: float = 1e-6,
+                 weight_decay: float = 0.01,
+                 bias_correction: bool = True,
+                 grad_averaging: bool = True,
+                 adam_w_mode: bool = True,
+                 max_grad_norm: float = 1.0,
+                 use_nvlamb: bool = False,
+                 reduced_precision_dtype=jnp.bfloat16,
+                 use_pallas: Optional[bool] = None):
+        self.learning_rate = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.adam_w_mode = adam_w_mode
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.reduced_precision_dtype = reduced_precision_dtype
+        self.use_pallas = use_pallas
+
+    def init(self, params: Any) -> MixedPrecisionLambState:
+        metas = multi_tensor.compute_metas(params,
+                                           align=multi_tensor.LANE)
+        masters = tuple(multi_tensor.pack(params, metas, jnp.float32))
+        return MixedPrecisionLambState(
+            count=jnp.zeros((), jnp.int32),
+            masters=masters,
+            m=tuple(jnp.zeros_like(b) for b in masters),
+            v=tuple(jnp.zeros_like(b) for b in masters))
+
+    def step(self, grads: Any, state: MixedPrecisionLambState, params: Any,
+             scaler_state: Optional[_scaler.ScalerState] = None,
+             axis_names=None):
+        """One conditional LAMB step.  ``grads`` are the (possibly
+        loss-scaled) gradients w.r.t. the reduced-precision params;
+        ``scaler_state`` supplies the scale and receives the
+        backoff/growth update (ref: step(grad_scaler=...),
+        fused_mixed_precision_lamb.py:140+).  Returns
+        ``(new_params, new_state, new_scaler_state, info)``.
+        """
+        fused = self.use_pallas if self.use_pallas is not None \
+            else jax.default_backend() == "tpu"
+        metas = multi_tensor.compute_metas(params,
+                                           align=multi_tensor.LANE)
+        gbufs = multi_tensor.pack(grads, metas)
+
+        finite = _scaler.all_finite(gbufs, axis_names=axis_names)
+        scale = scaler_state.loss_scale if scaler_state is not None \
+            else jnp.float32(1.0)
+        inv_scale = 1.0 / scale
+
+        # step counter advances only on finite steps
+        # (ref: fused_mixed_precision_lamb.py:205).
+        count = state.count + jnp.where(finite, 1, 0)
+        lr = _lr_at(self.learning_rate, count)
+        cf = jnp.maximum(count.astype(jnp.float32), 1.0)
+        if self.bias_correction:
+            bc1 = 1.0 - jnp.float32(self.beta1) ** cf
+            bc2 = 1.0 - jnp.float32(self.beta2) ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = (1.0 - self.beta1) if self.grad_averaging else 1.0
+
+        # Norm is of SCALED grads, so the clip threshold scales too
+        # (ref: fused_mixed_precision_lamb.py:182-184).
+        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                  for g in gbufs)
+        gnorm = jnp.sqrt(gsq)
+        if self.max_grad_norm is not None and self.max_grad_norm > 0:
+            max_eff = self.max_grad_norm * scale
+            clip = jnp.where(gnorm > max_eff,
+                             max_eff / jnp.maximum(gnorm, 1e-12), 1.0)
+        else:
+            clip = jnp.float32(1.0)
+        gscale = inv_scale * clip
+
+        new_masters, new_m, new_v = [], [], []
+        for i, meta in enumerate(metas):
+            if fused:
+                u, m, v = fused_optim.lamb_phase1(
+                    gbufs[i], state.masters[i], state.m[i], state.v[i],
+                    grad_scale=gscale, beta1=self.beta1, beta2=self.beta2,
+                    beta3=beta3, eps=self.eps,
+                    weight_decay=self.weight_decay,
+                    bias_correction1=bc1, bias_correction2=bc2,
+                    adam_w_mode=self.adam_w_mode)
+            else:
+                u, m, v = _lamb_phase1_jnp(
+                    gbufs[i], state.masters[i], state.m[i], state.v[i],
+                    gscale, self.beta1, self.beta2, beta3, self.eps,
+                    self.weight_decay, bc1, bc2, self.adam_w_mode)
+            ratio_elem = _trust_ratio_elem(
+                meta, u, state.masters[i], self.use_nvlamb,
+                self.weight_decay)
+            master_new = state.masters[i] - lr * ratio_elem * u
+            # Overflow: everything holds still (the mp kernel's
+            # found_inf no-op, ref: multi_tensor_lamb_mp.cu).
+            new_masters.append(jnp.where(finite, master_new,
+                                         state.masters[i]))
+            new_m.append(jnp.where(finite, m, state.m[i]))
+            new_v.append(jnp.where(finite, v, state.v[i]))
+
+        leaves = jax.tree_util.tree_leaves(params)
+        new_params = multi_tensor.unpack_groups(
+            new_masters, metas, out_dtypes=[l.dtype for l in leaves])
+
+        new_state = MixedPrecisionLambState(
+            count, tuple(new_masters), tuple(new_m), tuple(new_v))
+        new_scaler = _scaler.update(scaler_state, finite) \
+            if scaler_state is not None else None
+        return new_params, new_state, new_scaler, MPLambInfo(
+            grads_finite=finite, grad_norm=gnorm * inv_scale)
+
+    # -- checkpointing (masters must round-trip in full precision,
+    # ref: fused_mixed_precision_lamb.py:73-117 load_state_dict keeps
+    # state in fp32 rather than casting to param dtype) ------------------
+
+    def state_dict(self, state: MixedPrecisionLambState) -> dict:
+        return {"count": int(state.count),
+                "masters": [jnp.asarray(b) for b in state.masters],
+                "m": [jnp.asarray(b) for b in state.m],
+                "v": [jnp.asarray(b) for b in state.v]}
+
+    def load_state_dict(self, d: dict) -> MixedPrecisionLambState:
+        return MixedPrecisionLambState(
+            count=jnp.int32(d["count"]),
+            masters=tuple(jnp.asarray(b, jnp.float32)
+                          for b in d["masters"]),
+            m=tuple(jnp.asarray(b, jnp.float32) for b in d["m"]),
+            v=tuple(jnp.asarray(b, jnp.float32) for b in d["v"]))
+
+
+fused_mixed_precision_lamb = FusedMixedPrecisionLamb

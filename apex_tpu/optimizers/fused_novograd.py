@@ -4,23 +4,30 @@ NovoGrad keeps the second moment as ONE scalar per tensor — the moving
 average of the per-tensor gradient L2 norm (ref: fused_novograd.py
 ``norm_type=2``, kernel csrc/multi_tensor_novograd.cu).  Options:
 ``grad_averaging``, ``init_zero`` (v0 = 0 vs v0 = ||g1||^2),
-``adam_w_mode``-style decoupled decay, bias correction.
+decoupled decay, bias correction.
+
+TPU design mirrors FusedLAMB: params/grads/m are LANE-aligned packed
+flat buffers; the per-tensor ||g||^2 is a segment reduction (the
+reference's per-tensor norm pass); the normalize+decay+momentum+delta
+chain is one fused Pallas pass (``ops/fused_optim.novograd_update``) or
+the identical jnp math under ``use_pallas=False``.
 """
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from ..ops import fused_optim, multi_tensor
 from .fused_adam import ScalarOrSchedule, _lr_at
 
 
 class FusedNovoGradState(NamedTuple):
     count: jnp.ndarray
-    m: optax.Updates          # per-element first moment (fp32)
-    v: optax.Updates          # per-tensor scalar second moment (fp32)
+    m: Tuple[jnp.ndarray, ...]   # fp32 flat buffer per dtype group
+    v: Tuple[jnp.ndarray, ...]   # (num_tensors,) scalar second moments
 
 
 def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
@@ -31,22 +38,26 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
                    grad_averaging: bool = True,
                    init_zero: bool = False,
                    bias_correction: bool = True,
-                   norm_type: int = 2) -> optax.GradientTransformation:
+                   norm_type: int = 2,
+                   use_pallas: bool = None) -> optax.GradientTransformation:
     if norm_type != 2:
         raise ValueError("only norm_type=2 is supported "
                          "(ref: apex/optimizers/fused_novograd.py)")
+    LANE = multi_tensor.LANE
 
     def init(params):
+        metas = multi_tensor.compute_metas(params, align=LANE)
         return FusedNovoGradState(
             count=jnp.zeros((), jnp.int32),
-            m=jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params),
-            v=jax.tree_util.tree_map(
-                lambda p: jnp.zeros((), jnp.float32), params))
+            m=tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas),
+            v=tuple(jnp.zeros((len(m.sizes),), jnp.float32)
+                    for m in metas))
 
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_novograd requires params in update()")
+        fused = use_pallas if use_pallas is not None \
+            else jax.default_backend() == "tpu"
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)
@@ -58,31 +69,47 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
         first = state.count == 0
 
-        def leaf_update(g, p, m, v):
-            g = g.astype(jnp.float32)
-            p32 = p.astype(jnp.float32)
-            gnorm_sq = jnp.sum(g * g)
+        metas = multi_tensor.compute_metas(params, align=LANE)
+        gbufs = multi_tensor.pack(grads, metas)
+        pbufs = multi_tensor.pack(params, metas)
+
+        deltas, new_m, new_v = [], [], []
+        for i, meta in enumerate(metas):
+            seg = multi_tensor.segment_ids(meta)
+            n_seg = len(meta.sizes) + 1
+            g32 = gbufs[i].astype(jnp.float32)
+            # aligned packing interleaves the padding id -> ids unsorted
+            gn_sq = jax.ops.segment_sum(g32 * g32, seg, n_seg)[:-1]
             if init_zero:
-                v_new = beta2 * v + (1.0 - beta2) * gnorm_sq
+                v_new = beta2 * state.v[i] + (1.0 - beta2) * gn_sq
             else:
                 # v0 = ||g1||^2 on the first step
                 # (ref: fused_novograd.py init_zero=False default).
-                v_new = jnp.where(first, gnorm_sq,
-                                  beta2 * v + (1.0 - beta2) * gnorm_sq)
-            denom = jnp.sqrt(v_new / bc2) + eps
-            scaled = g / denom + weight_decay * p32
-            m_new = beta1 * m + beta3 * scaled
-            upd = m_new / bc1
-            return (-lr * upd).astype(p.dtype), m_new, v_new
+                v_new = jnp.where(first, gn_sq,
+                                  beta2 * state.v[i]
+                                  + (1.0 - beta2) * gn_sq)
+            denom_t = jnp.sqrt(v_new / bc2) + eps
+            denom_elem = jnp.concatenate(
+                [denom_t, jnp.ones((1,), jnp.float32)])[seg]
+            if fused:
+                d, m = fused_optim.novograd_update(
+                    gbufs[i], pbufs[i], state.m[i], denom_elem,
+                    lr=lr, beta1=beta1, beta3=beta3,
+                    weight_decay=weight_decay, bias_correction1=bc1)
+            else:
+                scaled = g32 / denom_elem \
+                    + weight_decay * pbufs[i].astype(jnp.float32)
+                m = beta1 * state.m[i] + beta3 * scaled
+                d = -lr * m / bc1
+            deltas.append(d)
+            new_m.append(m)
+            new_v.append(v_new)
 
-        out = jax.tree_util.tree_map(leaf_update, grads, params,
-                                     state.m, state.v)
-        treedef = jax.tree_util.tree_structure(params)
-        flat = treedef.flatten_up_to(out)
-        updates = treedef.unflatten([t[0] for t in flat])
-        new_m = treedef.unflatten([t[1] for t in flat])
-        new_v = treedef.unflatten([t[2] for t in flat])
-        return updates, FusedNovoGradState(count, new_m, new_v)
+        leaves = jax.tree_util.tree_leaves(params)
+        updates = multi_tensor.unpack_groups(
+            deltas, metas, out_dtypes=[l.dtype for l in leaves])
+        return updates, FusedNovoGradState(count, tuple(new_m),
+                                           tuple(new_v))
 
     return optax.GradientTransformation(init, update)
 

@@ -204,9 +204,12 @@ def test_fused_adagrad_matches_optax():
 # --- LAMB -------------------------------------------------------------------
 
 def test_fused_lamb_trust_ratio_math():
+    # use_nvlamb=True applies the adaptive ratio to zero-decay params too
+    # (ref: csrc/multi_tensor_lamb.cu:258 `use_nvlamb || decay != 0`).
     params = {"w": jnp.full((64,), 2.0)}
     tx = opt.fused_lamb(0.1, weight_decay=0.0, max_grad_norm=1e9,
-                        bias_correction=True, grad_averaging=True)
+                        bias_correction=True, grad_averaging=True,
+                        use_nvlamb=True, use_pallas=False)
     state = tx.init(params)
     g = {"w": jnp.full((64,), 0.1)}
     u, _ = tx.update(g, state, params)
@@ -218,9 +221,21 @@ def test_fused_lamb_trust_ratio_math():
     np.testing.assert_allclose(np.asarray(u["w"]), expect, rtol=1e-3)
 
 
+def test_fused_lamb_no_ratio_without_decay_or_nvlamb():
+    # Plain LAMB leaves zero-decay params un-adapted
+    # (ref: csrc/multi_tensor_lamb.cu:255-262).
+    params = {"w": jnp.full((64,), 2.0)}
+    g = {"w": jnp.full((64,), 0.1)}
+    tx = opt.fused_lamb(0.1, weight_decay=0.0, max_grad_norm=1e9,
+                        use_nvlamb=False, use_pallas=False)
+    u, _ = tx.update(g, tx.init(params), params)
+    # ratio == 1 -> update is just -lr * adam-style update (~ -0.1 each)
+    np.testing.assert_allclose(np.asarray(u["w"]), -0.1, rtol=1e-3)
+
+
 def test_fused_lamb_grad_clipping():
     params = make_params()
-    tx = opt.fused_lamb(0.1, max_grad_norm=0.5)
+    tx = opt.fused_lamb(0.1, max_grad_norm=0.5, use_pallas=False)
     state = tx.init(params)
     g = make_grads(params)
     gnorm = float(mt.l2norm(g))
@@ -230,22 +245,132 @@ def test_fused_lamb_grad_clipping():
                for l in jax.tree_util.tree_leaves(u))
 
 
+def test_fused_lamb_pallas_matches_jnp():
+    params = make_params()
+    g = make_grads(params)
+    kw = dict(weight_decay=0.01, max_grad_norm=1.0)
+    tx_j = opt.fused_lamb(0.1, use_pallas=False, **kw)
+    tx_p = opt.fused_lamb(0.1, use_pallas=True, **kw)  # interpret on CPU
+    u_j, s_j = tx_j.update(g, tx_j.init(params), params)
+    u_p, s_p = tx_p.update(g, tx_p.init(params), params)
+    tree_close(u_j, u_p, rtol=1e-6, atol=1e-7)
+    for a, b in zip(s_j.m, s_p.m):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fused_novograd_pallas_matches_jnp():
+    params = make_params()
+    g = make_grads(params)
+    tx_j = opt.fused_novograd(1e-2, weight_decay=0.01, use_pallas=False)
+    tx_p = opt.fused_novograd(1e-2, weight_decay=0.01, use_pallas=True)
+    u_j, _ = tx_j.update(g, tx_j.init(params), params)
+    u_p, _ = tx_p.update(g, tx_p.init(params), params)
+    tree_close(u_j, u_p, rtol=1e-6, atol=1e-7)
+
+
 # --- NovoGrad ---------------------------------------------------------------
 
 def test_fused_novograd_per_tensor_v():
     params = make_params()
-    tx = opt.fused_novograd(1e-2)
+    tx = opt.fused_novograd(1e-2, use_pallas=False)
     state = tx.init(params)
-    assert jax.tree_util.tree_leaves(state.v)[0].shape == ()
+    metas = mt.compute_metas(params, align=mt.LANE)
+    # second moment is ONE scalar per tensor (ref: fused_novograd.py)
+    assert state.v[0].shape == (len(metas[0].sizes),)
     g = make_grads(params)
     u, s2 = tx.update(g, state, params)
-    # first step: v = ||g||^2 per tensor (init_zero=False)
+    # first step: v = ||g||^2 per tensor (init_zero=False); the packed
+    # order follows the meta's leaf_indices
     leaves_g = jax.tree_util.tree_leaves(g)
-    leaves_v = jax.tree_util.tree_leaves(s2.v)
-    for gl, vl in zip(leaves_g, leaves_v):
-        np.testing.assert_allclose(float(vl),
-                                   float(jnp.sum(gl.astype(jnp.float32)**2)),
-                                   rtol=1e-5)
+    for k, leaf_idx in enumerate(metas[0].leaf_indices):
+        gl = leaves_g[leaf_idx]
+        np.testing.assert_allclose(
+            float(s2.v[0][k]),
+            float(jnp.sum(gl.astype(jnp.float32) ** 2)), rtol=1e-5)
+
+
+# --- FusedMixedPrecisionLamb ------------------------------------------------
+
+def test_mp_lamb_matches_fused_lamb_on_fp32():
+    # With fp32 params and no scaler, the mp variant must reproduce
+    # plain FusedLAMB stepping (masters == params).
+    params = make_params()
+    g = make_grads(params)
+    tx = opt.fused_lamb(0.1, weight_decay=0.01, use_pallas=False)
+    u, _ = tx.update(g, tx.init(params), params)
+    want = optax.apply_updates(params, u)
+
+    mp = opt.FusedMixedPrecisionLamb(0.1, weight_decay=0.01,
+                                     use_pallas=False)
+    new_p, _, sc, info = mp.step(g, mp.init(params), params)
+    assert sc is None and bool(info.grads_finite)
+    tree_close(want, new_p, rtol=1e-6, atol=1e-7)
+
+
+def test_mp_lamb_bf16_params_fp32_masters():
+    params = make_params(dtype=jnp.bfloat16)
+    g = make_grads(params)
+    mp = opt.FusedMixedPrecisionLamb(0.1, weight_decay=0.01,
+                                     use_pallas=False)
+    state = mp.init(params)
+    assert all(b.dtype == jnp.float32 for b in state.masters)
+    new_p, new_state, _, _ = mp.step(g, state, params)
+    # params re-emitted as cast(master): bf16 out, masters moved
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(new_p))
+    assert not np.allclose(np.asarray(new_state.masters[0]),
+                           np.asarray(state.masters[0]))
+    # emission is exactly the cast of the master buffer
+    metas = mt.compute_metas(params, align=mt.LANE)
+    emitted = mt.pack(new_p, metas, jnp.bfloat16)[0]
+    np.testing.assert_array_equal(
+        np.asarray(emitted, np.float32),
+        np.asarray(new_state.masters[0].astype(jnp.bfloat16), np.float32))
+
+
+def test_mp_lamb_scaler_overflow_skips_and_backs_off():
+    from apex_tpu.amp import scaler as sc
+    params = make_params()
+    g = make_grads(params)
+    g["scalar"] = jnp.float32(jnp.inf)
+    mp = opt.FusedMixedPrecisionLamb(0.1, use_pallas=False)
+    state = mp.init(params)
+    scaler = sc.init("dynamic")
+    new_p, new_state, new_scaler, info = mp.step(g, state, params,
+                                                 scaler_state=scaler)
+    assert not bool(info.grads_finite)
+    assert int(new_state.count) == 0  # step counter held still
+    tree_close(params, new_p, rtol=0, atol=0)
+    assert float(new_scaler.loss_scale) == float(scaler.loss_scale) * 0.5
+
+
+def test_mp_lamb_scaler_unscales_grads():
+    # Stepping with scaled grads + scaler must equal stepping with raw
+    # grads and no scaler (static scale, fp32 params).
+    from apex_tpu.amp import scaler as sc
+    params = make_params()
+    g = make_grads(params)
+    mp = opt.FusedMixedPrecisionLamb(0.1, weight_decay=0.01,
+                                     use_pallas=False)
+    p_raw, _, _, _ = mp.step(g, mp.init(params), params)
+    scaler = sc.init(1024.0)
+    g_scaled = jax.tree_util.tree_map(lambda x: x * 1024.0, g)
+    p_scaledpath, _, _, _ = mp.step(g_scaled, mp.init(params), params,
+                                    scaler_state=scaler)
+    tree_close(p_raw, p_scaledpath, rtol=1e-5, atol=1e-6)
+
+
+def test_mp_lamb_checkpoint_roundtrip():
+    params = make_params(dtype=jnp.bfloat16)
+    mp = opt.FusedMixedPrecisionLamb(0.1, use_pallas=False)
+    state = mp.init(params)
+    new_p, state, _, _ = mp.step(make_grads(params), state, params)
+    d = mp.state_dict(state)
+    restored = mp.load_state_dict(d)
+    assert int(restored.count) == int(state.count)
+    np.testing.assert_array_equal(np.asarray(restored.masters[0]),
+                                  np.asarray(state.masters[0]))
 
 
 # --- LARC -------------------------------------------------------------------
