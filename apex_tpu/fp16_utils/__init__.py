@@ -1,1 +1,38 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Legacy fp16 utilities (parity with ``apex/fp16_utils``).
+
+Exports mirror ``apex/fp16_utils/__init__.py:1-16``: the deprecated
+``FP16_Optimizer`` master-weight wrapper, the legacy standalone loss
+scalers, and the network conversion helpers.  ``convert_network`` is live
+(amp O2/O5 uses the same implementation via :mod:`apex_tpu.amp.cast`).
+"""
+from .fp16_optimizer import FP16_Optimizer
+from .fp16util import (
+    BN_convert_float,
+    FP16Model,
+    convert_network,
+    fp16_model,
+    master_copy,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+from .loss_scaler import DynamicLossScaler, LossScaler, to_python_float
+
+__all__ = [
+    "FP16_Optimizer",
+    "LossScaler",
+    "DynamicLossScaler",
+    "to_python_float",
+    "BN_convert_float",
+    "FP16Model",
+    "fp16_model",
+    "convert_network",
+    "master_copy",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "tofp16",
+]

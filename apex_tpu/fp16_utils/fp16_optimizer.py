@@ -1,0 +1,222 @@
+"""Legacy explicit master-weight optimizer wrapper (``FP16_Optimizer``).
+
+Parity surface for ``apex/fp16_utils/fp16_optimizer.py:13-554``.  The
+reference mutates a torch optimizer in place (swaps fp32 masters into
+``param_groups``, stashes fp16 grads, applies loss scaling with a
+host-synced overflow probe).  Here the same *workflow* — explicit masters,
+``backward``/``update_master_grads``/``clip_master_grads``/``step`` call
+sequence, overflow skip, state_dict round-trip — is provided as a
+host-side class holding pytrees, with the per-step math jit-compiled.
+
+This is the deprecated API kept for migration parity; new code should use
+:func:`apex_tpu.amp.initialize` (the reference deprecates FP16_Optimizer
+in favour of amp the same way).
+
+Usage (mirrors the reference's example at fp16_optimizer.py docstring)::
+
+    opt = FP16_Optimizer(params, optax_tx, static_loss_scale=128.0)
+    loss, grads = jax.value_and_grad(lambda p: opt.scale(loss_fn(p)))(
+        opt.model_params)
+    opt.backward(grads)            # stash + unscale into master grads
+    opt.clip_master_grads(1.0)     # optional
+    opt.step()                     # skip-on-overflow, masters -> model
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..amp import cast as _cast
+from .loss_scaler import DynamicLossScaler, LossScaler, to_python_float
+
+
+class FP16_Optimizer:
+    """Explicit master-weight wrapper over an optax transformation
+    (ref: apex/fp16_utils/fp16_optimizer.py:14-108 ``__init__``)."""
+
+    def __init__(self, params: Any, optimizer: optax.GradientTransformation,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = optimizer
+        # Model params stay in the caller's dtype (fp16/bf16); masters are
+        # the fp32 stepping copy (ref: fp16_optimizer.py:40-77).
+        self.model_params = params
+        self.master_params = _cast.master_copy(params)
+        self.opt_state = optimizer.init(self.master_params)
+        self.master_grads: Optional[Any] = None
+        self._scaled_model_grads: Optional[Any] = None
+        self.overflow = False
+        self.first_closure_call_this_step = True
+        self.verbose = verbose
+
+        if dynamic_loss_scale:
+            self.dynamic_loss_scale = True
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.dynamic_loss_scale = False
+            self.loss_scaler = LossScaler(static_loss_scale)
+
+        self._jit_step = jax.jit(self._step_impl)
+
+    def maybe_print(self, msg: str) -> None:
+        if self.verbose:
+            print(msg)
+
+    # -- gradient plumbing --------------------------------------------------
+
+    def scale(self, loss):
+        """Scale a loss before differentiation (the tape-free half of the
+        reference's ``backward(loss)``, ref: fp16_optimizer.py:373-434)."""
+        return self.loss_scaler.scale_loss(loss)
+
+    def zero_grad(self, set_grads_to_None: bool = True) -> None:
+        """Drop stashed grads (ref: fp16_optimizer.py:120-145; grads are
+        functional here, so both modes just clear the stash)."""
+        self.master_grads = None
+        self._scaled_model_grads = None
+
+    def backward(self, scaled_grads: Any,
+                 update_master_grads: bool = True) -> None:
+        """Accept gradients of the *scaled* loss w.r.t. ``model_params``
+        (ref: fp16_optimizer.py:373-434 — autograd produces scaled fp16
+        grads; here the caller differentiates ``self.scale(loss)``)."""
+        self._scaled_model_grads = scaled_grads
+        if update_master_grads:
+            self.update_master_grads()
+
+    def update_master_grads(self, scaled_grads: Optional[Any] = None) -> None:
+        """Unscale stashed model grads into fp32 master grads and run the
+        overflow probe (ref: fp16_optimizer.py:436-491)."""
+        if scaled_grads is not None:
+            self._scaled_model_grads = scaled_grads
+        assert self._scaled_model_grads is not None, \
+            "no stashed gradients: call backward() first"
+        inv = 1.0 / self.loss_scaler.loss_scale
+        self.master_grads = jax.tree_util.tree_map(
+            lambda g: jnp.asarray(g).astype(jnp.float32) * inv,
+            self._scaled_model_grads)
+        self.overflow = self.loss_scaler.has_overflow(self.master_grads)
+        # NOTE: the scale schedule advances in step(), not here — under
+        # gradient accumulation this runs once per micro-batch but the
+        # scaler must tick once per optimizer step (reference semantics:
+        # _update_scale inside FP16_Optimizer.step).
+
+    def clip_master_grads(self, max_norm: float,
+                          norm_type: float = 2) -> float:
+        """Clip master grads by global norm, return the pre-clip norm
+        (ref: fp16_optimizer.py:185-207; only norm_type=2 is supported,
+        matching every in-repo reference call site)."""
+        if norm_type != 2:
+            raise NotImplementedError(
+                "clip_master_grads supports norm_type=2 only")
+        if self.master_grads is None:
+            return 0.0
+        leaves = jax.tree_util.tree_leaves(self.master_grads)
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        self.master_grads = jax.tree_util.tree_map(
+            lambda g: g * coef, self.master_grads)
+        return to_python_float(norm)
+
+    # -- stepping -----------------------------------------------------------
+
+    def _step_impl(self, master_params, opt_state, master_grads,
+                   model_params):
+        updates, new_opt_state = self.optimizer.update(
+            master_grads, opt_state, master_params)
+        new_masters = optax.apply_updates(master_params, updates)
+        new_model = _cast.restore_dtypes(new_masters, model_params)
+        return new_masters, new_opt_state, new_model
+
+    def step(self, closure=None):
+        """Apply master grads unless this iteration overflowed
+        (ref: fp16_optimizer.py:272-333; closure form :334-371)."""
+        if closure is not None:
+            return self._step_with_closure(closure)
+        self.loss_scaler.update_scale(self.overflow)
+        if self.overflow:
+            self.maybe_print(
+                f"Gradient overflow.  Skipping step, reducing loss scale "
+                f"to {self.loss_scaler.loss_scale}")
+            return None
+        assert self.master_grads is not None, \
+            "call backward()/update_master_grads() before step()"
+        (self.master_params, self.opt_state,
+         self.model_params) = self._jit_step(
+            self.master_params, self.opt_state, self.master_grads,
+            self.model_params)
+        return None
+
+    def _step_with_closure(self, closure):
+        """Re-evaluation loop: the closure recomputes loss+grads against
+        the current params (ref: fp16_optimizer.py:334-371).  The closure
+        must call ``backward``/``update_master_grads`` itself and return
+        the loss."""
+        loss = closure()
+        # Bounded retry: once the dynamic scale has backed off to its
+        # floor (1.0), a still-non-finite gradient is a genuine NaN in
+        # the model, not a scaling overflow — re-evaluating can never fix
+        # it, so fail instead of spinning.
+        retries = 0
+        while self.overflow:
+            self.loss_scaler.update_scale(True)
+            scale = self.loss_scaler.loss_scale
+            self.maybe_print(
+                f"OVERFLOW within closure! Re-evaluating at loss "
+                f"scale {scale}")
+            if scale <= 1.0 or retries >= 64:
+                raise FloatingPointError(
+                    "gradients remain non-finite at loss scale "
+                    f"{scale} after {retries} closure re-evaluations — "
+                    "the model is producing NaN/inf independent of loss "
+                    "scaling")
+            retries += 1
+            loss = closure()
+        self.step()
+        return loss
+
+    # -- introspection / checkpointing --------------------------------------
+
+    def inspect_master_grad_data(self):
+        """ref: fp16_optimizer.py:493-526 — expose the master grads."""
+        return self.master_grads
+
+    def _get_loss_scale(self) -> float:
+        return self.loss_scaler.loss_scale
+
+    def _set_loss_scale(self, value: float) -> None:
+        self.loss_scaler.cur_scale = float(value)
+
+    loss_scale = property(_get_loss_scale, _set_loss_scale)
+
+    def state_dict(self) -> dict:
+        """ref: fp16_optimizer.py:209-228 — scaler config + overflow flag +
+        masters + inner optimizer state."""
+        return {
+            "loss_scaler": self.loss_scaler,
+            "dynamic_loss_scale": self.dynamic_loss_scale,
+            "overflow": self.overflow,
+            "first_closure_call_this_step":
+                self.first_closure_call_this_step,
+            "optimizer_state_dict": self.opt_state,
+            "fp32_from_fp16": self.master_params,
+        }
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        """ref: fp16_optimizer.py:230-270 — restores masters *into* the
+        wrapper; model params are refreshed from them so a checkpoint
+        taken at any precision resumes bitwise."""
+        self.loss_scaler = state_dict["loss_scaler"]
+        self.dynamic_loss_scale = state_dict["dynamic_loss_scale"]
+        self.overflow = state_dict["overflow"]
+        self.first_closure_call_this_step = state_dict[
+            "first_closure_call_this_step"]
+        self.opt_state = state_dict["optimizer_state_dict"]
+        self.master_params = state_dict["fp32_from_fp16"]
+        self.model_params = _cast.restore_dtypes(self.master_params,
+                                                 self.model_params)

@@ -1,0 +1,110 @@
+"""Precision conversion helpers for parameter pytrees.
+
+Parity surface for ``apex/fp16_utils/fp16util.py:7-187`` (``tofp16``,
+``BN_convert_float``, ``network_to_half``, ``convert_network``,
+``prep_param_lists``, ``model_grads_to_master_grads``,
+``master_params_to_model_params``, ``FP16Model``) re-expressed over
+pytrees.  The structural isinstance-walk of the reference becomes pure
+tree maps; the ``flat_master`` option (reference packs all masters into
+one contiguous fp32 buffer, ref: fp16util.py:90-133) maps onto the
+multi-tensor pack used by the fused optimizers.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..amp import cast as _cast
+from ..ops import multi_tensor as _mt
+
+# Re-exports: amp's live conversion machinery is the single implementation
+# (the reference likewise has amp O2/O5 call into fp16util,
+# ref: apex/amp/_initialize.py:176-182).
+convert_network = _cast.convert_network
+master_copy = _cast.master_copy
+
+
+def tofp16(x: Any) -> Any:
+    """Cast floating leaves to fp16 (ref: fp16util.py:7 ``tofp16`` module —
+    an input-cast layer; here a pure function usable anywhere)."""
+    return _cast.tree_cast(x, jnp.float16)
+
+
+def BN_convert_float(params: Any,
+                     bn_predicate: Optional[Callable] = None) -> Any:
+    """Force batch-norm leaves back to fp32 in an otherwise-half tree
+    (ref: fp16util.py:22-32 walks modules; here the BN leaves are found by
+    path predicate)."""
+    pred = bn_predicate or _cast.default_bn_predicate
+
+    def _fix(path, x):
+        x = jnp.asarray(x)
+        if pred(path) and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_fix, params)
+
+
+def network_to_half(params: Any) -> Any:
+    """Half-cast keeping BN fp32 (ref: fp16util.py:35-41 wraps the network
+    in ``tofp16 -> BN_convert_float(half net)``)."""
+    return _cast.convert_network(params, jnp.float16,
+                                 keep_batchnorm_fp32=True)
+
+
+def fp16_model(apply_fn: Callable) -> Callable:
+    """Wrap an apply function so inputs are cast to fp16 on entry
+    (ref: fp16util.py:73-84 ``FP16Model`` — convert network + prepend
+    ``tofp16``).  Cast the params with :func:`network_to_half` separately;
+    this handles the input side."""
+    def wrapped(params, *args, **kwargs):
+        return apply_fn(params, *[tofp16(a) for a in args], **kwargs)
+    return wrapped
+
+
+# Class-style alias for API parity with the reference's module wrapper.
+FP16Model = fp16_model
+
+
+def prep_param_lists(params: Any, flat_master: bool = False
+                     ) -> Tuple[Any, Any]:
+    """Return ``(model_params, master_params)``: the model tree unchanged
+    plus an fp32 master copy (ref: fp16util.py:90-133).
+
+    With ``flat_master=True`` the masters are packed into contiguous fp32
+    buffers (one per shape-compatible group) exactly as the reference
+    flattens into one ``_flatten_dense_tensors`` buffer; the accompanying
+    metas let :func:`master_params_to_model_params` unpack.
+    """
+    if flat_master:
+        masters = _cast.master_copy(params)
+        buffers, metas = _mt.pack_groups(masters)
+        return params, (buffers, metas)
+    return params, _cast.master_copy(params)
+
+
+def model_grads_to_master_grads(model_grads: Any, master_params: Any,
+                                flat_master: bool = False) -> Any:
+    """fp32-cast model grads into master layout
+    (ref: fp16util.py:136-156)."""
+    grads32 = _cast.tree_cast(model_grads, jnp.float32)
+    if flat_master:
+        buffers, metas = _mt.pack_groups(grads32)
+        return (buffers, metas)
+    return grads32
+
+
+def master_params_to_model_params(model_params: Any, master_params: Any,
+                                  flat_master: bool = False) -> Any:
+    """Emit model-dtype params from the masters
+    (ref: fp16util.py:158-186).  Returns the new model tree (functional —
+    no in-place copy)."""
+    if flat_master:
+        buffers, metas = master_params
+        masters = _mt.unpack_groups(buffers, metas)
+    else:
+        masters = master_params
+    return _cast.restore_dtypes(masters, model_params)

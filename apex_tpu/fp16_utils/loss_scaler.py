@@ -1,0 +1,118 @@
+"""Legacy standalone loss scalers (``LossScaler`` / ``DynamicLossScaler``).
+
+Parity surface for the reference's deprecated scalers
+(ref: apex/fp16_utils/loss_scaler.py:10,47).  These are *host-side*
+objects: ``has_overflow`` synchronises with the device each call, exactly
+like the reference's ``.item()``-based overflow probe.  New code should
+use the functional, sync-free :mod:`apex_tpu.amp.scaler` instead — these
+classes exist so reference users migrating legacy scripts find the same
+names and schedule semantics.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def to_python_float(t) -> float:
+    """ref: apex/fp16_utils/loss_scaler.py:4 — host scalar extraction."""
+    return float(jnp.asarray(t).reshape(()))
+
+
+def _tree_has_inf_or_nan(tree: Any) -> bool:
+    """Host-synced finite probe over a gradient pytree
+    (ref: apex/fp16_utils/loss_scaler.py:30,92 ``_has_inf_or_nan``)."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if x is not None]
+    if not leaves:
+        return False
+    finite = jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+    return not bool(finite)
+
+
+class LossScaler:
+    """Static loss scale (ref: apex/fp16_utils/loss_scaler.py:10-44).
+
+    ``update_scale`` never changes the scale; ``has_overflow`` always
+    reports False (the static scaler trusts the user-chosen scale, as the
+    reference does).
+    """
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    def has_overflow(self, params) -> bool:
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads: Any) -> Any:
+        """Multiply a gradient pytree by the scale (the reference's
+        module-hook form, ref: loss_scaler.py:40)."""
+        s = self.loss_scale
+        return jax.tree_util.tree_map(lambda g: g * s, grads)
+
+    def scale_loss(self, loss):
+        """``loss * loss_scale`` — the functional stand-in for
+        ``backward(loss)`` (JAX has no tape; differentiate the scaled
+        loss, ref: loss_scaler.py:43)."""
+        return loss * self.loss_scale
+
+    # Legacy alias kept for call-site parity.
+    backward = scale_loss
+
+
+class DynamicLossScaler:
+    """Dynamic loss scale with the reference's schedule
+    (ref: apex/fp16_utils/loss_scaler.py:47-131): on overflow divide by
+    ``scale_factor`` (floored at 1); grow by ``scale_factor`` every
+    ``scale_window`` iterations since the last overflow.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 32,
+                 scale_factor: float = 2.0, scale_window: int = 1000):
+        self.cur_scale = float(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+
+    def has_overflow(self, params) -> bool:
+        return _tree_has_inf_or_nan(params)
+
+    @staticmethod
+    def _has_inf_or_nan(x) -> bool:
+        return _tree_has_inf_or_nan(x)
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if (self.cur_iter - self.last_overflow_iter) \
+                    % self.scale_window == 0:
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads: Any) -> Any:
+        s = self.loss_scale
+        return jax.tree_util.tree_map(lambda g: g * s, grads)
+
+    def scale_loss(self, loss):
+        return loss * self.loss_scale
+
+    backward = scale_loss

@@ -1,1 +1,50 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Parameter reparameterization (parity with ``apex/reparameterization``).
+
+The reference installs forward-pre hooks that recompute weights from
+auxiliary parameters before every module call
+(ref: apex/reparameterization/__init__.py:4-103).  The functional
+workflow here (pass the SAME ``dim`` to every call — it is not stored in
+the tree; flax ``(in, out)`` kernels want ``dim=-1`` for per-output
+magnitudes)::
+
+    params = apply_weight_norm(params, dim=-1)          # w -> (w_v, w_g)
+    def loss_fn(params):
+        real = reparameterize_weight_norm(params, dim=-1)   # inside jit
+        return model.apply({"params": real}, x)
+    params = remove_weight_norm(params, dim=-1)         # collapse back
+"""
+from functools import partial
+
+from .reparameterization import (
+    Reparameterization,
+    apply_reparameterization,
+    remove_reparameterization,
+    reparameterize,
+)
+from .weight_norm import WeightNorm
+
+
+def apply_weight_norm(params, name: str = "", dim=0, predicate=None):
+    """ref: apex/reparameterization/__init__.py ``apply_weight_norm`` —
+    decompose matching leaves into ``_v``/``_g`` pairs."""
+    return apply_reparameterization(params, WeightNorm, name=name,
+                                    dim=dim, predicate=predicate)
+
+
+def remove_weight_norm(params, name: str = "", dim=0):
+    """ref: apex/reparameterization/__init__.py ``remove_weight_norm``."""
+    return remove_reparameterization(params, WeightNorm, name=name, dim=dim)
+
+
+reparameterize_weight_norm = partial(reparameterize, reparameterization=WeightNorm)
+
+__all__ = [
+    "Reparameterization",
+    "WeightNorm",
+    "apply_reparameterization",
+    "remove_reparameterization",
+    "reparameterize",
+    "apply_weight_norm",
+    "remove_weight_norm",
+    "reparameterize_weight_norm",
+]

@@ -1,0 +1,150 @@
+"""Generic parameter reparameterization over pytrees.
+
+Parity surface for ``apex/reparameterization/reparameterization.py``
+(``Reparameterization`` base: decompose a weight into auxiliary
+parameters, recompute it before every forward).  The reference installs
+module forward-pre hooks; JAX has no module mutation, so the same
+contract is functional: :func:`apply_reparameterization` rewrites a param
+pytree (each targeted leaf ``w`` becomes ``w_v``/``w_g`` style auxiliary
+leaves) and :func:`reparameterize` — called at the top of the user's
+apply/loss function, *inside* jit — materializes the weights again, so
+gradients flow to the auxiliary parameters exactly as the hook-based
+recompute does.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+class Reparameterization:
+    """Base class: how one weight decomposes and recomposes.
+
+    Subclasses define ``SUFFIXES`` (auxiliary leaf name suffixes),
+    :meth:`decompose` (weight -> aux tuple) and :meth:`compute_weight`
+    (aux tuple -> weight), mirroring the reference's
+    ``compute_weight``/``reparameterize``/``remove`` triple
+    (ref: apex/reparameterization/reparameterization.py).
+    """
+
+    SUFFIXES: Tuple[str, ...] = ()
+
+    @staticmethod
+    def decompose(weight: jnp.ndarray, dim: Optional[int]):
+        raise NotImplementedError
+
+    @staticmethod
+    def compute_weight(*aux, dim: Optional[int]):
+        raise NotImplementedError
+
+
+def _is_mapping(x) -> bool:
+    # Accept flax FrozenDict and any mapping, not just plain dict.
+    import collections.abc
+    return isinstance(x, collections.abc.Mapping)
+
+
+def _rebuild(node, out: dict):
+    """Reconstruct with the input's mapping type (FrozenDict stays
+    frozen)."""
+    if isinstance(node, dict):
+        return out
+    try:
+        return type(node)(out)
+    except Exception:
+        return out
+
+
+def _aux_base(node, k: str, sfx) -> Optional[str]:
+    """k is part of a decomposition only if the FULL suffix family is
+    present at this level — a leaf merely *named* like one (e.g. a plain
+    'gate_g' parameter) is left untouched."""
+    for s in sfx:
+        if k.endswith(s):
+            base = k[: -len(s)]
+            if all(base + s2 in node for s2 in sfx):
+                return base
+    return None
+
+
+def default_predicate(name: str, leaf) -> bool:
+    """Reference default: all parameters except 1-d vectors and scalars
+    (ref: apex/reparameterization/__init__.py apply_weight_norm doc)."""
+    arr = jnp.asarray(leaf)
+    return (jnp.issubdtype(arr.dtype, jnp.floating) and arr.ndim >= 2)
+
+
+def apply_reparameterization(params: Any, reparameterization,
+                             name: str = "", dim: Optional[int] = 0,
+                             predicate: Optional[Callable] = None) -> Any:
+    """Rewrite a (nested-dict) param tree, replacing each targeted weight
+    leaf with its auxiliary decomposition
+    (ref: apex/reparameterization/__init__.py ``apply_reparameterization``).
+
+    ``name`` selects a specific leaf name; empty selects every leaf the
+    ``predicate`` accepts (default: floating, ndim>=2).
+    """
+    pred = predicate or default_predicate
+    sfx = reparameterization.SUFFIXES
+
+    def walk(node):
+        if not _is_mapping(node):
+            return node
+        out = {}
+        for k, v in node.items():
+            if _is_mapping(v):
+                out[k] = walk(v)
+            elif (name and k == name) or (not name and pred(k, v)):
+                aux = reparameterization.decompose(jnp.asarray(v), dim)
+                for s, a in zip(sfx, aux):
+                    out[k + s] = a
+            else:
+                out[k] = v
+        return _rebuild(node, out)
+
+    return walk(params)
+
+
+def reparameterize(params: Any, reparameterization,
+                   dim: Optional[int] = 0) -> Any:
+    """Materialize weights from auxiliary leaves (differentiable; call
+    inside the jitted forward — the functional analogue of the reference's
+    forward-pre hook recompute)."""
+    return _recompose_walk(params, reparameterization, dim, name=None)
+
+
+def remove_reparameterization(params: Any, reparameterization,
+                              name: str = "", dim: Optional[int] = 0) -> Any:
+    """Collapse auxiliary leaves back into plain weights
+    (ref: apex/reparameterization/__init__.py ``remove_reparameterization``).
+    ``name`` restricts removal to one leaf name; empty removes all."""
+    return _recompose_walk(params, reparameterization, dim,
+                           name=name or None)
+
+
+def _recompose_walk(params: Any, reparameterization, dim,
+                    name: Optional[str]) -> Any:
+    """Shared walk for reparameterize/remove: collapse each complete
+    suffix family (optionally restricted to ``name``) into its weight."""
+    sfx = reparameterization.SUFFIXES
+    primary = sfx[0]
+
+    def walk(node):
+        if not _is_mapping(node):
+            return node
+        out = {}
+        for k, v in node.items():
+            if _is_mapping(v):
+                out[k] = walk(v)
+                continue
+            base = _aux_base(node, k, sfx)
+            if base is None or (name is not None and base != name):
+                out[k] = v
+            elif k.endswith(primary):
+                aux = tuple(node[base + s] for s in sfx)
+                out[base] = reparameterization.compute_weight(*aux, dim=dim)
+            # non-primary aux leaves are consumed by the primary
+        return _rebuild(node, out)
+
+    return walk(params)

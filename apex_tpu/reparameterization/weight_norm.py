@@ -1,0 +1,49 @@
+"""Weight normalization: w = g * v / ||v||.
+
+Parity surface for ``apex/reparameterization/weight_norm.py:22``
+(``WeightNorm``; norm-over-all-dims-except-``dim`` per ``_norm`` at :8-18;
+Salimans & Kingma, arXiv:1602.07868).  The reference's
+``Fused_Weight_Norm`` CUDA kernel is unnecessary on TPU: the norm + scale
+is a tiny reduction XLA fuses into the consumer matmul's epilogue.
+
+Note on conventions: the reference's ``dim=0`` norms per *output* channel
+of a torch ``(out, in)`` weight.  Flax kernels are ``(in, out)``, so the
+per-output-channel norm there is ``dim=-1``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .reparameterization import Reparameterization
+
+
+def _norm(p: jnp.ndarray, dim: Optional[int]) -> jnp.ndarray:
+    """L2 norm over all dimensions except ``dim`` (keepdims), computed in
+    fp32 (ref: apex/reparameterization/weight_norm.py:8-18)."""
+    p32 = p.astype(jnp.float32)
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(p32)))
+    axes = tuple(i for i in range(p.ndim) if i != dim % p.ndim)
+    return jnp.sqrt(jnp.sum(jnp.square(p32), axis=axes, keepdims=True))
+
+
+class WeightNorm(Reparameterization):
+    """Decouple magnitude from direction: leaf ``w`` becomes ``w_v``
+    (direction, shaped like w) and ``w_g`` (magnitude, one per ``dim``
+    slice) (ref: apex/reparameterization/weight_norm.py:22-60)."""
+
+    SUFFIXES: Tuple[str, ...] = ("_v", "_g")
+
+    @staticmethod
+    def decompose(weight: jnp.ndarray, dim: Optional[int]):
+        g = _norm(weight, dim).astype(weight.dtype)
+        return weight, g
+
+    @staticmethod
+    def compute_weight(v: jnp.ndarray, g: jnp.ndarray,
+                       dim: Optional[int]):
+        w32 = (g.astype(jnp.float32) / (_norm(v, dim) + 0.0)
+               ) * v.astype(jnp.float32)
+        return w32.astype(v.dtype)

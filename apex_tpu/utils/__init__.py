@@ -1,1 +1,19 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Shared library utilities: rank-stamped logging.
+
+Parity surface for the reference's library-level observability glue —
+the root-logger ``RankInfoFormatter`` (ref: apex/__init__.py:29-42) and
+``apex/transformer/log_util.py``.
+"""
+from .log_util import (
+    RankInfoFormatter,
+    get_logger,
+    get_transformer_logger,
+    set_logging_level,
+)
+
+__all__ = [
+    "RankInfoFormatter",
+    "get_logger",
+    "get_transformer_logger",
+    "set_logging_level",
+]

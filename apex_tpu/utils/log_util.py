@@ -1,0 +1,68 @@
+"""Library logging with (tp, pp, dp) rank stamping.
+
+Parity surface for the reference's root-logger setup
+(ref: apex/__init__.py:29-42 ``RankInfoFormatter`` + handler install) and
+``apex/transformer/log_util.py`` (``get_transformer_logger``,
+``set_logging_level``).  On TPU the "rank" of a single-controller process
+is its mesh coordinates, read from :mod:`apex_tpu.parallel_state`; under
+multi-controller ``jax.distributed`` each host process stamps its own
+coordinates, which is exactly the reference's per-rank behavior.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Stamp every record with parallel-rank info
+    (ref: apex/__init__.py:30-36)."""
+
+    def format(self, record):
+        from .. import parallel_state
+        try:
+            record.rank_info = parallel_state.get_rank_info()
+        except Exception:
+            record.rank_info = "(tp=?, pp=?, dp=?)"
+        return super().format(record)
+
+
+_LIBRARY_ROOT_LOGGER_NAME = "apex_tpu"
+_library_root_logger = logging.getLogger(_LIBRARY_ROOT_LOGGER_NAME)
+_configured = False
+
+
+def _configure_library_root_logger() -> None:
+    """Install the rank-stamped stream handler once
+    (ref: apex/__init__.py:38-42; non-propagating so user logging config
+    is untouched)."""
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(RankInfoFormatter(
+        "%(asctime)s - %(name)s - %(levelname)s - %(rank_info)s - "
+        "%(message)s"))
+    _library_root_logger.addHandler(handler)
+    _library_root_logger.propagate = False
+    _configured = True
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    """Child logger keyed by module file name
+    (ref: apex/transformer/log_util.py:7-9)."""
+    _configure_library_root_logger()
+    name_wo_ext = os.path.splitext(os.path.basename(name))[0]
+    return logging.getLogger(
+        f"{_LIBRARY_ROOT_LOGGER_NAME}.{name_wo_ext}")
+
+
+# General-purpose alias: the library logger for any subsystem.
+get_logger = get_transformer_logger
+
+
+def set_logging_level(verbosity) -> None:
+    """Change root library-logger severity
+    (ref: apex/transformer/log_util.py:12-19)."""
+    _configure_library_root_logger()
+    _library_root_logger.setLevel(verbosity)

@@ -1,0 +1,212 @@
+"""fp16_utils parity tests.
+
+Models the reference's L0 ``run_fp16util`` suite (conversion helpers) and
+the FP16_Optimizer workflow tests: master-weight stepping, overflow skip
+with the dynamic scaler schedule, clip_master_grads, state_dict
+round-trip (ref: tests/L0/run_fp16util/test_fp16util.py,
+apex/fp16_utils/fp16_optimizer.py examples).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.fp16_utils import (
+    BN_convert_float,
+    DynamicLossScaler,
+    FP16_Optimizer,
+    LossScaler,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4), jnp.float32) * 0.5,
+                  "bias": jnp.zeros((4,), jnp.float32)},
+        "batch_norm": {"scale": jnp.ones((4,), jnp.float32),
+                       "bias": jnp.zeros((4,), jnp.float32)},
+    }
+
+
+class TestConversion:
+    def test_tofp16(self):
+        out = tofp16(_params())
+        assert out["dense"]["kernel"].dtype == jnp.float16
+        assert out["batch_norm"]["scale"].dtype == jnp.float16
+
+    def test_network_to_half_keeps_bn_fp32(self):
+        # ref: fp16util.py:35-41 (tofp16 + BN_convert_float)
+        out = network_to_half(_params())
+        assert out["dense"]["kernel"].dtype == jnp.float16
+        assert out["batch_norm"]["scale"].dtype == jnp.float32
+
+    def test_bn_convert_float(self):
+        half = tofp16(_params())
+        out = BN_convert_float(half)
+        assert out["dense"]["kernel"].dtype == jnp.float16
+        assert out["batch_norm"]["scale"].dtype == jnp.float32
+
+    @pytest.mark.parametrize("flat_master", [False, True])
+    def test_prep_and_writeback_roundtrip(self, flat_master):
+        model = tofp16(_params())
+        model_p, master_p = prep_param_lists(model,
+                                             flat_master=flat_master)
+        new_model = master_params_to_model_params(
+            model_p, master_p, flat_master=flat_master)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            model, new_model)
+        # dtypes restored to model precision
+        assert new_model["dense"]["kernel"].dtype == jnp.float16
+
+    def test_model_grads_to_master_grads(self):
+        grads = tofp16(_params())
+        m = model_grads_to_master_grads(grads, None)
+        assert m["dense"]["kernel"].dtype == jnp.float32
+
+
+class TestLegacyScalers:
+    def test_static_scaler(self):
+        s = LossScaler(128.0)
+        assert s.loss_scale == 128.0
+        assert not s.has_overflow({"g": jnp.array([jnp.inf])})
+        s.update_scale(True)
+        assert s.loss_scale == 128.0
+
+    def test_dynamic_schedule(self):
+        # ref schedule: halve (floored at 1) on overflow; grow every
+        # scale_window clean iters (ref: loss_scaler.py:113-122)
+        s = DynamicLossScaler(init_scale=4.0, scale_factor=2.0,
+                              scale_window=2)
+        s.update_scale(True)
+        assert s.loss_scale == 2.0
+        s.update_scale(False)
+        s.update_scale(False)
+        assert s.loss_scale == 4.0
+
+    def test_dynamic_overflow_probe(self):
+        s = DynamicLossScaler()
+        assert s.has_overflow({"g": jnp.array([1.0, jnp.inf])})
+        assert not s.has_overflow({"g": jnp.array([1.0, 2.0])})
+
+
+class TestFP16Optimizer:
+    def _loss_fn(self, p, x):
+        return jnp.sum(jnp.square(x @ p["w"] - 1.0))
+
+    def test_converges_with_static_scale(self):
+        params = {"w": jnp.full((4, 4), 0.5, jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.05),
+                             static_loss_scale=64.0)
+        x = jnp.ones((2, 4), jnp.float16)
+        losses = []
+        for _ in range(20):
+            loss, grads = jax.value_and_grad(
+                lambda p: opt.scale(self._loss_fn(p, x)))(opt.model_params)
+            opt.backward(grads)
+            opt.step()
+            losses.append(float(loss) / opt.loss_scale)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_masters_are_fp32(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1))
+        assert opt.master_params["w"].dtype == jnp.float32
+        assert opt.model_params["w"].dtype == jnp.float16
+
+    def test_overflow_skips_step_and_backs_off(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1),
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 8.0})
+        before = np.asarray(opt.master_params["w"])
+        opt.backward({"w": jnp.full((2, 2), jnp.inf, jnp.float16)})
+        assert opt.overflow
+        opt.step()
+        np.testing.assert_array_equal(np.asarray(opt.master_params["w"]),
+                                      before)
+        assert opt.loss_scale == 4.0
+
+    def test_clip_master_grads(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1))
+        opt.backward({"w": jnp.full((2, 2), 10.0, jnp.float16)})
+        norm = opt.clip_master_grads(1.0)
+        assert norm == pytest.approx(20.0, rel=1e-3)
+        clipped = np.asarray(opt.master_grads["w"])
+        assert np.linalg.norm(clipped) <= 1.0 + 1e-4
+
+    def test_state_dict_roundtrip(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1),
+                             static_loss_scale=32.0)
+        opt.backward({"w": jnp.ones((2, 2), jnp.float16) * 32.0})
+        opt.step()
+        sd = opt.state_dict()
+
+        opt2 = FP16_Optimizer({"w": jnp.zeros((2, 2), jnp.float16)},
+                              optax.sgd(0.1), static_loss_scale=32.0)
+        opt2.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(opt2.master_params["w"]),
+                                      np.asarray(opt.master_params["w"]))
+        np.testing.assert_array_equal(np.asarray(opt2.model_params["w"]),
+                                      np.asarray(opt.model_params["w"]))
+        assert opt2.loss_scale == 32.0
+
+    def test_scale_schedule_ticks_once_per_step(self):
+        # Gradient accumulation: several backward()/update_master_grads()
+        # per optimizer step must advance the dynamic schedule ONCE (the
+        # reference ticks in FP16_Optimizer.step).
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.01),
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 4.0,
+                                                "scale_window": 3})
+        g = {"w": jnp.ones((2, 2), jnp.float16)}
+        for _ in range(3):  # 3 optimizer steps, 4 micro-batches each
+            for _ in range(4):
+                opt.backward(g)
+            opt.step()
+        assert opt.loss_scaler.cur_iter == 3
+
+    def test_zero_grad_clears_stash(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1))
+        opt.backward({"w": jnp.ones((2, 2), jnp.float16)})
+        opt.zero_grad()
+        with pytest.raises(AssertionError, match="no stashed"):
+            opt.update_master_grads()
+
+    def test_closure_raises_on_persistent_nan(self):
+        params = {"w": jnp.ones((2, 2), jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.1),
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 4.0})
+
+        def bad_closure():
+            opt.backward({"w": jnp.full((2, 2), jnp.nan, jnp.float16)})
+            return 0.0
+
+        with pytest.raises(FloatingPointError):
+            opt.step(bad_closure)
+
+    def test_step_with_closure(self):
+        params = {"w": jnp.full((2, 2), 2.0, jnp.float16)}
+        opt = FP16_Optimizer(params, optax.sgd(0.05))
+        x = jnp.ones((2, 2), jnp.float16)
+
+        def closure():
+            loss, grads = jax.value_and_grad(
+                lambda p: opt.scale(self._loss_fn(p, x)))(opt.model_params)
+            opt.backward(grads)
+            return float(loss)
+
+        l0 = opt.step(closure)
+        l1 = opt.step(closure)
+        assert l1 < l0
