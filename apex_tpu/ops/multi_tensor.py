@@ -107,25 +107,8 @@ def pack_groups(tree: Any) -> Tuple[List[jnp.ndarray], List[FlatMeta]]:
     The per-dtype grouping mirrors the reference's
     ``split_half_float_double_bfloat16`` bucketing
     (ref: apex/parallel/distributed.py:60-76)."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    buffers, metas = [], []
-    for dtype, idxs in _group_leaves(leaves).items():
-        shapes = tuple(tuple(jnp.asarray(leaves[i]).shape) for i in idxs)
-        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
-        offsets, off = [], 0
-        for s in sizes:
-            offsets.append(off)
-            off += s
-        total = off
-        padded = max(_PAD_TO, -(-total // _PAD_TO) * _PAD_TO)
-        flat = jnp.concatenate(
-            [jnp.ravel(leaves[i]) for i in idxs]
-            + ([jnp.zeros((padded - total,), dtype)] if padded > total
-               else []))
-        buffers.append(flat)
-        metas.append(FlatMeta(treedef, tuple(idxs), shapes, sizes,
-                              tuple(offsets), total, padded, dtype))
-    return buffers, metas
+    metas = compute_metas(tree)
+    return pack(tree, metas), metas
 
 
 def unpack_groups(buffers: Sequence[jnp.ndarray],

@@ -80,33 +80,17 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
         # Phase 1a: global grad norm + clip factor over ALL groups
         # (ref: apex/optimizers/fused_lamb.py:163-185 multi_tensor_l2norm
         # over the union of fp16+fp32 grads; padding gaps are zero).
-        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                  for g in gbufs)
-        gnorm = jnp.sqrt(gsq)
-        if max_grad_norm is not None and max_grad_norm > 0:
-            clip = jnp.where(gnorm > max_grad_norm,
-                             max_grad_norm / jnp.maximum(gnorm, 1e-12), 1.0)
-        else:
-            clip = jnp.float32(1.0)
+        gnorm, clip = _global_grad_clip(gbufs, max_grad_norm)
 
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            if fused:
-                u, m, v = fused_optim.lamb_phase1(
-                    gbufs[i], pbufs[i], state.m[i], state.v[i],
-                    grad_scale=clip, beta1=beta1, beta2=beta2, beta3=beta3,
-                    eps=eps, weight_decay=weight_decay,
-                    bias_correction1=bc1, bias_correction2=bc2,
-                    adam_w_mode=adam_w_mode)
-            else:
-                u, m, v = _lamb_phase1_jnp(
-                    gbufs[i], pbufs[i], state.m[i], state.v[i],
-                    clip, beta1, beta2, beta3, eps, weight_decay, bc1, bc2,
-                    adam_w_mode)
-            ratio_elem = _trust_ratio_elem(
-                meta, u, pbufs[i].astype(jnp.float32), use_nvlamb,
-                weight_decay)
-            deltas.append(-lr * ratio_elem * u)
+            adapted_u, m, v = _lamb_group_update(
+                meta, gbufs[i], pbufs[i], state.m[i], state.v[i],
+                gscale=clip, beta1=beta1, beta2=beta2, beta3=beta3,
+                eps=eps, weight_decay=weight_decay, bc1=bc1, bc2=bc2,
+                adam_w_mode=adam_w_mode, use_nvlamb=use_nvlamb,
+                fused=fused)
+            deltas.append(-lr * adapted_u)
             new_m.append(m)
             new_v.append(v)
 
@@ -116,6 +100,50 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
         return updates, FusedLAMBState(count, tuple(new_m), tuple(new_v))
 
     return optax.GradientTransformation(init, update)
+
+
+def _global_grad_clip(gbufs, max_norm):
+    """Global grad norm over all packed groups + clip factor
+    (ref: apex/optimizers/fused_lamb.py:163-185).  ``max_norm`` None/0
+    disables clipping.  Mixed-precision LAMB passes
+    ``max_grad_norm * loss_scale`` because its norm is of scaled grads
+    (ref: fused_mixed_precision_lamb.py:182-184)."""
+    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gbufs)
+    gnorm = jnp.sqrt(gsq)
+    # The enable decision must be static (max_norm may be a traced value
+    # when the caller scales it by a traced loss scale — pass None to
+    # disable in that case).
+    disabled = max_norm is None or (
+        isinstance(max_norm, (int, float)) and max_norm <= 0)
+    if disabled:
+        clip = jnp.float32(1.0)
+    else:
+        clip = jnp.where(gnorm > max_norm,
+                         max_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+    return gnorm, clip
+
+
+def _lamb_group_update(meta, gbuf, pbuf, m, v, *, gscale, beta1, beta2,
+                       beta3, eps, weight_decay, bc1, bc2, adam_w_mode,
+                       use_nvlamb, fused):
+    """Stage 1 (Pallas or jnp) + per-tensor trust ratio for one packed
+    dtype group.  Returns ``(ratio*update, m_new, v_new)``; the caller
+    applies the learning rate (and any overflow select).  Shared by
+    FusedLAMB and FusedMixedPrecisionLamb so the clip/trust-ratio
+    semantics can never diverge between them."""
+    if fused:
+        u, m_new, v_new = fused_optim.lamb_phase1(
+            gbuf, pbuf, m, v, grad_scale=gscale, beta1=beta1, beta2=beta2,
+            beta3=beta3, eps=eps, weight_decay=weight_decay,
+            bias_correction1=bc1, bias_correction2=bc2,
+            adam_w_mode=adam_w_mode)
+    else:
+        u, m_new, v_new = _lamb_phase1_jnp(
+            gbuf, pbuf, m, v, gscale, beta1, beta2, beta3, eps,
+            weight_decay, bc1, bc2, adam_w_mode)
+    ratio_elem = _trust_ratio_elem(meta, u, pbuf.astype(jnp.float32),
+                                   use_nvlamb, weight_decay)
+    return ratio_elem * u, m_new, v_new
 
 
 def _trust_ratio_elem(meta, u, p32, use_nvlamb, weight_decay):

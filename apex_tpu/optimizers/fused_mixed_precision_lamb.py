@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from ..amp import scaler as _scaler
 from ..ops import fused_optim, multi_tensor
 from .fused_adam import ScalarOrSchedule, _lr_at
-from .fused_lamb import _lamb_phase1_jnp, _trust_ratio_elem
+from .fused_lamb import _global_grad_clip, _lamb_group_update
 
 
 class MixedPrecisionLambState(NamedTuple):
@@ -125,36 +125,21 @@ class FusedMixedPrecisionLamb:
 
         # Norm is of SCALED grads, so the clip threshold scales too
         # (ref: fused_mixed_precision_lamb.py:182-184).
-        gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                  for g in gbufs)
-        gnorm = jnp.sqrt(gsq)
-        if self.max_grad_norm is not None and self.max_grad_norm > 0:
-            max_eff = self.max_grad_norm * scale
-            clip = jnp.where(gnorm > max_eff,
-                             max_eff / jnp.maximum(gnorm, 1e-12), 1.0)
-        else:
-            clip = jnp.float32(1.0)
+        max_eff = self.max_grad_norm * scale \
+            if (self.max_grad_norm is not None
+                and self.max_grad_norm > 0) else None
+        gnorm, clip = _global_grad_clip(gbufs, max_eff)
         gscale = inv_scale * clip
 
         new_masters, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            if fused:
-                u, m, v = fused_optim.lamb_phase1(
-                    gbufs[i], state.masters[i], state.m[i], state.v[i],
-                    grad_scale=gscale, beta1=self.beta1, beta2=self.beta2,
-                    beta3=beta3, eps=self.eps,
-                    weight_decay=self.weight_decay,
-                    bias_correction1=bc1, bias_correction2=bc2,
-                    adam_w_mode=self.adam_w_mode)
-            else:
-                u, m, v = _lamb_phase1_jnp(
-                    gbufs[i], state.masters[i], state.m[i], state.v[i],
-                    gscale, self.beta1, self.beta2, beta3, self.eps,
-                    self.weight_decay, bc1, bc2, self.adam_w_mode)
-            ratio_elem = _trust_ratio_elem(
-                meta, u, state.masters[i], self.use_nvlamb,
-                self.weight_decay)
-            master_new = state.masters[i] - lr * ratio_elem * u
+            adapted_u, m, v = _lamb_group_update(
+                meta, gbufs[i], state.masters[i], state.m[i], state.v[i],
+                gscale=gscale, beta1=self.beta1, beta2=self.beta2,
+                beta3=beta3, eps=self.eps, weight_decay=self.weight_decay,
+                bc1=bc1, bc2=bc2, adam_w_mode=self.adam_w_mode,
+                use_nvlamb=self.use_nvlamb, fused=fused)
+            master_new = state.masters[i] - lr * adapted_u
             # Overflow: everything holds still (the mp kernel's
             # found_inf no-op, ref: multi_tensor_lamb_mp.cu).
             new_masters.append(jnp.where(finite, master_new,

@@ -1,0 +1,58 @@
+"""Profiling stack (parity with ``apex/pyprof``).
+
+Three layers, mirroring the reference's nvtx -> parse -> prof pipeline
+(ref: apex/pyprof/nvtx/nvmarker.py, pyprof/parse/nvvp.py, pyprof/prof/):
+
+- :mod:`.nvtx` — op annotation: ``annotate``/``push``/``pop``/``range``
+  emitting ``jax.named_scope`` + ``TraceAnnotation`` ranges with
+  serialized call signatures.
+- :mod:`.profile` — trace session façade over ``jax.profiler`` wired into
+  the transformer Timers (the ``--prof`` window workflow).
+- :mod:`.prof` — analytical per-op FLOP/byte/roofline attribution by
+  walking the jaxpr directly (no offline SQLite parse needed on TPU),
+  with ``report()`` producing the reference's TSV table and
+  ``xla_cost_analysis``/``measure`` as cross-checks.
+"""
+from . import nvtx
+from .nvtx import annotate, pop, push
+from .nvtx import range as range_annotation
+from .profile import ProfileWindow, trace
+from .prof import (
+    DeviceSpec,
+    OpRecord,
+    analyze,
+    device_spec,
+    measure,
+    report,
+    summary_by_op,
+    total_bytes,
+    total_flops,
+    xla_cost_analysis,
+)
+
+
+def init() -> None:
+    """Arm annotation (ref: ``import apex.pyprof; pyprof.nvtx.init()``)."""
+    nvtx.init()
+
+
+__all__ = [
+    "init",
+    "nvtx",
+    "annotate",
+    "push",
+    "pop",
+    "range_annotation",
+    "trace",
+    "ProfileWindow",
+    "analyze",
+    "report",
+    "summary_by_op",
+    "total_flops",
+    "total_bytes",
+    "xla_cost_analysis",
+    "measure",
+    "OpRecord",
+    "DeviceSpec",
+    "device_spec",
+]

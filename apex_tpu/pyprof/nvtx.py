@@ -1,0 +1,143 @@
+"""Op annotation: profiler ranges encoding call site + arg shapes/dtypes.
+
+Parity surface for ``apex/pyprof/nvtx/nvmarker.py:1-222``, which
+monkey-patches ~all of ``torch.*`` to push NVTX ranges whose message is a
+JSON dict of {module, function, args shapes/dtypes}.  JAX is functional —
+there is no global namespace to patch — so the same capability is a
+*decorator/wrapper* API: :func:`annotate` wraps any function so each call
+runs under a :func:`jax.named_scope` (visible in XLA HLO op names and in
+``jax.profiler`` traces) carrying the serialized call signature, and
+:func:`push`/:func:`pop` / :func:`range` give the manual-range API
+(``torch.cuda.nvtx.range_push`` parity, used by the reference's DDP hooks
+and imagenet ``--prof`` driver, ref: apex/parallel/distributed.py:357,
+examples/imagenet/main_amp.py:335-362).
+
+Scope names flow into the jaxpr ``name_stack``, so
+:mod:`apex_tpu.pyprof.prof` can attribute FLOPs/bytes back to these
+annotations — the role the NVTX->nvvp->prof pipeline plays in the
+reference.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_enabled = False
+
+
+def init() -> None:
+    """Enable annotation (ref: apex/pyprof/nvtx/nvmarker.py ``init()``
+    patches the world; here it just arms the wrappers so ``annotate`` is
+    zero-cost until profiling is wanted)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def _describe(x: Any):
+    """Shape/dtype summary of one argument (the reference serializes
+    tensor shapes+dtypes into the NVTX message,
+    ref: nvmarker.py ``argMarker``)."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) or hasattr(x, "shape"):
+        try:
+            return {"shape": tuple(int(d) for d in x.shape),
+                    "dtype": str(getattr(x, "dtype", "?"))}
+        except Exception:
+            return {"type": type(x).__name__}
+    if isinstance(x, (int, float, bool, str)) or x is None:
+        return x
+    return {"type": type(x).__name__}
+
+
+def call_signature(fn_name: str, args, kwargs, module: str = "") -> str:
+    """JSON call record matching the reference's marker payload
+    (ref: nvmarker.py — {'mod', 'op', 'args'})."""
+    payload = {
+        "mod": module,
+        "op": fn_name,
+        "args": [_describe(a) for a in args],
+    }
+    if kwargs:
+        payload["kwargs"] = {k: _describe(v) for k, v in kwargs.items()}
+    return json.dumps(payload, default=str)
+
+
+def _sanitize(name: str) -> str:
+    # named_scope names end up in HLO metadata; keep them short and safe.
+    return name.replace("/", ".").replace(" ", "")[:128]
+
+
+def annotate(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+             detailed: bool = False):
+    """Decorator: run ``fn`` under a named scope carrying its signature.
+
+    With ``detailed=True`` the scope name embeds the JSON arg record
+    (shapes/dtypes) — the full nvmarker payload; default is the plain
+    qualified name, which is what you want inside jit (stable scope names
+    avoid retrace churn).  Works on traced and untraced functions alike.
+    """
+    def deco(f):
+        scope = name or getattr(f, "__qualname__", f.__name__)
+
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            if not _enabled:
+                return f(*args, **kwargs)
+            label = scope
+            if detailed:
+                label = _sanitize(
+                    scope + ":" + call_signature(scope, args, kwargs))
+            with jax.named_scope(_sanitize(label)):
+                return f(*args, **kwargs)
+
+        return wrapped
+
+    return deco(fn) if fn is not None else deco
+
+
+class _RangeStack:
+    """Manual push/pop ranges (``nvtx.range_push/range_pop`` parity).
+
+    Outside jit these become ``jax.profiler.TraceAnnotation``s (visible in
+    profiler timelines); inside jit a named_scope cannot be push/popped
+    imperatively, so use :func:`range` (context manager) there.
+    """
+
+    def __init__(self):
+        self._stack = []
+
+    def push(self, msg: str) -> None:
+        ann = jax.profiler.TraceAnnotation(_sanitize(msg))
+        ann.__enter__()
+        self._stack.append(ann)
+
+    def pop(self) -> None:
+        if self._stack:
+            self._stack.pop().__exit__(None, None, None)
+
+
+_ranges = _RangeStack()
+push = _ranges.push
+pop = _ranges.pop
+
+
+@contextlib.contextmanager
+def range(msg: str):  # noqa: A001 - parity name (nvtx.range)
+    """Scoped range usable both inside jit (named_scope -> HLO metadata)
+    and outside (TraceAnnotation -> profiler timeline)."""
+    with jax.named_scope(_sanitize(msg)), \
+            jax.profiler.TraceAnnotation(_sanitize(msg)):
+        yield

@@ -1,0 +1,78 @@
+"""Profiler session façade: ``jax.profiler`` traces wired to the Timers.
+
+Parity surface for the reference's profiling *workflow* — run N warmup
+iterations, switch the profiler on, run the profiled window, emit ranges
+(ref: examples/imagenet/main_amp.py:335-362 ``--prof`` window with
+``cudaProfilerStart/Stop`` + nvtx push/pop; apex/pyprof/parse consumes the
+dump offline).  On TPU the dump is a TensorBoard-loadable trace directory
+produced by ``jax.profiler``; op-level attribution comes from
+:mod:`apex_tpu.pyprof.prof` instead of an offline SQLite parse.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+from ..transformer.pipeline_parallel.utils import Timers, get_timers
+
+
+@contextlib.contextmanager
+def trace(logdir: str, timers: Optional[Timers] = None,
+          name: str = "profile-window",
+          create_perfetto_link: bool = False) -> Iterator[None]:
+    """Profiled window: starts a ``jax.profiler`` trace into ``logdir``
+    and times the window on the shared :class:`Timers` registry (so the
+    trace wall-time shows up next to the schedule timers the transformer
+    stack already logs).
+
+    Usage (the imagenet ``--prof`` pattern)::
+
+        for it, batch in enumerate(loader):
+            if it == args.prof_start:
+                ctx = pyprof.trace("/tmp/tb"); ctx.__enter__()
+            ...
+    """
+    t = (timers or get_timers())(name)
+    jax.profiler.start_trace(logdir,
+                             create_perfetto_link=create_perfetto_link)
+    t.start()
+    try:
+        yield
+    finally:
+        t.stop()
+        jax.profiler.stop_trace()
+
+
+class ProfileWindow:
+    """Iteration-window profiler switch (ref: main_amp.py:335-345 —
+    ``--prof`` starts at iteration A, stops at B)."""
+
+    def __init__(self, logdir: str, start_iter: int, stop_iter: int,
+                 timers: Optional[Timers] = None):
+        self.logdir = logdir
+        self.start_iter = int(start_iter)
+        self.stop_iter = int(stop_iter)
+        self.timers = timers
+        self._ctx: Optional[contextlib.AbstractContextManager] = None
+
+    def step(self, iteration: int) -> None:
+        """Call once per training iteration."""
+        if iteration == self.start_iter and self._ctx is None:
+            self._ctx = trace(self.logdir, timers=self.timers)
+            self._ctx.__enter__()
+        elif iteration == self.stop_iter and self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def close(self) -> None:
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+def server(port: int = 9999):
+    """Start the on-demand profiling server (TensorBoard 'capture
+    profile' target) — the always-on alternative to a fixed window."""
+    return jax.profiler.start_server(port)
