@@ -1,0 +1,20 @@
+"""Fused multi-head attention module family
+(parity with ``apex/contrib/multihead_attn``).
+
+The reference ships 8 CUDA extension variants (self/encdec × {plain,
+bias, bias+additive-mask, norm_add}) plus a fused masked-softmax-dropout;
+here the variants are module *options* over one Pallas-backed core
+(flash attention / scaled-masked softmax), which is the TPU-idiomatic
+shape of the same capability: options compose inside one jitted graph
+instead of multiplying kernels.
+"""
+from .encdec_multihead_attn import EncdecMultiheadAttn
+from .functional import attn_core, mask_softmax_dropout
+from .self_multihead_attn import SelfMultiheadAttn
+
+__all__ = [
+    "SelfMultiheadAttn",
+    "EncdecMultiheadAttn",
+    "attn_core",
+    "mask_softmax_dropout",
+]

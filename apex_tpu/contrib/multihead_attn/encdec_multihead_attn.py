@@ -1,0 +1,126 @@
+"""EncdecMultiheadAttn: fused encoder-decoder cross-attention module.
+
+Parity surface for ``apex/contrib/multihead_attn/encdec_multihead_attn.py``
+(:31-160): separate Q projection (from the decoder query) and packed 2E
+KV projection (from the encoder output), byte key-padding / time masks,
+attention dropout, and the ``include_norm_add`` pre-LN + residual
+variant.  Layout (time, batch, embed) as the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...normalization import FusedLayerNorm
+from .functional import attn_core
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """ref: apex/contrib/multihead_attn/encdec_multihead_attn.py:31."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        assert self.embed_dim % self.num_heads == 0, \
+            "embed_dim must be divisible by num_heads"
+        assert self.impl in ("fast", "default"), \
+            f"Unsupported impl: {self.impl} !"
+        e = self.embed_dim
+        self.in_proj_weight_q = self.param(
+            "in_proj_weight_q", nn.initializers.xavier_uniform(),
+            (e, e), self.dtype)
+        # [2E, E] init'd like [E, E]: xavier gain sqrt(1.5) (ref :81-86).
+        self.in_proj_weight_kv = self.param(
+            "in_proj_weight_kv",
+            nn.initializers.variance_scaling(1.5, "fan_avg", "uniform"),
+            (2 * e, e), self.dtype)
+        self.out_proj_weight = self.param(
+            "out_proj_weight", nn.initializers.xavier_uniform(),
+            (e, e), self.dtype)
+        if self.bias:
+            zeros = nn.initializers.zeros
+            self.in_proj_bias_q = self.param(
+                "in_proj_bias_q", zeros, (e,), self.dtype)
+            self.in_proj_bias_kv = self.param(
+                "in_proj_bias_kv", zeros, (2 * e,), self.dtype)
+            self.out_proj_bias = self.param(
+                "out_proj_bias", zeros, (e,), self.dtype)
+        if self.include_norm_add:
+            self.lyr_nrm = FusedLayerNorm(normalized_shape=self.embed_dim)
+
+    def __call__(self, query, key, value=None,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 need_weights: bool = False,
+                 attn_mask: Optional[jnp.ndarray] = None,
+                 is_training: bool = True):
+        """ref :98-160.  ``query`` (tq, b, e) from the decoder; ``key``
+        (tk, b, e) from the encoder (``value`` must alias it, as in the
+        fused reference).  Returns ``(output, None)``."""
+        del need_weights
+        assert value is None or value is key, \
+            "encdec attention requires value is key (fused KV projection)"
+        sq, b, e = query.shape
+        sk = key.shape[0]
+        h = self.num_heads
+        d = e // h
+        scaling = d ** -0.5
+
+        assert not (key_padding_mask is not None and attn_mask is not None), \
+            "attn_mask and key_padding_mask should not be both defined!"
+
+        residual = query
+        x_q = self.lyr_nrm(query) if self.include_norm_add else query
+
+        q = x_q @ self.in_proj_weight_q.T
+        kv = key @ self.in_proj_weight_kv.T
+        if self.bias:
+            q = q + self.in_proj_bias_q
+            kv = kv + self.in_proj_bias_kv
+        # reference packs kv per head as [sk, b, h, 2, d]
+        # (ref: encdec_multihead_attn_func.py kv slicing)
+        kv = kv.reshape(sk, b, h, 2, d)
+        q = jnp.transpose(q.reshape(sq, b, h, d), (1, 2, 0, 3))
+        k = jnp.transpose(kv[:, :, :, 0], (1, 2, 0, 3))
+        v = jnp.transpose(kv[:, :, :, 1], (1, 2, 0, 3))
+
+        mask = None
+        use_time_mask = False
+        if key_padding_mask is not None:
+            mask = key_padding_mask[:, None, None, :]
+        elif attn_mask is not None:
+            mask = attn_mask
+            use_time_mask = True
+
+        rng = None
+        if self.dropout > 0.0 and is_training:
+            rng = self.make_rng("dropout")
+
+        ctx = attn_core(q, k, v, scaling, mask=mask,
+                        mask_additive=False,
+                        use_time_mask=use_time_mask,
+                        dropout_prob=self.dropout, rng=rng,
+                        is_training=is_training,
+                        use_fast=self.impl == "fast")
+
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
+        out = ctx @ self.out_proj_weight.T
+        if self.bias:
+            out = out + self.out_proj_bias
+
+        if self.include_norm_add:
+            if self.dropout > 0.0 and is_training:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.dropout,
+                    out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = residual + out
+        return out, None

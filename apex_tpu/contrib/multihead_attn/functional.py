@@ -1,0 +1,108 @@
+"""Functional attention cores for the MHA module family.
+
+Parity surface for the reference's attention autograd Functions
+(ref: apex/contrib/multihead_attn/self_multihead_attn_func.py:6-160,
+fast_self_multihead_attn_func.py, mask_softmax_dropout_func.py:6-80).
+The reference hand-schedules cuBLAS batched GEMMs + fused
+softmax-dropout CUDA kernels; on TPU the same dataflow is expressed as
+jnp einsums + the Pallas kernels (flash attention for the unmasked /
+causal paths, scaled-masked softmax otherwise) and XLA fuses the rest.
+Dropout uses explicit JAX PRNG keys instead of in-kernel philox states —
+same semantics (independent mask per call), reproducible by key.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.flash_attention import flash_attention
+from ...ops.scaled_softmax import (scaled_masked_softmax,
+                                   scaled_upper_triang_masked_softmax)
+
+NEG_INF = -10000.0  # the reference's masked-fill value
+
+
+def mask_softmax_dropout(inputs: jnp.ndarray,
+                         pad_mask: Optional[jnp.ndarray] = None,
+                         mask_additive: bool = False,
+                         dropout_prob: float = 0.0,
+                         rng: Optional[jax.Array] = None,
+                         is_training: bool = True,
+                         heads: Optional[int] = None) -> jnp.ndarray:
+    """Fused softmax(+mask)+dropout over attention scores
+    (ref: apex/contrib/multihead_attn/mask_softmax_dropout_func.py:6-80).
+
+    ``inputs``: (..., sq, sk) scores.  ``pad_mask``: boolean with 1 =
+    masked-out (reference byte-mask convention) broadcastable to inputs,
+    or additive float mask when ``mask_additive``.  ``heads`` is accepted
+    for signature parity (the reference needs it to unflatten; the array
+    layout here already carries it).
+    """
+    x = inputs.astype(jnp.float32)
+    if pad_mask is not None:
+        if mask_additive:
+            x = x + pad_mask.astype(jnp.float32)
+        else:
+            x = jnp.where(pad_mask.astype(bool), NEG_INF, x)
+    probs = jax.nn.softmax(x, axis=-1).astype(inputs.dtype)
+    if dropout_prob > 0.0 and is_training:
+        if rng is None:
+            raise ValueError("dropout requires an rng key")
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0)
+    return probs
+
+
+def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              scaling: float,
+              mask: Optional[jnp.ndarray] = None,
+              mask_additive: bool = False,
+              use_time_mask: bool = False,
+              dropout_prob: float = 0.0,
+              rng: Optional[jax.Array] = None,
+              is_training: bool = True,
+              use_fast: bool = True) -> jnp.ndarray:
+    """softmax(scale * q k^T [masked]) v with attention dropout.
+
+    Shapes: (b, h, s, d).  Dispatch mirrors the reference's impl split:
+
+    * no mask / causal time-mask, no attention dropout -> Pallas flash
+      attention (the fast_*_attn kernels' successor; no seqlen cap);
+    * causal with dropout -> Pallas causal softmax + explicit AV;
+    * padding/additive masks -> scaled-masked softmax + explicit AV
+      (ref: self_attn_func's matmul1 -> masked softmax -> dropout ->
+      matmul2 pipeline).
+    """
+    dropping = dropout_prob > 0.0 and is_training
+    if use_fast and not dropping and (mask is None
+                                      or (use_time_mask
+                                          and not mask_additive)):
+        return flash_attention(q, k, v, scale=scaling,
+                               causal=mask is not None and use_time_mask)
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
+    if use_time_mask and mask is not None and not mask_additive:
+        probs = scaled_upper_triang_masked_softmax(scores, scale=scaling)
+    elif mask is not None and not mask_additive:
+        # boolean mask, 1 = masked out; the Pallas kernel broadcasts
+        # over heads itself, so normalize to (b, 1, sq, sk)
+        b, _h, sq, sk = scores.shape
+        m = mask.astype(bool)
+        while m.ndim < 4:
+            m = m[:, None] if m.ndim >= 2 and m.shape[0] == b \
+                else m[None]
+        m = jnp.broadcast_to(m, (b, 1, sq, sk))
+        probs = scaled_masked_softmax(scores, m, scale=scaling)
+    else:
+        x = scores.astype(jnp.float32) * scaling
+        if mask is not None:  # additive
+            x = x + mask.astype(jnp.float32)
+        probs = jax.nn.softmax(x, axis=-1).astype(scores.dtype)
+    if dropping:
+        if rng is None:
+            raise ValueError("attention dropout requires an rng key")
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -1,0 +1,174 @@
+"""SelfMultiheadAttn: fused self-attention module.
+
+Parity surface for ``apex/contrib/multihead_attn/self_multihead_attn.py``
+(:31-178): packed 3E in-projection (or ``separate_qkv_params``), optional
+biases, byte key-padding mask / additive mask / time (causal) mask,
+attention dropout, and the ``include_norm_add`` variant (pre-LayerNorm +
+residual add with hidden dropout, the fast_self_multihead_attn_norm_add
+fusion).  ``impl='fast'`` routes the core through the Pallas kernels
+(flash attention / scaled-masked softmax — superseding the 8
+fast_multihead_attn CUDA modules); ``impl='default'`` is the plain XLA
+path (the reference's torch fallback), used for parity testing.
+
+Layout: inputs are (time, batch, embed) exactly as the reference
+(``Input shape: Time x Batch x Channel``, ref :124-132).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ...normalization import FusedLayerNorm
+from .functional import attn_core
+
+
+class SelfMultiheadAttn(nn.Module):
+    """ref: apex/contrib/multihead_attn/self_multihead_attn.py:31."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"              # 'fast' (Pallas) | 'default' (XLA)
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        assert self.embed_dim % self.num_heads == 0, \
+            "embed_dim must be divisible by num_heads"
+        assert self.impl in ("fast", "default"), \
+            f"Unsupported impl: {self.impl} !"
+        if self.mask_additive:
+            assert not self.include_norm_add, \
+                "additive mask not supported with layer norm"
+        e = self.embed_dim
+        # in_proj_weight is [3E, E] init'd like an [E, E] matrix: xavier
+        # with gain sqrt(2) (ref :100-108 and the comment there).
+        if self.separate_qkv_params:
+            init = nn.initializers.xavier_uniform()
+            self.q_weight = self.param("q_weight", init, (e, e), self.dtype)
+            self.k_weight = self.param("k_weight", init, (e, e), self.dtype)
+            self.v_weight = self.param("v_weight", init, (e, e), self.dtype)
+        else:
+            init = nn.initializers.variance_scaling(
+                2.0, "fan_avg", "uniform")  # xavier_uniform gain sqrt(2)
+            self.in_proj_weight = self.param(
+                "in_proj_weight", init, (3 * e, e), self.dtype)
+        self.out_proj_weight = self.param(
+            "out_proj_weight", nn.initializers.xavier_uniform(),
+            (e, e), self.dtype)
+        if self.bias:
+            zeros = nn.initializers.zeros
+            if self.separate_qkv_params:
+                self.q_bias = self.param("q_bias", zeros, (e,), self.dtype)
+                self.k_bias = self.param("k_bias", zeros, (e,), self.dtype)
+                self.v_bias = self.param("v_bias", zeros, (e,), self.dtype)
+            else:
+                self.in_proj_bias = self.param(
+                    "in_proj_bias", zeros, (3 * e,), self.dtype)
+            self.out_proj_bias = self.param(
+                "out_proj_bias", zeros, (e,), self.dtype)
+        if self.include_norm_add:
+            self.lyr_nrm = FusedLayerNorm(normalized_shape=self.embed_dim)
+
+    def _qkv_weights(self):
+        """Interleave per-head q/k/v blocks exactly as the reference
+        packs separate params into the fused layout (ref :133-141)."""
+        e, h = self.embed_dim, self.num_heads
+        d = e // h
+        if not self.separate_qkv_params:
+            w = self.in_proj_weight
+            b = self.in_proj_bias if self.bias else None
+            return w, b
+        w = jnp.concatenate([
+            self.q_weight.reshape(h, 1, d, e),
+            self.k_weight.reshape(h, 1, d, e),
+            self.v_weight.reshape(h, 1, d, e),
+        ], axis=1).reshape(3 * e, e)
+        b = None
+        if self.bias:
+            b = jnp.concatenate([
+                self.q_bias.reshape(h, 1, d),
+                self.k_bias.reshape(h, 1, d),
+                self.v_bias.reshape(h, 1, d),
+            ], axis=1).reshape(3 * e)
+        return w, b
+
+    def __call__(self, query, key=None, value=None,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 need_weights: bool = False,
+                 attn_mask: Optional[jnp.ndarray] = None,
+                 is_training: bool = True):
+        """ref :124-178.  ``key``/``value`` accepted for signature parity
+        (self-attention ignores them); ``key_padding_mask`` is
+        (batch, src_len) with 1 = padding (byte-mask convention) or an
+        additive float mask when ``mask_additive``; ``attn_mask`` marks
+        the causal time mask.  Returns ``(output, None)``.
+        """
+        del key, value, need_weights
+        sq, b, e = query.shape
+        h = self.num_heads
+        d = e // h
+        scaling = d ** -0.5
+
+        assert not (key_padding_mask is not None and attn_mask is not None), \
+            "attn_mask and key_padding_mask should not be both defined!"
+        if attn_mask is not None:
+            assert not self.mask_additive, \
+                "additive mask not supported for time mask"
+
+        residual = query
+        x = self.lyr_nrm(query) if self.include_norm_add else query
+
+        w, bias_ = self._qkv_weights()
+        qkv = x @ w.T  # (sq, b, 3e)
+        if bias_ is not None:
+            qkv = qkv + bias_
+        # reference layout: [sq, b, h, 3, d] — q/k/v interleaved per head
+        # (ref: self_attn_func.py:31-38)
+        qkv = qkv.reshape(sq, b, h, 3, d)
+        # -> (b, h, sq, d)
+        q = jnp.transpose(qkv[:, :, :, 0], (1, 2, 0, 3))
+        k = jnp.transpose(qkv[:, :, :, 1], (1, 2, 0, 3))
+        v = jnp.transpose(qkv[:, :, :, 2], (1, 2, 0, 3))
+
+        mask = None
+        use_time_mask = False
+        if key_padding_mask is not None:
+            # (b, sk) -> (b, 1, 1, sk)
+            mask = key_padding_mask[:, None, None, :]
+        elif attn_mask is not None:
+            mask = attn_mask
+            use_time_mask = True
+
+        rng = None
+        if self.dropout > 0.0 and is_training:
+            rng = self.make_rng("dropout")
+
+        ctx = attn_core(q, k, v, scaling, mask=mask,
+                        mask_additive=self.mask_additive,
+                        use_time_mask=use_time_mask,
+                        dropout_prob=self.dropout, rng=rng,
+                        is_training=is_training,
+                        use_fast=self.impl == "fast")
+
+        # (b, h, sq, d) -> (sq, b, e)
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
+        out = ctx @ self.out_proj_weight.T
+        if self.bias:
+            out = out + self.out_proj_bias
+
+        if self.include_norm_add:
+            # hidden dropout + residual add (ref jit_dropout_add :19-23)
+            if self.dropout > 0.0 and is_training:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.dropout,
+                    out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = residual + out
+        return out, None

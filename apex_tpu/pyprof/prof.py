@@ -207,6 +207,26 @@ def _eqn_cost(eqn) -> Tuple[float, float]:
 
 # Sub-jaxpr trip-count handling -------------------------------------------
 
+# Branch-cost memo for cond selection.  Keyed by jaxpr object id; the
+# jaxpr itself is stored as the value's first element so the id cannot be
+# recycled while the memo is alive.  Without this, nested conds make the
+# analyzer re-walk branches exponentially.
+_BRANCH_FLOPS_MEMO: dict = {}
+
+
+def _branch_flops(closed) -> float:
+    key = id(closed)
+    hit = _BRANCH_FLOPS_MEMO.get(key)
+    if hit is not None and hit[0] is closed:
+        return hit[1]
+    recs = _walk(closed, scope="", mult=1, out=None)
+    cost = sum(r.flops for r in recs)
+    _BRANCH_FLOPS_MEMO[key] = (closed, cost)
+    if len(_BRANCH_FLOPS_MEMO) > 4096:
+        _BRANCH_FLOPS_MEMO.clear()
+    return cost
+
+
 def _subjaxprs(eqn):
     """Yield (closed_jaxpr, trip_count) pairs for call-like primitives."""
     name = eqn.primitive.name
@@ -222,10 +242,7 @@ def _subjaxprs(eqn):
         # worst-case branch (reference reports kernels actually run; a
         # static analyzer takes the max)
         branches = p["branches"]
-        costs = []
-        for br in branches:
-            recs = _walk(br.jaxpr, scope="", mult=1, out=None)
-            costs.append(sum(r.flops for r in recs))
+        costs = [_branch_flops(br) for br in branches]
         best = int(np.argmax(costs)) if branches else 0
         yield branches[best], 1
         return

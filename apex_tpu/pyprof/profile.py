@@ -15,11 +15,12 @@ from typing import Iterator, Optional
 
 import jax
 
-from ..transformer.pipeline_parallel.utils import Timers, get_timers
+# Timers are imported lazily inside trace(): pyprof.prof.analyze() must be
+# usable without dragging in the transformer stack.
 
 
 @contextlib.contextmanager
-def trace(logdir: str, timers: Optional[Timers] = None,
+def trace(logdir: str, timers=None,
           name: str = "profile-window",
           create_perfetto_link: bool = False) -> Iterator[None]:
     """Profiled window: starts a ``jax.profiler`` trace into ``logdir``
@@ -34,10 +35,19 @@ def trace(logdir: str, timers: Optional[Timers] = None,
                 ctx = pyprof.trace("/tmp/tb"); ctx.__enter__()
             ...
     """
+    from ..transformer.pipeline_parallel.utils import get_timers
+
     t = (timers or get_timers())(name)
-    jax.profiler.start_trace(logdir,
-                             create_perfetto_link=create_perfetto_link)
+    # Start the timer FIRST: if it is already running (shared registry),
+    # this raises before the profiler starts, so a timer error can never
+    # leak a running profiler session.
     t.start()
+    try:
+        jax.profiler.start_trace(
+            logdir, create_perfetto_link=create_perfetto_link)
+    except Exception:
+        t.stop()
+        raise
     try:
         yield
     finally:
@@ -50,7 +60,7 @@ class ProfileWindow:
     ``--prof`` starts at iteration A, stops at B)."""
 
     def __init__(self, logdir: str, start_iter: int, stop_iter: int,
-                 timers: Optional[Timers] = None):
+                 timers=None):
         self.logdir = logdir
         self.start_iter = int(start_iter)
         self.stop_iter = int(stop_iter)
@@ -58,11 +68,15 @@ class ProfileWindow:
         self._ctx: Optional[contextlib.AbstractContextManager] = None
 
     def step(self, iteration: int) -> None:
-        """Call once per training iteration."""
-        if iteration == self.start_iter and self._ctx is None:
+        """Call once per training iteration.  The window is
+        [start_iter, stop_iter); an empty window never opens, and an
+        iteration counter that jumps past stop_iter (checkpoint resume)
+        still closes the trace."""
+        if (self._ctx is None and iteration == self.start_iter
+                and iteration < self.stop_iter):
             self._ctx = trace(self.logdir, timers=self.timers)
             self._ctx.__enter__()
-        elif iteration == self.stop_iter and self._ctx is not None:
+        if self._ctx is not None and iteration >= self.stop_iter:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
 

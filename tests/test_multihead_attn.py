@@ -1,0 +1,284 @@
+"""MHA module family tests.
+
+Models the reference's contrib test pattern
+(ref: apex/contrib/test/multihead_attn/test_self_multihead_attn.py —
+fused module vs reference implementation on identical weights): the
+'fast' Pallas-backed path is parity-checked against the 'default' XLA
+path and against a hand-written plain-JAX MHA.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    mask_softmax_dropout,
+)
+
+E, H, SQ, SK, B = 32, 4, 16, 12, 2
+
+
+def _x(shape, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape,
+                             jnp.float32) * 0.5
+
+
+def _plain_self_mha(params, x, heads, key_padding_mask=None, causal=False,
+                    additive_mask=None):
+    """Hand-written reference MHA (time, batch, embed) with the
+    reference's packed-qkv layout [s, b, h, 3, d]."""
+    sq, b, e = x.shape
+    d = e // heads
+    w = params["in_proj_weight"]
+    qkv = (x @ w.T).reshape(sq, b, heads, 3, d)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    # (s, b, h, d) -> (b, h, s, d)
+    q, k, v = (jnp.transpose(t, (1, 2, 0, 3)) for t in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+    scores = scores.astype(jnp.float32)
+    if key_padding_mask is not None:
+        scores = jnp.where(key_padding_mask[:, None, None, :].astype(bool),
+                           -10000.0, scores)
+    if additive_mask is not None:
+        scores = scores + additive_mask[:, None, None, :]
+    if causal:
+        tri = jnp.tril(jnp.ones((scores.shape[-2], scores.shape[-1]),
+                                bool))
+        scores = jnp.where(tri, scores, -10000.0)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
+    return ctx @ params["out_proj_weight"].T
+
+
+class TestSelfMultiheadAttn:
+    def _mk(self, **kw):
+        m = SelfMultiheadAttn(embed_dim=E, num_heads=H, **kw)
+        x = _x((SQ, B, E))
+        variables = m.init(jax.random.PRNGKey(1), x, is_training=False)
+        return m, variables, x
+
+    def test_matches_plain_reference(self):
+        m, variables, x = self._mk(impl="default")
+        out, _ = m.apply(variables, x, is_training=False)
+        want = _plain_self_mha(variables["params"], x, H)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_fast_matches_default(self):
+        m_f, variables, x = self._mk(impl="fast")
+        m_d = SelfMultiheadAttn(embed_dim=E, num_heads=H, impl="default")
+        out_f, _ = m_f.apply(variables, x, is_training=False)
+        out_d, _ = m_d.apply(variables, x, is_training=False)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                                   atol=2e-5)
+
+    def test_time_mask_is_causal(self):
+        m, variables, x = self._mk(impl="fast")
+        tri = ~jnp.tril(jnp.ones((SQ, SQ), bool))  # True above diagonal
+        out, _ = m.apply(variables, x, attn_mask=tri, is_training=False)
+        want = _plain_self_mha(variables["params"], x, H, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5)
+        # causality: output at t must not depend on inputs after t
+        x2 = x.at[-1].set(x[-1] + 100.0)
+        out2, _ = m.apply(variables, x2, attn_mask=tri, is_training=False)
+        np.testing.assert_allclose(np.asarray(out[:-1]),
+                                   np.asarray(out2[:-1]), atol=2e-5)
+
+    def test_key_padding_mask(self):
+        m, variables, x = self._mk(impl="fast")
+        pad = jnp.zeros((B, SQ), bool).at[:, -3:].set(True)
+        out, _ = m.apply(variables, x, key_padding_mask=pad,
+                         is_training=False)
+        want = _plain_self_mha(variables["params"], x, H,
+                               key_padding_mask=pad)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5)
+        # padded keys must not influence the output
+        x2 = x.at[-1].set(x[-1] * 13.0)
+        out2, _ = m.apply(variables, x2, key_padding_mask=pad,
+                          is_training=False)
+        np.testing.assert_allclose(np.asarray(out[: SQ - 3]),
+                                   np.asarray(out2[: SQ - 3]), atol=2e-5)
+
+    def test_additive_mask(self):
+        m = SelfMultiheadAttn(embed_dim=E, num_heads=H, bias=True,
+                              mask_additive=True, impl="default")
+        x = _x((SQ, B, E))
+        variables = m.init(jax.random.PRNGKey(1), x, is_training=False)
+        add = jnp.zeros((B, SQ)).at[:, -2:].set(-10000.0)
+        out, _ = m.apply(variables, x, key_padding_mask=add,
+                         is_training=False)
+        # -10000 additive ~ hard mask
+        pad = jnp.zeros((B, SQ), bool).at[:, -2:].set(True)
+        out_hard, _ = SelfMultiheadAttn(
+            embed_dim=E, num_heads=H, bias=True,
+            impl="default").apply(variables, x, key_padding_mask=pad,
+                                  is_training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out_hard),
+                                   atol=1e-4)
+
+    def test_bias_params_exist_and_used(self):
+        m, variables, x = self._mk(bias=True)
+        p = variables["params"]
+        assert "in_proj_bias" in p and "out_proj_bias" in p
+        p2 = dict(p)
+        p2["out_proj_bias"] = p["out_proj_bias"] + 1.0
+        out1, _ = m.apply({"params": p}, x, is_training=False)
+        out2, _ = m.apply({"params": p2}, x, is_training=False)
+        np.testing.assert_allclose(np.asarray(out2 - out1), 1.0,
+                                   atol=1e-5)
+
+    def test_separate_qkv_params_match_packed(self):
+        # separate q/k/v weights laid out per-head must equal the packed
+        # module given the corresponding packed weight (ref :133-141)
+        m_sep = SelfMultiheadAttn(embed_dim=E, num_heads=H,
+                                  separate_qkv_params=True, impl="default")
+        x = _x((SQ, B, E))
+        vs = m_sep.init(jax.random.PRNGKey(1), x, is_training=False)
+        out_sep, _ = m_sep.apply(vs, x, is_training=False)
+
+        d = E // H
+        p = vs["params"]
+        packed = jnp.concatenate([
+            p["q_weight"].reshape(H, 1, d, E),
+            p["k_weight"].reshape(H, 1, d, E),
+            p["v_weight"].reshape(H, 1, d, E)], axis=1).reshape(3 * E, E)
+        m_pk = SelfMultiheadAttn(embed_dim=E, num_heads=H, impl="default")
+        out_pk, _ = m_pk.apply(
+            {"params": {"in_proj_weight": packed,
+                        "out_proj_weight": p["out_proj_weight"]}},
+            x, is_training=False)
+        np.testing.assert_allclose(np.asarray(out_sep),
+                                   np.asarray(out_pk), atol=1e-5)
+
+    def test_norm_add_variant(self):
+        m, variables, x = self._mk(include_norm_add=True)
+        assert "lyr_nrm" in variables["params"]
+        out, _ = m.apply(variables, x, is_training=False)
+        # residual path: zero attention weights -> output == input
+        zeroed = jax.tree_util.tree_map(jnp.zeros_like,
+                                        variables["params"])
+        zeroed["lyr_nrm"] = variables["params"]["lyr_nrm"]
+        out0, _ = m.apply({"params": zeroed}, x, is_training=False)
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(x),
+                                   atol=1e-5)
+
+    def test_attention_dropout_deterministic_by_key(self):
+        m = SelfMultiheadAttn(embed_dim=E, num_heads=H, dropout=0.5)
+        x = _x((SQ, B, E))
+        variables = m.init(
+            {"params": jax.random.PRNGKey(1),
+             "dropout": jax.random.PRNGKey(2)}, x, is_training=True)
+        r = {"dropout": jax.random.PRNGKey(7)}
+        out1, _ = m.apply(variables, x, is_training=True, rngs=r)
+        out2, _ = m.apply(variables, x, is_training=True, rngs=r)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        out3, _ = m.apply(variables, x, is_training=True,
+                          rngs={"dropout": jax.random.PRNGKey(8)})
+        assert not np.allclose(np.asarray(out1), np.asarray(out3))
+        # eval mode = no dropout
+        oe1, _ = m.apply(variables, x, is_training=False)
+        oe2, _ = m.apply(variables, x, is_training=False)
+        np.testing.assert_array_equal(np.asarray(oe1), np.asarray(oe2))
+
+    def test_gradients_flow(self):
+        m, variables, x = self._mk()
+
+        def loss(p):
+            out, _ = m.apply({"params": p}, x, is_training=False)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss)(variables["params"])
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert float(jnp.abs(leaf).sum()) > 0
+
+
+class TestEncdecMultiheadAttn:
+    def test_cross_attention_shapes_and_parity(self):
+        m = EncdecMultiheadAttn(embed_dim=E, num_heads=H, impl="fast")
+        q = _x((SQ, B, E), 1)
+        kv = _x((SK, B, E), 2)
+        variables = m.init(jax.random.PRNGKey(1), q, kv,
+                           is_training=False)
+        out_f, _ = m.apply(variables, q, kv, is_training=False)
+        assert out_f.shape == (SQ, B, E)
+        m_d = EncdecMultiheadAttn(embed_dim=E, num_heads=H,
+                                  impl="default")
+        out_d, _ = m_d.apply(variables, q, kv, is_training=False)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                                   atol=2e-5)
+
+    def test_key_padding_mask_blocks_encoder_positions(self):
+        m = EncdecMultiheadAttn(embed_dim=E, num_heads=H, impl="default")
+        q = _x((SQ, B, E), 1)
+        kv = _x((SK, B, E), 2)
+        variables = m.init(jax.random.PRNGKey(1), q, kv,
+                           is_training=False)
+        pad = jnp.zeros((B, SK), bool).at[:, -4:].set(True)
+        out, _ = m.apply(variables, q, kv, key_padding_mask=pad,
+                         is_training=False)
+        kv2 = kv.at[-1].set(kv[-1] * 50.0)
+        out2, _ = m.apply(variables, q, kv2, key_padding_mask=pad,
+                          is_training=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   atol=1e-5)
+
+    def test_norm_add_residual(self):
+        m = EncdecMultiheadAttn(embed_dim=E, num_heads=H,
+                                include_norm_add=True, impl="default")
+        q = _x((SQ, B, E), 1)
+        kv = _x((SK, B, E), 2)
+        variables = m.init(jax.random.PRNGKey(1), q, kv,
+                           is_training=False)
+        zeroed = jax.tree_util.tree_map(jnp.zeros_like,
+                                        variables["params"])
+        zeroed["lyr_nrm"] = variables["params"]["lyr_nrm"]
+        out0, _ = m.apply({"params": zeroed}, q, kv, is_training=False)
+        np.testing.assert_allclose(np.asarray(out0), np.asarray(q),
+                                   atol=1e-5)
+
+    def test_bias_requires_default_impl_ok(self):
+        # reference forbids bias in fast mode; here both impls support it
+        # (capability superset) — just verify it runs and matches
+        m_d = EncdecMultiheadAttn(embed_dim=E, num_heads=H, bias=True,
+                                  impl="default")
+        q = _x((SQ, B, E), 1)
+        kv = _x((SK, B, E), 2)
+        vs = m_d.init(jax.random.PRNGKey(1), q, kv, is_training=False)
+        out_d, _ = m_d.apply(vs, q, kv, is_training=False)
+        m_f = EncdecMultiheadAttn(embed_dim=E, num_heads=H, bias=True,
+                                  impl="fast")
+        out_f, _ = m_f.apply(vs, q, kv, is_training=False)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                                   atol=2e-5)
+
+
+class TestMaskSoftmaxDropout:
+    def test_softmax_with_byte_mask(self):
+        x = _x((B, H, SQ, SQ))
+        mask = jnp.zeros((B, 1, SQ, SQ), bool).at[..., -2:].set(True)
+        probs = mask_softmax_dropout(x, mask, is_training=False)
+        p = np.asarray(probs)
+        assert p[..., -2:].max() < 1e-3
+        np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+
+    def test_additive_mask(self):
+        x = _x((B, H, SQ, SQ))
+        add = jnp.zeros((B, 1, SQ, SQ)).at[..., -2:].set(-10000.0)
+        probs = mask_softmax_dropout(x, add, mask_additive=True,
+                                     is_training=False)
+        assert np.asarray(probs)[..., -2:].max() < 1e-3
+
+    def test_dropout_scaling(self):
+        x = jnp.zeros((2, 2, 8, 128))
+        probs = mask_softmax_dropout(x, dropout_prob=0.5,
+                                     rng=jax.random.PRNGKey(0),
+                                     is_training=True)
+        p = np.asarray(probs, np.float64)
+        # E[p] preserved by 1/keep scaling
+        assert abs(p.mean() * 128 - 1.0) < 0.1
+        assert (p == 0).mean() == pytest.approx(0.5, abs=0.05)

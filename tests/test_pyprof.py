@@ -199,5 +199,33 @@ class TestProfileSession:
         for it in range(6):
             w.step(it)
             jax.block_until_ready(jnp.ones((4,)) * it)
+        assert w._ctx is None  # closed by step(4), not leaked
         w.close()
         assert os.path.isdir(str(tmp_path / "tb2"))
+
+    def test_profile_window_empty_never_opens(self, tmp_path):
+        w = pyprof.ProfileWindow(str(tmp_path / "tb3"), 3, 3)
+        for it in range(6):
+            w.step(it)
+        assert w._ctx is None
+
+    def test_profile_window_closes_on_iteration_jump(self, tmp_path):
+        w = pyprof.ProfileWindow(str(tmp_path / "tb4"), 1, 3)
+        w.step(1)
+        assert w._ctx is not None
+        w.step(10)  # checkpoint-resume style jump past stop_iter
+        assert w._ctx is None
+
+    def test_trace_timer_conflict_does_not_leak_profiler(self, tmp_path):
+        from apex_tpu.transformer.pipeline_parallel.utils import Timers
+
+        timers = Timers()
+        timers("w").start()  # already running
+        with pytest.raises(RuntimeError):
+            with pyprof.trace(str(tmp_path / "tb5"), timers=timers,
+                              name="w"):
+                pass
+        timers("w").stop()
+        # profiler must still be usable
+        with pyprof.trace(str(tmp_path / "tb6")):
+            jax.block_until_ready(jnp.ones((4,)))
