@@ -1,0 +1,168 @@
+"""RNN backend: cells scanned over time, stacked, optionally
+bidirectional.
+
+Parity surface for ``apex/RNN/RNNBackend.py`` (``RNNCell`` :232-330,
+``stackedRNN`` :90-230, ``bidirectionalRNN`` :25-88).  The reference
+steps cells in a Python loop over timesteps with mutable per-module
+hidden state; the TPU form is ``jax.lax.scan`` over the time axis
+(one compiled graph, weights resident, XLA pipelines the gate matmuls),
+with hidden state threaded functionally.
+
+Layout is (seq, batch, features) — the reference "always assumes input
+is NOT batch_first" (ref :240).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+
+def _uniform_init(hidden_size):
+    """uniform(-1/sqrt(H), 1/sqrt(H)) — the reference's reset_parameters
+    (ref: RNNBackend.py:291-296)."""
+    stdev = 1.0 / (hidden_size ** 0.5)
+
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -stdev, stdev)
+
+    return init
+
+
+class RNNCell(nn.Module):
+    """One recurrent layer scanned over time (ref: RNNBackend.py:232).
+
+    ``gate_multiplier``: 4 for LSTM-like, 3 for GRU, 1 for plain RNN.
+    ``n_hidden_states``: 2 for (h, c) cells, 1 for h-only.
+    ``output_size != hidden_size`` adds the ``w_ho`` recurrent
+    projection (ref :259-261).
+    """
+
+    gate_multiplier: int
+    input_size: int
+    hidden_size: int
+    cell: Callable
+    n_hidden_states: int = 2
+    bias: bool = False
+    output_size: Optional[int] = None
+    multiplicative: bool = False   # adds w_mih/w_mhh (mLSTM)
+
+    @property
+    def out_size(self) -> int:
+        return self.output_size or self.hidden_size
+
+    def setup(self):
+        init = _uniform_init(self.hidden_size)
+        gate_size = self.gate_multiplier * self.hidden_size
+        self.w_ih = self.param("w_ih", init, (gate_size, self.input_size))
+        self.w_hh = self.param("w_hh", init, (gate_size, self.out_size))
+        if self.out_size != self.hidden_size:
+            self.w_ho = self.param("w_ho", init,
+                                   (self.out_size, self.hidden_size))
+        if self.bias:
+            self.b_ih = self.param("b_ih", init, (gate_size,))
+            self.b_hh = self.param("b_hh", init, (gate_size,))
+        if self.multiplicative:
+            self.w_mih = self.param("w_mih", init,
+                                    (self.out_size, self.input_size))
+            self.w_mhh = self.param("w_mhh", init,
+                                    (self.out_size, self.out_size))
+
+    def initial_state(self, bsz: int) -> Tuple[jnp.ndarray, ...]:
+        """Zero hidden states (ref init_hidden :300-310).  State 0 is
+        the output-sized h; the rest are hidden-sized (c)."""
+        sizes = [self.out_size] + [self.hidden_size] * (
+            self.n_hidden_states - 1)
+        return tuple(jnp.zeros((bsz, s)) for s in sizes)
+
+    def _step(self, x_t, hidden):
+        b_ih = self.b_ih if self.bias else None
+        b_hh = self.b_hh if self.bias else None
+        if self.multiplicative:
+            new = self.cell(x_t, hidden, self.w_ih, self.w_hh,
+                            self.w_mih, self.w_mhh, b_ih=b_ih, b_hh=b_hh)
+        else:
+            new = self.cell(x_t, hidden, self.w_ih, self.w_hh,
+                            b_ih=b_ih, b_hh=b_hh)
+        new = list(new)
+        if self.out_size != self.hidden_size:
+            new[0] = new[0] @ self.w_ho.T
+        return tuple(new)
+
+    def __call__(self, inputs, initial_state=None, reverse: bool = False):
+        """Scan over (T, B, I).  Returns (outputs (T, B, out), final
+        hidden tuple).  ``reverse=True`` runs right-to-left and returns
+        outputs re-reversed to input order (the backward half of the
+        bidirectional wrapper, ref stackedRNN.forward(reverse=True))."""
+        bsz = inputs.shape[1]
+        h0 = initial_state or self.initial_state(bsz)
+
+        def body(hidden, x_t):
+            new = self._step(x_t, hidden)
+            return new, new[0]
+
+        xs = jnp.flip(inputs, 0) if reverse else inputs
+        final, outs = jax.lax.scan(body, h0, xs)
+        if reverse:
+            outs = jnp.flip(outs, 0)
+        return outs, final
+
+
+class stackedRNN(nn.Module):
+    """num_layers cells stacked, inter-layer dropout
+    (ref: RNNBackend.py:90-230)."""
+
+    cell_factory: Callable[[int], RNNCell]  # input_size -> cell module
+    num_layers: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, inputs, initial_states=None, reverse: bool = False,
+                 collect_hidden: bool = False, is_training: bool = True):
+        x = inputs
+        finals = []
+        for i in range(self.num_layers):
+            # layer 0 sees the input width; deeper layers see the
+            # previous layer's output width (ref new_like(), :277-289)
+            layer = self.cell_factory(x.shape[-1])
+            x, final = layer(x, None if initial_states is None
+                             else initial_states[i], reverse=reverse)
+            finals.append(final)
+            if self.dropout > 0.0 and is_training \
+                    and i < self.num_layers - 1:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.dropout, x.shape)
+                x = jnp.where(keep, x / (1.0 - self.dropout), 0.0)
+        hiddens = tuple(finals) if collect_hidden else (finals[-1],)
+        return x, hiddens
+
+
+class bidirectionalRNN(nn.Module):
+    """Forward + backward stacks, features concatenated
+    (ref: RNNBackend.py:25-88)."""
+
+    cell_factory: Callable[[int], RNNCell]
+    num_layers: int = 1
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, inputs, collect_hidden: bool = False,
+                 is_training: bool = True):
+        fwd = stackedRNN(self.cell_factory, self.num_layers,
+                         self.dropout, name="fwd")
+        bwd = stackedRNN(self.cell_factory, self.num_layers,
+                         self.dropout, name="bckwrd")
+        fwd_out, fwd_h = fwd(inputs, collect_hidden=collect_hidden,
+                             is_training=is_training)
+        bwd_out, bwd_h = bwd(inputs, reverse=True,
+                             collect_hidden=collect_hidden,
+                             is_training=is_training)
+        output = jnp.concatenate([fwd_out, bwd_out], axis=-1)
+        hiddens = tuple(
+            tuple(jnp.concatenate([f, b], axis=-1)
+                  for f, b in zip(fh, bh))
+            for fh, bh in zip(fwd_h, bwd_h))
+        return output, hiddens

@@ -1,0 +1,20 @@
+"""Legacy RNN backend (parity with ``apex/RNN``): lax.scan cells.
+
+Exports mirror ``apex/RNN/__init__.py`` (models + backend classes).
+"""
+from . import cells
+from .models import GRU, LSTM, ReLU, Tanh, mLSTM, toRNNBackend
+from .RNNBackend import RNNCell, bidirectionalRNN, stackedRNN
+
+__all__ = [
+    "LSTM",
+    "GRU",
+    "ReLU",
+    "Tanh",
+    "mLSTM",
+    "toRNNBackend",
+    "RNNCell",
+    "stackedRNN",
+    "bidirectionalRNN",
+    "cells",
+]

@@ -1,0 +1,77 @@
+"""RNN cell step functions.
+
+Parity surface for the cell math the reference pulls from
+``torch.nn._functions.rnn`` (LSTMCell/GRUCell/RNNReLUCell/RNNTanhCell)
+plus ``apex/RNN/cells.py:55-80`` (``mLSTMCell`` — multiplicative LSTM,
+Krause et al. 2016).  Each cell is a pure function
+``cell(x, hidden, weights) -> new_hidden`` stepped by ``lax.scan`` in
+:mod:`.RNNBackend` (the TPU substitute for the reference's per-timestep
+Python loop + fused pointwise CUDA epilogues — XLA fuses the gate
+nonlinearities into the matmuls on its own).
+
+Weight convention matches torch: ``w_ih`` (gates*H, I), ``w_hh``
+(gates*H, H), gate order i,f,g,o for LSTM and r,z,n for GRU.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(x, w, b=None):
+    y = x @ w.T
+    return y + b if b is not None else y
+
+
+def lstm_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    """(h, c) -> (h', c'); gate order i,f,g,o (torch LSTMCell)."""
+    hx, cx = hidden
+    gates = _linear(x, w_ih, b_ih) + _linear(hx, w_hh, b_hh)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return hy, cy
+
+
+def gru_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    """h -> h'; gate order r,z,n (torch GRUCell)."""
+    (hx,) = hidden
+    gi = _linear(x, w_ih, b_ih)
+    gh = _linear(hx, w_hh, b_hh)
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return ((1.0 - z) * n + z * hx,)
+
+
+def rnn_relu_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    (hx,) = hidden
+    return (jax.nn.relu(_linear(x, w_ih, b_ih)
+                        + _linear(hx, w_hh, b_hh)),)
+
+
+def rnn_tanh_cell(x, hidden, w_ih, w_hh, b_ih=None, b_hh=None):
+    (hx,) = hidden
+    return (jnp.tanh(_linear(x, w_ih, b_ih)
+                     + _linear(hx, w_hh, b_hh)),)
+
+
+def mlstm_cell(x, hidden, w_ih, w_hh, w_mih, w_mhh,
+               b_ih=None, b_hh=None):
+    """Multiplicative LSTM (ref: apex/RNN/cells.py:55-80): the hidden
+    input to the gates is ``m = (x W_mih^T) * (h W_mhh^T)``."""
+    hx, cx = hidden
+    m = _linear(x, w_mih) * _linear(hx, w_mhh)
+    gates = _linear(x, w_ih, b_ih) + _linear(m, w_hh, b_hh)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    cy = f * cx + i * g
+    hy = o * jnp.tanh(cy)
+    return hy, cy

@@ -1,0 +1,85 @@
+"""RNN model factories (parity with ``apex/RNN/models.py:8-53``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import cells as _cells
+from .RNNBackend import RNNCell, bidirectionalRNN, stackedRNN
+
+
+def toRNNBackend(cell_factory, num_layers: int, bidirectional: bool = False,
+                 dropout: float = 0.0):
+    """ref: models.py:8-16."""
+    if bidirectional:
+        return bidirectionalRNN(cell_factory, num_layers, dropout=dropout)
+    return stackedRNN(cell_factory, num_layers, dropout=dropout)
+
+
+def _factory(gate_multiplier, hidden_size, cell, n_hidden_states, bias,
+             output_size, multiplicative=False):
+    def make(input_size: int) -> RNNCell:
+        return RNNCell(gate_multiplier=gate_multiplier,
+                       input_size=input_size, hidden_size=hidden_size,
+                       cell=cell, n_hidden_states=n_hidden_states,
+                       bias=bias, output_size=output_size,
+                       multiplicative=multiplicative)
+    return make
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True,
+         batch_first=False, dropout=0.0, bidirectional=False,
+         output_size: Optional[int] = None):
+    """ref: models.py:19-24.  ``batch_first`` unsupported (the backend
+    is seq-major, ref RNNBackend.py:240)."""
+    assert not batch_first, "backend is seq-major (ref RNNBackend:240)"
+    del input_size  # width is taken from the data (ref new_like)
+    return toRNNBackend(
+        _factory(4, hidden_size, _cells.lstm_cell, 2, bias, output_size),
+        num_layers, bidirectional, dropout=dropout)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False,
+        output_size: Optional[int] = None):
+    """ref: models.py:26-31."""
+    assert not batch_first
+    del input_size
+    return toRNNBackend(
+        _factory(3, hidden_size, _cells.gru_cell, 1, bias, output_size),
+        num_layers, bidirectional, dropout=dropout)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False,
+         output_size: Optional[int] = None):
+    """ref: models.py:33-38."""
+    assert not batch_first
+    del input_size
+    return toRNNBackend(
+        _factory(1, hidden_size, _cells.rnn_relu_cell, 1, bias,
+                 output_size),
+        num_layers, bidirectional, dropout=dropout)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False,
+         output_size: Optional[int] = None):
+    """ref: models.py:40-45."""
+    assert not batch_first
+    del input_size
+    return toRNNBackend(
+        _factory(1, hidden_size, _cells.rnn_tanh_cell, 1, bias,
+                 output_size),
+        num_layers, bidirectional, dropout=dropout)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True,
+          batch_first=False, dropout=0.0, bidirectional=False,
+          output_size: Optional[int] = None):
+    """ref: models.py:47-53 + cells.py:12-53."""
+    assert not batch_first
+    del input_size
+    return toRNNBackend(
+        _factory(4, hidden_size, _cells.mlstm_cell, 2, bias, output_size,
+                 multiplicative=True),
+        num_layers, bidirectional, dropout=dropout)

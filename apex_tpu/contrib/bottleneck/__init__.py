@@ -1,0 +1,4 @@
+"""Fused ResNet bottleneck block (parity with ``apex/contrib/bottleneck``)."""
+from .bottleneck import Bottleneck, FrozenBatchNorm2d, SpatialBottleneck
+
+__all__ = ["Bottleneck", "FrozenBatchNorm2d", "SpatialBottleneck"]

@@ -1,0 +1,128 @@
+"""ResNet bottleneck block with frozen BN (inference-fused form).
+
+Parity surface for ``apex/contrib/bottleneck/bottleneck.py:10-217``
+(``FrozenBatchNorm2d`` :10-50, ``Bottleneck`` :112-217 — the ResNet v1.5
+block with stride on the 1x1, frozen BN, built on cudnn-frontend fused
+conv graphs) and ``SpatialBottleneck`` :386-500 (the same block with the
+spatial (H) dimension sharded across a GPU group, halo-exchanged by
+NCCL).
+
+TPU design: the conv+scale+bias+relu chains are left to XLA, which fuses
+them the way the cudnn-frontend graph API does on GPU — the module's job
+is the exact arithmetic (frozen BN folds into a per-channel scale/bias
+affine).  SpatialBottleneck's halo exchange maps onto GSPMD: shard H on
+a mesh axis and XLA inserts the halo collectives for the 3x3 conv
+automatically, so the module is the same code with a sharding
+annotation, not a hand-written ppermute pipeline.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ... import parallel_state
+
+
+class FrozenBatchNorm2d(nn.Module):
+    """BatchNorm with fixed (non-trainable, non-updating) statistics —
+    a per-channel affine ``scale * x + bias`` with
+    ``scale = weight * rsqrt(running_var + eps)`` folded at call time
+    (ref: bottleneck.py:10-50, get_scale_bias :25-31)."""
+
+    num_features: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.num_features
+        weight = self.variable("batch_stats", "weight",
+                               lambda: jnp.ones((c,), jnp.float32))
+        bias = self.variable("batch_stats", "bias",
+                             lambda: jnp.zeros((c,), jnp.float32))
+        mean = self.variable("batch_stats", "running_mean",
+                             lambda: jnp.zeros((c,), jnp.float32))
+        var = self.variable("batch_stats", "running_var",
+                            lambda: jnp.ones((c,), jnp.float32))
+        scale = weight.value * jax.lax.rsqrt(var.value + self.eps)
+        shift = bias.value - mean.value * scale
+        return (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+
+
+def _conv(ch_out, kernel, stride=1, name=None):
+    # kaiming_uniform(a=1) as the reference initializes conv weights
+    # (ref: bottleneck.py:158-160): gain = sqrt(2/(1+a^2)) = 1, bound =
+    # sqrt(3/fan_in) == variance_scaling(scale=1.0, fan_in, uniform).
+    return nn.Conv(ch_out, (kernel, kernel), strides=(stride, stride),
+                   padding="SAME" if kernel > 1 else "VALID",
+                   use_bias=False,
+                   kernel_init=nn.initializers.variance_scaling(
+                       1.0, "fan_in", "uniform"),
+                   name=name)
+
+
+class Bottleneck(nn.Module):
+    """ResNet v1.5 bottleneck: 1x1(stride)-3x3-1x1 with frozen BN and
+    residual relu (ref: bottleneck.py:112-217; stride placement comment
+    :113-119 — this fork puts stride on the FIRST 1x1).  NHWC layout
+    (TPU-native; the reference's ``explicit_nhwc`` fast path is the only
+    path here)."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    groups: int = 1
+    dilation: int = 1
+    use_cudnn: bool = False      # GPU knob, ignored
+    explicit_nhwc: bool = True   # NHWC is native on TPU
+
+    @nn.compact
+    def __call__(self, x):
+        if self.groups != 1:
+            raise RuntimeError("Only support groups == 1")
+        if self.dilation != 1:
+            raise RuntimeError("Only support dilation == 1")
+
+        out = _conv(self.bottleneck_channels, 1, self.stride,
+                    name="conv1")(x)
+        out = FrozenBatchNorm2d(self.bottleneck_channels, name="bn1")(out)
+        out = jax.nn.relu(out)
+        out = _conv(self.bottleneck_channels, 3, 1, name="conv2")(out)
+        out = FrozenBatchNorm2d(self.bottleneck_channels, name="bn2")(out)
+        out = jax.nn.relu(out)
+        out = _conv(self.out_channels, 1, 1, name="conv3")(out)
+        out = FrozenBatchNorm2d(self.out_channels, name="bn3")(out)
+
+        if self.stride != 1 or self.in_channels != self.out_channels:
+            identity = _conv(self.out_channels, 1, self.stride,
+                             name="downsample_conv")(x)
+            identity = FrozenBatchNorm2d(self.out_channels,
+                                         name="downsample_bn")(identity)
+        else:
+            identity = x
+        return jax.nn.relu(out + identity)
+
+
+class SpatialBottleneck(Bottleneck):
+    """Bottleneck with the H dimension sharded over a mesh axis
+    (ref: bottleneck.py:386-500 — spatial_group_size GPUs exchange 3x3
+    halos by NCCL p2p).  Under GSPMD the same computation is the parent
+    block with a sharding constraint on H; XLA inserts the halo
+    exchanges for the 3x3 conv.  ``spatial_axis`` names the mesh axis
+    (None = unsharded, identical to :class:`Bottleneck`)."""
+
+    spatial_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        if self.spatial_axis is not None:
+            from jax.sharding import PartitionSpec as P
+
+            mesh = parallel_state.get_mesh()
+            x = jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(
+                    mesh, P(None, self.spatial_axis, None, None)))
+        return super().__call__(x)

@@ -1,0 +1,22 @@
+"""Group batch norm (parity with ``apex/contrib/groupbn``).
+
+The reference's ``bnp`` extension is an NHWC persistent batch norm with
+fused add+relu, synchronizing statistics across a ``bn_group`` of GPUs
+via raw CUDA IPC peer memory (ref: apex/contrib/groupbn/batch_norm.py:239,
+csrc/groupbn/ipc.cu) — a hand-rolled bypass of NCCL.  On TPU:
+
+* NHWC is the native conv layout; nothing to opt into.
+* cross-device stats = ``lax.psum`` over a mesh axis — the IPC trick is
+  GPU-specific and needs no equivalent (XLA collectives ride ICI).
+* the add+relu epilogue fusion is a module option XLA folds into the
+  surrounding computation.
+
+So :class:`BatchNorm2d_NHWC` here is SyncBatchNorm (whose psum-stats
+implementation already covers the welford machinery,
+apex_tpu/parallel/sync_batchnorm.py) plus the reference's fused
+``z``-add + relu forward signature (``forward(x, z=None)``,
+ref: batch_norm.py:210-231).
+"""
+from .batch_norm import BatchNorm2d_NHWC
+
+__all__ = ["BatchNorm2d_NHWC"]

@@ -1,0 +1,55 @@
+"""BatchNorm2d_NHWC: group-synchronized BN with fused add+relu.
+
+Parity surface for ``apex/contrib/groupbn/batch_norm.py:115-237``
+(``BatchNorm2d_NHWC(num_features, fuse_relu, bn_group, ...)``, forward
+``(x, z=None)`` where ``z`` is the residual added before the relu — the
+bn_addrelu fusion, ref :63-113).  Statistics sync uses the mesh data
+axis (``lax.psum``) instead of the reference's CUDA IPC peer-memory
+exchange; ``bn_group`` maps onto the axis name (None = local BN).  The
+CUDA occupancy knobs (``max_cta_per_sm``, ``cta_launch_margin``,
+``multi_stream``) have no TPU meaning and are accepted-and-ignored for
+signature parity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ... import parallel_state
+from ...parallel.sync_batchnorm import SyncBatchNorm
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """ref: apex/contrib/groupbn/batch_norm.py:115."""
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    max_cta_per_sm: int = 2        # GPU knob, ignored
+    cta_launch_margin: int = 12    # GPU knob, ignored
+    multi_stream: bool = False     # GPU knob, ignored
+    eps: float = 1e-5
+    momentum: float = 0.1
+    axis_name: Optional[str] = parallel_state.DATA_AXIS
+
+    @nn.compact
+    def __call__(self, x, z: Optional[jnp.ndarray] = None,
+                 use_running_average: bool = False):
+        """``z`` is the residual input of the bn_addrelu fusion
+        (ref :210-231: ``bn_addrelu`` when z is not None)."""
+        bn = SyncBatchNorm(
+            num_features=self.num_features, eps=self.eps,
+            momentum=self.momentum,
+            # bn_group=1 means LOCAL batch norm in the reference (stats
+            # sync only engages for groups of >1 devices,
+            # ref: batch_norm.py:117 bn_group semantics).
+            axis_name=self.axis_name if self.bn_group > 1 else None,
+            fuse_relu=False, name="bn")
+        y = bn(x, use_running_average=use_running_average)
+        if z is not None:
+            y = y + z
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0)
+        return y

@@ -24,6 +24,23 @@ from ...ops.scaled_softmax import (scaled_masked_softmax,
 NEG_INF = -10000.0  # the reference's masked-fill value
 
 
+def _is_causal_mask(mask, sq: int, sk: int) -> bool:
+    """True iff ``mask`` is concretely the strict-upper-triangle boolean
+    mask (True = masked).  Traced masks return False (generic masked
+    softmax handles them — always correct, just not the specialized
+    kernel)."""
+    try:
+        import numpy as np
+
+        m = np.asarray(mask).astype(bool)
+    except Exception:
+        return False
+    if m.shape[-2:] != (sq, sk):
+        return False
+    want = ~np.tri(sq, sk, dtype=bool)
+    return bool((m.reshape((-1, sq, sk)) == want).all())
+
+
 def mask_softmax_dropout(inputs: jnp.ndarray,
                          pad_mask: Optional[jnp.ndarray] = None,
                          mask_additive: bool = False,
@@ -76,14 +93,19 @@ def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       matmul2 pipeline).
     """
     dropping = dropout_prob > 0.0 and is_training
-    if use_fast and not dropping and (mask is None
-                                      or (use_time_mask
-                                          and not mask_additive)):
+    sq, sk = q.shape[-2], k.shape[-2]
+    # The reference honors the CONTENT of the time mask (masked_fill
+    # with the caller's matrix, ref: self_attn_func.py); only a mask
+    # that is literally the strict upper triangle may take the
+    # specialized causal kernels.
+    causal = (use_time_mask and mask is not None and not mask_additive
+              and _is_causal_mask(mask, sq, sk))
+    if use_fast and not dropping and (mask is None or causal):
         return flash_attention(q, k, v, scale=scaling,
-                               causal=mask is not None and use_time_mask)
+                               causal=causal)
 
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
-    if use_time_mask and mask is not None and not mask_additive:
+    if causal:
         probs = scaled_upper_triang_masked_softmax(scores, scale=scaling)
     elif mask is not None and not mask_additive:
         # boolean mask, 1 = masked out; the Pallas kernel broadcasts

@@ -1,0 +1,14 @@
+"""Structured 2:4 sparsity (parity with ``apex/contrib/sparsity``)."""
+from .asp import ASP, ASPOptimizer, SparsityState, default_whitelist
+from .sparse_masklib import create_mask, fill, m4n2_1d, m4n2_2d_best
+
+__all__ = [
+    "ASP",
+    "ASPOptimizer",
+    "SparsityState",
+    "default_whitelist",
+    "create_mask",
+    "fill",
+    "m4n2_1d",
+    "m4n2_2d_best",
+]

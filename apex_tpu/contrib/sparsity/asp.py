@@ -1,0 +1,255 @@
+"""ASP — automatic structured (2:4) sparsity workflow.
+
+Parity surface for ``apex/contrib/sparsity/asp.py:21-217``.  The
+reference mutates the model in place (mask buffers on modules) and
+monkey-patches ``optimizer.step`` to mask grads before and weights after
+each step.  The functional equivalent: a :class:`SparsityState` pytree of
+masks, :func:`wrap_optimizer` producing an optax transformation that
+masks updates (so pruned weights, once zeroed, stay exactly zero through
+any inner optimizer — same invariant as the reference's double masking),
+and explicit :meth:`compute_sparse_masks` / :meth:`restore_pruned_weights`
+workflow calls.  Checkpoint continuity matches the reference: masked
+params carry literal zeros, and masks serialize via ``state_dict``
+(the contrib checkpoint-continuity tests' contract,
+ref: apex/contrib/sparsity/test/checkpointing_test_part1.py).
+
+A classmethod facade mirrors the reference's global-singleton API
+(``ASP.init_model_for_pruning`` / ``init_optimizer_for_pruning`` /
+``compute_sparse_masks`` / ...) for drop-in-shaped migration.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .sparse_masklib import create_mask
+
+
+class SparsityState(NamedTuple):
+    """Masks pytree: a mask array for sparse leaves, ``None`` for dense
+    leaves.  ``enabled`` mirrors the reference's 'sparsity off by
+    default until compute_sparse_masks' contract."""
+
+    masks: Any
+    enabled: bool = False
+
+
+def default_whitelist(path, leaf) -> bool:
+    """Eligible leaves: floating, rank >= 2, pattern-divisible columns —
+    the functional analogue of the reference's
+    Linear/Conv module-type whitelist (ref: asp.py:31,95-125 checks
+    weights of whitelisted module classes with dims divisible by 4)."""
+    arr = jnp.asarray(leaf)
+    if not jnp.issubdtype(arr.dtype, jnp.floating) or arr.ndim < 2:
+        return False
+    return arr.shape[-1] % 4 == 0 and arr.shape[-2] % 4 == 0
+
+
+def _path_name(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                    for k in path)
+
+
+class ASPOptimizer:
+    """Functional ASP session bound to one parameter tree."""
+
+    def __init__(self, mask_calculator="m4n2_1d",
+                 whitelist: Callable = default_whitelist,
+                 allowed_layer_names: Optional[list] = None,
+                 disallowed_layer_names: list = (),
+                 verbosity: int = 0):
+        if isinstance(mask_calculator, str):
+            pattern = mask_calculator
+
+            def calc(p):
+                return create_mask(p, pattern)
+
+            self.calculate_mask = calc
+        else:
+            self.calculate_mask = mask_calculator
+        self.whitelist = whitelist
+        self.allowed = allowed_layer_names
+        self.disallowed = tuple(disallowed_layer_names)
+        self.verbosity = verbosity
+
+    def _eligible(self, path, leaf) -> bool:
+        name = _path_name(path)
+        if self.allowed is not None and not any(a in name
+                                                for a in self.allowed):
+            return False
+        if any(d in name for d in self.disallowed):
+            return False
+        return self.whitelist(path, leaf)
+
+    # -- workflow (ref: asp.py docstring recipe :36-50) ---------------------
+
+    def init(self, params: Any) -> SparsityState:
+        """Augment with all-ones masks — sparsity off until
+        :meth:`compute_sparse_masks` (ref: asp.py:29-125)."""
+        masks = jax.tree_util.tree_map_with_path(
+            lambda path, p: jnp.ones_like(p) if self._eligible(path, p)
+            else None, params)
+        return SparsityState(masks=masks, enabled=False)
+
+    def compute_sparse_masks(self, params: Any, state: SparsityState):
+        """Search masks on current weights, zero pruned weights.
+
+        Returns ``(masked_params, new_state)``
+        (ref: asp.py:155-174; recompute is always possible here — the
+        dense values live in ``params``/optimizer masters, not a
+        side buffer, so ``allow_recompute_mask`` is implicitly True).
+        """
+        def mk(p, m):
+            return None if m is None else self.calculate_mask(p)
+
+        masks = jax.tree_util.tree_map(
+            mk, params, state.masks, is_leaf=lambda x: x is None)
+        new_params = self.apply_masks(params, masks)
+        if self.verbosity >= 2:
+            for path, m in jax.tree_util.tree_leaves_with_path(
+                    masks, is_leaf=lambda x: x is None):
+                if m is not None:
+                    pct = 100.0 * float(jnp.sum(m)) / m.size
+                    print(f"[ASP] Enabled {pct:.2f}% sparsity for "
+                          f"{_path_name(path)} of size={tuple(m.shape)}")
+        return new_params, SparsityState(masks=masks, enabled=True)
+
+    def restore_pruned_weights(self, state: SparsityState
+                               ) -> SparsityState:
+        """Disable sparsity: masks back to ones (ref: asp.py:176-189).
+        Pruned weight VALUES are zeros from the masking step — restoring
+        dense values is the caller's job (reload a dense checkpoint), as
+        the reference requires ``allow_recompute_mask`` for the same."""
+        masks = jax.tree_util.tree_map(
+            lambda m: None if m is None else jnp.ones_like(m),
+            state.masks, is_leaf=lambda x: x is None)
+        return SparsityState(masks=masks, enabled=False)
+
+    @staticmethod
+    def apply_masks(tree: Any, masks: Any) -> Any:
+        """Elementwise mask; dense leaves (mask None) pass through."""
+        return jax.tree_util.tree_map(
+            lambda p, m: p if m is None else p * m.astype(p.dtype),
+            tree, masks, is_leaf=lambda x: x is None)
+
+    def is_sparsity_enabled(self, state: SparsityState) -> bool:
+        """ref: asp.py:191-210 — consistent all-dense or all-50%."""
+        total = sp100 = sp50 = 0
+        for m in jax.tree_util.tree_leaves(state.masks):
+            total += 1
+            s = float(jnp.sum(m))
+            if s == m.size:
+                sp100 += 1
+            elif 2 * s == m.size:
+                sp50 += 1
+        assert total in (sp100, sp50), "Inconsistent model sparsity"
+        return total != sp100 if total else False
+
+    def wrap_optimizer(self, tx: optax.GradientTransformation
+                       ) -> optax.GradientTransformation:
+        """The reference's patched ``optimizer.step``
+        (ref: asp.py:127-153): grads masked before the inner update,
+        updates masked after, so a weight pruned to zero can never move.
+        State is ``(inner_state, SparsityState)``; thread the live
+        SparsityState in by replacing it in the optax state after
+        :meth:`compute_sparse_masks`."""
+        def init(params):
+            return (tx.init(params), self.init(params))
+
+        def update(grads, state, params=None):
+            inner_state, sp = state
+            g = self.apply_masks(grads, sp.masks)
+            updates, new_inner = tx.update(g, inner_state, params)
+            updates = self.apply_masks(updates, sp.masks)
+            return updates, (new_inner, sp)
+
+        return optax.GradientTransformation(init, update)
+
+
+# ---------------------------------------------------------------------------
+# Reference-shaped classmethod facade (global singleton, ref: asp.py:21-27)
+# ---------------------------------------------------------------------------
+
+class ASP:
+    __session: Optional[ASPOptimizer] = None
+    __state: Optional[SparsityState] = None
+    __params: Any = None
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator="m4n2_1d",
+                               verbosity=0, whitelist=default_whitelist,
+                               allowed_layer_names=None,
+                               disallowed_layer_names=(),
+                               allow_recompute_mask=False,
+                               custom_layer_dict=None):
+        """ref: asp.py:29-125.  ``params`` is the parameter pytree (the
+        functional 'model'); returns the initial SparsityState."""
+        assert cls.__session is None, "ASP has been initialized already."
+        del allow_recompute_mask, custom_layer_dict  # implicit / n-a
+        cls.__session = ASPOptimizer(
+            mask_calculator, whitelist=whitelist,
+            allowed_layer_names=allowed_layer_names,
+            disallowed_layer_names=disallowed_layer_names,
+            verbosity=verbosity)
+        cls.__params = params
+        cls.__state = cls.__session.init(params)
+        return cls.__state
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, tx: optax.GradientTransformation):
+        """ref: asp.py:127-153 — returns the mask-aware transformation."""
+        assert cls.__session is not None, \
+            "Called ASP.init_optimizer_for_pruning before " \
+            "ASP.init_model_for_pruning."
+        return cls.__session.wrap_optimizer(tx)
+
+    @classmethod
+    def compute_sparse_masks(cls, params=None):
+        """ref: asp.py:155-174 — returns (masked_params, state)."""
+        params = cls.__params if params is None else params
+        masked, cls.__state = cls.__session.compute_sparse_masks(
+            params, cls.__state)
+        cls.__params = masked
+        return masked, cls.__state
+
+    @classmethod
+    def restore_pruned_weights(cls):
+        cls.__state = cls.__session.restore_pruned_weights(cls.__state)
+        return cls.__state
+
+    @classmethod
+    def is_sparsity_enabled(cls):
+        return cls.__session.is_sparsity_enabled(cls.__state)
+
+    @classmethod
+    def prune_trained_model(cls, params, tx):
+        """ref: asp.py:212-217 — one-call recipe."""
+        cls.init_model_for_pruning(params, mask_calculator="m4n2_1d",
+                                   verbosity=2)
+        wrapped = cls.init_optimizer_for_pruning(tx)
+        masked, state = cls.compute_sparse_masks()
+        return masked, wrapped, state
+
+    @classmethod
+    def state_dict(cls) -> dict:
+        """Mask checkpoint continuity
+        (ref: contrib/sparsity/test/checkpointing_test_part1.py)."""
+        return {"masks": cls.__state.masks,
+                "enabled": cls.__state.enabled}
+
+    @classmethod
+    def load_state_dict(cls, d: dict):
+        cls.__state = SparsityState(masks=d["masks"],
+                                    enabled=d["enabled"])
+        return cls.__state
+
+    @classmethod
+    def _reset(cls):
+        """Testing hook (the reference singleton has no reset; tests
+        re-import)."""
+        cls.__session = None
+        cls.__state = None
+        cls.__params = None

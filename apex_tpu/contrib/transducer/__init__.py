@@ -1,0 +1,14 @@
+"""RNN-T transducer (parity with ``apex/contrib/transducer``)."""
+from .transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+
+__all__ = [
+    "TransducerJoint",
+    "TransducerLoss",
+    "transducer_joint",
+    "transducer_loss",
+]

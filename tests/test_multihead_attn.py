@@ -88,6 +88,36 @@ class TestSelfMultiheadAttn:
         np.testing.assert_allclose(np.asarray(out[:-1]),
                                    np.asarray(out2[:-1]), atol=2e-5)
 
+    def test_non_causal_time_mask_content_honored(self):
+        # The reference masked_fills with the caller's matrix; a
+        # sliding-window mask must NOT be silently replaced by causal.
+        m, variables, x = self._mk(impl="default")
+        win = 4
+        i = jnp.arange(SQ)
+        window = ~((i[None, :] <= i[:, None])
+                   & (i[:, None] - i[None, :] < win))  # True = masked
+        out, _ = m.apply(variables, x, attn_mask=window,
+                         is_training=False)
+        causal = _plain_self_mha(variables["params"], x, H, causal=True)
+        assert not np.allclose(np.asarray(out), np.asarray(causal),
+                               atol=1e-4)
+        # manual windowed reference
+        sq, b, e = x.shape
+        d = e // H
+        w = variables["params"]["in_proj_weight"]
+        qkv = (x @ w.T).reshape(sq, b, H, 3, d)
+        q, k, v = (jnp.transpose(qkv[..., j, :], (1, 2, 0, 3))
+                   for j in range(3))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+        s = jnp.where(window[None, None], -10000.0,
+                      s.astype(jnp.float32))
+        probs = jax.nn.softmax(s, -1).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
+        want = ctx @ variables["params"]["out_proj_weight"].T
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5)
+
     def test_key_padding_mask(self):
         m, variables, x = self._mk(impl="fast")
         pad = jnp.zeros((B, SQ), bool).at[:, -3:].set(True)
