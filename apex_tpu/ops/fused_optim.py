@@ -24,11 +24,56 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
-BLOCK_ROWS = 512  # 512x128 fp32 = 256 KiB per buffer per block
+# 1024x128 fp32 = 512 KiB per buffer per block.  Swept on v5e at
+# GPT-345M buffer sizes (uncontended): 512 rows starves the DMA
+# pipeline (77 ms), 1024 -> 45.4 ms, 2048 -> 38.1 ms BUT 2048 x 7
+# buffers double-buffered = 17 MiB, over the 16 MiB scoped-vmem limit
+# for Adam's 7-buffer signature; m/v input_output_aliasing measured
+# slower.  1024 is the largest universally-safe block.
+BLOCK_ROWS = 1024
+
+
+def group_use_pallas(use_pallas, meta) -> bool:
+    """Per-group kernel dispatch policy.
+
+    Explicit True/False wins.  Auto (None): the Pallas kernel runs for
+    multi-leaf packed groups on TPU — the multi-tensor regime the
+    kernels exist for (hundreds of small tensors in one pass,
+    ref: csrc/multi_tensor_apply.cuh).  Single-leaf *direct* groups
+    (GPT-scale embeddings/stacked blocks, >= multi_tensor.
+    DIRECT_MIN_ELEMS) take the jnp path: XLA's own fusion of the
+    identical math measured faster on v5e at 355M params (28.9 ms vs
+    38.1 ms for the best Pallas config), so fusing them by hand would
+    be a demotion-by-vanity.  Numbers recorded in BENCH artifacts.
+    """
+    if use_pallas is not None:
+        return bool(use_pallas)
+    return jax.default_backend() == "tpu" and not meta.direct
 
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def flatten_for_kernel(*bufs):
+    """Ravel (and LANE-pad if needed) native-shape group buffers for a
+    kernel call.  Returns ``(flats, restore)`` where ``restore(x)``
+    un-pads and reshapes a kernel output back to the group shape."""
+    shape = bufs[0].shape
+    n = 1
+    for d in shape:
+        n *= int(d)
+    pad = (-n) % LANE
+    flats = [jnp.ravel(b) for b in bufs]
+    if pad:
+        flats = [jnp.pad(f, (0, pad)) for f in flats]
+
+    def restore(x):
+        if pad:
+            x = x[:n]
+        return x.reshape(shape)
+
+    return flats, restore
 
 
 def _pad_rows(n_rows: int) -> int:
@@ -48,17 +93,16 @@ def _elementwise_call(kernel, hyp: jnp.ndarray,
     n = inputs[0].shape[0]
     assert n % LANE == 0, f"flat buffer length {n} not a multiple of {LANE}"
     rows = n // LANE
-    prows = _pad_rows(rows)
-    grid = prows // BLOCK_ROWS
+    # No host-side padding: Pallas masks the ragged last block itself.
+    # An explicit jnp.pad of the inputs (and the matching output slice)
+    # would add a full read+write of every buffer — at GPT-scale packs
+    # that overhead tripled the step time vs the unfused XLA chain.
+    block_rows = min(BLOCK_ROWS, rows)
+    grid = -(-rows // block_rows)
 
-    views = []
-    for x in inputs:
-        v = x.reshape(rows, LANE)
-        if prows != rows:
-            v = jnp.pad(v, ((0, prows - rows), (0, 0)))
-        views.append(v)
+    views = [x.reshape(rows, LANE) for x in inputs]
 
-    blockspec = pl.BlockSpec((BLOCK_ROWS, LANE), lambda i: (i, 0),
+    blockspec = pl.BlockSpec((block_rows, LANE), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
     outs = pl.pallas_call(
         kernel,
@@ -66,11 +110,11 @@ def _elementwise_call(kernel, hyp: jnp.ndarray,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
         + [blockspec] * len(views),
         out_specs=[blockspec] * len(out_dtypes),
-        out_shape=[jax.ShapeDtypeStruct((prows, LANE), d)
+        out_shape=[jax.ShapeDtypeStruct((rows, LANE), d)
                    for d in out_dtypes],
         interpret=_interpret() if interpret is None else interpret,
     )(hyp.astype(jnp.float32), *views)
-    return [o[:rows].reshape(n) for o in outs]
+    return [o.reshape(n) for o in outs]
 
 
 # --- Adam (ref: csrc/multi_tensor_adam.cu AdamFunctor :24-110) -------------

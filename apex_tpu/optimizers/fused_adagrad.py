@@ -21,27 +21,27 @@ def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
                   weight_decay: float = 0.0,
                   use_pallas: bool = None) -> optax.GradientTransformation:
     def init(params):
-        metas = multi_tensor.compute_metas(params)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
         return FusedAdagradState(
             count=jnp.zeros((), jnp.int32),
-            h=tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas))
+            h=multi_tensor.state_zeros(metas))
 
     def update(grads, state, params=None):
-        fused = use_pallas if use_pallas is not None \
-            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_adagrad requires params in update()")
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
-        metas = multi_tensor.compute_metas(params)
-        gbufs = multi_tensor.pack(grads, metas)
-        pbufs = multi_tensor.pack(params, metas)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
         deltas, new_h = [], []
         for i, meta in enumerate(metas):
-            if fused:
+            if fused_optim.group_use_pallas(use_pallas, meta):
+                (gb, pb, hb), restore = fused_optim.flatten_for_kernel(
+                    gbufs[i], pbufs[i], state.h[i])
                 d, h = fused_optim.adagrad_update(
-                    gbufs[i], pbufs[i], state.h[i],
-                    lr=lr, eps=eps, weight_decay=weight_decay)
+                    gb, pb, hb, lr=lr, eps=eps, weight_decay=weight_decay)
+                d, h = restore(d), restore(h)
             else:
                 g = gbufs[i].astype(jnp.float32) \
                     + weight_decay * pbufs[i].astype(jnp.float32)
@@ -50,7 +50,7 @@ def fused_adagrad(learning_rate: ScalarOrSchedule = 1e-2,
             deltas.append(d)
             new_h.append(h)
         leaves = jax.tree_util.tree_leaves(params)
-        updates = multi_tensor.unpack_groups(
+        updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedAdagradState(count, tuple(new_h))
 

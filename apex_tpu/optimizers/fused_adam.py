@@ -46,15 +46,13 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
     """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4)."""
 
     def init(params):
-        metas = multi_tensor.compute_metas(params)
-        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        zeros = multi_tensor.state_zeros(metas)
         return FusedAdamState(count=jnp.zeros((), jnp.int32),
                               m=zeros, v=tuple(jnp.zeros_like(z)
                                                for z in zeros))
 
     def update(grads, state, params=None):
-        fused = use_pallas if use_pallas is not None \
-            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_adam requires params in update()")
         count = state.count + 1
@@ -66,18 +64,21 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
         else:
             bc1 = bc2 = jnp.float32(1.0)
 
-        metas = multi_tensor.compute_metas(params)
-        gbufs = multi_tensor.pack(grads, metas)
-        pbufs = multi_tensor.pack(params, metas)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            if fused:
+            if fused_optim.group_use_pallas(use_pallas, meta):
+                (gb, pb, mb, vb), restore = fused_optim.flatten_for_kernel(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i])
                 d, m, v = fused_optim.adam_update(
-                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    gb, pb, mb, vb,
                     lr=lr, beta1=beta1, beta2=beta2, eps=eps,
                     weight_decay=weight_decay,
                     bias_correction1=bc1, bias_correction2=bc2,
                     adam_w_mode=adam_w_mode)
+                d, m, v = restore(d), restore(m), restore(v)
             else:
                 d, m, v = _adam_jnp(
                     gbufs[i], pbufs[i], state.m[i], state.v[i],
@@ -87,7 +88,7 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
             new_m.append(m)
             new_v.append(v)
         leaves = jax.tree_util.tree_leaves(params)
-        updates = multi_tensor.unpack_groups(
+        updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedAdamState(count, tuple(new_m), tuple(new_v))
 

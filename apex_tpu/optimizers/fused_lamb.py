@@ -61,8 +61,6 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_lamb requires params in update()")
-        fused = use_pallas if use_pallas is not None \
-            else jax.default_backend() == "tpu"
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)
@@ -89,7 +87,7 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                 gscale=clip, beta1=beta1, beta2=beta2, beta3=beta3,
                 eps=eps, weight_decay=weight_decay, bc1=bc1, bc2=bc2,
                 adam_w_mode=adam_w_mode, use_nvlamb=use_nvlamb,
-                fused=fused)
+                fused=fused_optim.group_use_pallas(use_pallas, meta))
             deltas.append(-lr * adapted_u)
             new_m.append(m)
             new_v.append(v)

@@ -100,8 +100,6 @@ class FusedMixedPrecisionLamb:
         fused_mixed_precision_lamb.py:140+).  Returns
         ``(new_params, new_state, new_scaler_state, info)``.
         """
-        fused = self.use_pallas if self.use_pallas is not None \
-            else jax.default_backend() == "tpu"
         metas = multi_tensor.compute_metas(params,
                                            align=multi_tensor.LANE)
         gbufs = multi_tensor.pack(grads, metas)
@@ -138,7 +136,9 @@ class FusedMixedPrecisionLamb:
                 gscale=gscale, beta1=self.beta1, beta2=self.beta2,
                 beta3=beta3, eps=self.eps, weight_decay=self.weight_decay,
                 bc1=bc1, bc2=bc2, adam_w_mode=self.adam_w_mode,
-                use_nvlamb=self.use_nvlamb, fused=fused)
+                use_nvlamb=self.use_nvlamb,
+                fused=fused_optim.group_use_pallas(
+                    self.use_pallas, meta))
             master_new = state.masters[i] - lr * adapted_u
             # Overflow: everything holds still (the mp kernel's
             # found_inf no-op, ref: multi_tensor_lamb_mp.cu).

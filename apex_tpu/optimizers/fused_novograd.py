@@ -56,8 +56,6 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("fused_novograd requires params in update()")
-        fused = use_pallas if use_pallas is not None \
-            else jax.default_backend() == "tpu"
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)
@@ -91,7 +89,7 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
             denom_t = jnp.sqrt(v_new / bc2) + eps
             denom_elem = jnp.concatenate(
                 [denom_t, jnp.ones((1,), jnp.float32)])[seg]
-            if fused:
+            if fused_optim.group_use_pallas(use_pallas, meta):
                 d, m = fused_optim.novograd_update(
                     gbufs[i], pbufs[i], state.m[i], denom_elem,
                     lr=lr, beta1=beta1, beta3=beta3,

@@ -38,24 +38,21 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
             "(ref: apex/optimizers/fused_sgd.py:61-62)")
 
     def init(params):
-        metas = multi_tensor.compute_metas(params)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
         return FusedSGDState(
             count=jnp.zeros((), jnp.int32),
-            momentum=tuple(jnp.zeros((m.padded,), jnp.float32)
-                           for m in metas))
+            momentum=multi_tensor.state_zeros(metas))
 
     def update(grads, state, params=None):
-        fused = use_pallas if use_pallas is not None \
-            else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("fused_sgd requires params in update()")
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         first = (state.count == 0).astype(jnp.float32) if momentum else \
             jnp.float32(0.0)
-        metas = multi_tensor.compute_metas(params)
-        gbufs = multi_tensor.pack(grads, metas)
-        pbufs = multi_tensor.pack(params, metas)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
         deltas, new_mom = [], []
         for i, meta in enumerate(metas):
             if momentum == 0.0:
@@ -65,14 +62,16 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
                 g = g + weight_decay * p32
                 deltas.append((-lr * g).astype(meta.dtype))
                 new_mom.append(state.momentum[i])
-            elif fused:
+            elif fused_optim.group_use_pallas(use_pallas, meta):
+                (gb, pb, mb), restore = fused_optim.flatten_for_kernel(
+                    gbufs[i], pbufs[i], state.momentum[i])
                 d, mom = fused_optim.sgd_update(
-                    gbufs[i], pbufs[i], state.momentum[i],
+                    gb, pb, mb,
                     lr=lr, momentum=momentum, dampening=dampening,
                     weight_decay=weight_decay, nesterov=nesterov,
                     wd_after_momentum=wd_after_momentum, first_run=first)
-                deltas.append(d)
-                new_mom.append(mom)
+                deltas.append(restore(d))
+                new_mom.append(restore(mom))
             else:
                 d, mom = _sgd_jnp(gbufs[i], pbufs[i], state.momentum[i],
                                   lr, momentum, dampening, weight_decay,
@@ -80,7 +79,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
                 deltas.append(d)
                 new_mom.append(mom)
         leaves = jax.tree_util.tree_leaves(params)
-        updates = multi_tensor.unpack_groups(
+        updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedSGDState(count, tuple(new_mom))
 

@@ -1,20 +1,27 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 O5 (bf16 + fp32 masters) training
-throughput on the local accelerator.
+"""Benchmarks against BASELINE.json's north-star metrics.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+Prints ONE JSON line.  Headline (metric/value/unit/vs_baseline) is the
+ResNet-50 O5 training throughput vs the 2500 img/s A100 anchor (NVIDIA
+NGC resnet50 v1.5 AMP benchmarks, single A100 — BASELINE.json
+"within 10% of A100 images/sec/chip").  The ``extras`` field carries the
+other BASELINE metrics:
 
-``vs_baseline`` is measured images/sec divided by 2500 — a published
-A100 ResNet-50 AMP training throughput (NVIDIA NGC resnet50 v1.5
-benchmarks, single A100, mixed precision), the north-star comparison
-point in BASELINE.json ("within 10% of A100 images/sec/chip").
+- ``optimizer_step``: fused (Pallas) vs unfused (optax) step time at
+  RN50-class (~26M) and GPT-345M-class (~355M) parameter counts
+  (BASELINE "optimizer-step µs vs unfused"; the reference bar is
+  csrc/multi_tensor_adam.cu's single-launch multi-tensor kernel).
+- ``collective``: psum bandwidth sweep when >1 device is attached; on
+  the single-chip bench host ICI is unmeasurable, so on-chip HBM
+  reduction bandwidth is recorded instead, explicitly labeled.
+- ``gpt2_345m``: single-chip GPT-2-345M train step (flash attention,
+  scaled softmax path, fused LayerNorm, fused xentropy, FusedAdam) —
+  the transformer-path TPU number (BASELINE "configs": GPT-2 345M).
 
-The train step is the full framework path: apex_tpu.amp O5 policy,
-fused SGD (Pallas), SyncBatchNorm stats, fused cross-entropy.
-Iterations are naturally chained through params, and completion is
-forced with a value fetch (async dispatch under-reports otherwise).
+Iterations are chained through params; completion forced with a value
+fetch (async dispatch under-reports otherwise).
 """
+import functools
 import json
 import os
 import sys
@@ -36,13 +43,34 @@ BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 IMAGE = 224
 WARMUP = 3
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+SKIP_EXTRAS = os.environ.get("BENCH_SKIP_EXTRAS", "") == "1"
 
 
-def main():
-    if not parallel_state.model_parallel_is_initialized():
-        parallel_state.initialize_model_parallel()
-    n_dev = parallel_state.get_world_size()
+def _force(out):
+    """Full device sync via a scalar readback (block_until_ready alone
+    has proven unreliable through the remote-device tunnel)."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(jnp.sum(jnp.ravel(leaf)[:1]))
 
+
+def _timeit(fn, *args, iters=10, warmup=2):
+    """Seconds per call, device-synced via a value readback."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    _force(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _force(out)
+    return (time.perf_counter() - t0) / iters
+
+
+# --------------------------------------------------------------------------
+# Headline: ResNet-50 O5 images/sec
+# --------------------------------------------------------------------------
+
+def bench_resnet50():
     policy = amp.get_policy("O5")
     model = ResNet50(num_classes=1000, dtype=policy.compute_dtype)
     key = jax.random.PRNGKey(0)
@@ -75,24 +103,231 @@ def main():
             grads, amp_state, params)
         return new_params, mutated["batch_stats"], new_amp_state, loss
 
-    mesh = parallel_state.get_mesh()
-    with mesh:
-        p, bs, st = params, batch_stats, amp_state
-        for _ in range(WARMUP):
-            p, bs, st, loss = train_step(p, bs, st, images, labels)
-        float(loss)  # force completion of warmup
-        t0 = time.time()
-        for _ in range(ITERS):
-            p, bs, st, loss = train_step(p, bs, st, images, labels)
-        float(loss)  # force completion
-        dt = time.time() - t0
+    p, bs, st = params, batch_stats, amp_state
+    for _ in range(WARMUP):
+        p, bs, st, loss = train_step(p, bs, st, images, labels)
+    float(loss)
+    t0 = time.time()
+    for _ in range(ITERS):
+        p, bs, st, loss = train_step(p, bs, st, images, labels)
+    float(loss)
+    dt = time.time() - t0
+    return BATCH * ITERS / dt
 
-    ips = BATCH * ITERS / dt
+
+# --------------------------------------------------------------------------
+# Extra 1: optimizer-step µs, fused (Pallas) vs unfused (optax)
+# --------------------------------------------------------------------------
+
+def _synthetic_params(total: int, key):
+    """Param tree with a transformer-like leaf-size mix summing to
+    ~``total`` elements."""
+    leaves = {}
+    i = 0
+    remaining = total
+    big = total // 8
+    while remaining > 0:
+        n = min(remaining, big)
+        cols = 1024
+        rows = max(1, n // cols)
+        leaves[f"w{i}"] = jax.random.normal(
+            jax.random.fold_in(key, i), (rows, cols), jnp.float32) * 0.01
+        remaining -= rows * cols
+        i += 1
+    return leaves
+
+
+def bench_optimizers():
+    import optax
+
+    from apex_tpu.optimizers import fused_adam, fused_sgd as fsgd
+
+    sizes = (("rn50_26m", 26_000_000), ("gpt345m_355m", 355_000_000))
+    if os.environ.get("BENCH_SMOKE") == "1":
+        sizes = (("smoke_1m", 1_000_000), ("smoke_4m", 4_000_000))
+    results = []
+    for label, count in sizes:
+        for opt_name, fused_tx, plain_tx in (
+            ("adam", fused_adam(1e-3),
+             optax.adam(1e-3, b1=0.9, b2=0.999)),
+            ("sgd_momentum", fsgd(0.1, momentum=0.9),
+             optax.sgd(0.1, momentum=0.9)),
+        ):
+            row = {"params": label, "optimizer": opt_name}
+            for kind, tx in (("fused_us", fused_tx),
+                             ("unfused_us", plain_tx)):
+                # Params re-generated per run and donated into the step
+                # so at 355M a single chip holds one params copy + one
+                # state copy (donation reuses their HBM each iteration).
+                p = _synthetic_params(count, jax.random.PRNGKey(3))
+                grads = jax.tree_util.tree_map(
+                    lambda x: x * 0.001 + 0.001, p)
+                s = jax.jit(tx.init)(p)
+                # distinct buffers for donation (zeros/constant leaves
+                # can share one cached buffer)
+                s = jax.tree_util.tree_map(jnp.array, s)
+
+                @functools.partial(jax.jit, donate_argnums=(1, 2))
+                def step(g, s, p):
+                    u, s2 = tx.update(g, s, p)
+                    return optax.apply_updates(p, u), s2
+
+                for _ in range(2):
+                    p, s = step(grads, s, p)
+                _force(p)
+                # best-of-3: the shared bench chip shows +-2x run noise
+                dt = float("inf")
+                for _rep in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(8):
+                        p, s = step(grads, s, p)
+                    _force(p)
+                    dt = min(dt, (time.perf_counter() - t0) / 8)
+                del p, s, grads
+                row[kind] = round(dt * 1e6, 1)
+            row["speedup"] = round(row["unfused_us"] / row["fused_us"], 3)
+            results.append(row)
+            print(f"[bench] optimizer {label}/{opt_name}: {row}",
+                  file=sys.stderr)
+    return results
+
+
+# --------------------------------------------------------------------------
+# Extra 2: collective / memory bandwidth
+# --------------------------------------------------------------------------
+
+def bench_collective():
+    n_dev = jax.device_count()
+    out = {"devices": n_dev}
+    if n_dev > 1:
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        sweep = []
+        for mb in (1, 8, 64, 256):
+            n = mb * 1024 * 1024 // 4
+            x = jnp.ones((n_dev, n // n_dev), jnp.float32)
+
+            def ar(x):
+                return jax.shard_map(
+                    lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                    in_specs=P("data"), out_specs=P())(x)
+
+            jit_ar = jax.jit(ar)
+            dt = _timeit(lambda: jit_ar(x), iters=10)
+            # ring allreduce moves 2(n-1)/n of the buffer per link
+            bus_bytes = 4 * n * 2 * (n_dev - 1) / n_dev
+            sweep.append({"mib": mb,
+                          "allreduce_gbps": round(bus_bytes / dt / 1e9,
+                                                  2)})
+        out["psum_sweep"] = sweep
+    else:
+        # single chip: ICI bandwidth is unmeasurable; record HBM
+        # reduction bandwidth as the honest stand-in.
+        n = 256 * 1024 * 1024 // 4
+        x = jnp.ones((n,), jnp.float32)
+        red = jax.jit(lambda x: jnp.sum(x))
+        dt = _timeit(lambda: red(x), iters=10)
+        out["note"] = ("single chip attached - ICI unmeasurable; "
+                       "hbm_read_gbps is the on-chip reduction bandwidth")
+        out["hbm_read_gbps"] = round(4 * n / dt / 1e9, 1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Extra 3: GPT-2 345M single-chip train step (transformer Pallas path)
+# --------------------------------------------------------------------------
+
+def bench_gpt345m():
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.testing.standalone_gpt import GPTModel
+
+    seq = int(os.environ.get("BENCH_GPT_SEQ", "1024"))
+    batch = int(os.environ.get("BENCH_GPT_BATCH", "4"))
+    vocab, hidden, layers, heads = 50304, 1024, 24, 16
+    if os.environ.get("BENCH_SMOKE") == "1":
+        vocab, hidden, layers, heads = 1024, 256, 2, 4
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=True,
+        checkpoint_activations=True, dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, axis=-1)
+    variables = jax.jit(model.init)(key, tokens)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_adam(1e-4), opt_level="O5")
+    del variables  # free the fp32 init copy (masters hold their own)
+    # distinct buffers for donation (constant-cache aliasing)
+    params, amp_state = jax.tree_util.tree_map(jnp.array,
+                                               (params, amp_state))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, amp_state, tokens, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens,
+                                 deterministic=True)
+            loss = jnp.mean(softmax_cross_entropy_loss(
+                logits.reshape(-1, logits.shape[-1]),
+                labels.reshape(-1), half_to_float=True))
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_state, _ = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, new_state, loss
+
+    p, st = params, amp_state
+    for _ in range(2):
+        p, st, loss = train_step(p, st, tokens, labels)
+    float(loss)
+    t0 = time.time()
+    n_it = 8
+    for _ in range(n_it):
+        p, st, loss = train_step(p, st, tokens, labels)
+    float(loss)
+    dt = (time.time() - t0) / n_it
+    tokens_per_sec = batch * seq / dt
+    # model flops: 6 * params * tokens (fwd+bwd) + attention term
+    flops = 6.0 * n_params * batch * seq \
+        + 12.0 * layers * hidden * batch * seq * seq
+    return {"params_m": round(n_params / 1e6, 1), "seq": seq,
+            "batch": batch, "step_ms": round(dt * 1e3, 1),
+            "tokens_per_sec": round(tokens_per_sec, 0),
+            "model_tflops_per_sec": round(flops / dt / 1e12, 1)}
+
+
+def main():
+    if not parallel_state.model_parallel_is_initialized():
+        parallel_state.initialize_model_parallel()
+    n_dev = parallel_state.get_world_size()
+    mesh = parallel_state.get_mesh()
+
+    with mesh:
+        print("[bench] resnet50...", file=sys.stderr)
+        ips = bench_resnet50()
+        print(f"[bench] resnet50 done: {ips:.1f} img/s", file=sys.stderr)
+        extras = {}
+        if not SKIP_EXTRAS:
+            extras["optimizer_step"] = bench_optimizers()
+            print("[bench] collective...", file=sys.stderr)
+            extras["collective"] = bench_collective()
+            print("[bench] gpt2_345m...", file=sys.stderr)
+            extras["gpt2_345m"] = bench_gpt345m()
+
     print(json.dumps({
         "metric": f"resnet50_o5_train_images_per_sec_{n_dev}chip",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(ips / A100_BASELINE_IPS, 3),
+        "extras": extras,
     }))
 
 
