@@ -54,11 +54,13 @@ PROMOTE_PRIMS = frozenset({
     "atan2", "nextafter", "select_n", "concatenate",
 })
 
-# Call-like / control-flow primitives the interpreter recurses into or
-# leaves untouched (custom-autodiff bodies must keep their rules).
+# Call-like primitives the interpreter recurses into; scan/while/cond
+# are handled structurally (re-traced with the interpreter in their
+# bodies, see autocast._eval_scan et al.).  OPAQUE bodies keep their
+# custom autodiff rules untouched.
 RECURSE_PRIMS = frozenset({"jit", "pjit", "closed_call", "core_call",
                            "remat", "remat2", "checkpoint"})
 OPAQUE_PRIMS = frozenset({
     "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
-    "scan", "while", "cond", "custom_root", "custom_linear_solve",
+    "custom_root", "custom_linear_solve",
 })

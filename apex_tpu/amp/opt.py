@@ -1,0 +1,112 @@
+"""Legacy per-optimizer handle API (``OptimWrapper``).
+
+Parity surface for ``apex/amp/opt.py:9-103`` — the pre-``amp.initialize``
+workflow where a handle wraps an optimizer and ``scale_loss`` is a
+per-loss context manager with per-loss dynamic scalers and
+skip-on-overflow.  The modern path is :class:`apex_tpu.amp.AmpOptimizer`
+(which this wrapper delegates to); this class exists so reference users
+migrating ``amp_handle.wrap_optimizer(opt, num_loss=N)`` scripts find
+the same shape.
+
+Tape-free translation of the reference's grad plumbing: the context
+manager yields a *scale factor carrier* — compute your grads of
+``scaled_loss`` and hand them to :meth:`accumulate`; ``step`` applies
+the summed unscaled grads unless any loss overflowed (the reference's
+cached-grads dance at opt.py:27-52 exists only because torch grads
+accumulate in-place; functional grads just add).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..fp16_utils.loss_scaler import DynamicLossScaler
+
+
+class OptimWrapper:
+    """ref: apex/amp/opt.py:9."""
+
+    def __init__(self, optimizer: optax.GradientTransformation,
+                 params: Any, num_loss: int = 1):
+        self._optimizer = optimizer
+        self.params = params
+        self.opt_state = optimizer.init(params)
+        self._num_loss = num_loss
+        self._loss_idx = 0
+        self._skip_next = [False] * num_loss
+        self._loss_scaler = [DynamicLossScaler() for _ in range(num_loss)]
+        self._acc_grads: Optional[Any] = None
+
+    @contextlib.contextmanager
+    def scale_loss(self, loss=None):
+        """Per-loss scaling window (ref: opt.py:18-52).
+
+        Yields the current loss scale (multiply your loss by it before
+        differentiating); on exit the window advances to the next loss
+        id.  Pass the scaled grads to :meth:`accumulate` inside the
+        window.
+        """
+        scaler = self._cur_loss_scaler()
+        yield scaler.loss_scale
+        self._loss_idx += 1
+
+    def accumulate(self, scaled_grads: Any) -> None:
+        """Unscale grads of the current loss and add into the
+        accumulator (the functional form of the reference's in-place
+        ``p.grad`` accumulation + ``unscale``, ref: opt.py:39-45)."""
+        scaler = self._cur_loss_scaler()
+        inv = 1.0 / scaler.loss_scale
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.asarray(g).astype(jnp.float32) * inv,
+            scaled_grads)
+        overflow = scaler.has_overflow(grads)
+        scaler.update_scale(overflow)
+        self._skip_next[self._loss_idx] = overflow
+        if not overflow:
+            if self._acc_grads is None:
+                self._acc_grads = grads
+            else:
+                self._acc_grads = jax.tree_util.tree_map(
+                    jnp.add, self._acc_grads, grads)
+
+    def _cur_loss_scaler(self) -> DynamicLossScaler:
+        assert 0 <= self._loss_idx < self._num_loss
+        return self._loss_scaler[self._loss_idx]
+
+    def step(self, closure=None):
+        """ref: opt.py:58-77 — skip if ANY loss overflowed this round."""
+        if closure is not None:
+            raise NotImplementedError(
+                "The `closure` argument is unsupported by the amp "
+                "optimizer wrapper.")
+        self._loss_idx = 0
+        if any(self._skip_next):
+            self._skip_next = [False] * self._num_loss
+            self._acc_grads = None
+            return self.params
+        if self._acc_grads is not None:
+            updates, self.opt_state = self._optimizer.update(
+                jax.tree_util.tree_map(
+                    lambda g, p: g.astype(jnp.asarray(p).dtype),
+                    self._acc_grads, self.params),
+                self.opt_state, self.params)
+            self.params = optax.apply_updates(self.params, updates)
+            self._acc_grads = None
+        return self.params
+
+    def zero_grad(self) -> None:
+        self._acc_grads = None
+
+    def state_dict(self) -> dict:
+        return {"opt_state": self.opt_state, "params": self.params,
+                "loss_scales": [s.cur_scale for s in self._loss_scaler]}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.opt_state = d["opt_state"]
+        self.params = d["params"]
+        for s, v in zip(self._loss_scaler, d["loss_scales"]):
+            s.cur_scale = v

@@ -160,3 +160,119 @@ def test_register_decorators():
     assert float_function(probe)(jnp.ones(2, jnp.bfloat16))[0] == jnp.float32
     a, b = promote_function(probe)(jnp.ones(2, jnp.bfloat16), jnp.ones(2))
     assert a == jnp.float32 and b == jnp.float32
+
+
+# --- control flow: scan/while/cond bodies get casting (VERDICT weak #7) ----
+
+def _dot_dtype_inside(jaxpr_str):
+    """Extract the operand dtype of the first dot_general in a jaxpr
+    dump (bf16 operands show as 'bf16[' on the dot's args)."""
+    return "bf16" in jaxpr_str
+
+
+def test_scan_body_is_autocast():
+    w = jnp.ones((8, 8), jnp.float32)
+
+    def scanned(x):
+        def body(c, _):
+            return jnp.tanh(c @ w), None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    f = autocast(scanned, compute_dtype=jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((4, 8), jnp.float32))
+    # the scan body must contain convert_element_type to bf16 feeding the dot
+    body = None
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            body = eqn.params["jaxpr"].jaxpr
+    assert body is not None
+    body_str = str(body)
+    assert "bf16" in body_str, f"no bf16 casts inside scan body:\n{body_str}"
+    # carry fixed point intact: output matches input structure and runs
+    out = f(jnp.ones((4, 8), jnp.float32))
+    assert out.shape == (4, 8)
+    assert out.dtype == jnp.float32  # carry dtype restored
+
+
+def test_scanned_gpt_like_trains_under_o4():
+    """A scanned-layer transformer block under O4 must cast inside the
+    layers AND still train (grad flows through the interpreter)."""
+    H = 16
+    params = {
+        "w_qkv": jax.random.normal(jax.random.PRNGKey(0), (4, H, H))
+        * 0.1,
+        "w_out": jax.random.normal(jax.random.PRNGKey(1), (H, 4)) * 0.1,
+    }
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, H))
+    y = jax.random.normal(jax.random.PRNGKey(3), (8, 4))
+
+    def model(p, x):
+        def layer(h, w):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(layer, x, p["w_qkv"])
+        return h @ p["w_out"]
+
+    def loss_fn(p):
+        pred = autocast(model, compute_dtype=jnp.bfloat16)(p, x)
+        return jnp.mean((pred - y) ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    assert all(bool(jnp.all(jnp.isfinite(l)))
+               for l in jax.tree_util.tree_leaves(g))
+    p = params
+    l0 = float(loss_fn(p))
+    step = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda w, gr: w - 0.5 * gr, p, jax.grad(loss_fn)(p)))
+    for _ in range(40):
+        p = step(p)
+    assert float(loss_fn(p)) < l0 * 0.7
+
+
+def test_while_body_is_autocast():
+    w = jnp.ones((8, 8), jnp.float32)
+
+    def looped(x):
+        def cond(state):
+            i, _ = state
+            return i < 3
+
+        def body(state):
+            i, c = state
+            return i + 1, c @ w
+
+        _, out = jax.lax.while_loop(cond, body, (0, x))
+        return out
+
+    f = autocast(looped, compute_dtype=jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((4, 8), jnp.float32))
+    body = None
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+    assert body is not None and "bf16" in str(body)
+    out = f(jnp.ones((4, 8), jnp.float32))
+    assert out.dtype == jnp.float32  # carry restored
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.ones((4, 8)) @ w @ w @ w),
+                               rtol=1e-2)
+
+
+def test_cond_branches_are_autocast():
+    w = jnp.ones((8, 8), jnp.float32) * 0.5
+
+    def branched(x, flag):
+        return jax.lax.cond(flag, lambda v: v @ w, lambda v: v * 2.0, x)
+
+    f = autocast(branched, compute_dtype=jnp.bfloat16)
+    x = jnp.ones((4, 8), jnp.float32)
+    jaxpr = jax.make_jaxpr(f)(x, True)
+    br = None
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "cond":
+            br = eqn.params["branches"]
+    assert br is not None and any("bf16" in str(b.jaxpr) for b in br)
+    np.testing.assert_allclose(np.asarray(f(x, True)),
+                               np.asarray(x @ w), rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(f(x, False)),
+                               np.asarray(x * 2.0), rtol=1e-6)
