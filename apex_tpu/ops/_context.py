@@ -1,19 +1,41 @@
 """Execution-context helpers shared by the Pallas op wrappers."""
 from __future__ import annotations
 
-from jax._src import core as _jax_core
+import jax
 
 
-def in_manual_axis_context() -> bool:
-    """True when tracing inside ``shard_map`` manual axes.
+def in_manual_axis_context(*operands) -> bool:
+    """True when the computation is inside ``shard_map`` manual axes.
 
     Pallas calls cannot yet express varying-mesh-axis (VMA) types on
     their outputs, so inside ``shard_map(check_vma=True)`` every fused op
     routes to its XLA-fusion reference implementation — same math, XLA
     still fuses it per shard.  Outside (plain jit / pjit / GSPMD) the
     Pallas kernels run.
+
+    Detection prefers the public ``jax.typeof(operand).vma`` type when
+    operands are given: only values actually *varying* over manual axes
+    force the fallback, so ``vmap(axis_name=...)`` and replicated values
+    inside shard_map keep the Pallas path (the private axis-env check
+    this replaces disabled it for any named axis).  With no operands the
+    axis-env heuristic is used; if both probes break (API drift) the
+    error propagates rather than silently choosing a path.
     """
-    try:
-        return bool(_jax_core.get_axis_env().axis_sizes)
-    except Exception:  # pragma: no cover - private-API drift safety
+    probed = False
+    for x in operands:
+        try:
+            vma = jax.typeof(x).vma
+        except (AttributeError, TypeError):
+            continue
+        probed = True
+        if vma:
+            return True
+    if probed:
         return False
+    # No operands (or none carried a vma type): conservative axis-env
+    # probe.  Deliberately NOT wrapped in a blanket except — if this
+    # private API drifts, failing loudly here beats silently running a
+    # Pallas call inside shard_map where check_vma rejects it later.
+    from jax._src import core as _jax_core
+
+    return bool(_jax_core.get_axis_env().axis_sizes)

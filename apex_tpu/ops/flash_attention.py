@@ -317,7 +317,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     from ._context import in_manual_axis_context
 
-    if in_manual_axis_context():
+    if in_manual_axis_context(q, k, v):
         return mha_reference(q, k, v, scale=scale, causal=causal)
     return _flash_attention_fused(q, k, v, scale, causal, block_q, block_k)
 

@@ -225,6 +225,6 @@ def layer_norm(x: jnp.ndarray,
     """
     from ._context import in_manual_axis_context
 
-    if in_manual_axis_context():
+    if in_manual_axis_context(x):
         return _layer_norm_reference(x, gamma, beta, eps)
     return _layer_norm_fused(x, gamma, beta, eps)

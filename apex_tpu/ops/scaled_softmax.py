@@ -69,7 +69,7 @@ def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
     shard_map manual axes the XLA reference path runs."""
     from ._context import in_manual_axis_context
 
-    if in_manual_axis_context():
+    if in_manual_axis_context(x):
         sq, sk = x.shape[-2:]
         s = x.astype(jnp.float32) * scale
         mask = jnp.tril(jnp.ones((sq, sk), bool))
@@ -162,7 +162,7 @@ def scaled_masked_softmax(x: jnp.ndarray, mask: jnp.ndarray,
     shard_map manual axes the XLA reference path runs."""
     from ._context import in_manual_axis_context
 
-    if in_manual_axis_context():
+    if in_manual_axis_context(x, mask):
         s = x.astype(jnp.float32) * scale
         s = jnp.where(mask, jnp.float32(-10000.0), s)
         return jax.nn.softmax(s, axis=-1).astype(x.dtype)

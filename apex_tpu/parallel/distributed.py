@@ -36,6 +36,7 @@ gradients are pre-summed and only need ``tree / world_size`` — see
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Optional
 
@@ -138,16 +139,13 @@ class DistributedDataParallel:
     num_allreduce_streams: int = 1
     retain_allreduce_buffers: bool = False
 
-    def __post_init__(self):
-        self._sync = not self.delay_allreduce
-
     def __call__(self, params, *args, **kwargs):
         # Differentiate w.r.t. a *varying* view of the params so autodiff
         # does not pre-psum the cotangent (see module docstring); the one
         # collective below then owns the knob semantics.
         grads = self.grad_fn(make_varying(params, self.axis_name),
                              *args, **kwargs)
-        if not self._sync:
+        if self.delay_allreduce:
             return grads
         return sync_gradients(
             grads, self.axis_name,
@@ -155,16 +153,12 @@ class DistributedDataParallel:
             gradient_predivide_factor=self.gradient_predivide_factor,
             allreduce_always_fp32=self.allreduce_always_fp32)
 
+    @contextlib.contextmanager
     def no_sync(self):
-        """Context manager suppressing the sync (gradient accumulation
-        microbatches; the reference gets this via ``delay_allreduce``)."""
-        ddp = self
-
-        class _NoSync:
-            def __enter__(self):
-                ddp._sync = False
-
-            def __exit__(self, *exc):
-                ddp._sync = not ddp.delay_allreduce
-
-        return _NoSync()
+        """Gradient-accumulation window: yields a NO-SYNC view of this
+        wrapper (``with ddp.no_sync() as ddp_acc: ddp_acc(...)``) —
+        microbatch calls on the view return raw local grads; reduce once
+        afterwards with :func:`allreduce_params`.  Unlike the reference
+        (and this wrapper's earlier revision) no shared state is
+        mutated, so the wrapper can be traced/reused concurrently."""
+        yield dataclasses.replace(self, delay_allreduce=True)

@@ -94,8 +94,15 @@ class FusedScaleMaskSoftmax:
             sq, sk = inputs.shape[-2:]
             causal = jnp.tril(jnp.ones((sq, sk), bool))
             inputs = jnp.where(causal, inputs, -10000.0)
-        elif mask is not None and self.mask_func is not None:
-            inputs = self.mask_func(inputs, mask)
+        elif mask is not None:
+            if self.mask_func is not None:
+                inputs = self.mask_func(inputs, mask)
+            else:
+                # default attention_mask_func: fill masked (True)
+                # positions (ref: the reference always installs
+                # masked_fill(-10000); a None mask_func must not
+                # silently DROP the mask)
+                inputs = jnp.where(mask.astype(bool), -10000.0, inputs)
         probs = jnp.exp(inputs - jnp.max(inputs, -1, keepdims=True))
         probs = probs / jnp.sum(probs, -1, keepdims=True)
         if self.input_in_float16 and self.softmax_in_fp32:

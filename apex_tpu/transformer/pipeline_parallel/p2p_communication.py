@@ -27,13 +27,25 @@ import jax
 from ...parallel_state import PIPE_AXIS
 
 
-def _shift(x, axis_name: str, forward: bool):
+def _shift(x, axis_name: str, forward: bool, wrap: bool = False):
     size = jax.lax.axis_size(axis_name)
     if forward:
-        perm = [(i, i + 1) for i in range(size - 1)]
+        perm = [(i, (i + 1) % size) for i in range(size if wrap
+                                                   else size - 1)]
     else:
-        perm = [(i + 1, i) for i in range(size - 1)]
+        perm = [((i + 1) % size, i) for i in range(size if wrap
+                                                   else size - 1)]
     return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward_cyclic(output_tensor,
+                                     axis_name: str = PIPE_AXIS):
+    """Cyclic forward hop: the last stage's output arrives at stage 0 —
+    the interleaved schedule's model-chunk "connector" (the wrap-around
+    send the reference implements as an extra p2p between first and last
+    stage, ref: fwd_bwd_pipelining_with_interleaving.py chunk
+    hand-off)."""
+    return _shift(output_tensor, axis_name, forward=True, wrap=True)
 
 
 def send_forward_recv_forward(output_tensor, axis_name: str = PIPE_AXIS):

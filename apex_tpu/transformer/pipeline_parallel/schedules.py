@@ -195,6 +195,109 @@ def forward_backward_pipelining_without_interleaving(
     return loss, grads
 
 
+def pipeline_forward_interleaved(stage_fn: Callable, chunk_params: Any,
+                                 microbatches: Any, *,
+                                 axis_name: str = PIPE_AXIS,
+                                 checkpoint_policy: Optional[str] = "full"):
+    """Interleaved (virtual-pipeline) forward: ONE scan, one block
+    application per stage per tick, chunks overlapped in time.
+
+    This is the true interleaved schedule, not sequential chunk sweeps:
+    slot ``k`` of a stage processes chunk ``(k // P) %% vpp`` on
+    microbatch ``(k // (vpp*P))*P + k %% P`` — the reference's
+    chunk-major groups-of-P order
+    (ref: fwd_bwd_pipelining_with_interleaving.py:100-140
+    ``get_model_chunk_id``).  Stage ``s`` runs slot ``k`` at tick
+    ``s + k``; a single *cyclic* ppermute per tick both feeds stage
+    ``s+1`` and carries the chunk connector (last stage -> stage 0).
+    Makespan is ``vpp*M + P`` ticks versus the sequential-sweep
+    ``vpp*(M + P - 1)`` — the ``(vpp-1)*(P-1)`` bubble the interleaved
+    schedule exists to remove is removed.
+    """
+    nstages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    vpp = jax.tree.leaves(chunk_params)[0].shape[0]
+    num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    K = vpp * num_micro
+    group = vpp * nstages
+
+    fn = stage_fn
+    if checkpoint_policy is not None:
+        pol = (CHECKPOINT_POLICIES[checkpoint_policy]
+               if isinstance(checkpoint_policy, str) else checkpoint_policy)
+        fn = jax.checkpoint(stage_fn, policy=pol)
+
+    def decode(k):
+        """slot -> (chunk, microbatch) in chunk-major groups of P."""
+        a = k // group
+        rem = k % group
+        c = rem // nstages
+        m = a * nstages + rem % nstages
+        return c, m
+
+    def _varying(tree):
+        def mark(x, ref):
+            target = set(jax.typeof(ref).vma) | {axis_name}
+            missing = tuple(a for a in target
+                            if a not in jax.typeof(x).vma)
+            return jax.lax.pcast(x, missing, to="varying") if missing \
+                else x
+        ref_leaves = jax.tree.leaves(jax.tree.map(lambda m: m[0],
+                                                  microbatches))
+        return jax.tree.map(
+            mark, tree,
+            jax.tree.unflatten(jax.tree.structure(tree), ref_leaves))
+
+    first_mb = jax.tree.map(lambda x: x[0], microbatches)
+    state0 = _varying(_tree_zeros_like(first_mb))
+    outputs0 = _varying(jax.tree.map(
+        lambda x: jnp.zeros((num_micro,) + x.shape, x.dtype), first_mb))
+
+    def tick(carry, t):
+        state, outputs = carry
+        k = t - rank
+        active = (k >= 0) & (k < K)
+        c, m = decode(jnp.clip(k, 0, K - 1))
+
+        params_c = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0,
+                                                   keepdims=False),
+            chunk_params)
+        fresh = jax.tree.map(
+            lambda mb: jax.lax.dynamic_index_in_dim(
+                mb, jnp.clip(m, 0, num_micro - 1), 0, keepdims=False),
+            microbatches)
+        # fresh data enters only at (stage 0, chunk 0); everything else
+        # consumes the carry (pipeline input or chunk connector).
+        x = _tree_where((rank == 0) & (c == 0), fresh, state)
+        y = fn(params_c, x)
+        y = _tree_where(active, y, _tree_zeros_like(y))
+
+        # Collection: at stage 0, when the carry came from the last
+        # stage's last chunk, it is a FINAL output for that microbatch.
+        kprev = t - nstages
+        cp, mp = decode(jnp.clip(kprev, 0, K - 1))
+        collect = ((rank == 0) & (kprev >= 0) & (kprev < K)
+                   & (cp == vpp - 1))
+        wi = jnp.clip(mp, 0, num_micro - 1)
+        outputs = jax.tree.map(
+            lambda buf, s: jnp.where(
+                collect,
+                jax.lax.dynamic_update_index_in_dim(buf, s, wi, 0),
+                buf),
+            outputs, state)
+
+        state = jax.tree.map(
+            lambda o: p2p_communication.send_forward_recv_forward_cyclic(
+                o, axis_name), y)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, outputs0), jnp.arange(K + nstages))
+    # Only stage 0 collected; psum replicates across the axis.
+    return jax.tree.map(lambda o: jax.lax.psum(o, axis_name), outputs)
+
+
 def forward_backward_pipelining_with_interleaving(
         stage_fn: Callable, loss_fn: Callable, stage_params: Any,
         microbatches: Any, *, forward_only: bool = False,
@@ -206,22 +309,32 @@ def forward_backward_pipelining_with_interleaving(
     ``stage_params`` carries a leading virtual-chunk axis: chunk ``c`` of
     stage ``s`` owns layer block ``c * num_stages + s`` — the reference's
     round-robin model-chunk assignment (ref: parallel_state.py:101-108).
-    Each chunk sweep is a full spatial pipeline; the last stage's output
-    re-enters stage 0 for the next chunk (the reference's wrap-around
-    "connector" between model chunks).  XLA overlaps successive sweeps'
-    collectives where dependencies allow; the capability contract
-    (vpp model chunks, same math, bounded memory) matches the reference.
+    Chunks execute overlapped (one scan, one block per stage per tick —
+    see :func:`pipeline_forward_interleaved`); reverse-mode AD through
+    the scan yields the interleaved backward order.
     """
-    vpp = jax.tree.leaves(stage_params)[0].shape[0]
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
+    nstages = jax.lax.axis_size(axis_name)
+    vpp = jax.tree.leaves(stage_params)[0].shape[0]
 
     def total_loss(stage_params):
-        acts = microbatches
-        for c in range(vpp):
-            chunk = jax.tree.map(lambda p, c=c: p[c], stage_params)
-            acts = pipeline_forward(stage_fn, chunk, acts,
-                                    axis_name=axis_name,
-                                    checkpoint_policy=checkpoint_policy)
+        if num_micro % nstages == 0:
+            acts = pipeline_forward_interleaved(
+                stage_fn, stage_params, microbatches,
+                axis_name=axis_name,
+                checkpoint_policy=checkpoint_policy)
+        else:
+            # The interleaved slot mapping requires M %% P == 0 (the
+            # reference's interleaved schedule asserts the same,
+            # ref: fwd_bwd_pipelining_with_interleaving.py); for other
+            # M fall back to sequential chunk sweeps — same math, the
+            # pre-interleaving bubble.
+            acts = microbatches
+            for c in range(vpp):
+                chunk = jax.tree.map(lambda p, c=c: p[c], stage_params)
+                acts = pipeline_forward(
+                    stage_fn, chunk, acts, axis_name=axis_name,
+                    checkpoint_policy=checkpoint_policy)
         losses = jax.vmap(loss_fn)(acts, jnp.arange(num_micro))
         return jnp.mean(losses)
 

@@ -127,7 +127,7 @@ def get_autoresume():
 class _Timer:
     """Host-side timer with device-sync elapsed
     (ref: pipeline_parallel/_timers.py:6-40 — cuda synchronize becomes
-    block_until_ready on a sentinel, or plain wall time)."""
+    block_until_ready on the timed region's outputs)."""
 
     def __init__(self, name: str):
         self.name = name
@@ -135,19 +135,34 @@ class _Timer:
         self._started = False
         self._start_time = None
 
+    @staticmethod
+    def _sync(wait_on=None):
+        """Device sync: block on the arrays produced in the timed region
+        when given (the cuda.synchronize analogue — pass the step's
+        outputs to ``stop``).  ``effects_barrier`` alone only awaits
+        side-effecting computations, not in-flight pure dispatch, so a
+        sentinel computation is enqueued as the fallback: devices
+        execute their stream in order, so blocking on it drains prior
+        work."""
+        if wait_on is not None:
+            jax.block_until_ready(wait_on)
+        else:
+            jax.block_until_ready(jnp.zeros(()) + 0.0)
+        jax.effects_barrier()
+
     def start(self):
         import time
         if self._started:
             raise RuntimeError("timer has already been started")
-        jax.effects_barrier()
+        self._sync()
         self._start_time = time.perf_counter()
         self._started = True
 
-    def stop(self):
+    def stop(self, wait_on=None):
         import time
         if not self._started:
             raise RuntimeError("timer is not started")
-        jax.effects_barrier()
+        self._sync(wait_on)
         self._elapsed += time.perf_counter() - self._start_time
         self._started = False
 

