@@ -24,21 +24,37 @@ from ...ops.scaled_softmax import (scaled_masked_softmax,
 NEG_INF = -10000.0  # the reference's masked-fill value
 
 
+# id-keyed memo for the host-side causal check (eager callers pass the
+# same mask object every step; avoid a device->host copy per call).  The
+# mask object is kept in the value so its id cannot be recycled.
+_CAUSAL_MEMO: dict = {}
+
+
 def _is_causal_mask(mask, sq: int, sk: int) -> bool:
     """True iff ``mask`` is concretely the strict-upper-triangle boolean
-    mask (True = masked).  Traced masks return False (generic masked
-    softmax handles them — always correct, just not the specialized
-    kernel)."""
+    mask (True = masked).  Traced masks return False — the generic
+    masked softmax then handles them (always correct; callers that know
+    their mask is causal should pass ``mask_is_causal=True`` to
+    :func:`attn_core` to keep the fast path under jit)."""
+    key = (id(mask), sq, sk)
+    hit = _CAUSAL_MEMO.get(key)
+    if hit is not None and hit[0] is mask:
+        return hit[1]
     try:
         import numpy as np
 
         m = np.asarray(mask).astype(bool)
     except Exception:
-        return False
+        return False  # traced: no memo (tracer ids recycle fast)
     if m.shape[-2:] != (sq, sk):
-        return False
-    want = ~np.tri(sq, sk, dtype=bool)
-    return bool((m.reshape((-1, sq, sk)) == want).all())
+        result = False
+    else:
+        want = ~np.tri(sq, sk, dtype=bool)
+        result = bool((m.reshape((-1, sq, sk)) == want).all())
+    _CAUSAL_MEMO[key] = (mask, result)
+    if len(_CAUSAL_MEMO) > 1024:
+        _CAUSAL_MEMO.clear()
+    return result
 
 
 def mask_softmax_dropout(inputs: jnp.ndarray,
@@ -80,7 +96,8 @@ def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               dropout_prob: float = 0.0,
               rng: Optional[jax.Array] = None,
               is_training: bool = True,
-              use_fast: bool = True) -> jnp.ndarray:
+              use_fast: bool = True,
+              mask_is_causal: Optional[bool] = None) -> jnp.ndarray:
     """softmax(scale * q k^T [masked]) v with attention dropout.
 
     Shapes: (b, h, s, d).  Dispatch mirrors the reference's impl split:
@@ -97,9 +114,14 @@ def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # The reference honors the CONTENT of the time mask (masked_fill
     # with the caller's matrix, ref: self_attn_func.py); only a mask
     # that is literally the strict upper triangle may take the
-    # specialized causal kernels.
+    # specialized causal kernels.  Under jit the mask is a tracer and
+    # the content check cannot run — pass ``mask_is_causal=True`` to
+    # assert causality and keep the flash path.
+    if mask_is_causal is None:
+        mask_is_causal = _is_causal_mask(mask, sq, sk) \
+            if mask is not None else False
     causal = (use_time_mask and mask is not None and not mask_additive
-              and _is_causal_mask(mask, sq, sk))
+              and mask_is_causal)
     if use_fast and not dropping and (mask is None or causal):
         return flash_attention(q, k, v, scale=scaling,
                                causal=causal)

@@ -1,0 +1,136 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context capability the reference does NOT have (its fused softmax
+caps at seq 2048 and FMHA at 512, ref: fused_softmax.py:151-170,
+setup.py:408-424; SURVEY §2.10 records SP/CP as absent).  Here sequence
+length becomes a *scaling axis*: Q, K, V are sharded over a mesh axis,
+K/V blocks rotate around the ring with one ``ppermute`` per step, and
+each device merges blockwise-attention partials with the online-softmax
+(max, sumexp, accumulator) recurrence — attention memory per chip is
+O(s_local^2) and the K/V hops ride ICI neighbour links (Liu et al. 2023,
+"Ring Attention with Blockwise Transformers"; merge math is the flash
+attention combine).
+
+Call inside ``shard_map`` with q, k, v sequence-sharded on
+``axis_name``; the result is the bit-for-tolerance equivalent of dense
+softmax attention over the full sequence.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _block_attend(q, k, v, scale, qpos, kpos, causal):
+    """One blockwise partial: returns (m, l, acc) for local q against
+    this k/v block, with causal masking by GLOBAL positions."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = kpos[None, :] <= qpos[:, None]          # True = attend
+        s = jnp.where(mask[None, None], s, _NEG)
+    m = jnp.max(s, axis=-1)                            # (b, h, sq)
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: m = _NEG -> p rows would be exp(0)=1; zero them
+    p = jnp.where((m > _NEG / 2)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str,
+                   scale: Optional[float] = None,
+                   causal: bool = False) -> jnp.ndarray:
+    """Exact attention with K/V rotating around ``axis_name``.
+
+    Shapes (per shard): q, k, v are (b, h, s_local, d); the global
+    sequence is ``axis_size * s_local`` with shard i owning positions
+    ``[i*s_local, (i+1)*s_local)``.  Returns the local output shard
+    (b, h, s_local, d).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    nshards = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    qpos = rank * s_local + jnp.arange(s_local)
+
+    perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    def merge(m, l, acc, bm, bl, bacc):
+        m_new = jnp.maximum(m, bm)
+        c_old = jnp.exp(m - m_new)
+        c_blk = jnp.exp(bm - m_new)
+        # guard: rows never touched keep m = _NEG; exp(_NEG-_NEG)=1 ok
+        l = l * c_old + bl * c_blk
+        acc = acc * c_old[..., None] + bacc * c_blk[..., None]
+        return m_new, l, acc
+
+    def step(carry, i):
+        kk, vv, m, l, acc = carry
+        # Rotate FIRST (steps 1..n-1): after i rotations the held block
+        # originated at rank - i, and no trailing hop is wasted (the
+        # final iteration's rotation would otherwise be discarded — one
+        # superfluous pair of ICI collectives per layer per step).
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        src = (rank - i) % nshards
+        kpos = src * s_local + jnp.arange(s_local)
+        bm, bl, bacc = _block_attend(q, kk, vv, scale, qpos, kpos,
+                                     causal)
+        m, l, acc = merge(m, l, acc, bm, bl, bacc)
+        return (kk, vv, m, l, acc), None
+
+    # step 0: the local block, no hop
+    m0, l0, acc0 = _block_attend(q, k, v, scale, qpos, qpos, causal)
+    if nshards > 1:
+        (_, _, m, l, acc), _ = jax.lax.scan(
+            step, (k, v, m0, l0, acc0), jnp.arange(1, nshards))
+    else:
+        m, l, acc = m0, l0, acc0
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str,
+                      scale: Optional[float] = None,
+                      causal: bool = False,
+                      attention_fn=None) -> jnp.ndarray:
+    """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps
+    the sharded axis from SEQUENCE to HEADS, runs full-sequence
+    attention locally on a head subset (the Pallas flash kernel by
+    default), and swaps back.
+
+    Per-shard shapes (b, h, s_local, d) with ``h %% axis_size == 0``.
+    Two all-to-alls replace the ring's ``axis_size`` ppermutes —
+    preferable when heads are plentiful and ICI all-to-all bandwidth is
+    good; ring attention wins when s_local is large enough to overlap
+    compute with the hops.
+    """
+    nshards = jax.lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    assert h % nshards == 0, (
+        f"heads {h} not divisible by axis size {nshards}")
+
+    def seq_to_heads(x):
+        # (b, h, s_local, d) -> (b, h/P, P*s_local, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                               tiled=True)
+        return x
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attention_fn is None:
+        from .flash_attention import flash_attention as attention_fn
+    out = attention_fn(qh, kh, vh, scale=scale, causal=causal)
+    return heads_to_seq(out)

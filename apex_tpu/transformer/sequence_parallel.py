@@ -1,0 +1,81 @@
+"""Sequence/context parallelism: the long-context scaling axis.
+
+The reference treats sequence length as a LIMIT (fused softmax sk <=
+2048, FMHA 512; SURVEY §2.10 "SP/CP: not present"); this framework
+treats it as a sharding axis, first-class next to dp/tp/pp:
+
+- :func:`ring_self_attention` — exact attention over a sequence-sharded
+  axis via rotating K/V blocks (:mod:`apex_tpu.ops.ring_attention`).
+- :func:`ulysses_self_attention` — all-to-all head<->sequence swap, full
+  attention on a head subset.
+- Megatron-style SP region mappings for the LN/dropout segments between
+  TP blocks: :func:`scatter_to_sequence_parallel_region` /
+  :func:`gather_from_sequence_parallel_region` /
+  :func:`reduce_scatter_to_sequence_parallel_region` — under TP, the
+  activations between the column/row-parallel pairs are replicated; SP
+  shards them along sequence so LayerNorm+dropout memory scales 1/tp
+  and the TP allreduce becomes allgather+reduce-scatter (same bytes,
+  less activation memory).
+
+All functions run inside ``shard_map`` over the named axis.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.ring_attention import ring_attention, ulysses_attention
+from ..parallel_state import TENSOR_AXIS
+
+SEQUENCE_AXIS = "sequence"
+
+
+# --- SP region mappings ----------------------------------------------------
+
+def scatter_to_sequence_parallel_region(x, axis_name: str = TENSOR_AXIS,
+                                        seq_dim: int = 1):
+    """Replicated (b, s, h) -> local sequence shard (b, s/P, h): each
+    rank keeps its slice (the SP entry scatter)."""
+    rank = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    s = x.shape[seq_dim]
+    assert s % n == 0, f"sequence {s} not divisible by axis size {n}"
+    return jax.lax.dynamic_slice_in_dim(x, rank * (s // n), s // n,
+                                        seq_dim)
+
+
+def gather_from_sequence_parallel_region(x, axis_name: str = TENSOR_AXIS,
+                                         seq_dim: int = 1):
+    """Local shard (b, s/P, h) -> full sequence (b, s, h) via
+    all-gather (the SP->TP boundary gather)."""
+    return jax.lax.all_gather(x, axis_name, axis=seq_dim, tiled=True)
+
+
+def reduce_scatter_to_sequence_parallel_region(
+        x, axis_name: str = TENSOR_AXIS, seq_dim: int = 1):
+    """Partial sums (b, s, h) on every rank -> reduced local sequence
+    shard (b, s/P, h).  This replaces the row-parallel output allreduce
+    under SP (allreduce == allgather . reduce_scatter; SP keeps only
+    the reduce_scatter half here and the allgather at the next block's
+    entry — same total bytes, 1/P activation residency)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=seq_dim,
+                                tiled=True)
+
+
+# --- sequence-parallel attention ------------------------------------------
+
+def ring_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
+                        scale: Optional[float] = None,
+                        causal: bool = False):
+    """Exact self-attention with q/k/v sequence-sharded over
+    ``axis_name`` (b, h, s_local, d per shard)."""
+    return ring_attention(q, k, v, axis_name, scale=scale, causal=causal)
+
+
+def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
+                           scale: Optional[float] = None,
+                           causal: bool = False):
+    return ulysses_attention(q, k, v, axis_name, scale=scale,
+                             causal=causal)

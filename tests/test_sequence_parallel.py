@@ -1,0 +1,150 @@
+"""Sequence/context parallelism tests: ring attention and Ulysses
+all-to-all attention must equal dense attention over the full sequence,
+including gradients; SP region mappings must compose to identity /
+allreduce.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.sequence_parallel import (
+    gather_from_sequence_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    ring_self_attention,
+    scatter_to_sequence_parallel_region,
+    ulysses_self_attention,
+)
+
+B, H, S, D = 2, 8, 32, 16  # global sequence 32 over 4 shards
+
+
+def seq_mesh(n=4):
+    return Mesh(np.array(jax.devices()[:n]), ("sequence",))
+
+
+def _qkv(seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, S, D), jnp.float32) * 0.3
+                 for k in ks)
+
+
+def _dense(q, k, v, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    if causal:
+        tri = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(tri[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _run_sharded(fn, q, k, v, mesh):
+    spec = P(None, None, "sequence", None)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec))(q, k, v)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = seq_mesh()
+        q, k, v = _qkv()
+        out = _run_sharded(
+            functools.partial(ring_self_attention, causal=causal),
+            q, k, v, mesh)
+        want = _dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gradients_match_dense(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(1)
+
+        def ring_loss(q, k, v):
+            out = _run_sharded(
+                functools.partial(ring_self_attention, causal=True),
+                q, k, v, mesh)
+            return jnp.sum(out ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) ** 2)
+
+        gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_long_sequence_memory_is_blockwise(self):
+        # capability check: global seq 128 on 8 shards runs (the
+        # reference's kernels cap out; ring has no cap)
+        mesh = seq_mesh(8)
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (1, 2, 128, 8)) * 0.2
+                   for kk in ks)
+        out = _run_sharded(
+            functools.partial(ring_self_attention, causal=True),
+            q, k, v, mesh)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (8 ** -0.5)
+        tri = jnp.tril(jnp.ones((128, 128), bool))
+        want = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            jax.nn.softmax(jnp.where(tri[None, None], s, -1e30), -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = seq_mesh()
+        q, k, v = _qkv(3)
+        out = _run_sharded(
+            functools.partial(ulysses_self_attention, causal=causal),
+            q, k, v, mesh)
+        want = _dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestSPRegionMappings:
+    def test_scatter_gather_roundtrip(self):
+        mesh = seq_mesh()
+        x = jax.random.normal(jax.random.PRNGKey(0), (B, S, 16))
+
+        def f(x):
+            local = scatter_to_sequence_parallel_region(
+                x, "sequence")
+            assert local.shape == (B, S // 4, 16)
+            full = gather_from_sequence_parallel_region(local, "sequence")
+            # full is replicated in value but varying in type (check_vma
+            # cannot prove the gather equal across shards); re-scatter so
+            # the out_specs reconstruct the global tensor
+            return scatter_to_sequence_parallel_region(full, "sequence")
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P(),
+            out_specs=P(None, "sequence", None)))(x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   rtol=1e-6)
+
+    def test_reduce_scatter_then_gather_is_allreduce(self):
+        mesh = seq_mesh()
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, S, 8))
+
+        def f(xl):
+            # xl differs per rank (sharded on leading dim); rs+gather
+            # over seq == psum
+            part = reduce_scatter_to_sequence_parallel_region(
+                xl, "sequence")
+            full = gather_from_sequence_parallel_region(part, "sequence")
+            return full - jax.lax.psum(xl, "sequence")
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("sequence"),
+            out_specs=P("sequence")))(x)
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
