@@ -1,0 +1,112 @@
+"""Megatron-style global singletons for the test stack.
+
+Parity surface for ``apex/transformer/testing/global_vars.py:26-190``:
+``set_global_variables`` parses args and builds the microbatch
+calculator / tensorboard writer / timers singletons; ``get_args`` /
+``get_num_microbatches`` / ``get_timers`` etc. read them.  The timers and
+microbatch calculator are the ones the pipeline stack already owns
+(:mod:`apex_tpu.transformer.pipeline_parallel.utils`), so state is never
+duplicated.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..transformer.pipeline_parallel import utils as _pp_utils
+from .arguments import parse_args as _parse_args_impl
+
+_GLOBAL_ARGS = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+
+
+def _ensure_var_is_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+
+
+def _ensure_var_is_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."
+
+
+def get_args():
+    """ref: global_vars.py:34-37."""
+    _ensure_var_is_initialized(_GLOBAL_ARGS, "args")
+    return _GLOBAL_ARGS
+
+
+def get_num_microbatches() -> int:
+    return _pp_utils.get_num_microbatches()
+
+
+def get_current_global_batch_size() -> int:
+    return _pp_utils.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    _pp_utils.update_num_microbatches(consumed_samples,
+                                      consistency_check)
+
+
+def get_tensorboard_writer():
+    """ref: global_vars.py:69-72 (may be None)."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def get_timers():
+    return _pp_utils.get_timers()
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args=False, args=None):
+    """Parse args + build singletons (ref: global_vars.py:87-99)."""
+    global _GLOBAL_ARGS
+    _ensure_var_is_not_initialized(_GLOBAL_ARGS, "args")
+    _GLOBAL_ARGS = _parse_args_impl(
+        extra_args_provider=extra_args_provider,
+        defaults=args_defaults or {},
+        ignore_unknown_args=ignore_unknown_args, args=args)
+    _build_num_microbatches_calculator(_GLOBAL_ARGS)
+    if _GLOBAL_ARGS.tensorboard_dir is not None:
+        _set_tensorboard_writer(_GLOBAL_ARGS)
+    return _GLOBAL_ARGS
+
+
+def _build_num_microbatches_calculator(args):
+    """ref: global_vars.py:112-120."""
+    if args.global_batch_size is None or args.micro_batch_size is None:
+        return
+    _pp_utils.setup_microbatch_calculator(
+        rank=0,
+        rampup_batch_size=args.rampup_batch_size,
+        global_batch_size=args.global_batch_size,
+        micro_batch_size=args.micro_batch_size,
+        data_parallel_size=args.data_parallel_size)
+
+
+def _set_tensorboard_writer(args):
+    """ref: global_vars.py:136-154 — best-effort import."""
+    global _GLOBAL_TENSORBOARD_WRITER
+    _ensure_var_is_not_initialized(_GLOBAL_TENSORBOARD_WRITER,
+                                   "tensorboard writer")
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        _GLOBAL_TENSORBOARD_WRITER = SummaryWriter(
+            log_dir=args.tensorboard_dir)
+    except Exception:
+        print("WARNING: TensorBoard writing requested but unavailable "
+              "(no tensorboard package), no TensorBoard logs will be "
+              "written.", flush=True)
+
+
+def destroy_global_vars():
+    """Testing hook: reset the singletons (the reference relies on
+    process exit; tests here share a process)."""
+    global _GLOBAL_ARGS, _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_ARGS = None
+    _GLOBAL_TENSORBOARD_WRITER = None

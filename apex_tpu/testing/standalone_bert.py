@@ -1,0 +1,188 @@
+"""Standalone BERT test model.
+
+Parity surface for ``apex/transformer/testing/standalone_bert.py:10-223``:
+bidirectional (padding-mask) transformer, token-type embeddings, pooler,
+``BertLMHead`` (dense+gelu+LN then tied-embedding logits with its own
+bias), optional binary (NSP) head, vocab-parallel masked-LM loss.  Built
+from the same library blocks as the GPT model
+(:mod:`apex_tpu.testing.standalone_gpt`).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..normalization import FusedLayerNorm
+from ..transformer.enums import AttnMaskType
+from ..transformer.layers import ParallelTransformer
+from ..transformer.tensor_parallel import vocab_parallel_cross_entropy
+from .standalone_gpt import Dtype, GPTEmbedding
+
+Array = jnp.ndarray
+
+
+def bert_extended_attention_mask(attention_mask: Array) -> Array:
+    """(b, s) 1=real/0=pad -> (b, 1, s, s) boolean, True = masked out
+    (ref: standalone_bert.py:10-24 — outer product then ``< 0.5``)."""
+    b1s = attention_mask[:, None, :]
+    bs1 = attention_mask[:, :, None]
+    bss = b1s * bs1
+    return (bss[:, None, :, :] < 0.5)
+
+
+def bert_position_ids(token_ids: Array) -> Array:
+    """ref: standalone_bert.py:26-33."""
+    s = token_ids.shape[1]
+    return jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32),
+                            token_ids.shape)
+
+
+class BertEmbedding(GPTEmbedding):
+    """GPT embedding + token-type embeddings
+    (ref: BertModel num_tokentypes=2)."""
+
+    num_tokentypes: int = 2
+
+    def setup(self):
+        super().setup()
+        if self.num_tokentypes > 0:
+            self.tokentype_embeddings = nn.Embed(
+                self.num_tokentypes, self.hidden_size,
+                embedding_init=nn.initializers.normal(stddev=0.02),
+                dtype=self.dtype, name="tokentype_embeddings")
+
+    def __call__(self, tokens, tokentype_ids=None,
+                 deterministic: bool = True):
+        h = super().__call__(tokens, deterministic)
+        if tokentype_ids is not None and self.num_tokentypes > 0:
+            h = h + self.tokentype_embeddings(tokentype_ids)
+        return h
+
+
+class Pooler(nn.Module):
+    """[CLS] pooler: dense+tanh over position 0 (Megatron pooler)."""
+
+    hidden_size: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden):  # (b, s, h)
+        x = hidden[:, 0]
+        x = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     name="dense")(x)
+        return jnp.tanh(x)
+
+
+class BertLMHead(nn.Module):
+    """Masked-LM head (ref: standalone_bert.py:35-74): dense + gelu +
+    LayerNorm, then logits against the (tied) word-embedding matrix with
+    a learned per-vocab bias."""
+
+    hidden_size: int
+    vocab_size: int
+    layernorm_epsilon: float = 1e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, attend_fn):
+        x = nn.Dense(self.hidden_size, dtype=self.dtype, name="dense")(
+            hidden)
+        x = jax.nn.gelu(x)
+        x = FusedLayerNorm(self.hidden_size, eps=self.layernorm_epsilon,
+                           name="layernorm")(x).astype(self.dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.vocab_size,), jnp.float32)
+        return attend_fn(x) + bias
+
+
+class BertModel(nn.Module):
+    """ref: standalone_bert.py:101-213."""
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_attention_heads: int
+    max_sequence_length: int
+    num_tokentypes: int = 2
+    add_binary_head: bool = True
+    attention_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    checkpoint_activations: bool = False
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    def setup(self):
+        self.embedding = BertEmbedding(
+            self.vocab_size, self.hidden_size, self.max_sequence_length,
+            embedding_dropout=self.hidden_dropout,
+            num_tokentypes=self.num_tokentypes, dtype=self.dtype,
+            axis_name=self.axis_name, name="embedding")
+        self.transformer = ParallelTransformer(
+            num_layers=self.num_layers, hidden_size=self.hidden_size,
+            num_attention_heads=self.num_attention_heads,
+            attn_mask_type=AttnMaskType.padding,
+            attention_dropout=self.attention_dropout,
+            hidden_dropout=self.hidden_dropout, use_flash=False,
+            checkpoint_activations=self.checkpoint_activations,
+            dtype=self.dtype, axis_name=self.axis_name,
+            name="transformer")
+        self.lm_head = BertLMHead(
+            self.hidden_size, self.vocab_size, dtype=self.dtype,
+            name="lm_head")
+        if self.add_binary_head:
+            self.pooler = Pooler(self.hidden_size, dtype=self.dtype,
+                                 name="pooler")
+            self.binary_head = nn.Dense(2, dtype=jnp.float32,
+                                        name="binary_head")
+
+    def __call__(self, tokens, attention_mask, tokentype_ids=None,
+                 lm_labels=None, deterministic: bool = True):
+        """Returns ``(lm_logits_or_loss, binary_logits)``
+        (ref: forward :148-175 + post_language_model_processing
+        :76-99)."""
+        ext_mask = bert_extended_attention_mask(
+            attention_mask.astype(jnp.float32))
+        h = self.embedding(tokens, tokentype_ids, deterministic)
+        h = self.transformer(h, ext_mask, deterministic)
+
+        binary_logits = None
+        if self.add_binary_head:
+            binary_logits = self.binary_head(
+                self.pooler(h).astype(jnp.float32))
+
+        lm_logits = self.lm_head(h, self.embedding.attend)
+        if lm_labels is None:
+            return lm_logits, binary_logits
+        if self.axis_name is not None:
+            lm_loss = vocab_parallel_cross_entropy(
+                lm_logits.astype(jnp.float32), lm_labels,
+                axis_name=self.axis_name)
+        else:
+            lf = lm_logits.astype(jnp.float32)
+            lse = jax.nn.logsumexp(lf, axis=-1)
+            lm_loss = lse - jnp.take_along_axis(
+                lf, lm_labels[..., None], axis=-1)[..., 0]
+        return lm_loss, binary_logits
+
+
+def bert_model_provider(args, pre_process=True, post_process=True,
+                        **overrides):
+    """ref: standalone_bert.py:215-223 — build from Megatron args."""
+    del pre_process, post_process  # single-program model
+    kw = dict(
+        vocab_size=args.vocab_size,
+        hidden_size=args.hidden_size,
+        num_layers=args.num_layers,
+        num_attention_heads=args.num_attention_heads,
+        max_sequence_length=args.max_position_embeddings,
+        attention_dropout=args.attention_dropout,
+        hidden_dropout=args.hidden_dropout,
+        checkpoint_activations=getattr(args, "checkpoint_activations",
+                                       False),
+        dtype=args.params_dtype,
+    )
+    kw.update(overrides)
+    return BertModel(**kw)

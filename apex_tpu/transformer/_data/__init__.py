@@ -1,0 +1,10 @@
+"""Data scheduling helpers (parity with ``apex/transformer/_data``)."""
+from ._batchsampler import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+__all__ = [
+    "MegatronPretrainingSampler",
+    "MegatronPretrainingRandomSampler",
+]

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""DCGAN with multi-model / multi-optimizer / multi-loss amp.
+
+Parity surface for ``examples/dcgan/main_amp.py`` — the reference's
+canonical exercise of ``amp.initialize([netD, netG], [optD, optG],
+num_losses=3)`` with per-loss ``scale_loss(..., loss_id=i)``
+(ref: main_amp.py:214-255: errD_real loss_id=0, errD_fake loss_id=1,
+errG loss_id=2).  Functionally: two AmpOptimizers (one per model), the
+discriminator's carrying TWO independent scalers whose gradients
+accumulate into one step — the ``num_losses`` machinery end-to-end.
+
+Run (synthetic data, tiny nets)::
+
+    python examples/dcgan/main_amp.py --iters 50 --opt-level O2
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+
+
+class Generator(nn.Module):
+    """Deconv stack z -> image (ref: main_amp.py:123-162, scaled down)."""
+
+    ngf: int = 32
+    nc: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):  # (b, 1, 1, nz)
+        x = nn.ConvTranspose(self.ngf * 4, (4, 4), strides=(1, 1),
+                             padding="VALID", dtype=self.dtype)(z)
+        x = nn.relu(nn.BatchNorm(use_running_average=False,
+                                 dtype=jnp.float32)(x))
+        x = nn.ConvTranspose(self.ngf * 2, (4, 4), strides=(2, 2),
+                             padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=False,
+                                 dtype=jnp.float32)(x))
+        x = nn.ConvTranspose(self.ngf, (4, 4), strides=(2, 2),
+                             padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=False,
+                                 dtype=jnp.float32)(x))
+        x = nn.ConvTranspose(self.nc, (4, 4), strides=(2, 2),
+                             padding="SAME", dtype=self.dtype)(x)
+        return jnp.tanh(x)  # (b, 32, 32, nc)
+
+
+class Discriminator(nn.Module):
+    """Conv stack image -> logit (ref: main_amp.py:165-196)."""
+
+    ndf: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.leaky_relu(nn.Conv(self.ndf, (4, 4), strides=(2, 2),
+                                  dtype=self.dtype)(x), 0.2)
+        x = nn.Conv(self.ndf * 2, (4, 4), strides=(2, 2),
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(nn.BatchNorm(use_running_average=False,
+                                       dtype=jnp.float32)(x), 0.2)
+        x = nn.Conv(self.ndf * 4, (4, 4), strides=(2, 2),
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(nn.BatchNorm(use_running_average=False,
+                                       dtype=jnp.float32)(x), 0.2)
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        return nn.Dense(1, dtype=jnp.float32)(x)[:, 0]
+
+
+def bce_with_logits(logits, target):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--nz", type=int, default=64)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    policy = amp.get_policy(args.opt_level)
+    netG = Generator(dtype=policy.compute_dtype)
+    netD = Discriminator(dtype=policy.compute_dtype)
+
+    key = jax.random.PRNGKey(args.seed)
+    z0 = jnp.zeros((2, 1, 1, args.nz), policy.compute_dtype)
+    img0 = jnp.zeros((2, 32, 32, 3), policy.compute_dtype)
+    gvars = netG.init(jax.random.fold_in(key, 0), z0)
+    dvars = netD.init(jax.random.fold_in(key, 1), img0)
+
+    # The reference's [netD, netG], [optD, optG], num_losses=3 split
+    # (ref :214-215): D owns losses 0 (real) and 1 (fake), G owns 2.
+    d_params, d_opt, d_state = amp.initialize(
+        dvars["params"], fused_adam(args.lr, beta1=0.5),
+        opt_level=args.opt_level, num_losses=2)
+    g_params, g_opt, g_state = amp.initialize(
+        gvars["params"], fused_adam(args.lr, beta1=0.5),
+        opt_level=args.opt_level, num_losses=1)
+    d_stats, g_stats = dvars["batch_stats"], gvars["batch_stats"]
+
+    def d_apply(params, stats, x):
+        out, mut = netD.apply({"params": params, "batch_stats": stats},
+                              x, mutable=["batch_stats"])
+        return out, mut["batch_stats"]
+
+    def g_apply(params, stats, z):
+        out, mut = netG.apply({"params": params, "batch_stats": stats},
+                              z, mutable=["batch_stats"])
+        return out, mut["batch_stats"]
+
+    @jax.jit
+    def train_step(d_params, g_params, d_state, g_state, d_stats,
+                   g_stats, real, z):
+        # --- update D: two losses, two scalers, one step (ref :225-247)
+        def d_loss_real(p):
+            logits, new_stats = d_apply(p, d_stats, real)
+            loss = bce_with_logits(logits, jnp.ones_like(logits))
+            return d_opt.scale_loss(loss, d_state, loss_id=0), \
+                (loss, new_stats)
+
+        fake, g_stats_after = g_apply(g_params, g_stats, z)
+
+        def d_loss_fake(p):
+            logits, new_stats = d_apply(p, d_stats,
+                                        jax.lax.stop_gradient(fake))
+            loss = bce_with_logits(logits, jnp.zeros_like(logits))
+            return d_opt.scale_loss(loss, d_state, loss_id=1), \
+                (loss, new_stats)
+
+        g_real, (errD_real, d_stats1) = jax.grad(
+            d_loss_real, has_aux=True)(d_params)
+        g_fake, (errD_fake, d_stats2) = jax.grad(
+            d_loss_fake, has_aux=True)(d_params)
+        # accumulate both D losses' grads, stepping once per loss id
+        # exactly as the reference's two backward()+step pattern
+        d_params, d_state, _ = d_opt.apply_gradients(
+            g_real, d_state, d_params, loss_id=0)
+        d_params, d_state, _ = d_opt.apply_gradients(
+            g_fake, d_state, d_params, loss_id=1)
+
+        # --- update G (ref :249-255, loss_id=2)
+        def g_loss(p):
+            fake, new_gstats = g_apply(p, g_stats_after, z)
+            logits, _ = d_apply(d_params, d_stats2, fake)
+            loss = bce_with_logits(logits, jnp.ones_like(logits))
+            return g_opt.scale_loss(loss, g_state, loss_id=0), \
+                (loss, new_gstats)
+
+        gg, (errG, g_stats_new) = jax.grad(g_loss, has_aux=True)(g_params)
+        g_params, g_state, _ = g_opt.apply_gradients(
+            gg, g_state, g_params, loss_id=0)
+        return (d_params, g_params, d_state, g_state, d_stats2,
+                g_stats_new, errD_real, errD_fake, errG)
+
+    data_key = jax.random.PRNGKey(args.seed + 1)
+    t0 = time.time()
+    for it in range(args.iters):
+        data_key, k1, k2 = jax.random.split(data_key, 3)
+        # synthetic "real" images: smooth blobs (anything non-noise)
+        base = jax.random.normal(k1, (args.batch_size, 8, 8, 3))
+        real = jax.image.resize(base, (args.batch_size, 32, 32, 3),
+                                "linear").astype(policy.compute_dtype)
+        z = jax.random.normal(k2, (args.batch_size, 1, 1, args.nz),
+                              policy.compute_dtype)
+        (d_params, g_params, d_state, g_state, d_stats, g_stats,
+         errD_real, errD_fake, errG) = train_step(
+            d_params, g_params, d_state, g_state, d_stats, g_stats,
+            real, z)
+        if it % 20 == 0:
+            print(f"[{it}/{args.iters}] Loss_D_real {float(errD_real):.4f} "
+                  f"Loss_D_fake {float(errD_fake):.4f} "
+                  f"Loss_G {float(errG):.4f} "
+                  f"scales D=({float(d_state.scalers[0].loss_scale):.0f},"
+                  f"{float(d_state.scalers[1].loss_scale):.0f}) "
+                  f"G={float(g_state.scalers[0].loss_scale):.0f}")
+    print(f"done {args.iters} iters in {time.time() - t0:.1f}s")
+    return float(errD_real), float(errD_fake), float(errG)
+
+
+if __name__ == "__main__":
+    main()

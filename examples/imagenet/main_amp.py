@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flax.serialization
 from apex_tpu import amp, parallel_state
 from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
-from apex_tpu.models.resnet import ResNet50
+from apex_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
 from apex_tpu.optimizers import fused_adam, fused_sgd
 
 
@@ -77,6 +77,11 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint", default="checkpoint.msgpack")
     p.add_argument("--save-every", type=int, default=0,
                    help="save checkpoint every N iters (0: per epoch)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="use only the first N devices (0 = all); "
+                        "single-core CI hosts starve the CPU-collective "
+                        "rendezvous when 8 virtual device threads share "
+                        "one core, so tests pin --devices 1")
     p.add_argument("--deterministic", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--prof", action="store_true",
@@ -171,8 +176,11 @@ def main(argv=None):
     if args.deterministic:
         jax.config.update("jax_threefry_partitionable", True)
 
+    if args.devices and parallel_state.model_parallel_is_initialized():
+        parallel_state.destroy_model_parallel()
     if not parallel_state.model_parallel_is_initialized():
-        parallel_state.initialize_model_parallel()
+        devices = jax.devices()[: args.devices] if args.devices else None
+        parallel_state.initialize_model_parallel(devices=devices)
     mesh = parallel_state.get_mesh()
     n_dev = parallel_state.get_world_size()
     if args.batch_size % n_dev:
@@ -180,8 +188,20 @@ def main(argv=None):
                          f"{n_dev} devices")
 
     policy = make_policy(args)
-    model = ResNet50(num_classes=args.num_classes,
-                     dtype=policy.compute_dtype)
+    archs = {
+        "resnet50": ResNet50,
+        "resnet101": ResNet101,
+        "resnet152": ResNet152,
+        # 2-stage narrow net: the deterministic tiny-npz convergence
+        # check (and quick CPU smoke runs) use this.
+        "resnet_tiny": lambda **kw: ResNet(stage_sizes=(1, 1), width=16,
+                                           **kw),
+    }
+    if args.arch not in archs:
+        raise SystemExit(f"unknown --arch {args.arch!r} "
+                         f"(choices: {sorted(archs)})")
+    model = archs[args.arch](num_classes=args.num_classes,
+                             dtype=policy.compute_dtype)
 
     key = jax.random.PRNGKey(args.seed)
     init_images = jnp.zeros((2, args.image_size, args.image_size, 3),
@@ -205,6 +225,14 @@ def main(argv=None):
         params, batch_stats, amp_state, start_step = load_checkpoint(
             args.resume, params, batch_stats, amp_opt, amp_state)
         print(f"=> resumed from {args.resume} at step {start_step}")
+
+    # The train step donates params/stats/amp_state; two state leaves
+    # that are the SAME cached constant buffer (e.g. a pair of int32(0)
+    # scaler counters deduplicated by jax's constant cache) would trip
+    # "donate the same buffer twice" — copy to guarantee distinct
+    # buffers.
+    params, batch_stats, amp_state = jax.tree_util.tree_map(
+        jnp.array, (params, batch_stats, amp_state))
 
     train_step = build_train_step(model, amp_opt, mesh)
 

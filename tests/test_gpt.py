@@ -6,6 +6,7 @@ run_bert_minimal_test.py idioms): the sharded model must match a dense
 single-device execution bit-for-tolerance, and the full 3D-parallel
 train step must learn.
 """
+import os
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -177,3 +178,33 @@ class TestGPTPipelined:
         assert losses[0] > losses[-1], f"no learning: {losses}"
         assert losses[-1] < 0.7 * losses[0], f"too slow: {losses}"
         assert np.isfinite(losses).all()
+
+    def test_3d_convergence_minimal(self):
+        """Reference-tier minimal convergence run
+        (ref: tests/L0/run_transformer/run_megatron_gpt_pipeline.py — a
+        short real optimization run, not just a few loss ticks): the
+        full dp x tp x pp train step with FusedAdam must memorize the
+        next-token task, driving loss from ~ln(V)=4.16 to <0.5 (0.009
+        at 150 steps).  Runs in a SUBPROCESS: a long 8-virtual-device
+        shard_map loop inside the thread-heavy pytest process starves
+        the single-core CPU-collective rendezvous (40 s abort in
+        xla/rendezvous.cc) and kills the whole suite."""
+        import subprocess
+        import sys as _sys
+
+        runner = os.path.join(os.path.dirname(__file__),
+                              "_gpt_convergence_runner.py")
+        proc = None
+        for attempt in range(2):  # one retry: rendezvous flake budget
+            proc = subprocess.run(
+                [_sys.executable, runner, "60"],
+                capture_output=True, text=True, timeout=1200,
+                cwd=os.path.join(os.path.dirname(__file__), ".."))
+            if proc.returncode == 0:
+                break
+            if "rendezvous" not in proc.stderr:
+                break  # a real failure — don't retry it away
+        assert proc.returncode == 0, (
+            f"convergence runner failed\n--- stdout ---\n{proc.stdout}"
+            f"\n--- stderr ---\n{proc.stderr[-2000:]}")
+        assert "CONVERGED" in proc.stdout, proc.stdout

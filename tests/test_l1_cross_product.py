@@ -1,0 +1,97 @@
+"""L1-style cross-product integration harness.
+
+Models the reference's L1 tier (ref: tests/L1/cross_product/run.sh +
+tests/L1/common/{run_test.sh,compare.py}): run the full imagenet driver
+over the cross product of opt_level x loss_scale x keep_batchnorm, dump
+per-iteration losses, and apply compare.py's EXACT-equality oracle
+(``assert loss_e == loss_p``, ref compare.py:36-50) between repeated
+runs of each config, plus cross-config convergence sanity.
+"""
+import importlib.util
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "apex_tpu_example_main_amp_l1",
+    os.path.join(os.path.dirname(__file__), "..", "examples", "imagenet",
+                 "main_amp.py"))
+main_amp = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(main_amp)
+
+
+def _run(tmp_path, tag, opt_level, loss_scale, keep_bn, npz, iters=6):
+    log = str(tmp_path / f"loss_{tag}.log")
+    argv = [
+        "--data", npz, "--arch", "resnet_tiny",
+        "--devices", "1",
+        "--batch-size", "16", "--iters", str(iters), "--epochs", "1",
+        "--image-size", "32", "--num-classes", "4",
+        "--opt-level", opt_level, "--deterministic",
+        "--print-freq", "100", "--loss-log", log,
+        "--checkpoint", str(tmp_path / f"ck_{tag}.msgpack"),
+    ]
+    if loss_scale is not None:
+        argv += ["--loss-scale", str(loss_scale)]
+    if keep_bn is not None:
+        argv += ["--keep-batchnorm-fp32", str(keep_bn)]
+    final = main_amp.main(argv)
+    with open(log) as f:
+        return f.read(), final
+
+
+def _npz(tmp_path):
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 4, size=128).astype(np.int32)
+    means = rng.uniform(-1, 1, size=(4, 3)).astype(np.float32)
+    images = (means[labels][:, None, None, :]
+              + 0.25 * rng.randn(128, 32, 32, 3)).astype(np.float32)
+    path = str(tmp_path / "l1.npz")
+    np.savez(path, images=images, labels=labels)
+    return path
+
+
+# The reference sweeps O0-O3 x {none,1,128,dynamic} x {none,True,False};
+# this subset covers every axis value at least once while keeping suite
+# time bounded.
+COMBOS = [
+    ("O0", None, None),
+    ("O1", "dynamic", None),
+    ("O2", "128.0", "True"),
+    ("O3", "128.0", "False"),
+    ("O5", None, None),
+]
+
+
+class TestL1CrossProduct:
+    @pytest.mark.parametrize("opt_level,loss_scale,keep_bn", COMBOS)
+    def test_bitwise_reproducible(self, tmp_path, opt_level, loss_scale,
+                                  keep_bn):
+        """compare.py oracle: two runs of the same config produce
+        IDENTICAL loss curves (ref: compare.py:36-50 exact equality)."""
+        npz = _npz(tmp_path)
+        tag = f"{opt_level}_{loss_scale}_{keep_bn}"
+        log_a, _ = _run(tmp_path, tag + "_a", opt_level, loss_scale,
+                        keep_bn, npz)
+        log_b, _ = _run(tmp_path, tag + "_b", opt_level, loss_scale,
+                        keep_bn, npz)
+        assert log_a == log_b, (
+            f"{tag}: nondeterministic losses\nA:\n{log_a}\nB:\n{log_b}")
+        assert len(log_a.splitlines()) == 6
+
+    def test_all_opt_levels_learn(self, tmp_path):
+        """Every precision config must make training progress on the
+        separable set (the reference's qualitative L1 expectation)."""
+        npz = _npz(tmp_path)
+        finals = {}
+        for opt_level, loss_scale, keep_bn in COMBOS:
+            tag = f"learn_{opt_level}"
+            log, final = _run(tmp_path, tag, opt_level, loss_scale,
+                              keep_bn, npz, iters=30)
+            first = float(log.splitlines()[0].split()[1])
+            finals[opt_level] = (first, final)
+        for lvl, (first, final) in finals.items():
+            assert np.isfinite(final), f"{lvl} diverged"
+            assert final < first, f"{lvl}: no progress {first}->{final}"

@@ -394,3 +394,67 @@ def test_larc_zero_param_passthrough():
     tx = opt.larc(learning_rate=0.1)
     u, _ = tx.update(g, tx.init(params), params)
     np.testing.assert_allclose(np.asarray(u["w"]), np.full(8, 2.0))
+
+
+class TestDirectGroups:
+    """Large leaves bypass packing (native-shape processing); parity
+    must hold across the packed/direct boundary."""
+
+    def test_direct_and_packed_leaves_match_optax(self, monkeypatch):
+        import optax
+
+        from apex_tpu.ops import multi_tensor
+        from apex_tpu.optimizers import fused_adam
+
+        monkeypatch.setattr(multi_tensor, "DIRECT_MIN_ELEMS", 1000)
+        params = {
+            "big": jnp.ones((40, 32)) * 0.5,      # 1280 >= 1000: direct
+            "small_a": jnp.ones((8, 16)) * 0.3,   # packed together
+            "small_b": jnp.ones((24,)) * 0.1,
+        }
+        grads = jax.tree_util.tree_map(
+            lambda p: p * 0.01 + 0.001, params)
+
+        tx = fused_adam(1e-2, weight_decay=0.01)
+        ref = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8,
+                          weight_decay=0.01)
+        s, rs = tx.init(params), ref.init(params)
+        p_f, p_r = params, params
+        for _ in range(5):
+            u, s = tx.update(grads, s, p_f)
+            p_f = optax.apply_updates(p_f, u)
+            ur, rs = ref.update(grads, rs, p_r)
+            p_r = optax.apply_updates(p_r, ur)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            p_f, p_r)
+        # state layout: direct group native shape, packed group flat
+        shapes = sorted(x.shape for x in s.m)
+        assert (40, 32) in shapes
+
+    def test_direct_group_forced_pallas_matches_jnp(self, monkeypatch):
+        from apex_tpu.ops import multi_tensor
+        from apex_tpu.optimizers import fused_sgd
+
+        monkeypatch.setattr(multi_tensor, "DIRECT_MIN_ELEMS", 100)
+        params = {"w": jnp.ones((13, 11))}  # 143 elems: direct, unpadded
+        grads = {"w": jnp.full((13, 11), 0.01)}
+        outs = {}
+        for mode in (True, False):
+            tx = fused_sgd(0.1, momentum=0.9, use_pallas=mode)
+            s = tx.init(params)
+            p = params
+            for _ in range(3):
+                u, s = tx.update(grads, s, p)
+                p = optax_apply(p, u)
+            outs[mode] = p
+        np.testing.assert_allclose(np.asarray(outs[True]["w"]),
+                                   np.asarray(outs[False]["w"]),
+                                   rtol=1e-6)
+
+
+def optax_apply(p, u):
+    import optax
+
+    return optax.apply_updates(p, u)

@@ -1,0 +1,249 @@
+"""Tests for the Megatron testing assets: batch samplers, arguments,
+global_vars, standalone BERT, legacy OptimWrapper, DCGAN driver.
+
+Models the reference's usage of these assets in its L0 transformer tier
+(ref: tests/L0/run_transformer/*, run_bert_minimal_test.py).
+"""
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.transformer._data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+class TestBatchSamplers:
+    def test_sequential_shards_by_rank(self):
+        batches = {r: list(MegatronPretrainingSampler(
+            total_samples=32, consumed_samples=0,
+            local_minibatch_size=4, data_parallel_rank=r,
+            data_parallel_size=2)) for r in (0, 1)}
+        # rank windows of each global chunk of 8
+        assert batches[0][0] == [0, 1, 2, 3]
+        assert batches[1][0] == [4, 5, 6, 7]
+        assert batches[0][1] == [8, 9, 10, 11]
+        # disjoint, covering
+        flat = sorted(i for r in batches.values() for b in r for i in b)
+        assert flat == list(range(32))
+
+    def test_sequential_resume(self):
+        b = list(MegatronPretrainingSampler(
+            total_samples=16, consumed_samples=8,
+            local_minibatch_size=4, data_parallel_rank=0,
+            data_parallel_size=1))
+        assert b[0] == [8, 9, 10, 11]
+
+    def test_sequential_drop_last(self):
+        full = list(MegatronPretrainingSampler(
+            total_samples=10, consumed_samples=0,
+            local_minibatch_size=4, data_parallel_rank=0,
+            data_parallel_size=1, drop_last=False))
+        assert full[-1] == [8, 9]
+        dropped = list(MegatronPretrainingSampler(
+            total_samples=10, consumed_samples=0,
+            local_minibatch_size=4, data_parallel_rank=0,
+            data_parallel_size=1, drop_last=True))
+        assert all(len(b) == 4 for b in dropped)
+
+    def test_random_sampler_epoch_determinism_and_sharding(self):
+        mk = lambda r, consumed=0: list(MegatronPretrainingRandomSampler(
+            total_samples=64, consumed_samples=consumed,
+            local_minibatch_size=4, data_parallel_rank=r,
+            data_parallel_size=2))
+        a, b = mk(0), mk(0)
+        assert a == b  # same epoch seed -> same permutation
+        r0 = {i for batch in mk(0) for i in batch}
+        r1 = {i for batch in mk(1) for i in batch}
+        assert not (r0 & r1)  # disjoint rank buckets
+
+    def test_random_sampler_validation(self):
+        with pytest.raises(ValueError):
+            MegatronPretrainingRandomSampler(0, 0, 4, 0, 1)
+        with pytest.raises(ValueError):
+            MegatronPretrainingRandomSampler(8, 0, 4, 2, 2)
+
+
+class TestArguments:
+    def _parse(self, argv, **kw):
+        from apex_tpu.testing.arguments import parse_args
+        return parse_args(args=argv, **kw)
+
+    def test_parallel_factorization(self):
+        args = self._parse([
+            "--world-size", "8", "--tensor-model-parallel-size", "2",
+            "--pipeline-model-parallel-size", "2",
+            "--micro-batch-size", "4"])
+        assert args.data_parallel_size == 2
+        assert args.global_batch_size == 8
+
+    def test_derived_network_sizes(self):
+        args = self._parse([
+            "--hidden-size", "64", "--num-attention-heads", "4",
+            "--num-layers", "2", "--world-size", "1"])
+        assert args.ffn_hidden_size == 256
+        assert args.kv_channels == 16
+
+    def test_precision_flags(self):
+        args = self._parse(["--bf16", "--world-size", "1"])
+        assert args.params_dtype == jnp.bfloat16
+        args = self._parse(["--fp16", "--world-size", "1"])
+        assert args.params_dtype == jnp.float16
+
+    def test_indivisible_world_raises(self):
+        with pytest.raises(ValueError):
+            self._parse(["--world-size", "6",
+                         "--tensor-model-parallel-size", "4"])
+
+    def test_defaults_and_extra_args_provider(self):
+        def extra(parser):
+            parser.add_argument("--my-flag", type=int, default=None)
+            return parser
+
+        args = self._parse(["--world-size", "1"],
+                           extra_args_provider=extra,
+                           defaults={"my_flag": 7, "seq_length": 128})
+        assert args.my_flag == 7
+        assert args.seq_length == 128
+
+
+class TestGlobalVars:
+    def test_set_and_get(self):
+        from apex_tpu.testing import global_vars
+        from apex_tpu.transformer.pipeline_parallel import utils as ppu
+
+        global_vars.destroy_global_vars()
+        ppu.destroy_microbatch_calculator()
+        args = global_vars.set_global_variables(args=[
+            "--world-size", "2", "--micro-batch-size", "2",
+            "--global-batch-size", "8"])
+        assert global_vars.get_args() is args
+        assert global_vars.get_num_microbatches() == 2  # 8/(2*2)
+        assert global_vars.get_timers() is not None
+        global_vars.destroy_global_vars()
+        ppu.destroy_microbatch_calculator()
+
+
+class TestStandaloneBert:
+    def test_forward_and_mlm_loss(self):
+        from apex_tpu.testing.standalone_bert import BertModel
+
+        model = BertModel(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_attention_heads=4, max_sequence_length=16,
+                          attention_dropout=0.0, hidden_dropout=0.0)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+        mask = jnp.ones((2, 16), jnp.int32).at[1, -4:].set(0)
+        ttype = jnp.zeros((2, 16), jnp.int32).at[:, 8:].set(1)
+        variables = model.init(jax.random.PRNGKey(1), tokens, mask, ttype)
+        logits, binary = model.apply(variables, tokens, mask, ttype)
+        assert logits.shape == (2, 16, 64)
+        assert binary.shape == (2, 2)
+        loss, _ = model.apply(variables, tokens, mask, ttype,
+                              lm_labels=tokens)
+        assert loss.shape == (2, 16)
+        assert bool(jnp.all(jnp.isfinite(loss)))
+
+    def test_padding_mask_blocks_attention(self):
+        from apex_tpu.testing.standalone_bert import BertModel
+
+        model = BertModel(vocab_size=64, hidden_size=32, num_layers=1,
+                          num_attention_heads=4, max_sequence_length=16,
+                          add_binary_head=False, attention_dropout=0.0,
+                          hidden_dropout=0.0)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (1, 16), 0, 64)
+        mask = jnp.ones((1, 16), jnp.int32).at[0, -6:].set(0)
+        variables = model.init(jax.random.PRNGKey(1), tokens, mask)
+        out1, _ = model.apply(variables, tokens, mask)
+        tokens2 = tokens.at[0, -1].set((tokens[0, -1] + 1) % 64)
+        out2, _ = model.apply(variables, tokens2, mask)
+        # visible positions must not see the masked change
+        np.testing.assert_allclose(np.asarray(out1[0, :10]),
+                                   np.asarray(out2[0, :10]), atol=1e-5)
+
+    def test_bert_minimal_convergence(self):
+        """ref: run_bert_minimal_test.py — a short MLM optimization."""
+        from apex_tpu.testing.standalone_bert import BertModel
+
+        model = BertModel(vocab_size=32, hidden_size=32, num_layers=1,
+                          num_attention_heads=4, max_sequence_length=8,
+                          add_binary_head=False, attention_dropout=0.0,
+                          hidden_dropout=0.0)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 8), 0, 32)
+        mask = jnp.ones((4, 8), jnp.int32)
+        variables = model.init(jax.random.PRNGKey(1), tokens, mask)
+        params = variables["params"]
+        tx = optax.adam(5e-3)
+        ost = tx.init(params)
+
+        @jax.jit
+        def step(p, o):
+            def loss_fn(p):
+                loss, _ = model.apply({"params": p}, tokens, mask,
+                                      lm_labels=tokens)
+                return jnp.mean(loss)
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
+
+        l0 = None
+        for _ in range(80):
+            params, ost, loss = step(params, ost)
+            l0 = float(loss) if l0 is None else l0
+        assert float(loss) < l0 * 0.3, (l0, float(loss))
+
+
+class TestOptimWrapper:
+    def test_multi_loss_workflow(self):
+        from apex_tpu.amp.opt import OptimWrapper
+
+        params = {"w": jnp.ones((4, 4))}
+        x = jnp.ones((2, 4))
+        wrapper = OptimWrapper(optax.sgd(0.05), params, num_loss=2)
+
+        def loss_a(p):
+            return jnp.sum((x @ p["w"]) ** 2)
+
+        def loss_b(p):
+            return jnp.sum(jnp.abs(x @ p["w"]))
+
+        for _ in range(10):
+            for lf in (loss_a, loss_b):
+                with wrapper.scale_loss() as scale:
+                    g = jax.grad(lambda p: lf(p) * scale)(wrapper.params)
+                    wrapper.accumulate(g)
+            wrapper.step()
+        assert loss_a(wrapper.params) < loss_a(params)
+
+    def test_overflow_in_one_loss_skips_step(self):
+        from apex_tpu.amp.opt import OptimWrapper
+
+        params = {"w": jnp.ones((2, 2))}
+        wrapper = OptimWrapper(optax.sgd(0.1), params, num_loss=2)
+        with wrapper.scale_loss():
+            wrapper.accumulate({"w": jnp.ones((2, 2))})
+        with wrapper.scale_loss():
+            wrapper.accumulate({"w": jnp.full((2, 2), jnp.inf)})
+        before = np.asarray(wrapper.params["w"])
+        wrapper.step()
+        np.testing.assert_array_equal(np.asarray(wrapper.params["w"]),
+                                      before)
+
+
+class TestDCGANDriver:
+    def test_multi_model_multi_loss_amp(self):
+        spec = importlib.util.spec_from_file_location(
+            "apex_tpu_example_dcgan",
+            os.path.join(os.path.dirname(__file__), "..", "examples",
+                         "dcgan", "main_amp.py"))
+        dcgan = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dcgan)
+        errD_real, errD_fake, errG = dcgan.main(
+            ["--iters", "8", "--batch-size", "8", "--opt-level", "O2"])
+        for v in (errD_real, errD_fake, errG):
+            assert np.isfinite(v)
