@@ -39,7 +39,7 @@ from apex_tpu.optimizers import fused_sgd
 
 A100_BASELINE_IPS = 2500.0
 
-BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMAGE = 224
 WARMUP = 3
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
