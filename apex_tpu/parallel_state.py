@@ -30,6 +30,11 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
 TENSOR_AXIS = "tensor"
+# Reserved names for the sequence/context- and expert-parallel modules
+# (apex_tpu.transformer.{sequence,expert}_parallel); they build their
+# own meshes today but share the canonical naming.
+SEQUENCE_AXIS = "sequence"
+EXPERT_AXIS = "expert"
 # Device-order convention: ('pipe', 'data', 'tensor') — tensor innermost.
 MESH_AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, TENSOR_AXIS)
 

@@ -5,13 +5,15 @@ tensor parallelism, pipeline parallelism, parallel transformer building
 blocks, fused softmax, microbatch calculators, enums — over a
 ``jax.sharding.Mesh`` instead of NCCL process groups.
 """
-from . import functional, microbatches, pipeline_parallel, tensor_parallel
+from . import (expert_parallel, functional, microbatches,
+               pipeline_parallel, sequence_parallel, tensor_parallel)
 from .enums import AttnMaskType, AttnType, LayerType
 from .layers import (ParallelMLP, ParallelSelfAttention,
                      ParallelTransformer, ParallelTransformerLayer)
 
 __all__ = [
-    "functional", "microbatches", "pipeline_parallel", "tensor_parallel",
+    "expert_parallel", "functional", "microbatches", "pipeline_parallel",
+    "sequence_parallel", "tensor_parallel",
     "AttnMaskType", "AttnType", "LayerType",
     "ParallelMLP", "ParallelSelfAttention", "ParallelTransformer",
     "ParallelTransformerLayer",

@@ -1,0 +1,163 @@
+"""Expert parallelism: Switch-style MoE with all-to-all token dispatch.
+
+The reference has no MoE (SURVEY §2.10: "EP: not present anywhere");
+this module completes the parallelism alphabet (dp/tp/pp/sp/**ep**) the
+framework's mesh registry reserves.  Design follows Switch Transformer
+(Fedus et al. 2021) / GShard dispatch algebra, TPU-first:
+
+- experts are sharded over a mesh axis (one or more experts per shard);
+- a top-1 router assigns each token an expert and a gate probability;
+- tokens are packed into a fixed-capacity ``(experts, capacity, h)``
+  dispatch buffer (static shapes — XLA requirement; overflow tokens are
+  dropped, the standard capacity-factor contract) and exchanged with ONE
+  ``all_to_all`` each way over ICI;
+- the combine scatter multiplies by the gate so router gradients flow.
+
+Everything runs inside ``shard_map`` over ``axis_name``; capacity math
+is per-shard static.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel_state import EXPERT_AXIS  # noqa: F401
+
+
+class RouterOutput(NamedTuple):
+    expert_index: jnp.ndarray   # (T,) int32 chosen expert per token
+    gate: jnp.ndarray           # (T,) f32 chosen-expert probability
+    load_balancing_loss: jnp.ndarray  # scalar aux loss (Switch eq. 4)
+
+
+def top1_router(logits: jnp.ndarray) -> RouterOutput:
+    """Top-1 gating with the Switch load-balancing auxiliary loss."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+    num_experts = logits.shape[-1]
+    # fraction of tokens per expert x mean router prob per expert
+    frac = jnp.mean(
+        jax.nn.one_hot(idx, num_experts, dtype=jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return RouterOutput(idx.astype(jnp.int32), gate, aux)
+
+
+def _dispatch_indices(expert_index: jnp.ndarray, num_experts: int,
+                      capacity: int):
+    """Position of each token within its expert's capacity slots.
+
+    Returns ``(slot, keep)``: slot in [0, capacity) and a keep mask
+    (False = dropped by overflow).  Pure cumsum arithmetic — no sorting,
+    no dynamic shapes.
+    """
+    one_hot = jax.nn.one_hot(expert_index, num_experts, dtype=jnp.int32)
+    position_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based
+    # every token's own one-hot contributes 1 to its cumsum, so slot is
+    # always >= 0; the only droppable state is capacity overflow
+    slot = jnp.sum(position_in_expert, axis=1) - 1               # (T,)
+    keep = slot < capacity
+    return jnp.minimum(slot, capacity - 1), keep
+
+
+def moe_dispatch_combine(x: jnp.ndarray,
+                         router: RouterOutput,
+                         expert_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                         num_experts: int,
+                         capacity_factor: float = 1.25,
+                         axis_name: Optional[str] = EXPERT_AXIS
+                         ) -> jnp.ndarray:
+    """Dispatch tokens to experts, apply, combine.
+
+    ``x``: (T, H) local tokens.  ``expert_fn`` maps the LOCAL experts'
+    buffer ``(local_experts, rows, H) -> same`` (vmapped expert MLP).
+    With ``axis_name`` the global experts are sharded over that axis
+    (``num_experts %% axis_size == 0``) and dispatch/return each ride one
+    ``all_to_all``; ``axis_name=None`` runs all experts locally (the
+    dense-equivalent used for parity tests).
+    """
+    T, H = x.shape
+    capacity = max(1, int(capacity_factor * T / num_experts))
+    slot, keep = _dispatch_indices(router.expert_index, num_experts,
+                                   capacity)
+
+    # scatter tokens into (num_experts, capacity, H)
+    buf = jnp.zeros((num_experts, capacity, H), x.dtype)
+    buf = buf.at[router.expert_index, slot].add(
+        jnp.where(keep[:, None], x, 0))
+
+    if axis_name is not None:
+        n_shards = jax.lax.axis_size(axis_name)
+        assert num_experts % n_shards == 0
+        # shard e receives every peer's slice for its local experts:
+        # (E, C, H) -> (E/P, P*C, H)
+        buf = jax.lax.all_to_all(buf, axis_name, split_axis=0,
+                                 concat_axis=1, tiled=True)
+
+    out = expert_fn(buf)
+
+    if axis_name is not None:
+        out = jax.lax.all_to_all(out, axis_name, split_axis=1,
+                                 concat_axis=0, tiled=True)
+
+    # combine: gather each token's slot output, weight by its gate
+    tok_out = out[router.expert_index, slot]
+    gate = jnp.where(keep, router.gate, 0.0).astype(jnp.float32)
+    return (tok_out.astype(jnp.float32) * gate[:, None]).astype(x.dtype)
+
+
+class ExpertParallelMLP:
+    """Switch-style MoE FFN layer over an expert mesh axis.
+
+    Functional container (params are an explicit pytree, like the other
+    shard_map-mode layers):
+
+    >>> layer = ExpertParallelMLP(hidden, ffn_hidden, num_experts)
+    >>> params = layer.init(key)              # experts stacked on axis 0
+    >>> y, aux = layer.apply(params, x)       # inside shard_map:
+    ...                                       # params sharded P(EXPERT_AXIS)
+    """
+
+    def __init__(self, hidden_size: int, ffn_hidden_size: int,
+                 num_experts: int, capacity_factor: float = 1.25,
+                 axis_name: Optional[str] = EXPERT_AXIS):
+        self.hidden_size = hidden_size
+        self.ffn_hidden_size = ffn_hidden_size
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.axis_name = axis_name
+
+    def init(self, key: jax.Array) -> dict:
+        kr, k1, k2 = jax.random.split(key, 3)
+        e, h, f = self.num_experts, self.hidden_size, self.ffn_hidden_size
+        s1 = (2.0 / h) ** 0.5
+        return {
+            "router": jax.random.normal(kr, (h, e), jnp.float32) * 0.02,
+            "wi": jax.random.normal(k1, (e, h, f), jnp.float32) * s1,
+            "wo": jax.random.normal(k2, (e, f, h), jnp.float32)
+            * (2.0 / f) ** 0.5,
+        }
+
+    def apply(self, params: dict, x: jnp.ndarray):
+        """(T, H) -> ((T, H), aux_loss).  Inside shard_map, pass expert
+        weights sharded ``P(EXPERT_AXIS)`` on their leading axis and the
+        router replicated; tokens may be data-sharded on any other
+        axis."""
+        logits = x.astype(jnp.float32) @ params["router"]
+        router = top1_router(logits)
+
+        def expert_fn(buf):  # (local_e, rows, H)
+            h = jnp.einsum("erh,ehf->erf", buf.astype(jnp.float32),
+                           params["wi"])
+            h = jax.nn.gelu(h)
+            return jnp.einsum("erf,efh->erh", h,
+                              params["wo"]).astype(buf.dtype)
+
+        y = moe_dispatch_combine(
+            x, router, expert_fn, self.num_experts,
+            capacity_factor=self.capacity_factor,
+            axis_name=self.axis_name)
+        return y, router.load_balancing_loss

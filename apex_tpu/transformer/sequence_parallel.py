@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..parallel_state import TENSOR_AXIS
 
-SEQUENCE_AXIS = "sequence"
+from ..parallel_state import SEQUENCE_AXIS  # noqa: F401
 
 
 # --- SP region mappings ----------------------------------------------------
