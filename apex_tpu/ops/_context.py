@@ -13,29 +13,27 @@ def in_manual_axis_context(*operands) -> bool:
     still fuses it per shard.  Outside (plain jit / pjit / GSPMD) the
     Pallas kernels run.
 
-    Detection prefers the public ``jax.typeof(operand).vma`` type when
-    operands are given: only values actually *varying* over manual axes
-    force the fallback, so ``vmap(axis_name=...)`` and replicated values
-    inside shard_map keep the Pallas path (the private axis-env check
-    this replaces disabled it for any named axis).  With no operands the
-    axis-env heuristic is used; if both probes break (API drift) the
-    error propagates rather than silently choosing a path.
+    The public ``jax.typeof(operand).vma`` type gives a fast positive
+    (any varying operand => manual context); the axis-env probe then
+    decides the rest.  The axis env CANNOT be skipped even when every
+    operand is unvarying: ``pallas_call`` inside
+    ``shard_map(check_vma=True)`` demands vma-typed out specs regardless
+    of operand variance, so replicated inputs still need the fallback.
+    Deliberate trade-off: this also routes ``vmap(axis_name=...)``
+    bodies (where the Pallas call would be legal) to the fallback —
+    named-axis vmap is rare and the fallback is merely the XLA-fused
+    reference implementation; choosing correctness under shard_map over
+    that corner's kernel dispatch.
+    The axis-env probe is deliberately NOT wrapped in a blanket except —
+    if the private API drifts, failing loudly here beats silently
+    running a Pallas call that check_vma rejects later.
     """
-    probed = False
     for x in operands:
         try:
-            vma = jax.typeof(x).vma
+            if jax.typeof(x).vma:
+                return True
         except (AttributeError, TypeError):
             continue
-        probed = True
-        if vma:
-            return True
-    if probed:
-        return False
-    # No operands (or none carried a vma type): conservative axis-env
-    # probe.  Deliberately NOT wrapped in a blanket except — if this
-    # private API drifts, failing loudly here beats silently running a
-    # Pallas call inside shard_map where check_vma rejects it later.
     from jax._src import core as _jax_core
 
     return bool(_jax_core.get_axis_env().axis_sizes)

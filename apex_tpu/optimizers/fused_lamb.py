@@ -34,7 +34,9 @@ from .fused_adam import ScalarOrSchedule, _lr_at
 
 class FusedLAMBState(NamedTuple):
     count: jnp.ndarray
-    m: Tuple[jnp.ndarray, ...]   # fp32 flat buffer per dtype group
+    # fp32 per group: flat (padded,) buffer for packed groups, native
+    # leaf shape for DIRECT groups (split_direct metas)
+    m: Tuple[jnp.ndarray, ...]
     v: Tuple[jnp.ndarray, ...]
 
 
@@ -52,8 +54,9 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
     LANE = multi_tensor.LANE
 
     def init(params):
-        metas = multi_tensor.compute_metas(params, align=LANE)
-        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
+        metas = multi_tensor.compute_metas(params, align=LANE,
+                                           split_direct=True)
+        zeros = multi_tensor.state_zeros(metas)
         return FusedLAMBState(count=jnp.zeros((), jnp.int32),
                               m=zeros,
                               v=tuple(jnp.zeros_like(z) for z in zeros))
@@ -71,9 +74,10 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
             bc1 = bc2 = jnp.float32(1.0)
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
 
-        metas = multi_tensor.compute_metas(params, align=LANE)
-        gbufs = multi_tensor.pack(grads, metas)
-        pbufs = multi_tensor.pack(params, metas)
+        metas = multi_tensor.compute_metas(params, align=LANE,
+                                           split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
 
         # Phase 1a: global grad norm + clip factor over ALL groups
         # (ref: apex/optimizers/fused_lamb.py:163-185 multi_tensor_l2norm
@@ -93,7 +97,7 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
             new_v.append(v)
 
         leaves = jax.tree_util.tree_leaves(params)
-        updates = multi_tensor.unpack_groups(
+        updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedLAMBState(count, tuple(new_m), tuple(new_v))
 
@@ -130,11 +134,14 @@ def _lamb_group_update(meta, gbuf, pbuf, m, v, *, gscale, beta1, beta2,
     FusedLAMB and FusedMixedPrecisionLamb so the clip/trust-ratio
     semantics can never diverge between them."""
     if fused:
+        (gb, pb, mb, vb), restore = fused_optim.flatten_for_kernel(
+            gbuf, pbuf, m, v)
         u, m_new, v_new = fused_optim.lamb_phase1(
-            gbuf, pbuf, m, v, grad_scale=gscale, beta1=beta1, beta2=beta2,
+            gb, pb, mb, vb, grad_scale=gscale, beta1=beta1, beta2=beta2,
             beta3=beta3, eps=eps, weight_decay=weight_decay,
             bias_correction1=bc1, bias_correction2=bc2,
             adam_w_mode=adam_w_mode)
+        u, m_new, v_new = restore(u), restore(m_new), restore(v_new)
     else:
         u, m_new, v_new = _lamb_phase1_jnp(
             gbuf, pbuf, m, v, gscale, beta1, beta2, beta3, eps,
@@ -150,7 +157,19 @@ def _trust_ratio_elem(meta, u, p32, use_nvlamb, weight_decay):
     (ref: multi_tensor_lamb.cu:230-330 LAMBStage2; per-tensor norms are
     the l2norm kernel's per_tensor=True output).  LANE-aligned packing
     interleaves the padding id between real segments, so the ids are
-    NOT sorted — no indices_are_sorted promise."""
+    NOT sorted — no indices_are_sorted promise.
+
+    DIRECT groups (one native-shape leaf) reduce over the whole buffer
+    — one scalar ratio, no segments, no packing."""
+    if multi_tensor.is_direct(meta):
+        if use_nvlamb or weight_decay != 0.0:
+            p_n2 = jnp.sum(p32 * p32)
+            u_n2 = jnp.sum(u.astype(jnp.float32) ** 2)
+            return jnp.where(
+                (p_n2 > 0) & (u_n2 > 0),
+                jnp.sqrt(p_n2) / jnp.sqrt(jnp.maximum(u_n2, 1e-24)),
+                1.0)
+        return jnp.float32(1.0)
     seg = multi_tensor.segment_ids(meta)
     n_seg = len(meta.sizes) + 1  # +1 for padding gaps
     if use_nvlamb or weight_decay != 0.0:

@@ -46,10 +46,11 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
     LANE = multi_tensor.LANE
 
     def init(params):
-        metas = multi_tensor.compute_metas(params, align=LANE)
+        metas = multi_tensor.compute_metas(params, align=LANE,
+                                           split_direct=True)
         return FusedNovoGradState(
             count=jnp.zeros((), jnp.int32),
-            m=tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas),
+            m=multi_tensor.state_zeros(metas),
             v=tuple(jnp.zeros((len(m.sizes),), jnp.float32)
                     for m in metas))
 
@@ -67,17 +68,23 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
         first = state.count == 0
 
-        metas = multi_tensor.compute_metas(params, align=LANE)
-        gbufs = multi_tensor.pack(grads, metas)
-        pbufs = multi_tensor.pack(params, metas)
+        metas = multi_tensor.compute_metas(params, align=LANE,
+                                           split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
 
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            seg = multi_tensor.segment_ids(meta)
-            n_seg = len(meta.sizes) + 1
             g32 = gbufs[i].astype(jnp.float32)
-            # aligned packing interleaves the padding id -> ids unsorted
-            gn_sq = jax.ops.segment_sum(g32 * g32, seg, n_seg)[:-1]
+            if multi_tensor.is_direct(meta):
+                # one native-shape leaf: the per-tensor 2nd moment is a
+                # scalar reduction, no segments
+                gn_sq = jnp.sum(g32 * g32)[None]
+            else:
+                seg = multi_tensor.segment_ids(meta)
+                n_seg = len(meta.sizes) + 1
+                # aligned packing interleaves the padding id -> unsorted
+                gn_sq = jax.ops.segment_sum(g32 * g32, seg, n_seg)[:-1]
             if init_zero:
                 v_new = beta2 * state.v[i] + (1.0 - beta2) * gn_sq
             else:
@@ -87,14 +94,23 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
                                   beta2 * state.v[i]
                                   + (1.0 - beta2) * gn_sq)
             denom_t = jnp.sqrt(v_new / bc2) + eps
-            denom_elem = jnp.concatenate(
-                [denom_t, jnp.ones((1,), jnp.float32)])[seg]
-            if fused_optim.group_use_pallas(use_pallas, meta):
+            if multi_tensor.is_direct(meta):
+                denom_elem = denom_t[0]  # scalar broadcast
+            else:
+                denom_elem = jnp.concatenate(
+                    [denom_t, jnp.ones((1,), jnp.float32)])[seg]
+            if fused_optim.group_use_pallas(use_pallas, meta) \
+                    and not multi_tensor.is_direct(meta):
                 d, m = fused_optim.novograd_update(
                     gbufs[i], pbufs[i], state.m[i], denom_elem,
                     lr=lr, beta1=beta1, beta3=beta3,
                     weight_decay=weight_decay, bias_correction1=bc1)
             else:
+                # direct groups always take this path (even under
+                # forced Pallas): their per-tensor denominator is ONE
+                # scalar, and shipping it to the elementwise kernel
+                # would require materializing a leaf-sized broadcast —
+                # the exact redundant full pass direct groups remove
                 scaled = g32 / denom_elem \
                     + weight_decay * pbufs[i].astype(jnp.float32)
                 m = beta1 * state.m[i] + beta3 * scaled
@@ -104,7 +120,7 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
             new_v.append(v_new)
 
         leaves = jax.tree_util.tree_leaves(params)
-        updates = multi_tensor.unpack_groups(
+        updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedNovoGradState(count, tuple(new_m),
                                            tuple(new_v))

@@ -453,8 +453,83 @@ class TestDirectGroups:
                                    np.asarray(outs[False]["w"]),
                                    rtol=1e-6)
 
+    def test_lamb_direct_matches_packed(self, monkeypatch):
+        """LAMB's scalar trust-ratio branch for direct groups must match
+        the segment-reduction packed path exactly."""
+        from apex_tpu.ops import multi_tensor
+        from apex_tpu.optimizers import fused_lamb
+
+        params = {"big": jnp.ones((40, 32)) * 0.5,
+                  "small": jnp.ones((8, 16)) * 0.3}
+        grads = jax.tree_util.tree_map(lambda p: p * 0.01 + 0.002, params)
+
+        def run(direct_min):
+            monkeypatch.setattr(multi_tensor, "DIRECT_MIN_ELEMS",
+                                direct_min)
+            tx = fused_lamb(1e-2, weight_decay=0.01, use_pallas=False)
+            s = tx.init(params)
+            p = params
+            for _ in range(4):
+                u, s = tx.update(grads, s, p)
+                p = optax_apply(p, u)
+            return p
+
+        p_direct = run(1000)       # 'big' is a direct group
+        p_packed = run(1 << 40)    # everything packed
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-6),
+            p_direct, p_packed)
+
+    def test_lamb_direct_forced_pallas_matches_jnp(self, monkeypatch):
+        from apex_tpu.ops import multi_tensor
+        from apex_tpu.optimizers import fused_lamb
+
+        monkeypatch.setattr(multi_tensor, "DIRECT_MIN_ELEMS", 100)
+        params = {"w": jnp.ones((13, 11))}
+        grads = {"w": jnp.full((13, 11), 0.01)}
+        outs = {}
+        for mode in (True, False):
+            tx = fused_lamb(1e-2, weight_decay=0.01, use_pallas=mode)
+            s = tx.init(params)
+            p = params
+            for _ in range(3):
+                u, s = tx.update(grads, s, p)
+                p = optax_apply(p, u)
+            outs[mode] = p
+        np.testing.assert_allclose(np.asarray(outs[True]["w"]),
+                                   np.asarray(outs[False]["w"]),
+                                   rtol=1e-5)
+
+    def test_novograd_direct_matches_packed(self, monkeypatch):
+        """NovoGrad's scalar per-tensor second moment for direct groups
+        must match the segment-sum packed path."""
+        from apex_tpu.ops import multi_tensor
+        from apex_tpu.optimizers import fused_novograd
+
+        params = {"big": jnp.ones((40, 32)) * 0.5,
+                  "small": jnp.ones((8, 16)) * 0.3}
+        grads = jax.tree_util.tree_map(lambda p: p * 0.01 + 0.002, params)
+
+        def run(direct_min):
+            monkeypatch.setattr(multi_tensor, "DIRECT_MIN_ELEMS",
+                                direct_min)
+            tx = fused_novograd(1e-2, weight_decay=0.01,
+                                use_pallas=False)
+            s = tx.init(params)
+            p = params
+            for _ in range(4):
+                u, s = tx.update(grads, s, p)
+                p = optax_apply(p, u)
+            return p
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-6),
+            run(1000), run(1 << 40))
 
 def optax_apply(p, u):
     import optax
 
     return optax.apply_updates(p, u)
+
