@@ -160,6 +160,11 @@ class ParallelTransformerLayer(nn.Module):
     layernorm_epsilon: float = 1e-5
     dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
+    # Optional substitute for the dense ParallelMLP (e.g. an MoE FFN,
+    # see layers_moe.MoEParallelTransformerLayer).  May return either
+    # the activation or an (activation, aux_loss) pair; the layer's
+    # return mirrors it.
+    mlp_module: Optional[Any] = None
 
     def _dropout(self, x, deterministic):
         if deterministic or self.hidden_dropout == 0.0:
@@ -187,10 +192,17 @@ class ParallelTransformerLayer(nn.Module):
         ln2 = FusedLayerNorm(self.hidden_size,
                              eps=self.layernorm_epsilon,
                              name="post_attention_layernorm")
-        mlp_out = ParallelMLP(self.hidden_size, self.ffn_hidden_size,
-                              dtype=self.dtype, axis_name=self.axis_name,
-                              name="mlp")(ln2(x).astype(self.dtype))
-        return x + self._dropout(mlp_out, deterministic).astype(x.dtype)
+        mlp = self.mlp_module if self.mlp_module is not None else \
+            ParallelMLP(self.hidden_size, self.ffn_hidden_size,
+                        dtype=self.dtype, axis_name=self.axis_name,
+                        name="mlp")
+        out = mlp(ln2(x).astype(self.dtype))
+        if isinstance(out, tuple):
+            mlp_out, aux = out
+            return (x + self._dropout(mlp_out,
+                                      deterministic).astype(x.dtype),
+                    aux)
+        return x + self._dropout(out, deterministic).astype(x.dtype)
 
 
 class ParallelTransformer(nn.Module):

@@ -1,0 +1,112 @@
+"""MoE transformer building blocks (flax / GSPMD mode).
+
+Complements :mod:`apex_tpu.transformer.expert_parallel` (the explicit
+shard_map layer): here the MoE FFN is a flax module whose expert weights
+carry a leading ``(num_experts, ...)`` axis — under pjit, annotate that
+axis with the ``expert`` mesh axis (``jax.sharding``) and XLA inserts
+the all-to-alls; on one device it runs dense.  Dispatch uses the GShard
+one-hot einsum formulation (static shapes, capacity drops), which GSPMD
+partitions cleanly.
+
+The reference has no MoE (SURVEY §2.10); this is capability beyond it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .enums import AttnMaskType
+from .expert_parallel import _dispatch_indices, top1_router
+from .layers import Dtype, ParallelTransformerLayer
+
+
+class MoEMLP(nn.Module):
+    """Switch-style MoE FFN, einsum-dispatch form.
+
+    Input (b, s, h) -> output (b, s, h) plus the load-balancing
+    auxiliary loss (collect it into the objective scaled by ~1e-2,
+    Switch Transformer sec. 2.2).  Expert matmuls run in ``dtype``
+    (bf16 for mixed precision) with fp32 accumulation; the router and
+    gate stay fp32 as routing is numerically sensitive.
+    """
+
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, h = x.shape
+        e, f = self.num_experts, self.ffn_hidden_size
+        cdt = self.dtype
+        tokens = x.reshape(b * s, h)
+        T = b * s
+        capacity = max(1, int(self.capacity_factor * T / e))
+
+        router_w = self.param("router", nn.initializers.normal(0.02),
+                              (h, e), jnp.float32)
+        wi = self.param("wi", nn.initializers.variance_scaling(
+            2.0, "fan_in", "normal"), (e, h, f), jnp.float32)
+        wo = self.param("wo", nn.initializers.variance_scaling(
+            2.0, "fan_in", "normal"), (e, f, h), jnp.float32)
+
+        router = top1_router(tokens.astype(jnp.float32) @ router_w)
+        slot, keep = _dispatch_indices(router.expert_index, e, capacity)
+
+        # one-hot dispatch/combine tensors (GShard): (T, e, capacity)
+        disp = (jax.nn.one_hot(router.expert_index, e)[:, :, None]
+                * jax.nn.one_hot(slot, capacity)[:, None, :]
+                * keep[:, None, None]).astype(cdt)
+        buf = jnp.einsum("th,tec->ech", tokens.astype(cdt), disp,
+                         preferred_element_type=jnp.float32)
+        hmid = jax.nn.gelu(jnp.einsum(
+            "ech,ehf->ecf", buf.astype(cdt), wi.astype(cdt),
+            preferred_element_type=jnp.float32))
+        out = jnp.einsum("ecf,efh->ech", hmid.astype(cdt),
+                         wo.astype(cdt),
+                         preferred_element_type=jnp.float32)
+        gate = jnp.where(keep, router.gate, 0.0)
+        y = jnp.einsum("ech,tec,t->th", out,
+                       disp.astype(jnp.float32), gate)
+        return (y.reshape(b, s, h).astype(x.dtype),
+                router.load_balancing_loss)
+
+
+def MoEParallelTransformerLayer(hidden_size: int,
+                                num_attention_heads: int,
+                                num_experts: int,
+                                ffn_hidden_size: Optional[int] = None,
+                                capacity_factor: float = 1.25,
+                                attn_mask_type: AttnMaskType =
+                                AttnMaskType.causal,
+                                attention_dropout: float = 0.1,
+                                hidden_dropout: float = 0.1,
+                                use_flash: bool = True,
+                                layernorm_epsilon: float = 1e-5,
+                                dtype: Dtype = jnp.float32,
+                                axis_name: Optional[str] = None,
+                                **kw) -> ParallelTransformerLayer:
+    """Pre-LN transformer layer with an MoE FFN — the standard
+    :class:`ParallelTransformerLayer` with its MLP swapped for
+    :class:`MoEMLP` via the ``mlp_module`` hook (no duplicated
+    LN/attention/residual wiring).  ``__call__`` returns
+    ``(y, aux_loss)``.  TP attention composes with expert-sharded MoE
+    weights under GSPMD (annotate attention weights on 'tensor', expert
+    weights on 'expert')."""
+    moe = MoEMLP(hidden_size, ffn_hidden_size or 4 * hidden_size,
+                 num_experts, capacity_factor=capacity_factor,
+                 dtype=dtype, name="moe_mlp")
+    return ParallelTransformerLayer(
+        hidden_size=hidden_size,
+        num_attention_heads=num_attention_heads,
+        ffn_hidden_size=ffn_hidden_size,
+        attn_mask_type=attn_mask_type,
+        attention_dropout=attention_dropout,
+        hidden_dropout=hidden_dropout, use_flash=use_flash,
+        layernorm_epsilon=layernorm_epsilon, dtype=dtype,
+        axis_name=axis_name, mlp_module=moe, **kw)
