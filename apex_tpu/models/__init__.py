@@ -1,1 +1,10 @@
-"""Placeholder — populated by the build plan (SURVEY.md §7)."""
+"""Model zoo: the example/benchmark architectures.
+
+ResNet variants live here (the imagenet driver + headline bench);
+transformer families (GPT, BERT) live in :mod:`apex_tpu.testing`
+mirroring the reference's placement of its standalone models under
+``apex/transformer/testing``.
+"""
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152
+
+__all__ = ["ResNet", "ResNet50", "ResNet101", "ResNet152"]
