@@ -312,3 +312,28 @@ class TestMaskSoftmaxDropout:
         # E[p] preserved by 1/keep scaling
         assert abs(p.mean() * 128 - 1.0) < 0.1
         assert (p == 0).mean() == pytest.approx(0.5, abs=0.05)
+
+
+class TestCausalHint:
+    def test_mask_is_causal_hint_under_jit(self):
+        """Under jit the mask is a tracer; the hint must keep the causal
+        fast path and match the content-checked eager result."""
+        from apex_tpu.contrib.multihead_attn import attn_core
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (2, 4, 16, 8)) * 0.5
+                   for kk in ks)
+        tri = ~jnp.tril(jnp.ones((16, 16), bool))
+
+        eager = attn_core(q, k, v, 8 ** -0.5, mask=tri,
+                          use_time_mask=True, is_training=False)
+
+        @jax.jit
+        def jitted(q, k, v, mask):
+            return attn_core(q, k, v, 8 ** -0.5, mask=mask,
+                             use_time_mask=True, is_training=False,
+                             mask_is_causal=True)
+
+        np.testing.assert_allclose(np.asarray(jitted(q, k, v, tri)),
+                                   np.asarray(eager), rtol=1e-5,
+                                   atol=2e-5)

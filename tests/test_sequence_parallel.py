@@ -148,3 +148,24 @@ class TestSPRegionMappings:
             f, mesh=mesh, in_specs=P("sequence"),
             out_specs=P("sequence")))(x)
         np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
+
+
+class TestUlyssesGradients:
+    def test_gradients_match_dense(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(5)
+
+        def ul_loss(q, k, v):
+            out = _run_sharded(
+                functools.partial(ulysses_self_attention, causal=True),
+                q, k, v, mesh)
+            return jnp.sum(out ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) ** 2)
+
+        gu = jax.grad(ul_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
