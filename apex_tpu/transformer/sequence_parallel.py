@@ -74,6 +74,72 @@ def ring_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
     return ring_attention(q, k, v, axis_name, scale=scale, causal=causal)
 
 
+class SequenceParallelSelfAttention:
+    """Full attention block over a sequence-sharded activation: fused
+    QKV projection, ring (or Ulysses) core, output projection — the
+    sequence-parallel sibling of
+    :class:`apex_tpu.transformer.layers.ParallelSelfAttention`.
+
+    Functional container for shard_map mode (params are an explicit
+    pytree; the per-token projections are embarrassingly parallel over
+    the sequence shards, so only the attention core communicates):
+
+    >>> attn = SequenceParallelSelfAttention(hidden, heads, causal=True)
+    >>> params = attn.init(key)
+    >>> y_local = attn.apply(params, x_local)  # inside shard_map,
+    ...                                        # x (b, s_local, h)
+    """
+
+    def __init__(self, hidden_size: int, num_attention_heads: int,
+                 causal: bool = True, mode: str = "ring",
+                 axis_name: Optional[str] = SEQUENCE_AXIS):
+        assert hidden_size % num_attention_heads == 0
+        assert mode in ("ring", "ulysses")
+        self.hidden_size = hidden_size
+        self.num_heads = num_attention_heads
+        self.head_dim = hidden_size // num_attention_heads
+        self.causal = causal
+        self.mode = mode
+        self.axis_name = axis_name
+
+    def init(self, key) -> dict:
+        k1, k2 = jax.random.split(key)
+        h = self.hidden_size
+        s = (1.0 / h) ** 0.5
+        return {
+            "qkv_kernel": jax.random.normal(k1, (h, 3 * h),
+                                            jnp.float32) * s,
+            "qkv_bias": jnp.zeros((3 * h,), jnp.float32),
+            "out_kernel": jax.random.normal(k2, (h, h),
+                                            jnp.float32) * s,
+            "out_bias": jnp.zeros((h,), jnp.float32),
+        }
+
+    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        b, s_local, h = x.shape
+        nh, d = self.num_heads, self.head_dim
+        qkv = x @ params["qkv_kernel"] + params["qkv_bias"]
+        qkv = qkv.reshape(b, s_local, 3, nh, d)
+        # (b, nh, s_local, d)
+        q, k, v = (jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
+                   for i in range(3))
+        if self.axis_name is None:
+            # dense single-device path: the canonical unfused reference
+            # (fp32-accumulating, shared with the flash/ring parity
+            # tests)
+            from ..ops.flash_attention import mha_reference
+
+            ctx = mha_reference(q, k, v, causal=self.causal)
+        elif self.mode == "ring":
+            ctx = ring_attention(q, k, v, self.axis_name,
+                                 causal=self.causal)
+        else:
+            ctx = ulysses_attention(q, k, v, self.axis_name,
+                                    causal=self.causal)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s_local, h)
+        return ctx @ params["out_kernel"] + params["out_bias"]
+
+
 def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                            scale: Optional[float] = None,
                            causal: bool = False):

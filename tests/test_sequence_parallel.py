@@ -169,3 +169,61 @@ class TestUlyssesGradients:
         for a, b in zip(gu, gd):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=5e-4, atol=5e-5)
+
+
+class TestSequenceParallelSelfAttention:
+    """Full attention block over sequence shards: per-shard projection,
+    ring/ulysses core — must equal the dense full-sequence block."""
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_matches_dense_block(self, mode):
+        from apex_tpu.transformer.sequence_parallel import (
+            SequenceParallelSelfAttention)
+
+        mesh = seq_mesh()
+        attn = SequenceParallelSelfAttention(H * D, H, causal=True,
+                                             mode=mode)
+        dense = SequenceParallelSelfAttention(H * D, H, causal=True,
+                                              axis_name=None)
+        params = attn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1),
+                              (B, S, H * D)) * 0.3
+
+        y_ref = dense.apply(params, x)
+        spec = P(None, "sequence", None)
+        y = jax.jit(jax.shard_map(
+            lambda p, x: attn.apply(p, x), mesh=mesh,
+            in_specs=(P(), spec), out_specs=spec))(params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=3e-4, atol=3e-5)
+
+    def test_trains_sequence_parallel(self):
+        from apex_tpu.transformer.sequence_parallel import (
+            SequenceParallelSelfAttention)
+
+        mesh = seq_mesh()
+        attn = SequenceParallelSelfAttention(H * D, H, causal=True)
+        params = attn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, S, H * D)) * 0.3
+        target = jnp.roll(x, 1, axis=1)
+        spec = P(None, "sequence", None)
+
+        def loss_fn(p):
+            def f(p, x, t):
+                y = attn.apply(p, x)
+                return jax.lax.psum(jnp.sum((y - t) ** 2), "sequence")
+            return jax.shard_map(f, mesh=mesh,
+                                 in_specs=(P(), spec, spec),
+                                 out_specs=P())(p, x, target) / x.size
+
+        step = jax.jit(lambda p: jax.tree_util.tree_map(
+            lambda w, g: w - 0.5 * g, p, jax.grad(loss_fn)(p)))
+        l0 = float(loss_fn(params))
+        for _ in range(250):
+            params = step(params)
+        lf = float(loss_fn(params))
+        # correctness is proven by the parity test; this asserts that
+        # gradients flow through the ring collectives and optimization
+        # makes steady progress (plain SGD on a softmax-attention
+        # shift task is slow by nature)
+        assert np.isfinite(lf) and lf < l0 * 0.9, (l0, lf)
