@@ -111,6 +111,7 @@ class GPTModel(nn.Module):
     hidden_dropout: float = 0.1
     use_flash: bool = True
     checkpoint_activations: bool = False
+    checkpoint_policy: str = "full"
     dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
 
@@ -127,6 +128,7 @@ class GPTModel(nn.Module):
             attention_dropout=self.attention_dropout,
             hidden_dropout=self.hidden_dropout, use_flash=self.use_flash,
             checkpoint_activations=self.checkpoint_activations,
+            checkpoint_policy=self.checkpoint_policy,
             dtype=self.dtype, axis_name=self.axis_name, name="transformer")
 
     def __call__(self, tokens, deterministic: bool = True):

@@ -219,6 +219,12 @@ class ParallelTransformer(nn.Module):
     hidden_dropout: float = 0.1
     use_flash: bool = True
     checkpoint_activations: bool = False
+    # Remat policy when checkpoint_activations is on: a key of
+    # tensor_parallel.random.CHECKPOINT_POLICIES ("full" recomputes
+    # everything; "dots"/"dots_with_no_batch_dims" keep matmul outputs
+    # and recompute only the cheap elementwise tail — the usual
+    # memory/compute sweet spot on TPU).
+    checkpoint_policy: str = "full"
     layernorm_epsilon: float = 1e-5
     dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
@@ -227,8 +233,15 @@ class ParallelTransformer(nn.Module):
     def __call__(self, x, attention_mask=None, deterministic: bool = True):
         layer_cls = ParallelTransformerLayer
         if self.checkpoint_activations:
-            layer_cls = nn.checkpoint(ParallelTransformerLayer,
-                                      static_argnums=(3,))
+            from .tensor_parallel.random import CHECKPOINT_POLICIES
+            if self.checkpoint_policy not in CHECKPOINT_POLICIES:
+                raise ValueError(
+                    f"unknown checkpoint_policy "
+                    f"{self.checkpoint_policy!r}; expected one of "
+                    f"{sorted(CHECKPOINT_POLICIES)}")
+            layer_cls = nn.checkpoint(
+                ParallelTransformerLayer, static_argnums=(3,),
+                policy=CHECKPOINT_POLICIES[self.checkpoint_policy])
         for i in range(self.num_layers):
             x = layer_cls(self.hidden_size, self.num_attention_heads,
                           ffn_hidden_size=self.ffn_hidden_size,

@@ -98,9 +98,11 @@ def MoEParallelTransformerLayer(hidden_size: int,
     ``(y, aux_loss)``.  TP attention composes with expert-sharded MoE
     weights under GSPMD (annotate attention weights on 'tensor', expert
     weights on 'expert')."""
+    # NOTE: inside the layer this module is bound as attribute
+    # ``mlp_module`` — that is its name in the param tree.
     moe = MoEMLP(hidden_size, ffn_hidden_size or 4 * hidden_size,
                  num_experts, capacity_factor=capacity_factor,
-                 dtype=dtype, name="moe_mlp")
+                 dtype=dtype)
     return ParallelTransformerLayer(
         hidden_size=hidden_size,
         num_attention_heads=num_attention_heads,

@@ -254,9 +254,15 @@ def bench_gpt345m():
         num_attention_heads=heads, max_sequence_length=seq,
         attention_dropout=0.0, hidden_dropout=0.0, use_flash=True,
         # remat off by default: batch 8 fits v5e HBM without it and
-        # measures 91.6 TFLOP/s vs 59.8 fully-rematerialized
+        # measures 91.6 TFLOP/s vs 59.8 fully-rematerialized.
+        # BENCH_GPT_REMAT=1 turns remat on; BENCH_GPT_REMAT_POLICY picks
+        # the jax.checkpoint policy (full | dots | dots_with_no_batch_dims
+        # — selective remat keeps matmul outputs, enabling larger batch
+        # at far less recompute than "full").
         checkpoint_activations=os.environ.get("BENCH_GPT_REMAT",
                                               "0") == "1",
+        checkpoint_policy=os.environ.get("BENCH_GPT_REMAT_POLICY",
+                                         "full"),
         dtype=jnp.bfloat16)
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(jax.random.fold_in(key, 1),

@@ -226,3 +226,39 @@ class TestMoEFlaxLayer:
                 sharded, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    rtol=2e-5, atol=1e-6)
+
+    def test_moe_layer_tp_x_ep_composition(self):
+        """TP x EP: the MoE transformer layer on a 2D
+        ('tensor','expert') mesh — attention/LN weights sharded on
+        'tensor' (from the layer's own flax partition metadata), expert
+        weights on 'expert' — must compile under GSPMD and match the
+        single-device result."""
+        from jax.sharding import NamedSharding
+
+        from apex_tpu.testing.standalone_gpt import boxed_specs, unbox
+        from apex_tpu.transformer.layers_moe import (
+            MoEParallelTransformerLayer)
+
+        layer = MoEParallelTransformerLayer(
+            hidden_size=H, num_attention_heads=4, num_experts=E,
+            attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+            capacity_factor=8.0)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, H)) * 0.5
+        variables = layer.init(jax.random.PRNGKey(1), x)
+        y_ref, aux_ref = layer.apply(variables, x)
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("tensor", "expert"))
+        params = unbox(variables["params"])
+        specs = boxed_specs(variables["params"])
+        specs["mlp_module"]["wi"] = P("expert")
+        specs["mlp_module"]["wo"] = P("expert")
+        sharded = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, specs)
+        with mesh:
+            y, aux = jax.jit(
+                lambda p, x: layer.apply({"params": p}, x))(sharded, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)

@@ -27,6 +27,38 @@ DATA = parallel_state.DATA_AXIS
 VOCAB, HID, HEADS, SEQ = 64, 32, 4, 16
 
 
+class TestCheckpointPolicy:
+    """Selective remat (jax.checkpoint policies) must be gradient-exact
+    vs no remat — it only changes what is recomputed, never the math."""
+
+    @pytest.mark.parametrize("policy", ["full", "dots",
+                                        "dots_with_no_batch_dims"])
+    def test_remat_policy_grads_match_no_remat(self, policy):
+        kw = dict(vocab_size=VOCAB, hidden_size=HID, num_layers=2,
+                  num_attention_heads=HEADS, max_sequence_length=SEQ,
+                  attention_dropout=0.0, hidden_dropout=0.0,
+                  use_flash=False)
+        plain = GPTModel(**kw)
+        remat = GPTModel(**kw, checkpoint_activations=True,
+                         checkpoint_policy=policy)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, SEQ), 0,
+                                    VOCAB)
+        labels = jnp.roll(tokens, -1, axis=-1)
+        variables = plain.init(jax.random.PRNGKey(0), tokens)
+
+        def loss(model, p):
+            logits = model.apply(p, tokens)
+            return gpt_loss(logits, labels)
+
+        l0, g0 = jax.value_and_grad(lambda p: loss(plain, p))(variables)
+        l1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(variables)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            g0, g1)
+
+
 class TestGPTTensorParallel:
     def _models(self, use_flash=False):
         kw = dict(vocab_size=VOCAB, hidden_size=HID, num_layers=2,
