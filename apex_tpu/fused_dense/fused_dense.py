@@ -57,9 +57,15 @@ class FusedDenseGeluDense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from jax.ad_checkpoint import checkpoint_name
+
         h = FusedDense(self.intermediate_features,
                        param_dtype=self.param_dtype, name="dense1")(x)
+        # Wide-intermediate tag: under the "all_but_ffn_wide" remat
+        # policy (tensor_parallel.random.CHECKPOINT_POLICIES) these are
+        # recomputed in the backward instead of saved.
+        h = checkpoint_name(h, "ffn_wide")
         h = jax.nn.gelu(h.astype(jnp.float32), approximate=False)
-        h = h.astype(x.dtype)
+        h = checkpoint_name(h.astype(x.dtype), "ffn_wide")
         return FusedDense(self.out_features,
                           param_dtype=self.param_dtype, name="dense2")(h)

@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ..normalization import FusedLayerNorm
 from ..ops.flash_attention import flash_attention
@@ -67,7 +68,10 @@ class ParallelMLP(nn.Module):
         h = ColumnParallelLinear(self.hidden_size, ffn, gather_output=False,
                                  dtype=self.dtype, axis_name=self.axis_name,
                                  name="dense_h_to_4h")(x)
-        h = self.activation(h)
+        # Tag the wide intermediates so the "all_but_ffn_wide" remat
+        # policy can drop exactly these (no-op under other policies).
+        h = checkpoint_name(h, "ffn_wide")
+        h = checkpoint_name(self.activation(h), "ffn_wide")
         return RowParallelLinear(ffn, self.hidden_size,
                                  input_is_parallel=True, dtype=self.dtype,
                                  axis_name=self.axis_name,

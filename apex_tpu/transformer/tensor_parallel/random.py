@@ -104,6 +104,13 @@ CHECKPOINT_POLICIES = {
     "full": _policies.nothing_saveable,
     "dots": _policies.dots_saveable,
     "dots_with_no_batch_dims": _policies.dots_with_no_batch_dims_saveable,
+    # Transformer sweet spot on TPU: save every residual EXCEPT the
+    # 4x-wide FFN intermediates (tagged "ffn_wide" in ParallelMLP /
+    # FusedDenseGeluDense) — those dominate per-layer activation HBM
+    # (width 4h in bf16), and recomputing them in the backward costs one
+    # h->4h matmul + gelu per layer (~+4% model FLOPs for GPT shapes).
+    "all_but_ffn_wide":
+        _policies.save_anything_except_these_names("ffn_wide"),
 }
 
 

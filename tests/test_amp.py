@@ -198,6 +198,41 @@ def test_o5_master_weights_step():
         np.asarray(new_state.master_params["w"].astype(jnp.bfloat16)))
 
 
+def test_static_scale_steps_unconditionally_reference_parity():
+    """apex's static LossScaler never skips (update_scale: should_skip
+    only when dynamic) — so the static path must not inspect grads and
+    must step even on inf; check_finite=True restores the skip."""
+    params = _toy_params()
+    inf_grads = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, jnp.inf), params)
+
+    opt = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O5"))
+    state = opt.init(params)
+    new_params, _, info = jax.jit(opt.apply_gradients)(
+        inf_grads, state, params)
+    assert bool(info.grads_finite)  # "unchecked", reported True
+    assert not np.isfinite(np.asarray(new_params["w"])).all()  # stepped
+
+    forced = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O5"),
+                              check_finite=True)
+    fstate = forced.init(params)
+    held_params, _, finfo = jax.jit(forced.apply_gradients)(
+        inf_grads, fstate, params)
+    assert not bool(finfo.grads_finite)
+    np.testing.assert_array_equal(np.asarray(held_params["w"]),
+                                  np.asarray(params["w"]))  # held
+
+
+def test_check_finite_false_rejected_for_dynamic():
+    params = _toy_params()
+    opt = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O2"),
+                           check_finite=False)
+    state = opt.init(params)
+    with pytest.raises(ValueError, match="dynamic"):
+        opt.apply_gradients(jax.tree_util.tree_map(jnp.zeros_like, params),
+                            state, params)
+
+
 def test_overflow_skips_step_and_backs_off():
     params = _toy_params()
     opt = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O2"))
