@@ -71,7 +71,13 @@ def _timeit(fn, *args, iters=10, warmup=2):
 # --------------------------------------------------------------------------
 
 def bench_resnet50():
-    policy = amp.get_policy("O5")
+    # BENCH_RN50_BN32=0 runs batchnorm in bf16 — the reference's
+    # "speed of light" config (ref: examples/imagenet/README.md:76-84
+    # "Performance is best with fp16 batchnorm").
+    if os.environ.get("BENCH_RN50_BN32", "1") == "0":
+        policy = amp.get_policy("O5", keep_batchnorm_fp32=False)
+    else:
+        policy = amp.get_policy("O5")
     model = ResNet50(num_classes=1000, dtype=policy.compute_dtype)
     key = jax.random.PRNGKey(0)
     variables = jax.jit(model.init, static_argnames="train")(
