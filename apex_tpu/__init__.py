@@ -52,6 +52,6 @@ def __getattr__(name):
     if name in ("amp", "optimizers", "ops", "normalization", "parallel",
                 "transformer", "models", "utils", "contrib", "fp16_utils",
                 "mlp", "fused_dense", "reparameterization", "testing",
-                "pyprof"):
+                "pyprof", "data"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

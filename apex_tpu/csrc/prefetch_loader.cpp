@@ -1,0 +1,264 @@
+// Native prefetching batch loader — the runtime side of the input
+// pipeline.
+//
+// TPU-native counterpart of the reference's input pipeline
+// (ref: examples/imagenet/main_amp.py:228-236 torch.utils.data.DataLoader
+// with worker processes; torch's loader core is C++).  Design differs
+// deliberately: instead of worker *processes* deserializing Python
+// objects, a C++ thread pool gathers batches out of a memory-mapped (or
+// otherwise resident) dataset into a fixed ring of pinned host buffers,
+// ahead of the training loop.  Python hands us raw pointers (numpy
+// memmap) — this file owns scheduling, shuffling and assembly only, so
+// it composes with any storage layer.
+//
+// Contract:
+//   * loader_create(...) -> opaque handle; spawns `num_threads` workers
+//     that fill a `prefetch_depth`-deep queue of assembled batches.
+//   * loader_next(handle, out_x, out_y) copies the next ready batch into
+//     caller buffers (blocking; GIL is released by ctypes during the
+//     call, so workers and the training loop overlap).
+//   * Epochs are implicit: after the last batch of an epoch the index
+//     permutation is re-drawn from (seed, epoch) — deterministic across
+//     runs and across loader restarts (resume = recreate + skip).
+//   * drop_last semantics: only full batches are served
+//     (n / batch per epoch), matching the bench/convergence drivers.
+//
+// Build: see apex_tpu/data/_build.py (single g++ -O3 -shared -fPIC
+// -pthread invocation, no external deps).
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  int64_t epoch;
+  int64_t index;  // batch index within the epoch
+  std::vector<float> x;
+  std::vector<int32_t> y;
+};
+
+struct Loader {
+  // Dataset views (not owned).
+  const uint8_t* images;   // n * item_elems elements, dtype below
+  const int32_t* labels;   // n
+  int64_t n;
+  int64_t item_elems;      // elements per image (H*W*C)
+  int dtype;               // 0 = float32, 1 = uint8 (normalized to f32)
+  // Normalization applied when dtype == uint8: (v/255 - mean[c]) / std[c]
+  // with c = flat_index % channels (NHWC).
+  std::vector<float> mean, stdev;
+  int64_t channels;
+
+  int64_t batch;
+  uint64_t seed;
+  int64_t prefetch_depth;
+  int64_t n_threads;  // fixed before workers start (workers.size() is
+                      // not safe to read while loader_create populates)
+
+  // Work scheduling: a single monotonically increasing batch cursor;
+  // workers claim (epoch, index) pairs and insert assembled batches
+  // into an ordered ready-map so consumers see epoch order even with
+  // several workers racing.
+  std::atomic<int64_t> cursor{0};
+  int64_t batches_per_epoch;
+
+  std::mutex mu;
+  std::condition_variable ready_cv;
+  std::condition_variable space_cv;
+  // Batches completed but not yet consumed, keyed by global index.
+  std::vector<Batch> ready;  // unordered; consumer searches for `next`
+  int64_t next = 0;          // next global batch index to hand out
+  bool stop = false;
+
+  std::vector<std::thread> workers;
+
+  // Per-epoch shuffle permutations, cached so each epoch's sort runs
+  // once, not once per batch; only a sliding window of recent epochs
+  // is kept.  The shuffle is sort-by-splitmix64-key — a deliberate
+  // choice over Fisher-Yates: it has no stdlib-RNG dependence (libc++
+  // and libstdc++ disagree on std::uniform_int_distribution), so the
+  // Python fallback reproduces it bitwise with vectorized numpy (see
+  // apex_tpu/data/loader.py _epoch_perm; parity is tested).
+  std::mutex perm_mu;
+  std::map<int64_t, std::shared_ptr<const std::vector<int64_t>>> perms;
+
+  static uint64_t splitmix64(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+  }
+
+  std::shared_ptr<const std::vector<int64_t>> perm_for(int64_t epoch) {
+    std::lock_guard<std::mutex> lk(perm_mu);
+    auto it = perms.find(epoch);
+    if (it != perms.end()) return it->second;
+    auto p = std::make_shared<std::vector<int64_t>>(n);
+    for (int64_t i = 0; i < n; ++i) (*p)[i] = i;
+    if (seed != 0) {  // seed 0 = no shuffle (sequential order)
+      const uint64_t base =
+          splitmix64(seed ^ (0x9e3779b97f4a7c15ull
+                             * static_cast<uint64_t>(epoch + 1)));
+      std::vector<uint64_t> key(n);
+      for (int64_t i = 0; i < n; ++i)
+        key[i] = splitmix64(base + static_cast<uint64_t>(i));
+      std::stable_sort(p->begin(), p->end(),
+                       [&](int64_t a, int64_t b) {
+                         return key[a] < key[b];
+                       });
+    }
+    perms[epoch] = p;
+    while (perms.size() > 4) perms.erase(perms.begin());
+    return p;
+  }
+
+  void assemble(Batch& b) {
+    // Hold the shared_ptr for the whole assembly: the cache may evict
+    // this epoch concurrently, and the map reference must not be the
+    // only owner while we index into the vector.
+    const std::shared_ptr<const std::vector<int64_t>> perm_owner =
+        perm_for(b.epoch);
+    const std::vector<int64_t>& perm = *perm_owner;
+    b.x.resize(batch * item_elems);
+    b.y.resize(batch);
+    const int64_t base = b.index * batch;
+    for (int64_t r = 0; r < batch; ++r) {
+      const int64_t src = perm[base + r];
+      b.y[r] = labels[src];
+      float* dst = b.x.data() + r * item_elems;
+      if (dtype == 0) {
+        std::memcpy(dst, reinterpret_cast<const float*>(images) +
+                             src * item_elems,
+                    item_elems * sizeof(float));
+      } else {
+        const uint8_t* s = images + src * item_elems;
+        for (int64_t j = 0; j < item_elems; ++j) {
+          const int64_t c = channels ? (j % channels) : 0;
+          const float m = mean.empty() ? 0.f : mean[c];
+          const float sd = stdev.empty() ? 1.f : stdev[c];
+          dst[j] = (static_cast<float>(s[j]) / 255.f - m) / sd;
+        }
+      }
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      const int64_t g = cursor.fetch_add(1);
+      Batch b;
+      b.epoch = g / batches_per_epoch;
+      b.index = g % batches_per_epoch;
+      assemble(b);
+      std::unique_lock<std::mutex> lk(mu);
+      // Bound memory: don't run further than prefetch_depth ahead of
+      // the consumer.
+      space_cv.wait(lk, [&] {
+        return stop || g < next + prefetch_depth + n_threads;
+      });
+      if (stop) return;
+      b.epoch = g;  // reuse field as the global index for ordering
+      ready.push_back(std::move(b));
+      ready_cv.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* loader_create(const void* images, const int32_t* labels, int64_t n,
+                    int64_t item_elems, int dtype, const float* mean,
+                    const float* stdev, int64_t channels, int64_t batch,
+                    uint64_t seed, int64_t num_threads,
+                    int64_t prefetch_depth, int64_t start_batch) {
+  auto* L = new Loader();
+  L->images = static_cast<const uint8_t*>(images);
+  L->labels = labels;
+  L->n = n;
+  L->item_elems = item_elems;
+  L->dtype = dtype;
+  L->channels = channels;
+  if (mean)
+    L->mean.assign(mean, mean + channels);
+  if (stdev)
+    L->stdev.assign(stdev, stdev + channels);
+  L->batch = batch;
+  L->seed = seed;
+  L->prefetch_depth = prefetch_depth < 1 ? 1 : prefetch_depth;
+  L->batches_per_epoch = n / batch;
+  if (L->batches_per_epoch < 1) {
+    delete L;
+    return nullptr;
+  }
+  // O(1) resume: start both the work cursor and the consumer cursor at
+  // start_batch so no skipped batch is ever assembled.
+  L->cursor.store(start_batch < 0 ? 0 : start_batch);
+  L->next = start_batch < 0 ? 0 : start_batch;
+  L->n_threads = num_threads < 1 ? 1 : num_threads;
+  for (int64_t i = 0; i < L->n_threads; ++i)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+// Copies the next batch into out_x (batch*item_elems floats) and out_y
+// (batch int32).  Returns the global batch index (>= 0), or -1 if the
+// loader was destroyed while waiting.
+int64_t loader_next(void* handle, float* out_x, int32_t* out_y) {
+  auto* L = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(L->mu);
+  const int64_t want = L->next;
+  Batch got;
+  bool found = false;
+  L->ready_cv.wait(lk, [&] {
+    if (L->stop) return true;
+    for (size_t i = 0; i < L->ready.size(); ++i) {
+      if (L->ready[i].epoch == want) {  // .epoch reused as global index
+        got = std::move(L->ready[i]);
+        L->ready.erase(L->ready.begin() + i);
+        found = true;
+        return true;
+      }
+    }
+    return false;
+  });
+  if (!found) return -1;  // shut down while waiting
+  L->next = want + 1;
+  L->space_cv.notify_all();
+  lk.unlock();
+  std::memcpy(out_x, got.x.data(), got.x.size() * sizeof(float));
+  std::memcpy(out_y, got.y.data(), got.y.size() * sizeof(int32_t));
+  return want;
+}
+
+int64_t loader_batches_per_epoch(void* handle) {
+  return static_cast<Loader*>(handle)->batches_per_epoch;
+}
+
+// Contract: must not run concurrently with loader_next on the same
+// handle from another thread — a blocked loader_next wakes and returns
+// -1 on stop, but the caller must have returned before the handle is
+// destroyed (the Python wrapper is single-consumer and serializes
+// close() with iteration).
+void loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop = true;
+  }
+  L->space_cv.notify_all();
+  L->ready_cv.notify_all();
+  for (auto& w : L->workers) w.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,9 @@
+"""Input pipeline: native prefetching loader + device-transfer overlap.
+
+Counterpart of the reference training scripts' ``torch.utils.data``
+usage (ref: examples/imagenet/main_amp.py:228-236); see
+:mod:`apex_tpu.data.loader` for the TPU-first design notes.
+"""
+from .loader import DataLoader, device_prefetch, native_available
+
+__all__ = ["DataLoader", "device_prefetch", "native_available"]

@@ -1,0 +1,47 @@
+"""Compile-on-first-use build for the native loader.
+
+No pip, no cmake: one ``g++ -O3 -shared -fPIC -pthread`` invocation,
+cached next to the source keyed by source mtime.  Absence of a compiler
+degrades gracefully — the Python fallback loader has identical
+semantics (tests assert parity).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "csrc", "prefetch_loader.cpp")
+_OUT = os.path.join(os.path.dirname(_SRC), "_build",
+                    "libprefetch_loader.so")
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def native_library_path(rebuild: bool = False) -> str:
+    """Return the path of the compiled shared library, building it if
+    the cache is stale.  Raises :class:`NativeBuildError` when no
+    compiler is available or compilation fails."""
+    with _LOCK:
+        if (not rebuild and os.path.exists(_OUT)
+                and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+            return _OUT
+        cxx = os.environ.get("CXX") or shutil.which("g++") \
+            or shutil.which("c++")
+        if cxx is None:
+            raise NativeBuildError("no C++ compiler on PATH")
+        os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+        tmp = _OUT + ".tmp"
+        cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               _SRC, "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"{' '.join(cmd)} failed:\n{proc.stderr[-2000:]}")
+        os.replace(tmp, _OUT)
+        return _OUT

@@ -49,6 +49,15 @@ def parse_args(argv=None):
     p.add_argument("--data", default=None,
                    help="path to an .npz with images/labels (default: "
                         "synthetic)")
+    p.add_argument("--loader", default="slice",
+                   choices=("slice", "auto", "native", "python"),
+                   help="npz batching: 'slice' = sequential wraparound "
+                        "slices (bitwise-stable legacy path); others use "
+                        "apex_tpu.data.DataLoader (per-epoch shuffle, "
+                        "C++ prefetch workers when 'native'/'auto', the "
+                        "reference's DataLoader(num_workers) analogue) "
+                        "with device-transfer overlap")
+    p.add_argument("--loader-threads", type=int, default=2)
     p.add_argument("--synthetic", action="store_true",
                    help="train on synthetic random data")
     p.add_argument("--arch", default="resnet50")
@@ -240,11 +249,26 @@ def main(argv=None):
     step = start_step
     data_key = jax.random.PRNGKey(args.seed + 1)
     npz = np.load(args.data) if args.data else None
+    loader = None
+    if npz is not None and args.loader != "slice":
+        from apex_tpu.data import DataLoader, device_prefetch
+
+        # Images pass through as stored (float32, or uint8 normalized by
+        # the loader's C++ path); start_batch gives O(1) deterministic
+        # resume — skipped batches are never assembled.
+        loader = DataLoader(
+            npz["images"], np.asarray(npz["labels"]), args.batch_size,
+            seed=args.seed + 1, num_threads=args.loader_threads,
+            backend=args.loader, start_batch=start_step)
+        batches = iter(device_prefetch(loader, size=2))
     t_start = time.time()
     with mesh:
         for epoch in range(args.epochs):
             for it in range(args.iters):
-                if npz is not None:
+                if loader is not None:
+                    images, labels = next(batches)
+                    images = images.astype(policy.compute_dtype)
+                elif npz is not None:
                     lo = (step * args.batch_size) % len(npz["images"])
                     images = jnp.asarray(
                         npz["images"][lo:lo + args.batch_size])

@@ -47,6 +47,25 @@ class TestImagenetDriverNpz:
         ])
         assert final_loss < 0.9, f"no convergence on npz data: {final_loss}"
 
+    def test_native_loader_convergence_and_determinism(self, tmp_path):
+        """The DataLoader path (C++ prefetch workers when available)
+        must also learn, and be run-to-run deterministic despite
+        multithreaded prefetch."""
+        npz = _make_npz(str(tmp_path / "tinyL.npz"))
+        argv = [
+            "--data", npz, "--arch", "resnet_tiny",
+            "--devices", "1", "--loader", "auto", "--loader-threads", "3",
+            "--batch-size", "32", "--iters", "60", "--epochs", "1",
+            "--image-size", "32", "--num-classes", "4",
+            "--lr", "0.02", "--opt-level", "O5", "--deterministic",
+            "--print-freq", "50",
+            "--checkpoint", str(tmp_path / "ckL.msgpack"),
+        ]
+        first = main_amp.main(argv)
+        second = main_amp.main(argv)
+        assert first < 0.9, f"no convergence via DataLoader: {first}"
+        assert first == second, (first, second)
+
     def test_npz_deterministic_across_runs(self, tmp_path):
         """Same seed + deterministic flag => bitwise-equal loss curves
         (the L1 compare.py exact-equality oracle,
