@@ -5,9 +5,10 @@ TPU-native equivalent of the reference's pipelined distributed LAMB
 reduce_scatter + allreduce pipeline :590-612, L2-norm pipelining, param
 all_gather after step).  LAMB's per-tensor trust ratios need norms over
 tensors that straddle shard boundaries: each device computes per-tensor
-partial sums over its shard via segment reduction, one ``psum`` restores
-the full per-tensor norms, and the trust ratio is gathered back
-per-element — the collective form of the reference's two-phase
+partial sums over its shard via segment reduction (ids computed on
+device — no packed-length constants), one ``psum`` restores the full
+per-tensor norms, and the trust ratio is gathered back per-element —
+the collective form of the reference's two-phase
 ``multi_tensor_l2norm`` + ``multi_tensor_lamb`` kernels.
 """
 from __future__ import annotations
@@ -72,7 +73,7 @@ def distributed_fused_lamb(
         pbufs = multi_tensor.pack(params, metas)
 
         # Stage 1a: reduce-scatter grads to shards.
-        g_shards, p_shards, seg_shards, paddeds = [], [], [], []
+        g_shards, p_shards, seg_shards = [], [], []
         for i, meta in enumerate(metas):
             padded = _shard_padded(meta, world)
             shard = padded // world
@@ -86,19 +87,28 @@ def distributed_fused_lamb(
             if grad_average:
                 g_sh = g_sh / world
             p_sh = jax.lax.dynamic_slice_in_dim(p, rank * shard, shard)
-            segs = jnp.pad(
-                multi_tensor.segment_ids(meta),
-                (0, padded - meta.padded),
-                constant_values=len(meta.sizes))
-            seg_sh = jax.lax.dynamic_slice_in_dim(segs, rank * shard, shard)
+            # Per-element tensor ids for this shard, computed on device
+            # (positions depend on the traced rank; a materialized
+            # full-buffer id constant would explode program size — see
+            # multi_tensor.device_segment_ids).
+            idx = rank * shard + jnp.arange(shard, dtype=jnp.int32)
+            seg_sh = multi_tensor.device_segment_ids(meta, idx)
             g_shards.append(g_sh)
             p_shards.append(p_sh)
             seg_shards.append(seg_sh)
-            paddeds.append(padded)
 
         # Stage 1b: global grad norm for clipping
         # (ref: distributed_fused_lamb.py L2-norm pipelining + clip).
-        local_sq = sum(jnp.sum(g * g) for g in g_shards)
+        # Reduce over (rows, LANE) views where possible — flat 1-D
+        # mega-vector reduces make XLA:TPU materialize an (N/2, 2)
+        # pair-layout temp with 64x lane padding (see
+        # multi_tensor.per_tensor_sumsq).
+        def _sumsq(g):
+            if g.ndim == 1 and g.size and g.size % multi_tensor.LANE == 0:
+                g = g.reshape(-1, multi_tensor.LANE)
+            return jnp.sum(g * g)
+
+        local_sq = sum(_sumsq(g) for g in g_shards)
         gnorm = jnp.sqrt(jax.lax.psum(local_sq, axis_name))
         clip = jnp.where(gnorm > max_grad_norm,
                          max_grad_norm / jnp.maximum(gnorm, 1e-12), 1.0) \
@@ -106,7 +116,6 @@ def distributed_fused_lamb(
 
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
-            nseg = len(meta.sizes) + 1  # +1 for padding segment
             g = g_shards[i] * clip
             p = p_shards[i]
             segs = seg_shards[i]
@@ -118,17 +127,27 @@ def distributed_fused_lamb(
             else:
                 upd = upd  # L2 mode folds decay into g pre-moment; keep
                 # AdamW default as the reference's distributed LAMB does.
-            # Stage 2: per-tensor norms across shard boundaries.
+            # Stage 2: per-tensor norms across shard boundaries: per-
+            # shard segment sums (ids computed on device, see
+            # device_segment_ids) + one psum.  segment_sum keeps exact
+            # per-segment accumulation (a cumsum range-difference would
+            # lose small late tensors to fp32 cancellation); the
+            # scatter's (index, update) pair temp is bounded by the
+            # ZeRO shard size, 1/world of the group.
+            nseg = len(meta.sizes) + 1
             w_sq = jax.lax.psum(
-                jax.ops.segment_sum(p * p, segs, num_segments=nseg),
+                jax.ops.segment_sum(p * p, segs, num_segments=nseg)[:-1],
                 axis_name)
             u_sq = jax.lax.psum(
-                jax.ops.segment_sum(upd * upd, segs, num_segments=nseg),
+                jax.ops.segment_sum(upd * upd, segs,
+                                    num_segments=nseg)[:-1],
                 axis_name)
             w_norm = jnp.sqrt(w_sq)
             u_norm = jnp.sqrt(u_sq)
             ratio = jnp.where((w_norm > 0) & (u_norm > 0),
                               w_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+            ratio = jnp.concatenate(
+                [ratio, jnp.ones((1,), jnp.float32)])  # padding id
             delta_sh = -lr * ratio[segs] * upd
             full = jax.lax.all_gather(delta_sh, axis_name, tiled=True)
             deltas.append(full[:meta.padded])

@@ -59,17 +59,37 @@ class FlatMeta:
 # parity or better.
 DIRECT_MIN_ELEMS = 1 << 22
 
+# Upper bound on a single packed group's element count (split_direct
+# consumers only; classic one-group-per-dtype callers like ZeRO keep a
+# monolithic buffer by design).  Empirical TPU-compiler guard: in large
+# fused programs, XLA materialized a ~10^8-element packed fp32 buffer as
+# an (N/2, 2) pair-layout temp whose 2->128 lane padding is 64x the data
+# (26.5 GB at BERT-large — compile-time OOM).  Bounded chunks keep the
+# multi-tensor launch-amortization win while capping any such temp.
+PACK_MAX_ELEMS = 1 << 24
+
 
 def _group_leaves(leaves, split_direct: bool = False) -> dict:
-    """leaf indices by (dtype, bucket): bucket None = shared per-dtype
-    pack, bucket i = leaf i's own direct group (split_direct only)."""
+    """leaf indices by (dtype, bucket): bucket None/int chunk id =
+    shared per-dtype pack (chunked at PACK_MAX_ELEMS), bucket
+    ("direct", i) = leaf i's own direct group (split_direct only)."""
     groups: dict = {}
+    if not split_direct:
+        for i, leaf in enumerate(leaves):
+            arr = jnp.asarray(leaf)
+            groups.setdefault((arr.dtype, None), []).append(i)
+        return groups
+    fill: dict = {}  # dtype -> (chunk id, elems in chunk)
     for i, leaf in enumerate(leaves):
         arr = jnp.asarray(leaf)
-        if split_direct and arr.size >= DIRECT_MIN_ELEMS:
-            groups[(arr.dtype, i)] = [i]
-        else:
-            groups.setdefault((arr.dtype, None), []).append(i)
+        if arr.size >= DIRECT_MIN_ELEMS:
+            groups[(arr.dtype, ("direct", i))] = [i]
+            continue
+        chunk, used = fill.get(arr.dtype, (0, 0))
+        if used and used + arr.size > PACK_MAX_ELEMS:
+            chunk, used = chunk + 1, 0
+        fill[arr.dtype] = (chunk, used + arr.size)
+        groups.setdefault((arr.dtype, chunk), []).append(i)
     return groups
 
 
@@ -101,9 +121,10 @@ def compute_metas(tree: Any, align: int = 1,
             off += -(-s // align) * align
         total = off
         padded = max(_PAD_TO, -(-total // _PAD_TO) * _PAD_TO)
-        metas.append(FlatMeta(treedef, tuple(idxs), shapes, sizes,
-                              tuple(offsets), total, padded, dtype,
-                              direct=bucket is not None))
+        metas.append(FlatMeta(
+            treedef, tuple(idxs), shapes, sizes, tuple(offsets), total,
+            padded, dtype,
+            direct=isinstance(bucket, tuple) and bucket[0] == "direct"))
     return metas
 
 
@@ -224,11 +245,82 @@ def unpack_groups(buffers: Sequence[jnp.ndarray],
 
 def segment_ids(meta: FlatMeta) -> jnp.ndarray:
     """Per-element tensor index for a packed buffer (padding gets the id
-    ``len(sizes)``); used for per-tensor norms (LAMB/NovoGrad)."""
+    ``len(sizes)``).
+
+    NOTE: prefer :func:`per_tensor_sumsq` / :func:`broadcast_per_tensor`
+    for per-tensor norm work — this materializes a host constant of
+    ``padded`` elements, which inlines into the program text and
+    explodes lowering size at scale (measured: 88 MB of StableHLO for a
+    2-layer BERT train step; an HTTP-413 compile-request rejection at
+    24 layers).  Kept for small buffers and tests."""
     ids = np.full((meta.padded,), len(meta.sizes), np.int32)
     for k, (o, s) in enumerate(zip(meta.offsets, meta.sizes)):
         ids[o:o + s] = k
     return jnp.asarray(ids)
+
+
+def per_tensor_sumsq(buf: jnp.ndarray, meta: FlatMeta) -> jnp.ndarray:
+    """Per-tensor sum-of-squares over a packed fp32 buffer, one entry
+    per leaf, via *static* slices (offsets/sizes are Python ints).
+
+    This is the multi_tensor_l2norm(per_tensor=True) role
+    (ref: csrc/multi_tensor_l2norm_kernel.cu) in a form whose program
+    size is O(n_leaves) — no scatter/segment ops, no packed-length
+    index constants (which OOM/413 at BERT-large scale).
+
+    Each slice spans to the next LANE-aligned offset (the padding gap
+    belongs to its preceding tensor; gaps are zero in every packed
+    buffer, contributing nothing to a sum of squares) so the reduction
+    input reshapes to (rows, LANE) — a flat mega-vector reduce makes
+    XLA:TPU materialize an (N/2, 2) stage whose lane padding is 64x
+    the data."""
+    x = buf.astype(jnp.float32)
+    sums = []
+    for k, o in enumerate(meta.offsets):
+        end = meta.offsets[k + 1] if k + 1 < len(meta.offsets) \
+            else meta.padded
+        seg = jax.lax.slice_in_dim(x, o, end)
+        if seg.size and seg.size % LANE == 0:
+            seg = seg.reshape(-1, LANE)
+        sums.append(jnp.sum(seg ** 2))
+    return jnp.stack(sums)
+
+
+def device_segment_ids(meta: FlatMeta, idx: jnp.ndarray) -> jnp.ndarray:
+    """Tensor index for arbitrary (possibly traced) packed-buffer
+    positions ``idx``; padding gaps map to ``len(sizes)``.
+
+    On-device binary search over the tiny offset table
+    (``jnp.searchsorted`` scan method — log(n_leaves) fused gathers per
+    element, no packed-length constants, no (N, k) temporaries), for
+    callers whose positions are dynamic — e.g. ZeRO shards indexed by
+    ``axis_index`` (distributed_fused_lamb)."""
+    starts = jnp.asarray(meta.offsets, jnp.int32)
+    ends = starts + jnp.asarray(meta.sizes, jnp.int32)
+    idx = idx.astype(jnp.int32)
+    k = jnp.searchsorted(starts, idx, side="right").astype(jnp.int32) - 1
+    k_safe = jnp.clip(k, 0, len(meta.sizes) - 1)
+    ok = (k >= 0) & (idx < ends[k_safe])
+    return jnp.where(ok, k_safe, jnp.int32(len(meta.sizes)))
+
+
+def broadcast_per_tensor(values: jnp.ndarray, meta: FlatMeta,
+                         fill: float = 1.0) -> jnp.ndarray:
+    """Expand per-tensor scalars ``values[k]`` back to a packed-buffer
+    element array (padding gaps get ``fill``) — the stage-2 broadcast of
+    the reference's LAMB/NovoGrad kernels, with the same O(n_leaves)
+    program-size property as :func:`per_tensor_sumsq`."""
+    pieces = []
+    pos = 0
+    for k, (o, s) in enumerate(zip(meta.offsets, meta.sizes)):
+        if o > pos:
+            pieces.append(jnp.full((o - pos,), fill, jnp.float32))
+        pieces.append(jnp.broadcast_to(values[k].astype(jnp.float32),
+                                       (s,)))
+        pos = o + s
+    if meta.padded > pos:
+        pieces.append(jnp.full((meta.padded - pos,), fill, jnp.float32))
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
 # --- amp_C-parity ops ------------------------------------------------------

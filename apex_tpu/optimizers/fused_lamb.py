@@ -110,7 +110,18 @@ def _global_grad_clip(gbufs, max_norm):
     disables clipping.  Mixed-precision LAMB passes
     ``max_grad_norm * loss_scale`` because its norm is of scaled grads
     (ref: fused_mixed_precision_lamb.py:182-184)."""
-    gsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gbufs)
+    # Reduce over (rows, LANE) views, never a flat mega-vector: XLA:TPU
+    # splits huge 1-D reductions into an (N/2, 2) stage whose
+    # lane-padded buffer is 64x the data (26.5 GB at BERT-large — a
+    # compile-time OOM).  Packed buffers are LANE-aligned; native-shape
+    # DIRECT leaves are already >=2-D or small.
+    def _sumsq(g):
+        g = g.astype(jnp.float32)
+        if g.ndim == 1 and g.size % multi_tensor.LANE == 0 and g.size:
+            g = g.reshape(-1, multi_tensor.LANE)
+        return jnp.sum(jnp.square(g))
+
+    gsq = sum(_sumsq(g) for g in gbufs)
     gnorm = jnp.sqrt(gsq)
     # The enable decision must be static (max_norm may be a traced value
     # when the caller scales it by a traced loss scale — pass None to
@@ -152,15 +163,15 @@ def _lamb_group_update(meta, gbuf, pbuf, m, v, *, gscale, beta1, beta2,
 
 
 def _trust_ratio_elem(meta, u, p32, use_nvlamb, weight_decay):
-    """Phase 2 ratios: per-tensor param/update norms via segment
-    reductions over the packed buffer, broadcast back per element
-    (ref: multi_tensor_lamb.cu:230-330 LAMBStage2; per-tensor norms are
-    the l2norm kernel's per_tensor=True output).  LANE-aligned packing
-    interleaves the padding id between real segments, so the ids are
-    NOT sorted — no indices_are_sorted promise.
+    """Phase 2 ratios: per-tensor param/update norms broadcast back per
+    element (ref: multi_tensor_lamb.cu:230-330 LAMBStage2; per-tensor
+    norms are the l2norm kernel's per_tensor=True output).  Packed
+    groups use static-slice reductions — no segment ops, whose
+    packed-length index arrays explode program size at BERT-large scale
+    (see multi_tensor.per_tensor_sumsq).
 
     DIRECT groups (one native-shape leaf) reduce over the whole buffer
-    — one scalar ratio, no segments, no packing."""
+    — one scalar ratio, no packing."""
     if multi_tensor.is_direct(meta):
         if use_nvlamb or weight_decay != 0.0:
             p_n2 = jnp.sum(p32 * p32)
@@ -170,19 +181,16 @@ def _trust_ratio_elem(meta, u, p32, use_nvlamb, weight_decay):
                 jnp.sqrt(p_n2) / jnp.sqrt(jnp.maximum(u_n2, 1e-24)),
                 1.0)
         return jnp.float32(1.0)
-    seg = multi_tensor.segment_ids(meta)
-    n_seg = len(meta.sizes) + 1  # +1 for padding gaps
-    if use_nvlamb or weight_decay != 0.0:
-        p_nsq = jax.ops.segment_sum(p32 * p32, seg, n_seg)[:-1]
-        u_nsq = jax.ops.segment_sum(u * u, seg, n_seg)[:-1]
-        ratio = jnp.where((p_nsq > 0) & (u_nsq > 0),
-                          jnp.sqrt(p_nsq) / jnp.sqrt(
-                              jnp.maximum(u_nsq, 1e-24)), 1.0)
-    else:
+    if not (use_nvlamb or weight_decay != 0.0):
         # ref: multi_tensor_lamb.cu:258 — plain LAMB leaves zero-decay
         # params un-adapted.
-        ratio = jnp.ones((n_seg - 1,), jnp.float32)
-    return jnp.concatenate([ratio, jnp.ones((1,), jnp.float32)])[seg]
+        return jnp.float32(1.0)
+    p_nsq = multi_tensor.per_tensor_sumsq(p32, meta)
+    u_nsq = multi_tensor.per_tensor_sumsq(u, meta)
+    ratio = jnp.where((p_nsq > 0) & (u_nsq > 0),
+                      jnp.sqrt(p_nsq) / jnp.sqrt(
+                          jnp.maximum(u_nsq, 1e-24)), 1.0)
+    return multi_tensor.broadcast_per_tensor(ratio, meta)
 
 
 def _lamb_phase1_jnp(g, p, m, v, gscale, b1, b2, b3, eps, wd, bc1, bc2,

@@ -81,10 +81,9 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
                 # scalar reduction, no segments
                 gn_sq = jnp.sum(g32 * g32)[None]
             else:
-                seg = multi_tensor.segment_ids(meta)
-                n_seg = len(meta.sizes) + 1
-                # aligned packing interleaves the padding id -> unsorted
-                gn_sq = jax.ops.segment_sum(g32 * g32, seg, n_seg)[:-1]
+                # static-slice per-tensor reductions (no segment ops —
+                # see multi_tensor.per_tensor_sumsq program-size note)
+                gn_sq = multi_tensor.per_tensor_sumsq(g32, meta)
             if init_zero:
                 v_new = beta2 * state.v[i] + (1.0 - beta2) * gn_sq
             else:
@@ -97,8 +96,8 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
             if multi_tensor.is_direct(meta):
                 denom_elem = denom_t[0]  # scalar broadcast
             else:
-                denom_elem = jnp.concatenate(
-                    [denom_t, jnp.ones((1,), jnp.float32)])[seg]
+                denom_elem = multi_tensor.broadcast_per_tensor(
+                    denom_t, meta)
             if fused_optim.group_use_pallas(use_pallas, meta) \
                     and not multi_tensor.is_direct(meta):
                 d, m = fused_optim.novograd_update(

@@ -320,6 +320,73 @@ def bench_gpt345m():
             "model_tflops_per_sec": round(flops / dt / 1e12, 1)}
 
 
+# --------------------------------------------------------------------------
+# Extra 4: BERT-large train step (FusedLayerNorm + scaled-masked-softmax
+# Pallas path + FusedLAMB — the BASELINE "BERT-large pretrain" config)
+# --------------------------------------------------------------------------
+
+def bench_bert_large():
+    from apex_tpu.optimizers import fused_lamb
+    from apex_tpu.testing.standalone_bert import BertModel
+
+    seq = int(os.environ.get("BENCH_BERT_SEQ", "512"))
+    batch = int(os.environ.get("BENCH_BERT_BATCH", "8"))
+    vocab, hidden, layers, heads = 30528, 1024, 24, 16
+    if os.environ.get("BENCH_SMOKE") == "1":
+        vocab, hidden, layers, heads = 1024, 256, 2, 4
+    model = BertModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    mask = jnp.ones((batch, seq), jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=-1)
+    nsp = jax.random.randint(jax.random.fold_in(key, 2), (batch,), 0, 2)
+    variables = jax.jit(model.init)(key, tokens, mask)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_lamb(1e-3), opt_level="O5")
+    del variables
+    params, amp_state = jax.tree_util.tree_map(jnp.array,
+                                               (params, amp_state))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, amp_state, tokens, mask, labels, nsp):
+        def loss_fn(p):
+            lm_loss, bin_logits = model.apply(
+                {"params": p}, tokens, mask, lm_labels=labels)
+            nsp_loss = jnp.mean(softmax_cross_entropy_loss(
+                bin_logits, nsp, half_to_float=True))
+            loss = jnp.mean(lm_loss) + nsp_loss
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_state, _ = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, new_state, loss
+
+    p, st = params, amp_state
+    for _ in range(2):
+        p, st, loss = train_step(p, st, tokens, mask, labels, nsp)
+    float(loss)
+    t0 = time.time()
+    n_it = 8
+    for _ in range(n_it):
+        p, st, loss = train_step(p, st, tokens, mask, labels, nsp)
+    float(loss)
+    dt = (time.time() - t0) / n_it
+    flops = 6.0 * n_params * batch * seq \
+        + 12.0 * layers * hidden * batch * seq * seq
+    return {"params_m": round(n_params / 1e6, 1), "seq": seq,
+            "batch": batch, "step_ms": round(dt * 1e3, 1),
+            "tokens_per_sec": round(batch * seq / dt, 0),
+            "model_tflops_per_sec": round(flops / dt / 1e12, 1)}
+
+
 def main():
     if not parallel_state.model_parallel_is_initialized():
         parallel_state.initialize_model_parallel()
@@ -337,6 +404,8 @@ def main():
             extras["collective"] = bench_collective()
             print("[bench] gpt2_345m...", file=sys.stderr)
             extras["gpt2_345m"] = bench_gpt345m()
+            print("[bench] bert_large...", file=sys.stderr)
+            extras["bert_large"] = bench_bert_large()
 
     print(json.dumps({
         "metric": f"resnet50_o5_train_images_per_sec_{n_dev}chip",
