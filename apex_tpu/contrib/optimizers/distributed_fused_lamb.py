@@ -98,17 +98,9 @@ def distributed_fused_lamb(
             seg_shards.append(seg_sh)
 
         # Stage 1b: global grad norm for clipping
-        # (ref: distributed_fused_lamb.py L2-norm pipelining + clip).
-        # Reduce over (rows, LANE) views where possible — flat 1-D
-        # mega-vector reduces make XLA:TPU materialize an (N/2, 2)
-        # pair-layout temp with 64x lane padding (see
-        # multi_tensor.per_tensor_sumsq).
-        def _sumsq(g):
-            if g.ndim == 1 and g.size and g.size % multi_tensor.LANE == 0:
-                g = g.reshape(-1, multi_tensor.LANE)
-            return jnp.sum(g * g)
-
-        local_sq = sum(_sumsq(g) for g in g_shards)
+        # (ref: distributed_fused_lamb.py L2-norm pipelining + clip);
+        # multi_tensor.sumsq carries the TPU reduction-shape guard.
+        local_sq = sum(multi_tensor.sumsq(g) for g in g_shards)
         gnorm = jnp.sqrt(jax.lax.psum(local_sq, axis_name))
         clip = jnp.where(gnorm > max_grad_norm,
                          max_grad_norm / jnp.maximum(gnorm, 1e-12), 1.0) \

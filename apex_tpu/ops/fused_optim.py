@@ -37,14 +37,15 @@ def group_use_pallas(use_pallas, meta) -> bool:
     """Per-group kernel dispatch policy.
 
     Explicit True/False wins.  Auto (None): the Pallas kernel runs for
-    multi-leaf packed groups on TPU — the multi-tensor regime the
-    kernels exist for (hundreds of small tensors in one pass,
-    ref: csrc/multi_tensor_apply.cuh).  Single-leaf *direct* groups
-    (GPT-scale embeddings/stacked blocks, >= multi_tensor.
-    DIRECT_MIN_ELEMS) take the jnp path: XLA's own fusion of the
-    identical math measured faster on v5e at 355M params (28.9 ms vs
-    38.1 ms for the best Pallas config), so fusing them by hand would
-    be a demotion-by-vanity.  Numbers recorded in BENCH artifacts.
+    non-direct packed groups on TPU.  With the measured default of
+    all-direct split_direct grouping (multi_tensor.DIRECT_MIN_ELEMS =
+    0: packing lost to XLA's native fusion at every scale tried, see
+    the measurement log there), the split_direct optimizers
+    (Adam/SGD/Adagrad/LAMB/NovoGrad) reach only the native path unless
+    the threshold is raised; consumers that pack monolithically by
+    design (FusedMixedPrecisionLamb, ZeRO shards, flat_master) still
+    dispatch Pallas under auto.  The kernels stay exact and tested for
+    use_pallas=True / raised thresholds.
     """
     if use_pallas is not None:
         return bool(use_pallas)

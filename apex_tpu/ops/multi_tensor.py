@@ -17,6 +17,7 @@ The overflow-buffer convention becomes a returned finite flag.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -51,13 +52,34 @@ class FlatMeta:
 
 # Leaves with at least this many elements form their own DIRECT group
 # (opt-in via compute_metas(split_direct=True)): their buffer is the
-# leaf itself — never packed, never copied.  Small leaves still pack per
-# dtype (the multi-tensor win: one kernel pass instead of hundreds of
-# tiny fusions).  Measured on v5e at 355M params: per-step packing of
-# huge leaves cost 2 extra full passes over params+grads and made the
-# fused path ~2x slower than unfused XLA; with direct groups it is at
-# parity or better.
-DIRECT_MIN_ELEMS = 1 << 22
+# leaf itself — never packed, never copied.
+#
+# Default 0 = EVERY leaf direct: on TPU, measured three times at
+# successively honest harnesses, packing always lost to XLA's native
+# fusion of the identical per-leaf math — there is no launch overhead
+# for a packed kernel to amortize inside one jitted program:
+#   * 355M/8-leaf trees: packed ~2x slower (2 extra passes over
+#     params+grads) — round-1 measurement, threshold 2^22;
+#   * BERT-large end-to-end: packing its 1-3M leaves cost ~30 ms/step
+#     in layout copies/converts (134.9 -> 105.4 ms at 2^20);
+#   * 400x65K-leaf microbench with single-dispatch scan timing and
+#     non-hoistable per-step packing: packed 0.44x (adam) / 0.59x
+#     (sgd) of native — the regime the pack was built for loses too.
+# The reference's multi-tensor design amortizes CUDA *launch* overhead
+# (ref: csrc/multi_tensor_apply.cuh), a cost class XLA does not have;
+# the Pallas packed kernels remain available via use_pallas=True /
+# APEX_TPU_DIRECT_MIN_ELEMS for hardware where the trade-off shifts.
+def _env_direct_min() -> int:
+    raw = os.environ.get("APEX_TPU_DIRECT_MIN_ELEMS", "0")
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"APEX_TPU_DIRECT_MIN_ELEMS={raw!r} is not an integer "
+            "(element-count threshold, e.g. 1048576)") from None
+
+
+DIRECT_MIN_ELEMS = _env_direct_min()
 
 # Upper bound on a single packed group's element count (split_direct
 # consumers only; classic one-group-per-dtype callers like ZeRO keep a
@@ -259,6 +281,20 @@ def segment_ids(meta: FlatMeta) -> jnp.ndarray:
     return jnp.asarray(ids)
 
 
+def sumsq(x: jnp.ndarray) -> jnp.ndarray:
+    """fp32 sum of squares with the TPU-safe reduction shape.
+
+    Long 1-D reductions make XLA:TPU materialize an (N/2, 2) stage whose
+    2->128 lane padding is 64x the data (a 26.5 GB compile-time OOM at
+    BERT-large scale); reducing over a (rows, LANE) view avoids it.
+    The single shared implementation of that workaround — keep every
+    whole-buffer norm on this helper."""
+    x = x.astype(jnp.float32)
+    if x.ndim == 1 and x.size and x.size % LANE == 0:
+        x = x.reshape(-1, LANE)
+    return jnp.sum(x * x)
+
+
 def per_tensor_sumsq(buf: jnp.ndarray, meta: FlatMeta) -> jnp.ndarray:
     """Per-tensor sum-of-squares over a packed fp32 buffer, one entry
     per leaf, via *static* slices (offsets/sizes are Python ints).
@@ -279,10 +315,7 @@ def per_tensor_sumsq(buf: jnp.ndarray, meta: FlatMeta) -> jnp.ndarray:
     for k, o in enumerate(meta.offsets):
         end = meta.offsets[k + 1] if k + 1 < len(meta.offsets) \
             else meta.padded
-        seg = jax.lax.slice_in_dim(x, o, end)
-        if seg.size and seg.size % LANE == 0:
-            seg = seg.reshape(-1, LANE)
-        sums.append(jnp.sum(seg ** 2))
+        sums.append(sumsq(jax.lax.slice_in_dim(x, o, end)))
     return jnp.stack(sums)
 
 

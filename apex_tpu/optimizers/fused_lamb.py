@@ -110,18 +110,7 @@ def _global_grad_clip(gbufs, max_norm):
     disables clipping.  Mixed-precision LAMB passes
     ``max_grad_norm * loss_scale`` because its norm is of scaled grads
     (ref: fused_mixed_precision_lamb.py:182-184)."""
-    # Reduce over (rows, LANE) views, never a flat mega-vector: XLA:TPU
-    # splits huge 1-D reductions into an (N/2, 2) stage whose
-    # lane-padded buffer is 64x the data (26.5 GB at BERT-large — a
-    # compile-time OOM).  Packed buffers are LANE-aligned; native-shape
-    # DIRECT leaves are already >=2-D or small.
-    def _sumsq(g):
-        g = g.astype(jnp.float32)
-        if g.ndim == 1 and g.size % multi_tensor.LANE == 0 and g.size:
-            g = g.reshape(-1, multi_tensor.LANE)
-        return jnp.sum(jnp.square(g))
-
-    gsq = sum(_sumsq(g) for g in gbufs)
+    gsq = sum(multi_tensor.sumsq(g) for g in gbufs)
     gnorm = jnp.sqrt(gsq)
     # The enable decision must be static (max_norm may be a traced value
     # when the caller scales it by a traced loss scale — pass None to

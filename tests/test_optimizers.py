@@ -275,19 +275,20 @@ def test_fused_novograd_per_tensor_v():
     params = make_params()
     tx = opt.fused_novograd(1e-2, use_pallas=False)
     state = tx.init(params)
-    metas = mt.compute_metas(params, align=mt.LANE)
-    # second moment is ONE scalar per tensor (ref: fused_novograd.py)
-    assert state.v[0].shape == (len(metas[0].sizes),)
+    # second moment is ONE scalar per tensor regardless of grouping
+    # (ref: fused_novograd.py) — the optimizer's own metas define the
+    # group layout (all-direct by default, packed when opted in).
+    metas = mt.compute_metas(params, align=mt.LANE, split_direct=True)
     g = make_grads(params)
     u, s2 = tx.update(g, state, params)
-    # first step: v = ||g||^2 per tensor (init_zero=False); the packed
-    # order follows the meta's leaf_indices
     leaves_g = jax.tree_util.tree_leaves(g)
-    for k, leaf_idx in enumerate(metas[0].leaf_indices):
-        gl = leaves_g[leaf_idx]
-        np.testing.assert_allclose(
-            float(s2.v[0][k]),
-            float(jnp.sum(gl.astype(jnp.float32) ** 2)), rtol=1e-5)
+    for i, meta in enumerate(metas):
+        assert s2.v[i].shape == (len(meta.sizes),)
+        for k, leaf_idx in enumerate(meta.leaf_indices):
+            gl = leaves_g[leaf_idx]
+            np.testing.assert_allclose(
+                float(s2.v[i][k]),
+                float(jnp.sum(gl.astype(jnp.float32) ** 2)), rtol=1e-5)
 
 
 # --- FusedMixedPrecisionLamb ------------------------------------------------
