@@ -1,9 +1,12 @@
-"""Shared library utilities: rank-stamped logging.
+"""Shared library utilities: rank-stamped logging + sharded checkpoints.
 
 Parity surface for the reference's library-level observability glue —
 the root-logger ``RankInfoFormatter`` (ref: apex/__init__.py:29-42) and
-``apex/transformer/log_util.py``.
+``apex/transformer/log_util.py`` — plus the Orbax-backed sharded/async
+checkpoint layer (:mod:`apex_tpu.utils.checkpoint`), the TPU-native
+upgrade of the reference's state-dict save/resume flow.
 """
+from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint
 from .log_util import (
     RankInfoFormatter,
     get_logger,
@@ -12,6 +15,9 @@ from .log_util import (
 )
 
 __all__ = [
+    "CheckpointManager",
+    "load_checkpoint",
+    "save_checkpoint",
     "RankInfoFormatter",
     "get_logger",
     "get_transformer_logger",
