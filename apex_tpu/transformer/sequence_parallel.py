@@ -145,3 +145,60 @@ def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                            causal: bool = False):
     return ulysses_attention(q, k, v, axis_name, scale=scale,
                              causal=causal)
+
+
+class SequenceParallelTransformerLayer:
+    """Pre-LN transformer layer over sequence-sharded activations: the
+    end-to-end context-parallel building block.
+
+    LayerNorm, MLP, and residuals are per-token (embarrassingly
+    parallel over the sequence shards); only the attention core
+    communicates (ring K/V rotation or Ulysses all-to-all).  The
+    sequence-parallel sibling of
+    :class:`apex_tpu.transformer.layers.ParallelTransformerLayer`, same
+    pre-LN wiring (LN -> attn -> residual -> LN -> MLP -> residual, LN
+    math in fp32).  ``axis_name=None`` runs the dense single-device
+    reference for parity tests.
+    """
+
+    def __init__(self, hidden_size: int, num_attention_heads: int,
+                 ffn_hidden_size: Optional[int] = None,
+                 causal: bool = True, mode: str = "ring",
+                 layernorm_epsilon: float = 1e-5,
+                 axis_name: Optional[str] = SEQUENCE_AXIS):
+        self.hidden_size = hidden_size
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.eps = layernorm_epsilon
+        self.attn = SequenceParallelSelfAttention(
+            hidden_size, num_attention_heads, causal=causal, mode=mode,
+            axis_name=axis_name)
+
+    def init(self, key) -> dict:
+        h, f = self.hidden_size, self.ffn_hidden_size
+        ka, k1, k2 = jax.random.split(key, 3)
+        return {
+            "ln1_weight": jnp.ones((h,), jnp.float32),
+            "ln1_bias": jnp.zeros((h,), jnp.float32),
+            "attention": self.attn.init(ka),
+            "ln2_weight": jnp.ones((h,), jnp.float32),
+            "ln2_bias": jnp.zeros((h,), jnp.float32),
+            "mlp_wi": jax.random.normal(k1, (h, f), jnp.float32)
+            * (2.0 / h) ** 0.5,
+            "mlp_bi": jnp.zeros((f,), jnp.float32),
+            "mlp_wo": jax.random.normal(k2, (f, h), jnp.float32)
+            * (1.0 / f) ** 0.5,
+            "mlp_bo": jnp.zeros((h,), jnp.float32),
+        }
+
+    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+        from ..ops.layer_norm import layer_norm
+
+        h = layer_norm(x, params["ln1_weight"], params["ln1_bias"],
+                       eps=self.eps)
+        x = x + self.attn.apply(params["attention"], h.astype(x.dtype))
+        h = layer_norm(x, params["ln2_weight"], params["ln2_bias"],
+                       eps=self.eps)
+        h = h.astype(x.dtype)
+        m = jax.nn.gelu(h @ params["mlp_wi"] + params["mlp_bi"])
+        return x + (m @ params["mlp_wo"] + params["mlp_bo"]).astype(
+            x.dtype)
