@@ -193,12 +193,14 @@ class SequenceParallelTransformerLayer:
     def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
         from ..ops.layer_norm import layer_norm
 
+        # layer_norm returns x.dtype (fp32 internal math); both residual
+        # branches cast back so a bf16 residual stream stays bf16 (the
+        # ParallelTransformerLayer convention, layers.py).
         h = layer_norm(x, params["ln1_weight"], params["ln1_bias"],
                        eps=self.eps)
-        x = x + self.attn.apply(params["attention"], h.astype(x.dtype))
+        x = x + self.attn.apply(params["attention"], h).astype(x.dtype)
         h = layer_norm(x, params["ln2_weight"], params["ln2_bias"],
                        eps=self.eps)
-        h = h.astype(x.dtype)
         m = jax.nn.gelu(h @ params["mlp_wi"] + params["mlp_bi"])
         return x + (m @ params["mlp_wo"] + params["mlp_bo"]).astype(
             x.dtype)

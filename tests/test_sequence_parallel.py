@@ -307,6 +307,18 @@ class TestSequenceParallelGPTEndToEnd:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
             g_sp, g_ref)
 
+    def test_layer_preserves_bf16_residual_stream(self):
+        from apex_tpu.transformer.sequence_parallel import (
+            SequenceParallelTransformerLayer)
+
+        layer = SequenceParallelTransformerLayer(16, 4, causal=True,
+                                                 axis_name=None)
+        params = layer.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16),
+                              jnp.bfloat16)
+        y = layer.apply(params, x)
+        assert y.dtype == jnp.bfloat16
+
     def test_sp_gpt_trains(self):
         from apex_tpu.optimizers import fused_adam
 
