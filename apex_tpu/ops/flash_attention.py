@@ -15,10 +15,13 @@ accumulator across k-blocks (TPU grids iterate sequentially, so scratch
 is a legal carry).  Matmuls hit the MXU in the input dtype with fp32
 accumulation; softmax math is fp32.
 
-Backward is the standard two-kernel flash backward: a dq pass (grid over
-q-blocks, accumulate over k) and a dk/dv pass (grid over k-blocks,
-accumulate over q), both recomputing probabilities from the saved
-per-row logsumexp.
+Backward: when the padded sequence fits one block and d <= 64 (the
+common case at the default 1024 blocks — e.g. GPT-345M s=1024), a
+single fused kernel produces dq/dk/dv in one pass (5 matmuls; scores
+and dp computed once).  Otherwise the standard two-kernel flash
+backward runs: a dq pass (grid over q-blocks, accumulate over k) and a
+dk/dv pass (grid over k-blocks, accumulate over q), both recomputing
+probabilities from the saved per-row logsumexp.
 """
 from __future__ import annotations
 
@@ -258,6 +261,35 @@ def _rows8(x2d, bq):
         x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
 
 
+def _bwd_fused_kernel(scale, causal, sq, sk,
+                      q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref):
+    """Single-block backward: when the whole (padded) sequence fits one
+    q-block and one k-block, dq/dk/dv come from ONE pass — the scores
+    ``s`` and ``dp`` are computed once instead of once per kernel (the
+    two-kernel flash backward recomputes both), removing 2 of the 7
+    matmuls; the two it removes are the d-contracted (half-MXU-lane)
+    ones, so the saving exceeds their FLOP share."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    s = _dot(q, k, trans_b=True) * scale              # (sq, sk) fp32
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = (k_pos < sk) & (q_pos < sq)
+    if causal:
+        mask &= q_pos >= k_pos
+    lse = lse_ref[0, 0, 0, :][:, None]
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+    dv_ref[0] = _dot(p.astype(do.dtype).T, do).astype(dv_ref.dtype)
+    dp = _dot(do, v, trans_b=True)
+    delta = delta_ref[0, 0, 0, :][:, None]
+    ds = p * (dp - delta) * scale
+    dq_ref[0] = _dot(ds.astype(k.dtype), k).astype(dq_ref.dtype)
+    dk_ref[0] = _dot(ds.astype(q.dtype).T, q).astype(dk_ref.dtype)
+
+
 def _flash_bwd(scale, causal, block_q, block_k, res, do):
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
@@ -279,6 +311,32 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
     lse_p = _pad_to(lse, 1, bq)
     lse8 = _rows8(lse_p, bq)
     delta8 = _rows8(delta, bq)
+
+    if nq == 1 and nk == 1 and d <= 64:
+        # Single-block fast path (e.g. GPT-345M s=1024 at the default
+        # 1024-blocks; ring-attention shards): one fused kernel, 5
+        # matmuls instead of 7.  d <= 64 keeps VMEM ~10 MB
+        # (2 score-shaped fp32 temps + 7 thin operands).
+        qb_spec = pl.BlockSpec((1, psq, d), lambda b_: (b_, 0, 0),
+                               memory_space=pltpu.VMEM)
+        kb_spec = pl.BlockSpec((1, psk, d), lambda b_: (b_, 0, 0),
+                               memory_space=pltpu.VMEM)
+        rb_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale, causal, sq, sk),
+            grid=(bh,),
+            in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
+                      rb_spec],
+            out_specs=[qb_spec, kb_spec, kb_spec],
+            out_shape=[jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+                       jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, psk, d), v.dtype)],
+            interpret=_interpret(),
+        )(q3, k3, v3, do3, lse8, delta8)
+        return (dq[:, :sq].reshape(b, h, sq, d),
+                dk[:, :sk].reshape(b, h, sk, d),
+                dv[:, :sk].reshape(b, h, sk, d))
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
                             memory_space=pltpu.VMEM)
