@@ -84,9 +84,13 @@ def _dot(a, b, trans_b=False):
 
 # --- forward ---------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, sq, sk, bq, bk,
-                q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc, m_sc, l_sc):
+def _fwd_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
+                q_ref, k_ref, v_ref, *rest):
+    if has_kvm:
+        kvm_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
+    else:
+        kvm_ref = None
+        o_ref, lse_ref, acc, m_sc, l_sc = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -109,11 +113,18 @@ def _fwd_kernel(scale, causal, sq, sk, bq, bk,
         mask = k_pos < sk
         if causal:
             mask &= q_pos >= k_pos
+        if has_kvm:
+            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
         s = jnp.where(mask, s, _NEG)
         m_prev = m_sc[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)
+        # explicit zero for masked entries: when a row is FULLY masked
+        # the running max equals _NEG and exp(s - m) would be 1, not 0
+        # — with the explicit mask such rows sum to l = 0, hit the
+        # zero-guard at the end, and emit exactly 0 (matching the
+        # backward kernels, which also zero p; gradients are 0 too).
+        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
         l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
         m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
@@ -139,7 +150,15 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _kvm8(kv_mask, b, psk, bk):
+    """(b, sk) key-validity mask -> (b, nkb, 8, bk) sublane-replicated
+    fp32 blocks (same trick as :func:`_rows8`)."""
+    m = _pad_to(kv_mask.astype(jnp.float32), 1, bk)  # (b, psk), pads 0
+    return jnp.broadcast_to(
+        m.reshape(b, psk // bk, 1, bk), (b, psk // bk, 8, bk))
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
@@ -158,10 +177,20 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
                           memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
                             memory_space=pltpu.VMEM)
+    has_kvm = kv_mask is not None
+    in_specs = [q_spec, k_spec, k_spec]
+    operands = [q3, k3, v3]
+    if has_kvm:
+        kvm_spec = pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, j, 0, 0),
+            memory_space=pltpu.VMEM)
+        in_specs.append(kvm_spec)
+        operands.append(_kvm8(kv_mask, b, psk, bk))
     o, lse8 = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, causal, sq, sk, bq, bk),
+        functools.partial(_fwd_kernel, scale, causal, has_kvm, sq, sk,
+                          bq, bk),
         grid=(bh, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec],
+        in_specs=in_specs,
         out_specs=[q_spec, lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
@@ -173,16 +202,21 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(*operands)
     lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
     return o[:, :sq].reshape(b, h, sq, d), lse
 
 
 # --- backward --------------------------------------------------------------
 
-def _bwd_dq_kernel(scale, causal, sq, sk, bq, bk,
+def _bwd_dq_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc):
+                   *rest):
+    if has_kvm:
+        kvm_ref, dq_ref, dq_acc = rest
+    else:
+        kvm_ref = None
+        dq_ref, dq_acc = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -203,6 +237,8 @@ def _bwd_dq_kernel(scale, causal, sq, sk, bq, bk,
         mask = k_pos < sk
         if causal:
             mask &= q_pos >= k_pos
+        if has_kvm:
+            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
         lse = lse_ref[0, 0, 0, :][:, None]
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = _dot(do_ref[0], v_ref[0], trans_b=True)
@@ -215,9 +251,14 @@ def _bwd_dq_kernel(scale, causal, sq, sk, bq, bk,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(scale, causal, sq, sk, bq, bk,
+def _bwd_dkv_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
                     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc):
+                    *rest):
+    if has_kvm:
+        kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        kvm_ref = None
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     i = pl.program_id(1)   # k block
     j = pl.program_id(2)   # q block
     nq = pl.num_programs(2)
@@ -239,6 +280,8 @@ def _bwd_dkv_kernel(scale, causal, sq, sk, bq, bk,
         mask = (k_pos < sk) & (q_pos < sq)
         if causal:
             mask &= q_pos >= k_pos
+        if has_kvm:
+            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
         lse = lse_ref[0, 0, 0, :][:, None]
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         do = do_ref[0]
@@ -261,9 +304,14 @@ def _rows8(x2d, bq):
         x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
 
 
-def _bwd_fused_kernel(scale, causal, sq, sk,
+def _bwd_fused_kernel(scale, causal, has_kvm, sq, sk,
                       q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref):
+                      *rest):
+    if has_kvm:
+        kvm_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        kvm_ref = None
+        dq_ref, dk_ref, dv_ref = rest
     """Single-block backward: when the whole (padded) sequence fits one
     q-block and one k-block, dq/dk/dv come from ONE pass — the scores
     ``s`` and ``dp`` are computed once instead of once per kernel (the
@@ -280,6 +328,8 @@ def _bwd_fused_kernel(scale, causal, sq, sk,
     mask = (k_pos < sk) & (q_pos < sq)
     if causal:
         mask &= q_pos >= k_pos
+    if has_kvm:
+        mask &= kvm_ref[0, 0, 0, :][None, :] > 0
     lse = lse_ref[0, 0, 0, :][:, None]
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
     dv_ref[0] = _dot(p.astype(do.dtype).T, do).astype(dv_ref.dtype)
@@ -290,7 +340,7 @@ def _bwd_fused_kernel(scale, causal, sq, sk,
     dk_ref[0] = _dot(ds.astype(q.dtype).T, q).astype(dk_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -311,6 +361,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
     lse_p = _pad_to(lse, 1, bq)
     lse8 = _rows8(lse_p, bq)
     delta8 = _rows8(delta, bq)
+    has_kvm = kv_mask is not None
+    kvm = _kvm8(kv_mask, b, psk, bk) if has_kvm else None
 
     if nq == 1 and nk == 1 and d <= 64:
         # Single-block fast path (e.g. GPT-345M s=1024 at the default
@@ -323,17 +375,25 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
                                memory_space=pltpu.VMEM)
         rb_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
                                memory_space=pltpu.VMEM)
+        in_specs = [qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
+                    rb_spec]
+        operands = [q3, k3, v3, do3, lse8, delta8]
+        if has_kvm:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
+                memory_space=pltpu.VMEM))
+            operands.append(kvm)
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale, causal, sq, sk),
+            functools.partial(_bwd_fused_kernel, scale, causal,
+                              has_kvm, sq, sk),
             grid=(bh,),
-            in_specs=[qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
-                      rb_spec],
+            in_specs=in_specs,
             out_specs=[qb_spec, kb_spec, kb_spec],
             out_shape=[jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
                        jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
                        jax.ShapeDtypeStruct((bh, psk, d), v.dtype)],
             interpret=_interpret(),
-        )(q3, k3, v3, do3, lse8, delta8)
+        )(*operands)
         return (dq[:, :sq].reshape(b, h, sq, d),
                 dk[:, :sk].reshape(b, h, sk, d),
                 dv[:, :sk].reshape(b, h, sk, d))
@@ -345,16 +405,25 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
     r_spec_i = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
                             memory_space=pltpu.VMEM)
 
+    in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
+                r_spec_i]
+    operands = [q3, k3, v3, do3, lse8, delta8]
+    if has_kvm:
+        # kv mask indexed by the K block (grid dim 2 here)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, j, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(kvm)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale, causal, sq, sk, bq, bk),
+        functools.partial(_bwd_dq_kernel, scale, causal, has_kvm, sq,
+                          sk, bq, bk),
         grid=(bh, nq, nk),
-        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
-                  r_spec_i],
+        in_specs=in_specs,
         out_specs=q_spec_i,
         out_shape=jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse8, delta8)
+    )(*operands)
 
     q_spec_j = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, j, 0),
                             memory_space=pltpu.VMEM)
@@ -362,18 +431,27 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do):
                             memory_space=pltpu.VMEM)
     r_spec_j = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, j, 0, 0),
                             memory_space=pltpu.VMEM)
+    in_specs = [q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
+                r_spec_j]
+    operands = [q3, k3, v3, do3, lse8, delta8]
+    if has_kvm:
+        # kv mask indexed by the K block (grid dim 1 here)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, i, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(kvm)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale, causal, sq, sk, bq, bk),
+        functools.partial(_bwd_dkv_kernel, scale, causal, has_kvm, sq,
+                          sk, bq, bk),
         grid=(bh, nk, nq),
-        in_specs=[q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
-                  r_spec_j],
+        in_specs=in_specs,
         out_specs=[k_spec_i, k_spec_i],
         out_shape=[jax.ShapeDtypeStruct((bh, psk, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, psk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, do3, lse8, delta8)
+    )(*operands)
 
     return (dq[:, :sq].reshape(b, h, sq, d),
             dk[:, :sk].reshape(b, h, sk, d),
@@ -397,19 +475,27 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     scale: Optional[float] = None,
                     causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jnp.ndarray:
-    """Fused attention: softmax(q k^T * scale [causal-masked]) v.
+                    block_k: int = DEFAULT_BLOCK_K,
+                    kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Fused attention: softmax(q k^T * scale [masked]) v.
 
     Shapes: q (b, h, sq, d); k, v (b, h, sk, d).  ``scale`` defaults to
-    1/sqrt(d).  Supersedes the reference's FMHA (seqlen<=512 cap,
-    ref: setup.py:408-424) and fast_multihead_attn kernels.  Inside
+    1/sqrt(d).  ``kv_mask`` (b, sk), True/nonzero = attend, masks
+    padding KEYS (the BERT padding-attention case) — a capability the
+    reference's FMHA lacks entirely (seqlen<=512, no mask support,
+    ref: setup.py:408-424); composes with ``causal``.  Inside
     shard_map manual axes the XLA reference path runs (Pallas calls
     cannot yet carry VMA types).
     """
     from ._context import in_manual_axis_context
 
     if in_manual_axis_context(q, k, v):
-        return mha_reference(q, k, v, scale=scale, causal=causal)
+        return mha_reference(q, k, v, scale=scale, causal=causal,
+                             kv_mask=kv_mask)
+    if kv_mask is not None:
+        return _flash_attention_masked(q, k, v,
+                                       kv_mask.astype(jnp.float32),
+                                       scale, causal, block_q, block_k)
     return _flash_attention_fused(q, k, v, scale, causal, block_q, block_k)
 
 
@@ -429,17 +515,52 @@ def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do):
 _flash_attention_fused.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def mha_reference(q, k, v, scale=None, causal=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention_masked(q, k, v, kv_mask, scale, causal,
+                            block_q, block_k):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                      kv_mask=kv_mask)[0]
+
+
+def _flash_masked_vjp_fwd(q, k, v, kv_mask, scale, causal, block_q,
+                          block_k):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        kv_mask=kv_mask)
+    return o, (q, k, v, o, lse, kv_mask)
+
+
+def _flash_masked_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse, kv_mask = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
+                            (q, k, v, o, lse), do, kv_mask=kv_mask)
+    # the (float) mask is a constant of the computation
+    return dq, dk, dv, jnp.zeros_like(kv_mask)
+
+
+_flash_attention_masked.defvjp(_flash_masked_vjp_fwd,
+                               _flash_masked_vjp_bwd)
+
+
+def mha_reference(q, k, v, scale=None, causal=False, kv_mask=None):
     """Unfused reference (the [b,h,sq,sk]-materializing baseline the
-    reference's standalone GPT uses) — for parity tests and benchmarks."""
+    reference's standalone GPT uses) — for parity tests and benchmarks.
+    ``kv_mask`` (b, sk): True/nonzero = attend."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    sq, sk = s.shape[-2:]
     if causal:
-        sq, sk = s.shape[-2:]
         mask = jnp.tril(jnp.ones((sq, sk), bool))
         s = jnp.where(mask, s, _NEG)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :].astype(bool), s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)

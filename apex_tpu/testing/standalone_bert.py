@@ -111,6 +111,11 @@ class BertModel(nn.Module):
     attention_dropout: float = 0.1
     hidden_dropout: float = 0.1
     checkpoint_activations: bool = False
+    # use_flash routes the (b, s) padding mask through the flash
+    # kernel's kv_mask path (no [b, h, s, s] score materialization) —
+    # a capability the reference's FMHA lacks; False keeps the
+    # reference-shaped FusedScaleMaskSoftmax path.
+    use_flash: bool = False
     dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
 
@@ -125,7 +130,7 @@ class BertModel(nn.Module):
             num_attention_heads=self.num_attention_heads,
             attn_mask_type=AttnMaskType.padding,
             attention_dropout=self.attention_dropout,
-            hidden_dropout=self.hidden_dropout, use_flash=False,
+            hidden_dropout=self.hidden_dropout, use_flash=self.use_flash,
             checkpoint_activations=self.checkpoint_activations,
             dtype=self.dtype, axis_name=self.axis_name,
             name="transformer")
@@ -143,10 +148,15 @@ class BertModel(nn.Module):
         """Returns ``(lm_logits_or_loss, binary_logits)``
         (ref: forward :148-175 + post_language_model_processing
         :76-99)."""
-        ext_mask = bert_extended_attention_mask(
-            attention_mask.astype(jnp.float32))
         h = self.embedding(tokens, tokentype_ids, deterministic)
-        h = self.transformer(h, ext_mask, deterministic)
+        if self.use_flash:
+            # the (b, s) mask rides the flash kernel's kv_mask lane
+            h = self.transformer(h, None, deterministic,
+                                 key_padding_mask=attention_mask)
+        else:
+            ext_mask = bert_extended_attention_mask(
+                attention_mask.astype(jnp.float32))
+            h = self.transformer(h, ext_mask, deterministic)
 
         binary_logits = None
         if self.add_binary_head:

@@ -98,7 +98,8 @@ class ParallelSelfAttention(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 key_padding_mask=None):
         b, s, _ = x.shape
         world = _maybe_axis_size(self.axis_name)
         heads_local = divide(self.num_attention_heads, world)
@@ -117,16 +118,37 @@ class ParallelSelfAttention(nn.Module):
 
         causal = self.attn_mask_type == AttnMaskType.causal
         scale = head_dim ** -0.5
-        if self.use_flash and attention_mask is None and causal \
+        if key_padding_mask is not None and attention_mask is not None:
+            raise ValueError(
+                "pass either attention_mask or key_padding_mask, not "
+                "both (fold padding into the attention_mask yourself)")
+        # flash handles causal and/or key-padding masks; an arbitrary
+        # (b, 1, sq, sk) attention_mask takes the materializing path.
+        if self.use_flash and attention_mask is None \
                 and (deterministic or self.attention_dropout == 0.0):
-            ctx = flash_attention(q, k, v, scale=scale, causal=True)
+            ctx = flash_attention(q, k, v, scale=scale, causal=causal,
+                                  kv_mask=key_padding_mask)
         else:
+            softmax_mask_type = self.attn_mask_type
+            if key_padding_mask is not None:
+                # fold padding keys (and, for causal models, the
+                # triangle — the causal-type softmax ignores its mask
+                # argument) into one padding-type mask
+                # (True = masked, the FusedScaleMaskSoftmax convention)
+                kmask = ~key_padding_mask.astype(bool)[:, None, None, :]
+                if causal:
+                    kmask = kmask | ~jnp.tril(jnp.ones(
+                        (s, key_padding_mask.shape[-1]), bool))[None,
+                                                                None]
+                attention_mask = jnp.broadcast_to(
+                    kmask, (b, 1, s, key_padding_mask.shape[-1]))
+                softmax_mask_type = AttnMaskType.padding
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32)
             softmax = FusedScaleMaskSoftmax(
                 input_in_fp16=self.dtype == jnp.float16,
                 input_in_bf16=self.dtype == jnp.bfloat16,
-                attn_mask_type=self.attn_mask_type,
+                attn_mask_type=softmax_mask_type,
                 scaled_masked_softmax_fusion=True,
                 mask_func=None, softmax_in_fp32=True, scale=scale)
             probs = softmax(scores.astype(self.dtype), attention_mask)
@@ -181,7 +203,8 @@ class ParallelTransformerLayer(nn.Module):
                          jnp.zeros((), x.dtype))
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 key_padding_mask=None):
         ln1 = FusedLayerNorm(self.hidden_size,
                              eps=self.layernorm_epsilon,
                              name="input_layernorm")
@@ -191,7 +214,8 @@ class ParallelTransformerLayer(nn.Module):
             attention_dropout=self.attention_dropout,
             use_flash=self.use_flash, dtype=self.dtype,
             axis_name=self.axis_name, name="self_attention")(
-                ln1(x).astype(self.dtype), attention_mask, deterministic)
+                ln1(x).astype(self.dtype), attention_mask, deterministic,
+                key_padding_mask)
         x = x + self._dropout(attn_out, deterministic).astype(x.dtype)
         ln2 = FusedLayerNorm(self.hidden_size,
                              eps=self.layernorm_epsilon,
@@ -234,7 +258,8 @@ class ParallelTransformer(nn.Module):
     axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 key_padding_mask=None):
         layer_cls = ParallelTransformerLayer
         if self.checkpoint_activations:
             from .tensor_parallel.random import CHECKPOINT_POLICIES
@@ -256,7 +281,8 @@ class ParallelTransformer(nn.Module):
                           layernorm_epsilon=self.layernorm_epsilon,
                           dtype=self.dtype, axis_name=self.axis_name,
                           name=f"layer_{i}")(x, attention_mask,
-                                             deterministic)
+                                             deterministic,
+                                             key_padding_mask)
         return FusedLayerNorm(self.hidden_size,
                               eps=self.layernorm_epsilon,
                               name="final_layernorm")(x).astype(self.dtype)

@@ -413,7 +413,11 @@ def bench_bert_large():
     model = BertModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_attention_heads=heads, max_sequence_length=seq,
-        attention_dropout=0.0, hidden_dropout=0.0, dtype=jnp.bfloat16)
+        attention_dropout=0.0, hidden_dropout=0.0,
+        # padding mask through the flash kernel's kv_mask path
+        # (BENCH_BERT_FLASH=0 for the reference-shaped softmax path)
+        use_flash=os.environ.get("BENCH_BERT_FLASH", "1") == "1",
+        dtype=jnp.bfloat16)
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(jax.random.fold_in(key, 1),
                                 (batch, seq), 0, vocab)

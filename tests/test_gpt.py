@@ -240,3 +240,27 @@ class TestGPTPipelined:
             f"convergence runner failed\n--- stdout ---\n{proc.stdout}"
             f"\n--- stderr ---\n{proc.stderr[-2000:]}")
         assert "CONVERGED" in proc.stdout, proc.stdout
+
+
+def test_self_attention_key_padding_mask_paths_agree():
+    """Causal attention with a key-padding mask: the flash kv_mask path
+    and the unfused folded-mask path must agree (the causal-type
+    softmax ignores its mask arg, so the fold must switch to a
+    combined padding-type mask)."""
+    from apex_tpu.transformer.layers import ParallelSelfAttention
+
+    kw = dict(hidden_size=32, num_attention_heads=4,
+              attention_dropout=0.0)
+    fl = ParallelSelfAttention(**kw, use_flash=True)
+    uf = ParallelSelfAttention(**kw, use_flash=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32)) * 0.5
+    kpm = jnp.ones((2, 16), jnp.int32).at[1, -6:].set(0)
+    variables = fl.init(jax.random.PRNGKey(1), x)
+    y_fl = fl.apply(variables, x, key_padding_mask=kpm)
+    y_uf = uf.apply(variables, x, key_padding_mask=kpm)
+    np.testing.assert_allclose(np.asarray(y_fl), np.asarray(y_uf),
+                               rtol=3e-4, atol=3e-5)
+    with pytest.raises(ValueError, match="not\\s+both"):
+        fl.apply(variables, x,
+                 attention_mask=jnp.zeros((2, 1, 16, 16), bool),
+                 key_padding_mask=kpm)

@@ -166,6 +166,31 @@ class TestStandaloneBert:
         np.testing.assert_allclose(np.asarray(out1[0, :10]),
                                    np.asarray(out2[0, :10]), atol=1e-5)
 
+    def test_flash_padding_path_matches_unfused(self):
+        """use_flash=True (kv_mask through the flash kernel) must match
+        the FusedScaleMaskSoftmax path on a real padding mask, in both
+        the forward and the MLM loss."""
+        from apex_tpu.testing.standalone_bert import BertModel
+
+        kw = dict(vocab_size=64, hidden_size=32, num_layers=2,
+                  num_attention_heads=4, max_sequence_length=16,
+                  attention_dropout=0.0, hidden_dropout=0.0)
+        ref = BertModel(**kw)
+        fl = BertModel(**kw, use_flash=True)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+        mask = jnp.ones((2, 16), jnp.int32).at[1, -5:].set(0)
+        variables = ref.init(jax.random.PRNGKey(1), tokens, mask)
+        lo_r, bin_r = ref.apply(variables, tokens, mask)
+        lo_f, bin_f = fl.apply(variables, tokens, mask)
+        # padded-position outputs differ by construction (they attend to
+        # nothing meaningful either way); compare valid positions
+        valid = np.asarray(mask, bool)
+        np.testing.assert_allclose(np.asarray(lo_f)[valid],
+                                   np.asarray(lo_r)[valid],
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(bin_f), np.asarray(bin_r),
+                                   rtol=2e-4, atol=2e-4)
+
     def test_bert_minimal_convergence(self):
         """ref: run_bert_minimal_test.py — a short MLM optimization."""
         from apex_tpu.testing.standalone_bert import BertModel
