@@ -77,3 +77,51 @@ class SoftmaxCrossEntropyLoss:
               half_to_float=False):
         return softmax_cross_entropy_loss(logits, labels, smoothing,
                                           half_to_float, padding_idx)
+
+
+def linear_cross_entropy_loss(hidden, kernel, labels, smoothing=0.0,
+                              padding_idx=None, chunks=8):
+    """Mean CE of ``softmax(hidden @ kernel.T)`` vs ``labels`` without
+    ever materializing the full (tokens, vocab) logits.
+
+    The LM-head logits of a 50k-vocab model are the largest activation
+    in the train step (GPT-345M batch 8: 2.5 GB of fp32+bf16 — the
+    batch-16 OOM in BENCH notes).  Row-chunked: each chunk's logits are
+    built, reduced to per-token losses, and rematerialized in the
+    backward (``jax.checkpoint``), so peak logits memory drops by
+    ``chunks``x at the cost of one extra chunk matmul each way.
+
+    ``hidden`` (tokens, h); ``kernel`` (vocab, h) — the tied embedding
+    table layout (``VocabParallelEmbedding.attend``); ``labels``
+    (tokens,).  Returns the scalar mean loss over non-padding tokens.
+    When ``chunks`` does not divide the token count, the largest
+    divisor <= chunks is used instead (never a silent dense fallback —
+    the caller asked for bounded logits memory).
+    """
+    t = hidden.shape[0]
+    chunks = max(1, min(int(chunks), t))
+    while t % chunks:
+        chunks -= 1
+
+    if chunks <= 1:
+        total = jnp.sum(softmax_cross_entropy_loss(
+            hidden @ kernel.T.astype(hidden.dtype), labels, smoothing,
+            True, padding_idx))
+    else:
+        hs = hidden.reshape(chunks, t // chunks, hidden.shape[1])
+        ls = labels.reshape(chunks, t // chunks)
+
+        @jax.checkpoint
+        def chunk_sum(h, l):
+            logits = h @ kernel.T.astype(h.dtype)
+            return jnp.sum(softmax_cross_entropy_loss(
+                logits, l, smoothing, True, padding_idx))
+
+        def body(acc, hl):
+            return acc + chunk_sum(*hl), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0.0), (hs, ls))
+
+    if padding_idx is None:
+        return total / t
+    return total / jnp.maximum(jnp.sum(labels != padding_idx), 1)

@@ -132,9 +132,15 @@ class GPTModel(nn.Module):
             dtype=self.dtype, axis_name=self.axis_name, name="transformer")
 
     def __call__(self, tokens, deterministic: bool = True):
+        return self.embedding.attend(
+            self.hidden_states(tokens, deterministic))
+
+    def hidden_states(self, tokens, deterministic: bool = True):
+        """Final hidden states WITHOUT the tied-head projection — for
+        memory-efficient losses that never materialize full logits
+        (``contrib.xentropy.linear_cross_entropy_loss``)."""
         h = self.embedding(tokens, deterministic)
-        h = self.transformer(h, None, deterministic)
-        return self.embedding.attend(h)
+        return self.transformer(h, None, deterministic)
 
 
 class GPTStage(nn.Module):

@@ -359,14 +359,34 @@ def bench_gpt345m():
     params, amp_state = jax.tree_util.tree_map(jnp.array,
                                                (params, amp_state))
 
+    # BENCH_GPT_CHUNKED_CE=<n>: route the LM loss through the chunked
+    # tied-head CE (contrib.xentropy.linear_cross_entropy_loss) — the
+    # (tokens, vocab) logits are never materialized (the batch-16 OOM
+    # was exactly those buffers).  0 = dense logits path.
+    ce_chunks = int(os.environ.get("BENCH_GPT_CHUNKED_CE", "0"))
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, amp_state, tokens, labels):
         def loss_fn(p):
-            logits = model.apply({"params": p}, tokens,
-                                 deterministic=True)
-            loss = jnp.mean(softmax_cross_entropy_loss(
-                logits.reshape(-1, logits.shape[-1]),
-                labels.reshape(-1), half_to_float=True))
+            if ce_chunks > 0:
+                from apex_tpu.contrib.xentropy import (
+                    linear_cross_entropy_loss)
+
+                h = model.apply({"params": p}, tokens,
+                                deterministic=True,
+                                method="hidden_states")
+                emb = p["embedding"]["word_embeddings"]["embedding"]
+                if hasattr(emb, "unbox"):  # flax Partitioned metadata
+                    emb = emb.unbox()
+                loss = linear_cross_entropy_loss(
+                    h.reshape(-1, h.shape[-1]), emb,
+                    labels.reshape(-1), chunks=ce_chunks)
+            else:
+                logits = model.apply({"params": p}, tokens,
+                                     deterministic=True)
+                loss = jnp.mean(softmax_cross_entropy_loss(
+                    logits.reshape(-1, logits.shape[-1]),
+                    labels.reshape(-1), half_to_float=True))
             return amp_opt.scale_loss(loss, amp_state), loss
 
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)

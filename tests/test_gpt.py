@@ -264,3 +264,22 @@ def test_self_attention_key_padding_mask_paths_agree():
         fl.apply(variables, x,
                  attention_mask=jnp.zeros((2, 1, 16, 16), bool),
                  key_padding_mask=kpm)
+
+
+def test_hidden_states_method_consistent_with_call():
+    """hidden_states + tied-head projection == __call__ logits (the
+    chunked-CE entry point must see exactly the model's final hiddens)."""
+    model = GPTModel(vocab_size=VOCAB, hidden_size=HID, num_layers=2,
+                     num_attention_heads=HEADS, max_sequence_length=SEQ,
+                     attention_dropout=0.0, hidden_dropout=0.0,
+                     use_flash=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, SEQ), 0,
+                                VOCAB)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(variables, tokens)
+    h = model.apply(variables, tokens, method="hidden_states")
+    emb = unbox(variables)["params"]["embedding"]["word_embeddings"][
+        "embedding"]
+    np.testing.assert_allclose(np.asarray(h @ emb.T),
+                               np.asarray(logits), rtol=1e-5,
+                               atol=1e-6)
