@@ -125,6 +125,25 @@ def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if use_fast and not dropping and (mask is None or causal):
         return flash_attention(q, k, v, scale=scaling,
                                causal=causal)
+    kpm = None
+    if mask is not None and not mask_additive and not use_time_mask:
+        bsz = q.shape[0]
+        # key-padding masks only: (b, sk), or the modules' pre-expanded
+        # (b, 1, 1, sk).  A (sq, sk) attention mask stays on the
+        # generic path (it is per-query, not per-key).
+        if mask.ndim == 2 and mask.shape == (bsz, sk):
+            kpm = mask
+        elif mask.ndim == 4 and mask.shape == (bsz, 1, 1, sk):
+            kpm = mask[:, 0, 0, :]
+    if use_fast and not dropping and kpm is not None:
+        # (1 = masked out, the reference's boolean convention) rides
+        # the flash kernel's kv_mask lane — no [b, h, sq, sk] score
+        # materialization.  Degenerate all-padding rows emit exact
+        # zeros here vs the -10000-fill path's uniform mean(v); both
+        # are garbage by construction, zeros are the safer garbage
+        # (zero gradients).
+        return flash_attention(q, k, v, scale=scaling,
+                               kv_mask=~kpm.astype(bool))
 
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k)
     if causal:
