@@ -10,6 +10,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 # a site-packages 'examples' package shadows the repo's; load by path
 _spec = importlib.util.spec_from_file_location(
@@ -20,13 +21,23 @@ main_amp = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(main_amp)
 
 
-def _make_npz(path, n=256, size=32, classes=4, seed=0):
-    """Separable dataset: class-dependent color means + noise."""
+def _make_npz(path, n=256, size=32, classes=4, seed=0,
+              dtype=np.float32):
+    """Separable dataset: class-dependent color means + noise.
+    ``dtype=np.uint8`` stores [0, 1]-clipped values scaled to bytes
+    (the realistic image storage format)."""
     rng = np.random.RandomState(seed)
     labels = rng.randint(0, classes, size=n).astype(np.int32)
-    means = rng.uniform(-1, 1, size=(classes, 3)).astype(np.float32)
-    images = (means[labels][:, None, None, :]
-              + 0.3 * rng.randn(n, size, size, 3)).astype(np.float32)
+    if dtype == np.uint8:
+        means = rng.uniform(0.2, 0.8, size=(classes, 3)).astype(
+            np.float32)
+        images = np.clip(means[labels][:, None, None, :]
+                         + 0.1 * rng.randn(n, size, size, 3), 0, 1)
+        images = (images * 255).astype(np.uint8)
+    else:
+        means = rng.uniform(-1, 1, size=(classes, 3)).astype(np.float32)
+        images = (means[labels][:, None, None, :]
+                  + 0.3 * rng.randn(n, size, size, 3)).astype(np.float32)
     np.savez(path, images=images, labels=labels)
     return path
 
@@ -65,6 +76,28 @@ class TestImagenetDriverNpz:
         second = main_amp.main(argv)
         assert first < 0.9, f"no convergence via DataLoader: {first}"
         assert first == second, (first, second)
+
+    def test_uint8_dataset_through_native_loader(self, tmp_path):
+        """uint8 storage (the realistic image format): the loader's
+        worker-side v/255 normalization must feed the driver and
+        converge — exercises the C++ uint8 path end to end."""
+        from apex_tpu.data import native_available
+
+        if not native_available():
+            pytest.skip("no C++ toolchain for the native loader")
+        npz = _make_npz(str(tmp_path / "tiny_u8.npz"), seed=3,
+                        dtype=np.uint8)
+        final_loss = main_amp.main([
+            "--data", npz, "--arch", "resnet_tiny",
+            "--devices", "1", "--loader", "native",
+            "--batch-size", "32", "--iters", "60", "--epochs", "1",
+            "--image-size", "32", "--num-classes", "4",
+            "--lr", "0.02", "--opt-level", "O5", "--deterministic",
+            "--print-freq", "50",
+            "--checkpoint", str(tmp_path / "cku8.msgpack"),
+        ])
+        assert final_loss < 0.9, f"no convergence on uint8 data: " \
+                                 f"{final_loss}"
 
     def test_npz_deterministic_across_runs(self, tmp_path):
         """Same seed + deterministic flag => bitwise-equal loss curves
