@@ -49,11 +49,16 @@ class StepInfo(NamedTuple):
     # With a *dynamic* scaler this is the measured finite flag; with a
     # static scaler gradients are not inspected (reference parity: the
     # static LossScaler steps regardless of overflow) and this reports
-    # constant True meaning "unchecked" — pass check_finite=True to
-    # AmpOptimizer to measure (and skip) under static scaling too.
+    # constant True.  ``grads_checked`` distinguishes the two: telemetry
+    # that alerts on overflow must gate on ``grads_checked`` before
+    # reading ``grads_finite`` — pass check_finite=True to AmpOptimizer
+    # to measure (and skip) under static scaling too.
     grads_finite: jnp.ndarray
     loss_scale: jnp.ndarray
     steps_skipped: jnp.ndarray
+    # Static (Python) flag: False when the step ran without inspecting
+    # gradients, so grads_finite==True means "unchecked", not "healthy".
+    grads_checked: bool = True
 
 
 class AmpOptimizer:
@@ -200,6 +205,7 @@ class AmpOptimizer:
             grads_finite=finite,
             loss_scale=new_scaler.loss_scale,
             steps_skipped=new_scaler.steps_skipped,
+            grads_checked=check,
         )
 
     # -- checkpointing (ref: apex/amp/frontend.py:428-454) ------------------

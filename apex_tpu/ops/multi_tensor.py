@@ -305,11 +305,17 @@ def per_tensor_sumsq(buf: jnp.ndarray, meta: FlatMeta) -> jnp.ndarray:
     index constants (which OOM/413 at BERT-large scale).
 
     Each slice spans to the next LANE-aligned offset (the padding gap
-    belongs to its preceding tensor; gaps are zero in every packed
-    buffer, contributing nothing to a sum of squares) so the reduction
-    input reshapes to (rows, LANE) — a flat mega-vector reduce makes
-    XLA:TPU materialize an (N/2, 2) stage whose lane padding is 64x
-    the data."""
+    belongs to its preceding tensor) so the reduction input reshapes to
+    (rows, LANE) — a flat mega-vector reduce makes XLA:TPU materialize
+    an (N/2, 2) stage whose lane padding is 64x the data.
+
+    PRECONDITION: padding gaps in ``buf`` must be exactly zero so they
+    contribute nothing to the preceding tensor's sum.  ``pack`` zero-
+    fills gaps and the LAMB phase-1 math maps 0 -> 0 only when eps > 0
+    (enforced by the fused_lamb AND FusedMixedPrecisionLamb
+    constructors — both share _lamb_group_update); any new caller
+    writing gaps must keep them zero or switch to
+    ``device_segment_ids``-based masking."""
     x = buf.astype(jnp.float32)
     sums = []
     for k, o in enumerate(meta.offsets):

@@ -51,6 +51,13 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                max_grad_norm: float = 1.0,
                use_nvlamb: bool = False,
                use_pallas: bool = None) -> optax.GradientTransformation:
+    if eps <= 0.0:
+        # Packed trust-ratio math needs phase-1 to map zero-filled
+        # alignment gaps to exactly 0 (per_tensor_sumsq folds each gap
+        # into the preceding tensor's norm); eps=0 makes gaps 0/0=NaN
+        # and silently poisons that tensor's ratio.
+        raise ValueError("fused_lamb requires eps > 0 "
+                         "(packed padding-gap invariant)")
     LANE = multi_tensor.LANE
 
     def init(params):

@@ -69,6 +69,13 @@ class FusedMixedPrecisionLamb:
                  use_nvlamb: bool = False,
                  reduced_precision_dtype=jnp.bfloat16,
                  use_pallas: Optional[bool] = None):
+        if eps <= 0.0:
+            # Shares fused_lamb's packed trust-ratio math
+            # (_lamb_group_update): eps=0 makes zero-filled alignment
+            # gaps 0/0=NaN in phase-1, which per_tensor_sumsq folds
+            # into the preceding tensor's norm.
+            raise ValueError("FusedMixedPrecisionLamb requires eps > 0 "
+                             "(packed padding-gap invariant)")
         self.learning_rate = learning_rate
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
         self.weight_decay = weight_decay

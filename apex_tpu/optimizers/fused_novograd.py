@@ -43,6 +43,14 @@ def fused_novograd(learning_rate: ScalarOrSchedule = 1e-3,
     if norm_type != 2:
         raise ValueError("only norm_type=2 is supported "
                          "(ref: apex/optimizers/fused_novograd.py)")
+    if eps <= 0.0:
+        # NovoGrad's gaps are safe at any eps (per_tensor_sumsq only
+        # sees the zero-filled grad buffer; gap denominators come from
+        # broadcast_per_tensor's fill=1.0) — but eps=0 still NaNs any
+        # tensor whose grads are all zero: v=0 gives denom=0 for that
+        # tensor's REAL elements.
+        raise ValueError("fused_novograd requires eps > 0 "
+                         "(zero-grad tensors would divide by zero)")
     LANE = multi_tensor.LANE
 
     def init(params):

@@ -180,54 +180,59 @@ def bench_optimizers():
                 force_pack = label.endswith("_packed") \
                     and kind == "fused_us"
                 saved_direct_min = _mt.DIRECT_MIN_ELEMS
-                if force_pack:
-                    _mt.DIRECT_MIN_ELEMS = 1 << 22
-                # Params re-generated per run and donated into the step
-                # so at 355M a single chip holds one params copy + one
-                # state copy (donation reuses their HBM each iteration).
-                p = _synthetic_params(count, jax.random.PRNGKey(3),
-                                      leaf_elems=leaf_elems)
-                grads = jax.tree_util.tree_map(
-                    lambda x: x * 0.001 + 0.001, p)
-                s = jax.jit(tx.init)(p)
-                # distinct buffers for donation (zeros/constant leaves
-                # can share one cached buffer)
-                s = jax.tree_util.tree_map(jnp.array, s)
+                try:
+                    if force_pack:
+                        _mt.DIRECT_MIN_ELEMS = 1 << 22
+                    # Params re-generated per run and donated into the
+                    # step so at 355M a single chip holds one params copy
+                    # + one state copy (donation reuses their HBM each
+                    # iteration).
+                    p = _synthetic_params(count, jax.random.PRNGKey(3),
+                                          leaf_elems=leaf_elems)
+                    grads = jax.tree_util.tree_map(
+                        lambda x: x * 0.001 + 0.001, p)
+                    s = jax.jit(tx.init)(p)
+                    # distinct buffers for donation (zeros/constant
+                    # leaves can share one cached buffer)
+                    s = jax.tree_util.tree_map(jnp.array, s)
 
-                # K steps inside one jitted scan: a single dispatch per
-                # measurement, so per-call tunnel/dispatch overhead
-                # (~1 ms through the remote-device proxy, comparable to
-                # the optimizer step itself) does not pollute the
-                # microbenchmark.
-                K = 64
+                    # K steps inside one jitted scan: a single dispatch
+                    # per measurement, so per-call tunnel/dispatch
+                    # overhead (~1 ms through the remote-device proxy,
+                    # comparable to the optimizer step itself) does not
+                    # pollute the microbenchmark.
+                    K = 64
 
-                @functools.partial(jax.jit, donate_argnums=(1, 2))
-                def steps(g, s, p):
-                    def body(carry, _):
-                        s, p = carry
-                        # step-dependent grads: keeps per-step work
-                        # (e.g. gradient packing) inside the loop —
-                        # constant grads let XLA hoist it and
-                        # under-count; the extra elementwise add costs
-                        # both variants identically.
-                        g_t = jax.tree_util.tree_map(
-                            lambda gg, pp: gg + 1e-12 * pp, g, p)
-                        u, s2 = tx.update(g_t, s, p)
-                        return (s2, optax.apply_updates(p, u)), ()
-                    (s, p), _ = jax.lax.scan(body, (s, p), None, length=K)
-                    return s, p
+                    @functools.partial(jax.jit, donate_argnums=(1, 2))
+                    def steps(g, s, p):
+                        def body(carry, _):
+                            s, p = carry
+                            # step-dependent grads: keeps per-step work
+                            # (e.g. gradient packing) inside the loop —
+                            # constant grads let XLA hoist it and
+                            # under-count; the extra elementwise add
+                            # costs both variants identically.
+                            g_t = jax.tree_util.tree_map(
+                                lambda gg, pp: gg + 1e-12 * pp, g, p)
+                            u, s2 = tx.update(g_t, s, p)
+                            return (s2, optax.apply_updates(p, u)), ()
+                        (s, p), _ = jax.lax.scan(body, (s, p), None,
+                                                 length=K)
+                        return s, p
 
-                s, p = steps(grads, s, p)
-                _force(p)
-                # best-of-3: the shared bench chip shows +-2x run noise
-                dt = float("inf")
-                for _rep in range(3):
-                    t0 = time.perf_counter()
                     s, p = steps(grads, s, p)
                     _force(p)
-                    dt = min(dt, (time.perf_counter() - t0) / K)
-                del p, s, grads
-                _mt.DIRECT_MIN_ELEMS = saved_direct_min
+                    # best-of-3: the shared bench chip shows +-2x run
+                    # noise
+                    dt = float("inf")
+                    for _rep in range(3):
+                        t0 = time.perf_counter()
+                        s, p = steps(grads, s, p)
+                        _force(p)
+                        dt = min(dt, (time.perf_counter() - t0) / K)
+                    del p, s, grads
+                finally:
+                    _mt.DIRECT_MIN_ELEMS = saved_direct_min
                 row[kind] = round(dt * 1e6, 1)
             row["speedup"] = round(row["unfused_us"] / row["fused_us"], 3)
             results.append(row)

@@ -211,6 +211,7 @@ def test_static_scale_steps_unconditionally_reference_parity():
     new_params, _, info = jax.jit(opt.apply_gradients)(
         inf_grads, state, params)
     assert bool(info.grads_finite)  # "unchecked", reported True
+    assert not bool(info.grads_checked)  # telemetry must gate on this
     assert not np.isfinite(np.asarray(new_params["w"])).all()  # stepped
 
     forced = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O5"),
@@ -219,6 +220,7 @@ def test_static_scale_steps_unconditionally_reference_parity():
     held_params, _, finfo = jax.jit(forced.apply_gradients)(
         inf_grads, fstate, params)
     assert not bool(finfo.grads_finite)
+    assert bool(finfo.grads_checked)
     np.testing.assert_array_equal(np.asarray(held_params["w"]),
                                   np.asarray(params["w"]))  # held
 

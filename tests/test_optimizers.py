@@ -245,6 +245,20 @@ def test_fused_lamb_grad_clipping():
                for l in jax.tree_util.tree_leaves(u))
 
 
+def test_lamb_novograd_reject_eps_zero():
+    """LAMB variants: eps=0 turns zero-filled packed padding gaps into
+    0/0=NaN in phase-1, poisoning the preceding tensor's trust ratio
+    (per_tensor_sumsq gap-zero precondition).  NovoGrad's gaps are safe
+    (grad-buffer sumsq, fill=1.0 denominators) but eps=0 NaNs any
+    all-zero-grad tensor's real elements (v=0 -> denom=0)."""
+    with pytest.raises(ValueError, match="eps > 0"):
+        opt.fused_lamb(0.1, eps=0.0)
+    with pytest.raises(ValueError, match="eps > 0"):
+        opt.fused_novograd(1e-2, eps=0.0)
+    with pytest.raises(ValueError, match="eps > 0"):
+        opt.FusedMixedPrecisionLamb(0.1, eps=0.0)
+
+
 def test_fused_lamb_pallas_matches_jnp():
     params = make_params()
     g = make_grads(params)
