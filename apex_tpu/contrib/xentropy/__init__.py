@@ -34,14 +34,26 @@ def softmax_cross_entropy_loss(logits: jnp.ndarray,
 
 
 def _xent_fwd(logits, labels, smoothing, half_to_float, padding_idx):
-    x = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(x, axis=-1)
-    nll = lse - jnp.take_along_axis(
-        x, labels[..., None], axis=-1).squeeze(-1)
+    # Keep each fp32 view of the logits SINGLE-consumer so XLA fuses
+    # the upcast into the reduction instead of materializing an fp32
+    # copy of the whole (tokens, vocab) array (measured 2.1 ms/step of
+    # pure convert+write at GPT-345M's 50k vocab).  jax's logsumexp
+    # feeds the SAME fp32 view to both the max and the exp-sum, so the
+    # convert materializes; computing the row max in the INPUT dtype
+    # (exact — the max of bf16 values IS their bf16 max) leaves one
+    # fp32 consumer: the exp-sum reduction.  The label logit is
+    # gathered from the low-precision logits (tokens-sized, exact in
+    # fp32 after the cast of just those elements).
+    m = jnp.max(logits, axis=-1).astype(jnp.float32)
+    lse = m + jnp.log(jnp.sum(
+        jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1))
+    x_label = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1).squeeze(-1)
+    nll = lse - x_label.astype(jnp.float32)
     if smoothing > 0.0:
         # (1-eps)*nll + eps*mean_j(lse - x_j)
         # (ref: xentropy_kernel.cu label-smoothing path).
-        smooth = lse - jnp.mean(x, axis=-1)
+        smooth = lse - jnp.mean(logits.astype(jnp.float32), axis=-1)
         loss = (1.0 - smoothing) * nll + smoothing * smooth
     else:
         loss = nll
@@ -54,14 +66,20 @@ def _xent_fwd(logits, labels, smoothing, half_to_float, padding_idx):
 
 def _xent_bwd(smoothing, half_to_float, padding_idx, res, dloss):
     logits, labels, lse = res
-    x = logits.astype(jnp.float32)
-    probs = jnp.exp(x - lse[..., None])
     vocab = logits.shape[-1]
-    onehot = jax.nn.one_hot(labels, vocab, dtype=jnp.float32)
-    target = (1.0 - smoothing) * onehot + smoothing / vocab
     dloss = dloss.astype(jnp.float32)
     if padding_idx is not None:
         dloss = jnp.where(labels == padding_idx, 0.0, dloss)
+    # One fused elementwise pass: probs (exp of the inline-upcast
+    # logits), the iota-compare one-hot, and the dloss scaling all
+    # land in a single bf16-out kernel — no fp32 (tokens, vocab)
+    # temporary (jax.nn.one_hot would materialize one).
+    onehot = (jax.lax.broadcasted_iota(labels.dtype, logits.shape,
+                                       logits.ndim - 1)
+              == labels[..., None])
+    target = jnp.where(onehot, 1.0 - smoothing + smoothing / vocab,
+                       smoothing / vocab)
+    probs = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
     dx = (probs - target) * dloss[..., None]
     return dx.astype(logits.dtype), None
 

@@ -9,11 +9,27 @@ materialization (the reference's core attention materializes
 the shape caps: any sq/sk (padded to block multiples), head dim 64-256,
 causal or full attention.
 
-Layout: q (b, h, sq, d), k/v (b, h, sk, d).  Grid (b*h, q-blocks,
-k-blocks), k innermost: VMEM scratch carries the running max, sum and
-accumulator across k-blocks (TPU grids iterate sequentially, so scratch
-is a legal carry).  Matmuls hit the MXU in the input dtype with fp32
-accumulation; softmax math is fp32.
+Layout: q (b, h, sq, d), k/v (b, h, sk, d).  Matmuls hit the MXU in the
+input dtype with fp32 accumulation; softmax math is fp32.
+
+Kernel-economy notes (v5e profile at GPT-345M shapes, b=8 h=16 s=1024
+d=64; structural matmul minimum fwd 262 us / bwd 611 us per call):
+- ``exp2`` with pre-folded constants: softmax runs as
+  ``exp2(s*a - m*a)`` with ``a = scale*log2(e)``, so no separate
+  ``s*scale`` pass over the (bq, bk) score array and no ln<->log2
+  conversion inside the hot loop.
+- scale folding: the backward feeds ``v*scale`` to the ``dp`` matmul
+  and pre-scales ``delta`` outside the kernel, turning
+  ``ds = p*(dp-delta)*scale`` into ``ds = p*(dp'-delta')`` — one fewer
+  score-shaped multiply.
+- no materialized transposes: ``dv = p^T do`` / ``dk = ds^T q`` use
+  ``dot_general`` contracting dim 0 of both operands (MXU-native)
+  instead of ``.T``-then-matmul, which lowers to cross-lane VPU
+  shuffles over the full score block.
+- static mask elision: block-aligned sequences (the common case) skip
+  the ``k_pos < sk`` compare entirely; q-padded rows are killed by
+  padding the saved logsumexp with +BIG (``exp2 -> 0``) rather than by
+  per-element masks.  Only ``causal`` and ``kv_mask`` pay a select.
 
 Backward: when the padded sequence fits one block and d <= 64 (the
 common case at the default 1024 blocks — e.g. GPT-345M s=1024), a
@@ -26,14 +42,18 @@ probabilities from the saved per-row logsumexp.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+_BIG = 1e30
+_LOG2E = math.log2(math.e)
 # Tuned on v5e via the GPT-345M train-step profile (b=8, h=16, s=1024,
 # d=64; device-time deltas are stable run-to-run even when wall clock is
 # not): (1024, 1024) beats (512, 1024) — 56.4 vs 62.2 ms/step of kernel
@@ -82,9 +102,75 @@ def _dot(a, b, trans_b=False):
                                preferred_element_type=jnp.float32)
 
 
+def _dot_t0(a, b):
+    """a^T @ b via dot_general contracting dim 0 of both operands —
+    the MXU consumes the transposed layout natively; an explicit
+    ``a.T`` would materialize the block through VPU lane shuffles."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _tri_mask(shape, q_off, k_off):
+    """q_pos >= k_pos causal mask from thin iotas (broadcast compare:
+    no full-block int32 position arrays)."""
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (shape[0], 1), 0)
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (1, shape[1]), 1)
+    return q_pos >= k_pos
+
+
+def _kcol_mask(shape, k_off, sk):
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (1, shape[1]), 1)
+    return jnp.broadcast_to(k_pos < sk, shape)
+
+
 # --- forward ---------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
+def _fwd_single_kernel(scale, a, causal, has_kvm, kpad, sq, sk,
+                       q_ref, k_ref, v_ref, *rest):
+    """Whole-(padded)-sequence-in-one-block forward: plain softmax, no
+    online-correction carries (the default 1024 blocks put GPT s=1024
+    and BERT s=512 here)."""
+    if has_kvm:
+        kvm_ref, o_ref, lse_ref = rest
+    else:
+        kvm_ref = None
+        o_ref, lse_ref = rest
+    q = q_ref[0]
+    k = k_ref[0]
+    s = _dot(q, k, trans_b=True)                      # raw logits, fp32
+    mask = None
+    if causal:
+        mask = _tri_mask(s.shape, 0, 0)
+    if kpad and not has_kvm:
+        # _kvm8 zero-pads, so kv_mask already masks pad columns
+        km = _kcol_mask(s.shape, 0, sk)
+        mask = km if mask is None else (mask & km)
+    if has_kvm:
+        vm = kvm_ref[0, 0, 0, :][None, :] > 0
+        mask = vm if mask is None else (mask & vm)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m = jnp.max(s, axis=1, keepdims=True)             # raw units
+    p = jnp.exp2((s - m) * a)
+    l = jnp.sum(p, axis=1, keepdims=True)
+    if has_kvm:
+        # fully-masked rows: m stayed at _NEG so (s - m) = 0 and p = 1
+        # spuriously; zero them via the row max instead of a
+        # score-shaped select.
+        dead = m <= _NEG * 0.5
+        l = jnp.where(dead, 0.0, l)
+    acc = _dot(p.astype(v_ref.dtype), v_ref[0])
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o = acc / safe_l
+    if has_kvm:
+        o = jnp.where(dead, 0.0, o)
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse = m * scale + jnp.log(safe_l)
+    lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :],
+                                     lse_ref.shape[2:])
+
+
+def _fwd_kernel(scale, a, causal, has_kvm, kpad, sq, sk, bq, bk,
                 q_ref, k_ref, v_ref, *rest):
     if has_kvm:
         kvm_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
@@ -107,24 +193,29 @@ def _fwd_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True) * scale          # (bq, bk) fp32
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos < sk
+        s = _dot(q, k, trans_b=True)                  # raw logits, fp32
+        mask = None
         if causal:
-            mask &= q_pos >= k_pos
+            mask = _tri_mask(s.shape, i * bq, j * bk)
+        if kpad and not has_kvm:
+            # _kvm8 zero-pads, so kv_mask already masks pad columns
+            km = _kcol_mask(s.shape, j * bk, sk)
+            mask = km if mask is None else (mask & km)
         if has_kvm:
-            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
-        s = jnp.where(mask, s, _NEG)
+            vm = kvm_ref[0, 0, 0, :][None, :] > 0
+            mask = vm if mask is None else (mask & vm)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
         m_prev = m_sc[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        corr = jnp.exp(m_prev - m_cur)
-        # explicit zero for masked entries: when a row is FULLY masked
-        # the running max equals _NEG and exp(s - m) would be 1, not 0
-        # — with the explicit mask such rows sum to l = 0, hit the
-        # zero-guard at the end, and emit exactly 0 (matching the
-        # backward kernels, which also zero p; gradients are 0 too).
-        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
+        corr = jnp.exp2((m_prev - m_cur) * a)
+        p = jnp.exp2((s - m_cur) * a)
+        if has_kvm:
+            # rows with every key masked so far keep m_cur = _NEG and
+            # (s - m_cur) = 0 at masked entries — zero p explicitly so
+            # such rows sum to l = 0 and emit exactly 0 (matching the
+            # backward, where the kv select already zeroes them).
+            p = jnp.where(mask, p, 0.0)
         l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
         m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
@@ -135,25 +226,29 @@ def _fwd_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
         l = l_sc[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
-        lse = m_sc[:, :1] + jnp.log(l)
+        lse = m_sc[:, :1] * scale + jnp.log(l)
         lse_ref[0, 0] = jnp.broadcast_to(lse[:, 0][None, :],
                                          lse_ref.shape[2:])
 
 
-def _pad_to(x, axis, mult):
+def _pad_to(x, axis, mult, value=0.0):
     size = x.shape[axis]
     pad = (-size) % mult
     if pad == 0:
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(x, widths)
+    return jnp.pad(x, widths, constant_values=value)
 
 
 def _kvm8(kv_mask, b, psk, bk):
-    """(b, sk) key-validity mask -> (b, nkb, 8, bk) sublane-replicated
-    fp32 blocks (same trick as :func:`_rows8`)."""
-    m = _pad_to(kv_mask.astype(jnp.float32), 1, bk)  # (b, psk), pads 0
+    """(b, sk) key-validity mask -> (b, psk/bk, 8, bk) sublane-
+    replicated fp32 blocks (same trick as :func:`_rows8`).  Pads with
+    zeros (= masked) to ``psk`` EXACTLY — the packed path's padded
+    length can exceed the next bk multiple of sk."""
+    m = kv_mask.astype(jnp.float32)
+    if m.shape[1] < psk:
+        m = jnp.pad(m, ((0, 0), (0, psk - m.shape[1])))
     return jnp.broadcast_to(
         m.reshape(b, psk // bk, 1, bk), (b, psk // bk, 8, bk))
 
@@ -170,6 +265,38 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
     bh, psq, _ = q3.shape
     psk = k3.shape[1]
     nq, nk = psq // bq, psk // bk
+    a = scale * _LOG2E
+    kpad = psk != sk
+
+    has_kvm = kv_mask is not None
+    if nq == 1 and nk == 1:
+        qb_spec = pl.BlockSpec((1, psq, d), lambda b_: (b_, 0, 0),
+                               memory_space=pltpu.VMEM)
+        kb_spec = pl.BlockSpec((1, psk, d), lambda b_: (b_, 0, 0),
+                               memory_space=pltpu.VMEM)
+        lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
+        in_specs = [qb_spec, kb_spec, kb_spec]
+        operands = [q3, k3, v3]
+        if has_kvm:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
+                memory_space=pltpu.VMEM))
+            operands.append(_kvm8(kv_mask, b, psk, bk))
+        o, lse8 = pl.pallas_call(
+            functools.partial(_fwd_single_kernel, scale, a, causal,
+                              has_kvm, kpad, sq, sk),
+            grid=(bh,),
+            in_specs=in_specs,
+            out_specs=[qb_spec, lse_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, 8, bq), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(*operands)
+        lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
+        return o[:, :sq].reshape(b, h, sq, d), lse
 
     q_spec = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
                           memory_space=pltpu.VMEM)
@@ -177,7 +304,6 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
                           memory_space=pltpu.VMEM)
     lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
                             memory_space=pltpu.VMEM)
-    has_kvm = kv_mask is not None
     in_specs = [q_spec, k_spec, k_spec]
     operands = [q3, k3, v3]
     if has_kvm:
@@ -187,8 +313,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
         in_specs.append(kvm_spec)
         operands.append(_kvm8(kv_mask, b, psk, bk))
     o, lse8 = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, causal, has_kvm, sq, sk,
-                          bq, bk),
+        functools.partial(_fwd_kernel, scale, a, causal, has_kvm, kpad,
+                          sq, sk, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[q_spec, lse_spec],
@@ -207,10 +333,114 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
     return o[:, :sq].reshape(b, h, sq, d), lse
 
 
-# --- backward --------------------------------------------------------------
+def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
+                      kv_mask=None):
+    """Self-attention forward over PACKED qkv (3*b*h, s, d): q/k/v are
+    row-ranges of one contiguous array, read via index-map offsets into
+    the SAME operand — no per-tensor relayout copies at the custom-call
+    boundary (measured 7.5 ms/step of pure (b,h,s,d) layout copies at
+    GPT-345M with the unpacked entry)."""
+    bh = b * h
+    s, d = qkv.shape[1], qkv.shape[2]
+    block_q, block_k = _clamp_blocks(block_q, block_k, d)
+    # clamp both blocks to s rounded up to the 128-lane grain: an
+    # s-sized bq next to a 128-floored bk would make lcm(bq, bk) — the
+    # shared padded length both block grids must divide — blow up
+    # (s=50 with default blocks: lcm(50, 128) = 3200).
+    grain = -(-s // 128) * 128
+    bq = min(block_q, grain)
+    bk = min(block_k, grain)
+    qkv3 = _pad_to(qkv, 1, math.lcm(bq, bk))
+    ps = qkv3.shape[1]
+    nq, nk = ps // bq, ps // bk
+    a = scale * _LOG2E
+    kpad = ps != s
+    has_kvm = kv_mask is not None
 
-def _bwd_dq_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
-                   q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    if nq == 1 and nk == 1:
+        def blkspec(off):
+            return pl.BlockSpec((1, ps, d),
+                                lambda b_, o=off: (b_ + o, 0, 0),
+                                memory_space=pltpu.VMEM)
+        lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+                                memory_space=pltpu.VMEM)
+        in_specs = [blkspec(0), blkspec(bh), blkspec(2 * bh)]
+        operands = [qkv3, qkv3, qkv3]
+        if has_kvm:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
+                memory_space=pltpu.VMEM))
+            operands.append(_kvm8(kv_mask, b, ps, bk))
+        o_spec = pl.BlockSpec((1, ps, d), lambda b_: (b_, 0, 0),
+                              memory_space=pltpu.VMEM)
+        o, lse8 = pl.pallas_call(
+            functools.partial(_fwd_single_kernel, scale, a, causal,
+                              has_kvm, kpad, s, s),
+            grid=(bh,),
+            in_specs=in_specs,
+            out_specs=[o_spec, lse_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, ps, d), qkv.dtype),
+                jax.ShapeDtypeStruct((bh, 1, 8, bq), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(*operands)
+        lse = lse8[:, :, 0, :].reshape(bh, ps)[:, :s]
+        return o[:, :s], lse
+
+    def qspec(off):
+        return pl.BlockSpec((1, bq, d),
+                            lambda b_, i, j, o=off: (b_ + o, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    def kspec(off):
+        return pl.BlockSpec((1, bk, d),
+                            lambda b_, i, j, o=off: (b_ + o, j, 0),
+                            memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [qspec(0), kspec(bh), kspec(2 * bh)]
+    operands = [qkv3, qkv3, qkv3]
+    if has_kvm:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, j, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(_kvm8(kv_mask, b, ps, bk))
+    o, lse8 = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale, a, causal, has_kvm, kpad,
+                          s, s, bq, bk),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[qspec(0), lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, ps, d), qkv.dtype),
+            jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*operands)
+    lse = lse8[:, :, 0, :].reshape(bh, ps)[:, :s]
+    return o[:, :s], lse
+
+
+# --- backward --------------------------------------------------------------
+#
+# All backward kernels recompute p as exp2(s*a - lse2) where
+# lse2 = lse*log2(e) is pre-scaled OUTSIDE the kernel and q-padded rows
+# get lse2 = +BIG (p underflows to exactly 0 — no q-position masks).
+# v arrives pre-multiplied by ``scale`` so ds = p*(dp' - delta') needs
+# no trailing ``*scale`` (delta' = delta*scale, also outside).  k-padded
+# columns keep a (static, unaligned-only) mask: their s is 0 so
+# p = exp2(-lse2) which can overflow to inf when lse is very negative,
+# and inf * the zero k-pad rows would NaN dq.  The kv_mask path needs
+# no kpad mask — _kvm8 zero-pads, masking pad columns for free.
+
+def _bwd_dq_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
+                   q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
                    *rest):
     if has_kvm:
         kvm_ref, dq_ref, dq_acc = rest
@@ -231,19 +461,25 @@ def _bwd_dq_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True) * scale
-        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = k_pos < sk
+        s = _dot(q, k, trans_b=True)
+        lse2 = lse2_ref[0, 0, 0, :][:, None]
+        arg = s * a - lse2
+        mask = None
         if causal:
-            mask &= q_pos >= k_pos
+            mask = _tri_mask(s.shape, i * bq, j * bk)
+        if kpad and not has_kvm:
+            km = _kcol_mask(s.shape, j * bk, sk)
+            mask = km if mask is None else (mask & km)
         if has_kvm:
-            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
-        lse = lse_ref[0, 0, 0, :][:, None]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dp = _dot(do_ref[0], v_ref[0], trans_b=True)
+            vm = kvm_ref[0, 0, 0, :][None, :] > 0
+            mask = vm if mask is None else (mask & vm)
+        if mask is not None:
+            arg = jnp.where(mask, arg, _NEG)
+        p = jnp.exp2(arg)
+        vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
+        dp = _dot(do_ref[0], vs, trans_b=True)
         delta = delta_ref[0, 0, 0, :][:, None]
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
         dq_acc[:] += _dot(ds.astype(k.dtype), k)
 
     @pl.when(j == nk - 1)
@@ -251,8 +487,8 @@ def _bwd_dq_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
-                    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
+                    q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
                     *rest):
     if has_kvm:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
@@ -274,22 +510,28 @@ def _bwd_dkv_kernel(scale, causal, has_kvm, sq, sk, bq, bk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True) * scale          # (bq, bk)
-        q_pos = j * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (k_pos < sk) & (q_pos < sq)
+        s = _dot(q, k, trans_b=True)                  # (bq, bk)
+        lse2 = lse2_ref[0, 0, 0, :][:, None]
+        arg = s * a - lse2
+        mask = None
         if causal:
-            mask &= q_pos >= k_pos
+            mask = _tri_mask(s.shape, j * bq, i * bk)
+        if kpad and not has_kvm:
+            km = _kcol_mask(s.shape, i * bk, sk)
+            mask = km if mask is None else (mask & km)
         if has_kvm:
-            mask &= kvm_ref[0, 0, 0, :][None, :] > 0
-        lse = lse_ref[0, 0, 0, :][:, None]
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            vm = kvm_ref[0, 0, 0, :][None, :] > 0
+            mask = vm if mask is None else (mask & vm)
+        if mask is not None:
+            arg = jnp.where(mask, arg, _NEG)
+        p = jnp.exp2(arg)
         do = do_ref[0]
-        dv_acc[:] += _dot(p.astype(do.dtype).T, do)
-        dp = _dot(do, v_ref[0], trans_b=True)
+        dv_acc[:] += _dot_t0(p.astype(do.dtype), do)
+        vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
+        dp = _dot(do, vs, trans_b=True)
         delta = delta_ref[0, 0, 0, :][:, None]
-        ds = p * (dp - delta) * scale                 # (bq, bk)
-        dk_acc[:] += _dot(ds.astype(q.dtype).T, q)
+        ds = p * (dp - delta)                         # (bq, bk)
+        dk_acc[:] += _dot_t0(ds.astype(q.dtype), q)
 
     @pl.when(j == nq - 1)
     def _finish():
@@ -304,40 +546,47 @@ def _rows8(x2d, bq):
         x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
 
 
-def _bwd_fused_kernel(scale, causal, has_kvm, sq, sk,
-                      q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_fused_kernel(a, vscale, causal, has_kvm, kpad, sq, sk,
+                      q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
                       *rest):
-    if has_kvm:
-        kvm_ref, dq_ref, dk_ref, dv_ref = rest
-    else:
-        kvm_ref = None
-        dq_ref, dk_ref, dv_ref = rest
     """Single-block backward: when the whole (padded) sequence fits one
     q-block and one k-block, dq/dk/dv come from ONE pass — the scores
     ``s`` and ``dp`` are computed once instead of once per kernel (the
     two-kernel flash backward recomputes both), removing 2 of the 7
     matmuls; the two it removes are the d-contracted (half-MXU-lane)
     ones, so the saving exceeds their FLOP share."""
+    if has_kvm:
+        kvm_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        kvm_ref = None
+        dq_ref, dk_ref, dv_ref = rest
     q = q_ref[0]
     k = k_ref[0]
-    v = v_ref[0]
     do = do_ref[0]
-    s = _dot(q, k, trans_b=True) * scale              # (sq, sk) fp32
-    q_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = (k_pos < sk) & (q_pos < sq)
+    s = _dot(q, k, trans_b=True)                      # (sq, sk) fp32
+    # dp next: it does not depend on the softmax, so the VPU's
+    # exp2/select work on p overlaps this MXU pass.
+    vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
+    dp = _dot(do, vs, trans_b=True)
+    lse2 = lse2_ref[0, 0, 0, :][:, None]
+    arg = s * a - lse2
+    mask = None
     if causal:
-        mask &= q_pos >= k_pos
+        mask = _tri_mask(s.shape, 0, 0)
+    if kpad and not has_kvm:
+        km = _kcol_mask(s.shape, 0, sk)
+        mask = km if mask is None else (mask & km)
     if has_kvm:
-        mask &= kvm_ref[0, 0, 0, :][None, :] > 0
-    lse = lse_ref[0, 0, 0, :][:, None]
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-    dv_ref[0] = _dot(p.astype(do.dtype).T, do).astype(dv_ref.dtype)
-    dp = _dot(do, v, trans_b=True)
+        vm = kvm_ref[0, 0, 0, :][None, :] > 0
+        mask = vm if mask is None else (mask & vm)
+    if mask is not None:
+        arg = jnp.where(mask, arg, _NEG)
+    p = jnp.exp2(arg)
+    dv_ref[0] = _dot_t0(p.astype(do.dtype), do).astype(dv_ref.dtype)
     delta = delta_ref[0, 0, 0, :][:, None]
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta)
     dq_ref[0] = _dot(ds.astype(k.dtype), k).astype(dq_ref.dtype)
-    dk_ref[0] = _dot(ds.astype(q.dtype).T, q).astype(dk_ref.dtype)
+    dk_ref[0] = _dot_t0(ds.astype(q.dtype), q).astype(dk_ref.dtype)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
@@ -347,19 +596,32 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
     bq = min(block_q, max(8, sq))
     bk = min(block_k, max(128, sk))
+    a = scale * _LOG2E
     q3 = _pad_to(q.reshape(b * h, sq, d), 1, bq)
     k3 = _pad_to(k.reshape(b * h, sk, d), 1, bk)
-    v3 = _pad_to(v.reshape(b * h, sk, d), 1, bk)
+    # scale folds into v INSIDE the kernels (a (bk, d) multiply in
+    # VMEM) so dp' = do (v*scale)^T and ds needs no score-shaped
+    # *scale; doing it here instead would cost a whole-array
+    # read+write pass per layer (measured ~1.4 ms/step at GPT-345M).
+    vs3 = _pad_to(v.reshape(b * h, sk, d), 1, bk)
     do3 = _pad_to(do.reshape(b * h, sq, d), 1, bq)
     bh, psq, _ = q3.shape
     psk = k3.shape[1]
     nq, nk = psq // bq, psk // bk
+    kpad = psk != sk
 
+    # delta scales by the SAME v.dtype-rounded constant the kernels
+    # fold into v: a non-power-of-two scale (e.g. d=96) rounds in bf16,
+    # and mixing rounded dp' with exact-scaled delta' would bias
+    # ds = p*(dp'-delta') wherever dp ~ delta.
+    scale_v = float(np.asarray(scale).astype(v.dtype))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(bh, sq)
+                    axis=-1).reshape(bh, sq) * scale_v
     delta = _pad_to(delta, 1, bq)
-    lse_p = _pad_to(lse, 1, bq)
-    lse8 = _rows8(lse_p, bq)
+    # +BIG pad: q-padded rows recompute p = exp2(s*a - BIG) = 0, so
+    # they contribute nothing to dk/dv and need no position masks.
+    lse2_p = _pad_to(lse * _LOG2E, 1, bq, value=_BIG)
+    lse8 = _rows8(lse2_p, bq)
     delta8 = _rows8(delta, bq)
     has_kvm = kv_mask is not None
     kvm = _kvm8(kv_mask, b, psk, bk) if has_kvm else None
@@ -377,15 +639,15 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
                                memory_space=pltpu.VMEM)
         in_specs = [qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
                     rb_spec]
-        operands = [q3, k3, v3, do3, lse8, delta8]
+        operands = [q3, k3, vs3, do3, lse8, delta8]
         if has_kvm:
             in_specs.append(pl.BlockSpec(
                 (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
                 memory_space=pltpu.VMEM))
             operands.append(kvm)
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale, causal,
-                              has_kvm, sq, sk),
+            functools.partial(_bwd_fused_kernel, a, scale, causal,
+                              has_kvm, kpad, sq, sk),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, kb_spec, kb_spec],
@@ -407,7 +669,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
 
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
                 r_spec_i]
-    operands = [q3, k3, v3, do3, lse8, delta8]
+    operands = [q3, k3, vs3, do3, lse8, delta8]
     if has_kvm:
         # kv mask indexed by the K block (grid dim 2 here)
         in_specs.append(pl.BlockSpec(
@@ -415,8 +677,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
             memory_space=pltpu.VMEM))
         operands.append(kvm)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale, causal, has_kvm, sq,
-                          sk, bq, bk),
+        functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm, kpad,
+                          sq, sk, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec_i,
@@ -433,7 +695,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
                             memory_space=pltpu.VMEM)
     in_specs = [q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
                 r_spec_j]
-    operands = [q3, k3, v3, do3, lse8, delta8]
+    operands = [q3, k3, vs3, do3, lse8, delta8]
     if has_kvm:
         # kv mask indexed by the K block (grid dim 1 here)
         in_specs.append(pl.BlockSpec(
@@ -441,8 +703,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
             memory_space=pltpu.VMEM))
         operands.append(kvm)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale, causal, has_kvm, sq,
-                          sk, bq, bk),
+        functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm, kpad,
+                          sq, sk, bq, bk),
         grid=(bh, nk, nq),
         in_specs=in_specs,
         out_specs=[k_spec_i, k_spec_i],
@@ -456,6 +718,139 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     return (dq[:, :sq].reshape(b, h, sq, d),
             dk[:, :sk].reshape(b, h, sk, d),
             dv[:, :sk].reshape(b, h, sk, d))
+
+
+def _flash_bwd_packed(scale, causal, block_q, block_k, res, do,
+                      kv_mask=None):
+    """Backward of :func:`_flash_fwd_packed`: the saved PACKED qkv is
+    read three times through offset index maps (no q/k/v relayout
+    copies); dq/dk/dv come back as one (3*b*h, s, d) array so the
+    caller's qkv-cotangent transpose fuses with this concatenation."""
+    qkv, o, lse, b, h = res
+    bh = b * h
+    s, d = qkv.shape[1], qkv.shape[2]
+    block_q, block_k = _clamp_blocks(block_q, block_k, d)
+    grain = -(-s // 128) * 128      # see _flash_fwd_packed
+    bq = min(block_q, grain)
+    bk = min(block_k, grain)
+    a = scale * _LOG2E
+    # everything q-indexed pads to the SAME ps as the packed qkv: the
+    # q-block grid spans ps // bq blocks, and a shorter do/lse/delta
+    # would alias real rows through Pallas' clamped block indexing.
+    lcm = math.lcm(bq, bk)
+    qkv3 = _pad_to(qkv, 1, lcm)
+    do3 = _pad_to(do, 1, lcm)
+    ps = qkv3.shape[1]
+    nq, nk = ps // bq, ps // bk
+    kpad = ps != s
+
+    scale_v = float(np.asarray(scale).astype(qkv.dtype))  # see _flash_bwd
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1) * scale_v
+    delta = _pad_to(delta, 1, lcm)
+    lse2_p = _pad_to(lse * _LOG2E, 1, lcm, value=_BIG)
+    lse8 = _rows8(lse2_p, bq)
+    delta8 = _rows8(delta, bq)
+    has_kvm = kv_mask is not None
+    kvm = _kvm8(kv_mask, b, ps, bk) if has_kvm else None
+
+    if nq == 1 and nk == 1 and d <= 64:
+        def blkspec(off):
+            return pl.BlockSpec((1, ps, d),
+                                lambda b_, o_=off: (b_ + o_, 0, 0),
+                                memory_space=pltpu.VMEM)
+        ob_spec = pl.BlockSpec((1, ps, d), lambda b_: (b_, 0, 0),
+                               memory_space=pltpu.VMEM)
+        rb_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        in_specs = [blkspec(0), blkspec(bh), blkspec(2 * bh), ob_spec,
+                    rb_spec, rb_spec]
+        operands = [qkv3, qkv3, qkv3, do3, lse8, delta8]
+        if has_kvm:
+            in_specs.append(pl.BlockSpec(
+                (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
+                memory_space=pltpu.VMEM))
+            operands.append(kvm)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, a, scale, causal,
+                              has_kvm, kpad, s, s),
+            grid=(bh,),
+            in_specs=in_specs,
+            out_specs=[ob_spec, ob_spec, ob_spec],
+            out_shape=[jax.ShapeDtypeStruct((bh, ps, d), qkv.dtype)] * 3,
+            interpret=_interpret(),
+        )(*operands)
+        return jnp.concatenate([dq[:, :s], dk[:, :s], dv[:, :s]],
+                               axis=0)
+
+    def spec_q(off):
+        return pl.BlockSpec((1, bq, d),
+                            lambda b_, i, j, o_=off: (b_ + o_, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    def spec_k(off):
+        return pl.BlockSpec((1, bk, d),
+                            lambda b_, i, j, o_=off: (b_ + o_, j, 0),
+                            memory_space=pltpu.VMEM)
+    r_spec_i = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+                            memory_space=pltpu.VMEM)
+    # do3 is its own (bh, ps, d) operand; spec_q(0) indexes it too
+    in_specs = [spec_q(0), spec_k(bh), spec_k(2 * bh), spec_q(0),
+                r_spec_i, r_spec_i]
+    operands = [qkv3, qkv3, qkv3, do3, lse8, delta8]
+    if has_kvm:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, j, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(kvm)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm,
+                          kpad, s, s, bq, bk),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, ps, d), qkv.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+
+    def spec_qj(off):
+        return pl.BlockSpec((1, bq, d),
+                            lambda b_, i, j, o_=off: (b_ + o_, j, 0),
+                            memory_space=pltpu.VMEM)
+
+    def spec_ki(off):
+        return pl.BlockSpec((1, bk, d),
+                            lambda b_, i, j, o_=off: (b_ + o_, i, 0),
+                            memory_space=pltpu.VMEM)
+    r_spec_j = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, j, 0, 0),
+                            memory_space=pltpu.VMEM)
+    do_spec_j = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, j, 0),
+                             memory_space=pltpu.VMEM)
+    out_ki = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, i, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs = [spec_qj(0), spec_ki(bh), spec_ki(2 * bh), do_spec_j,
+                r_spec_j, r_spec_j]
+    operands = [qkv3, qkv3, qkv3, do3, lse8, delta8]
+    if has_kvm:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, bk), lambda b_, i, j: (b_ // h, i, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(kvm)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm,
+                          kpad, s, s, bq, bk),
+        grid=(bh, nk, nq),
+        in_specs=in_specs,
+        out_specs=[out_ki, out_ki],
+        out_shape=[jax.ShapeDtypeStruct((bh, ps, d), qkv.dtype)] * 2,
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*operands)
+
+    return jnp.concatenate([dq[:, :s], dk[:, :s], dv[:, :s]], axis=0)
 
 
 # --- public API ------------------------------------------------------------
@@ -545,6 +940,111 @@ def _flash_masked_vjp_bwd(scale, causal, block_q, block_k, res, do):
 
 _flash_attention_masked.defvjp(_flash_masked_vjp_fwd,
                                _flash_masked_vjp_bwd)
+
+
+# --- packed-qkv self-attention entry ---------------------------------------
+
+def flash_attention_qkv(qkv: jnp.ndarray,
+                        scale: Optional[float] = None,
+                        causal: bool = False,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        kv_mask: Optional[jnp.ndarray] = None
+                        ) -> jnp.ndarray:
+    """Self-attention over PACKED projections: ``qkv`` (3, b, h, s, d),
+    returns the context (b, h, s, d).
+
+    One transposed copy of the fused qkv projection replaces the three
+    per-tensor (b,h,s,d) relayout copies the unpacked entry forces at
+    the Pallas custom-call boundary (XLA cannot fuse transposes into a
+    custom call; measured 7.5 ms/step of such copies at GPT-345M).
+    Inside the kernel q/k/v are row-ranges of one contiguous array read
+    via index-map offsets.  Semantics match
+    ``flash_attention(qkv[0], qkv[1], qkv[2], ...)``.
+    """
+    from ._context import in_manual_axis_context
+
+    if in_manual_axis_context(qkv):
+        return mha_reference(qkv[0], qkv[1], qkv[2], scale=scale,
+                             causal=causal, kv_mask=kv_mask)
+    if kv_mask is not None:
+        return _flash_qkv_masked(qkv, kv_mask.astype(jnp.float32),
+                                 scale, causal, block_q, block_k)
+    return _flash_qkv_fused(qkv, scale, causal, block_q, block_k)
+
+
+def _qkv_flat(qkv):
+    three, b, h, s, d = qkv.shape
+    assert three == 3, f"qkv leading dim must be 3, got {three}"
+    return qkv.reshape(3 * b * h, s, d), b, h
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _flash_qkv_fused(qkv, scale, causal, block_q, block_k):
+    flat, b, h = _qkv_flat(qkv)
+    if scale is None:
+        scale = qkv.shape[-1] ** -0.5
+    o, _ = _flash_fwd_packed(flat, b, h, scale, causal, block_q,
+                             block_k)
+    return o.reshape(b, h, *o.shape[1:])
+
+
+def _flash_qkv_vjp_fwd(qkv, scale, causal, block_q, block_k):
+    flat, b, h = _qkv_flat(qkv)
+    if scale is None:
+        scale = qkv.shape[-1] ** -0.5
+    o, lse = _flash_fwd_packed(flat, b, h, scale, causal, block_q,
+                               block_k)
+    return o.reshape(b, h, *o.shape[1:]), (flat, o, lse, b, h)
+
+
+def _flash_qkv_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    flat, o, lse, b, h = res
+    if scale is None:
+        scale = flat.shape[-1] ** -0.5
+    dflat = _flash_bwd_packed(scale, causal, block_q, block_k,
+                              (flat, o, lse, b, h),
+                              do.reshape(b * h, *do.shape[2:]))
+    return (dflat.reshape(3, b, h, *dflat.shape[1:]),)
+
+
+_flash_qkv_fused.defvjp(_flash_qkv_vjp_fwd, _flash_qkv_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _flash_qkv_masked(qkv, kv_mask, scale, causal, block_q, block_k):
+    flat, b, h = _qkv_flat(qkv)
+    if scale is None:
+        scale = qkv.shape[-1] ** -0.5
+    o, _ = _flash_fwd_packed(flat, b, h, scale, causal, block_q,
+                             block_k, kv_mask=kv_mask)
+    return o.reshape(b, h, *o.shape[1:])
+
+
+def _flash_qkv_masked_vjp_fwd(qkv, kv_mask, scale, causal, block_q,
+                              block_k):
+    flat, b, h = _qkv_flat(qkv)
+    if scale is None:
+        scale = qkv.shape[-1] ** -0.5
+    o, lse = _flash_fwd_packed(flat, b, h, scale, causal, block_q,
+                               block_k, kv_mask=kv_mask)
+    return o.reshape(b, h, *o.shape[1:]), (flat, o, lse, b, h, kv_mask)
+
+
+def _flash_qkv_masked_vjp_bwd(scale, causal, block_q, block_k, res, do):
+    flat, o, lse, b, h, kv_mask = res
+    if scale is None:
+        scale = flat.shape[-1] ** -0.5
+    dflat = _flash_bwd_packed(scale, causal, block_q, block_k,
+                              (flat, o, lse, b, h),
+                              do.reshape(b * h, *do.shape[2:]),
+                              kv_mask=kv_mask)
+    return (dflat.reshape(3, b, h, *dflat.shape[1:]),
+            jnp.zeros_like(kv_mask))
+
+
+_flash_qkv_masked.defvjp(_flash_qkv_masked_vjp_fwd,
+                         _flash_qkv_masked_vjp_bwd)
 
 
 def mha_reference(q, k, v, scale=None, causal=False, kv_mask=None):

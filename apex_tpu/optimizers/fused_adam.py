@@ -31,8 +31,39 @@ class FusedAdamState(NamedTuple):
     v: Tuple[jnp.ndarray, ...]
 
 
+class FusedTransformation(NamedTuple):
+    """optax-compatible transformation with an extra single-pass
+    ``fused_step``: ``(new_params, new_state, model_params) =
+    fused_step(grads, state, params, model_params=None)``.
+
+    ``update`` keeps the optax delta protocol; ``fused_step`` is the
+    in-place analogue of the reference's ``FusedAdam.step()`` — it
+    applies the update AND (given ``model_params``, the low-precision
+    template under amp master weights) emits the cast model copy from
+    the same kernel pass, saving the delta round-trip and the separate
+    master->model convert."""
+    init: Any
+    update: Any
+    fused_step: Any
+
+
 def _lr_at(lr: ScalarOrSchedule, count):
     return lr(count) if callable(lr) else lr
+
+
+def _lowp_dtype_for(meta, pbuf, model_leaves):
+    """Model-copy dtype for a DIRECT group when it differs from the
+    master dtype (packed groups cast via assemble instead)."""
+    if model_leaves is None or not multi_tensor.is_direct(meta):
+        return None
+    mdt = model_leaves[meta.leaf_indices[0]].dtype
+    return mdt if mdt != jnp.dtype(pbuf.dtype) else None
+
+
+def _assemble_model(new_p, lowps, metas, model_leaves):
+    return multi_tensor.assemble(
+        [lp if lp is not None else p2 for lp, p2 in zip(lowps, new_p)],
+        metas, out_dtypes=[l.dtype for l in model_leaves])
 
 
 def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
@@ -42,7 +73,7 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
                weight_decay: float = 0.0,
                adam_w_mode: bool = True,
                bias_correction: bool = True,
-               use_pallas: bool = None) -> optax.GradientTransformation:
+               use_pallas: bool = None) -> "FusedTransformation":
     """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4)."""
 
     def init(params):
@@ -92,7 +123,58 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedAdamState(count, tuple(new_m), tuple(new_v))
 
-    return optax.GradientTransformation(init, update)
+    def fused_step(grads, state, params, model_params=None):
+        """Single-pass step: new params (+ optional model copy) without
+        the optax delta round-trip — see FusedTransformation."""
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.float32(beta1) ** cf
+            bc2 = 1.0 - jnp.float32(beta2) ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
+        model_leaves = (jax.tree_util.tree_leaves(model_params)
+                        if model_params is not None else None)
+        new_p, new_m, new_v, lowps = [], [], [], []
+        for i, meta in enumerate(metas):
+            lowp_dt = _lowp_dtype_for(meta, pbufs[i], model_leaves)
+            if fused_optim.step_use_pallas(use_pallas, sum(meta.sizes)):
+                flats, restore = fused_optim.flatten_for_kernel(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i])
+                outs = fused_optim.adam_step(
+                    *flats, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                    weight_decay=weight_decay, bias_correction1=bc1,
+                    bias_correction2=bc2, adam_w_mode=adam_w_mode,
+                    lowp_dtype=lowp_dt)
+                p2, m2, v2 = (restore(o) for o in outs[:3])
+                lp = restore(outs[3]) if lowp_dt is not None else None
+            else:
+                d, m2, v2 = _adam_jnp(
+                    gbufs[i], pbufs[i], state.m[i], state.v[i],
+                    lr, beta1, beta2, eps, weight_decay, bc1, bc2,
+                    adam_w_mode)
+                p2 = pbufs[i] + d
+                lp = p2.astype(lowp_dt) if lowp_dt is not None else None
+            new_p.append(p2)
+            new_m.append(m2)
+            new_v.append(v2)
+            lowps.append(lp)
+        leaves = jax.tree_util.tree_leaves(params)
+        new_params = multi_tensor.assemble(
+            new_p, metas, out_dtypes=[l.dtype for l in leaves])
+        new_state = FusedAdamState(count, tuple(new_m), tuple(new_v))
+        model_out = None
+        if model_leaves is not None:
+            model_out = _assemble_model(new_p, lowps, metas,
+                                        model_leaves)
+        return new_params, new_state, model_out
+
+    return FusedTransformation(init, update, fused_step)
 
 
 def _adam_jnp(g, p, m, v, lr, b1, b2, eps, wd, bc1, bc2, adam_w_mode):

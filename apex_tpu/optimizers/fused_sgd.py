@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import optax
 
 from ..ops import fused_optim, multi_tensor
-from .fused_adam import ScalarOrSchedule, _lr_at
+from .fused_adam import (FusedTransformation, ScalarOrSchedule,
+                         _assemble_model, _lowp_dtype_for, _lr_at)
 
 
 class FusedSGDState(NamedTuple):
@@ -31,7 +32,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
               weight_decay: float = 0.0,
               nesterov: bool = False,
               wd_after_momentum: bool = False,
-              use_pallas: bool = None) -> optax.GradientTransformation:
+              use_pallas: bool = None) -> "FusedTransformation":
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError(
             "Nesterov momentum requires a momentum and zero dampening "
@@ -83,7 +84,64 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
         return updates, FusedSGDState(count, tuple(new_mom))
 
-    return optax.GradientTransformation(init, update)
+    def fused_step(grads, state, params, model_params=None):
+        """Single-pass step (+ optional model copy) — the in-place
+        ``FusedSGD.step()`` analogue; see FusedTransformation."""
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        first = (state.count == 0).astype(jnp.float32) if momentum else \
+            jnp.float32(0.0)
+        metas = multi_tensor.compute_metas(params, split_direct=True)
+        gbufs = multi_tensor.group_buffers(grads, metas)
+        pbufs = multi_tensor.group_buffers(params, metas)
+        model_leaves = (jax.tree_util.tree_leaves(model_params)
+                        if model_params is not None else None)
+        new_p, new_mom, lowps = [], [], []
+        for i, meta in enumerate(metas):
+            lowp_dt = _lowp_dtype_for(meta, pbufs[i], model_leaves)
+            if momentum == 0.0:
+                g = gbufs[i].astype(jnp.float32)
+                p32 = pbufs[i].astype(jnp.float32)
+                p2 = (p32 - lr * (g + weight_decay * p32)).astype(
+                    meta.dtype)
+                mom2 = state.momentum[i]
+            elif fused_optim.step_use_pallas(use_pallas,
+                                             sum(meta.sizes)):
+                flats, restore = fused_optim.flatten_for_kernel(
+                    gbufs[i], pbufs[i], state.momentum[i])
+                outs = fused_optim.sgd_step(
+                    *flats, lr=lr, momentum=momentum,
+                    dampening=dampening, weight_decay=weight_decay,
+                    nesterov=nesterov,
+                    wd_after_momentum=wd_after_momentum,
+                    first_run=first, lowp_dtype=lowp_dt)
+                p2, mom2 = restore(outs[0]), restore(outs[1])
+                lp = restore(outs[2]) if lowp_dt is not None else None
+                new_p.append(p2)
+                new_mom.append(mom2)
+                lowps.append(lp)
+                continue
+            else:
+                d, mom2 = _sgd_jnp(gbufs[i], pbufs[i],
+                                   state.momentum[i], lr, momentum,
+                                   dampening, weight_decay, nesterov,
+                                   wd_after_momentum, first)
+                p2 = pbufs[i] + d
+            new_p.append(p2)
+            new_mom.append(mom2)
+            lowps.append(p2.astype(lowp_dt) if lowp_dt is not None
+                         else None)
+        leaves = jax.tree_util.tree_leaves(params)
+        new_params = multi_tensor.assemble(
+            new_p, metas, out_dtypes=[l.dtype for l in leaves])
+        model_out = None
+        if model_leaves is not None:
+            model_out = _assemble_model(new_p, lowps, metas,
+                                        model_leaves)
+        return new_params, FusedSGDState(count, tuple(new_mom)), \
+            model_out
+
+    return FusedTransformation(init, update, fused_step)
 
 
 def _sgd_jnp(g, p, mom, lr, momentum, dampening, wd, nesterov,

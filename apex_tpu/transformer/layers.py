@@ -110,11 +110,6 @@ class ParallelSelfAttention(nn.Module):
                                    axis_name=self.axis_name,
                                    name="query_key_value")(x)
         qkv = qkv.reshape(b, s, heads_local, 3 * head_dim)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # (b, heads, s, d)
-        q = q.transpose(0, 2, 1, 3)
-        k = k.transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
 
         causal = self.attn_mask_type == AttnMaskType.causal
         scale = head_dim ** -0.5
@@ -124,6 +119,18 @@ class ParallelSelfAttention(nn.Module):
                 "both (fold padding into the attention_mask yourself)")
         # flash handles causal and/or key-padding masks; an arbitrary
         # (b, 1, sq, sk) attention_mask takes the materializing path.
+        # NOTE: a packed (3,b,h,s,d) route through flash_attention_qkv
+        # was measured end-to-end at GPT-345M and LOST ~5 ms/step: the
+        # single big 5-D transpose copies cost more than the per-tensor
+        # relayout copies they replace (the Pallas kernels themselves
+        # time identically).  Keep the per-tensor path here; the packed
+        # entry remains for callers that already hold packed qkv.
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (b, heads, s, d)
+        q = q.transpose(0, 2, 1, 3)
+        k = k.transpose(0, 2, 1, 3)
+        v = v.transpose(0, 2, 1, 3)
+
         if self.use_flash and attention_mask is None \
                 and (deterministic or self.attention_dropout == 0.0):
             ctx = flash_attention(q, k, v, scale=scale, causal=causal,

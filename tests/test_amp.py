@@ -225,6 +225,25 @@ def test_static_scale_steps_unconditionally_reference_parity():
                                   np.asarray(params["w"]))  # held
 
 
+def test_in_dtype_unscale_preserves_tiny_fp16_grads():
+    """unscale(out_dtype=None) must still route fp16 leaves through
+    fp32: a 2^16 scale would flush small fp16 grads to subnormals/zero
+    before the optimizer's upcast (bf16 shares fp32's exponent range
+    and multiplies exactly)."""
+    from apex_tpu.amp import scaler as sc
+
+    st = sc.init(loss_scale=65536.0)
+    tiny16 = jnp.asarray([3e-3], jnp.float16)   # /2^16 underflows fp16
+    small_bf = jnp.asarray([3e-3], jnp.bfloat16)
+    out = sc.unscale({"a": tiny16, "b": small_bf}, st, out_dtype=None)
+    assert out["a"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["a"]),
+                               float(tiny16[0]) / 65536.0, rtol=1e-3)
+    assert out["b"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out["b"], np.float32),
+                               float(small_bf[0]) / 65536.0, rtol=1e-2)
+
+
 def test_check_finite_false_rejected_for_dynamic():
     params = _toy_params()
     opt = amp.AmpOptimizer(optax.sgd(0.1), amp.get_policy("O2"),

@@ -245,6 +245,73 @@ def test_fused_lamb_grad_clipping():
                for l in jax.tree_util.tree_leaves(u))
 
 
+class TestFusedStep:
+    """Single-pass fused_step == update + apply_updates (+ the amp
+    model-copy writeback fused into the same pass)."""
+
+    @pytest.mark.parametrize("make_tx", [
+        lambda: opt.fused_adam(1e-3, weight_decay=0.01),
+        lambda: opt.fused_sgd(0.1, momentum=0.9),
+        lambda: opt.fused_sgd(0.05),                    # no momentum
+    ])
+    def test_matches_update_apply(self, make_tx):
+        params = make_params()
+        g = make_grads(params)
+        tx = make_tx()
+        s0 = tx.init(params)
+        u, s1 = tx.update(g, s0, params)
+        p1 = optax.apply_updates(params, u)
+        p2, s2, model = tx.fused_step(g, s0, params)
+        assert model is None
+        tree_close(p1, p2, rtol=1e-6, atol=1e-7)
+        for a, b in zip(jax.tree_util.tree_leaves(s1),
+                        jax.tree_util.tree_leaves(s2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+        # second step continues from the same state
+        g2 = jax.tree_util.tree_map(lambda x: x * 0.5, g)
+        u, s1b = tx.update(g2, s1, p1)
+        p1b = optax.apply_updates(p1, u)
+        p2b, s2b, _ = tx.fused_step(g2, s2, p2)
+        tree_close(p1b, p2b, rtol=1e-6, atol=1e-7)
+
+    def test_model_copy_emitted(self):
+        params = make_params()
+        g = make_grads(params)
+        model = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params)
+        tx = opt.fused_adam(1e-3)
+        p2, _, model_out = tx.fused_step(g, tx.init(params), params,
+                                         model_params=model)
+        assert jax.tree_util.tree_structure(model_out) == \
+            jax.tree_util.tree_structure(params)
+        for lo, hi in zip(jax.tree_util.tree_leaves(model_out),
+                          jax.tree_util.tree_leaves(p2)):
+            assert lo.dtype == jnp.bfloat16
+            np.testing.assert_array_equal(
+                np.asarray(lo, np.float32),
+                np.asarray(hi.astype(jnp.bfloat16), np.float32))
+
+    def test_pallas_step_matches_jnp(self, monkeypatch):
+        # force the Pallas step kernels (interpret mode on CPU) against
+        # the jnp path
+        params = make_params()
+        g = make_grads(params)
+        model = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params)
+        for make in (lambda u: opt.fused_adam(1e-3, weight_decay=0.01,
+                                              use_pallas=u),
+                     lambda u: opt.fused_sgd(0.1, momentum=0.9,
+                                             use_pallas=u)):
+            tx_j, tx_p = make(False), make(True)
+            pj, sj, mj = tx_j.fused_step(g, tx_j.init(params), params,
+                                         model_params=model)
+            pp, sp, mp = tx_p.fused_step(g, tx_p.init(params), params,
+                                         model_params=model)
+            tree_close(pj, pp, rtol=1e-6, atol=1e-7)
+            tree_close(mj, mp, rtol=1e-2, atol=1e-2)  # bf16 copies
+
+
 def test_lamb_novograd_reject_eps_zero():
     """LAMB variants: eps=0 turns zero-filled packed padding gaps into
     0/0=NaN in phase-1, poisoning the preceding tensor's trust ratio
