@@ -1,0 +1,74 @@
+"""Trace-time autocast context shared by amp.autocast and the fused
+custom-VJP ops.
+
+``amp.autocast`` cannot boundary-cast ``custom_vjp`` call sites at the
+jaxpr level — the saved body jaxpr is dtype-frozen (re-binding a body
+traced at fp32 with bf16 operands breaks on fp32 literals and Pallas
+block specs).  Instead it sets this context while TRACING the wrapped
+function; the framework's own custom-VJP entry points (flash attention,
+fused layer norm) read it and cast their inputs before their
+``custom_vjp`` wrapper binds, so the casts land in the traced graph
+itself.  This mirrors the reference's O1 design: the patcher wraps the
+call sites of ITS registered functions, arbitrary user functions are
+untouched (ref: apex/amp/amp.py:76-150 ``init`` patch loop).
+
+The state is registered with ``include_in_trace_context=True`` so JAX's
+jit/pjit TRACE CACHES are keyed on it: a function jitted outside
+autocast and then called under it (or vice versa) retraces instead of
+silently reusing a jaxpr built under the other precision regime.  Falls
+back to a plain contextvar (documented cache hazard) if the private
+config API ever changes shape.
+
+Lives in its own module so ``apex_tpu.ops`` never imports
+``apex_tpu.amp`` (and vice versa) at module level.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+try:
+    from jax._src import config as _jax_config
+
+    _STATE = _jax_config.optional_string_state(
+        name="apex_tpu_autocast_dtype",
+        default=None,
+        help="Active apex_tpu amp.autocast compute dtype (trace-time).",
+        include_in_trace_context=True,
+    )
+
+    def autocast_compute_dtype() -> Optional[Any]:
+        """The active ``amp.autocast`` compute dtype, or None outside
+        an autocast trace."""
+        val = _STATE.value
+        if val is None:
+            return None
+        import jax.numpy as jnp
+        return jnp.dtype(val)
+
+    class _Token:
+        def __init__(self, mgr):
+            self.mgr = mgr
+
+    def set_autocast_dtype(dtype) -> Any:
+        import jax.numpy as jnp
+        mgr = _STATE(jnp.dtype(dtype).name)
+        mgr.__enter__()
+        return _Token(mgr)
+
+    def reset_autocast_dtype(token) -> None:
+        token.mgr.__exit__(None, None, None)
+
+except (ImportError, AttributeError, TypeError):  # pragma: no cover
+    import contextvars
+
+    _AUTOCAST_DTYPE: contextvars.ContextVar[Optional[Any]] = \
+        contextvars.ContextVar("apex_tpu_autocast_dtype", default=None)
+
+    def autocast_compute_dtype() -> Optional[Any]:
+        return _AUTOCAST_DTYPE.get()
+
+    def set_autocast_dtype(dtype):
+        return _AUTOCAST_DTYPE.set(dtype)
+
+    def reset_autocast_dtype(token) -> None:
+        _AUTOCAST_DTYPE.reset(token)

@@ -26,9 +26,12 @@ interpreter inside, so a transformer stacked with ``lax.scan`` gets
 O1/O4 casting in its layers (the reference's patches likewise apply
 inside any Python loop).  Carry/branch outputs are cast back to their
 incoming dtypes so the structured-control-flow contracts (carry fixed
-point, branch aval agreement) hold.  Deliberate deviation: bodies of
-``custom_jvp``/``custom_vjp`` functions run unmodified (casting inside
-them could break user gradient rules).
+point, branch aval agreement) hold.  ``custom_jvp``/``custom_vjp``
+calls get BOUNDARY casting: their float inputs are cast to the compute
+dtype while the bodies (and gradient rules) run unmodified — the
+reference's O1 patching likewise wraps the *call sites* of its fused
+extensions without editing the kernels (see
+``lists.CUSTOM_BOUNDARY_PRIMS``).
 """
 from __future__ import annotations
 
@@ -41,6 +44,7 @@ from jax.extend import core as jcore
 
 from . import lists
 from .policy import Policy
+from .. import _autocast_ctx as _actx
 
 
 def _is_float(x) -> bool:
@@ -241,7 +245,16 @@ def autocast(fn: Optional[Callable] = None, *,
             out_tree_box.append(out_tree)
             return flat_out
 
-        closed = jax.make_jaxpr(flat_fn)(*flat_args)
+        # Trace with the autocast context set: the framework's fused
+        # custom-VJP ops (flash attention, fused layer norm) read it
+        # and cast their own inputs, embedding the boundary casts in
+        # the traced graph (see apex_tpu/_autocast_ctx.py for why the
+        # interpreter cannot cast custom_vjp call sites itself).
+        token = _actx.set_autocast_dtype(compute_dtype)
+        try:
+            closed = jax.make_jaxpr(flat_fn)(*flat_args)
+        finally:
+            _actx.reset_autocast_dtype(token)
         out_flat = _eval_autocast(
             closed.jaxpr, closed.consts, flat_args, compute_dtype)
         return jax.tree_util.tree_unflatten(out_tree_box[0], out_flat)

@@ -64,3 +64,14 @@ OPAQUE_PRIMS = frozenset({
     "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
     "custom_root", "custom_linear_solve",
 })
+
+# custom_vjp call sites cannot be boundary-cast at the jaxpr level —
+# the saved body jaxpr is dtype-frozen (fp32 literals/pallas blocks
+# break when re-bound at bf16).  Instead the framework's OWN custom-VJP
+# ops read the autocast TRACE-TIME context (autocast_compute_dtype())
+# and cast their inputs themselves: flash attention to the compute
+# dtype (matmul whitelist), fused layer norm to fp32 (the reference's
+# O1 puts layer_norm in FP32_FUNCS, ref:
+# apex/amp/lists/torch_overrides.py).  User custom-VJP functions are
+# untouched, exactly like unregistered functions under the reference's
+# patching; register with half/bfloat16/float_function as needed.

@@ -883,7 +883,15 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     cannot yet carry VMA types).
     """
     from ._context import in_manual_axis_context
+    from .._autocast_ctx import autocast_compute_dtype
 
+    # under amp.autocast (O1/O4) this call site is whitelisted: cast
+    # inputs to the compute dtype here, at trace time, because the
+    # interpreter cannot re-bind the dtype-frozen custom_vjp body
+    act = autocast_compute_dtype()
+    if act is not None and q.dtype != act \
+            and jnp.issubdtype(q.dtype, jnp.floating):
+        q, k, v = (x.astype(act) for x in (q, k, v))
     if in_manual_axis_context(q, k, v):
         return mha_reference(q, k, v, scale=scale, causal=causal,
                              kv_mask=kv_mask)
@@ -963,7 +971,14 @@ def flash_attention_qkv(qkv: jnp.ndarray,
     ``flash_attention(qkv[0], qkv[1], qkv[2], ...)``.
     """
     from ._context import in_manual_axis_context
+    from .._autocast_ctx import autocast_compute_dtype
 
+    # same autocast boundary contract as flash_attention (this entry's
+    # documented semantics are flash_attention(qkv[0], qkv[1], qkv[2]))
+    act = autocast_compute_dtype()
+    if act is not None and qkv.dtype != act \
+            and jnp.issubdtype(qkv.dtype, jnp.floating):
+        qkv = qkv.astype(act)
     if in_manual_axis_context(qkv):
         return mha_reference(qkv[0], qkv[1], qkv[2], scale=scale,
                              causal=causal, kv_mask=kv_mask)

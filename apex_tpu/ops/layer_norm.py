@@ -222,9 +222,22 @@ def layer_norm(x: jnp.ndarray,
     mixed-dtype variant, ref: csrc/layer_norm_cuda.cpp:133-158), or None
     for the non-affine form.  Inside shard_map manual axes the XLA
     reference path runs (Pallas calls cannot yet carry VMA types).
+
+    Under ``amp.autocast`` (O1/O4) this call site runs in FP32 — the
+    reference's O1 lists put ``layer_norm`` in FP32_FUNCS
+    (ref: apex/amp/lists/torch_overrides.py) — by casting the inputs at
+    trace time (the interpreter cannot re-bind the dtype-frozen
+    custom_vjp body; see apex_tpu/_autocast_ctx.py).
     """
     from ._context import in_manual_axis_context
+    from .._autocast_ctx import autocast_compute_dtype
 
+    if autocast_compute_dtype() is not None \
+            and jnp.issubdtype(x.dtype, jnp.floating) \
+            and x.dtype != jnp.float32:
+        x = x.astype(jnp.float32)
+        gamma = None if gamma is None else gamma.astype(jnp.float32)
+        beta = None if beta is None else beta.astype(jnp.float32)
     if in_manual_axis_context(x):
         return _layer_norm_reference(x, gamma, beta, eps)
     return _layer_norm_fused(x, gamma, beta, eps)

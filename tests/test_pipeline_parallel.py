@@ -201,6 +201,35 @@ class TestSchedules:
                                        np.asarray(rgrads[k]),
                                        rtol=1e-4, atol=1e-6)
 
+    def test_interleaved_fallback_warns_and_strict_raises(self):
+        """M %% P != 0 degrades to sequential sweeps — must WARN (the
+        bubble the caller asked to remove is back) and raise under
+        strict=True, matching the reference's assert."""
+        mesh, params, xs, ys = self._setup(4, m=6, nblocks=8)
+        vparams = jax.tree.map(
+            lambda x: x.reshape((2, 4) + x.shape[1:]), params)
+
+        def run(strict):
+            def go(vparams, xs, ys):
+                def loss_fn(out_mb, k):
+                    y = jax.lax.dynamic_index_in_dim(ys, k, 0,
+                                                     keepdims=False)
+                    return jnp.mean((out_mb - y) ** 2)
+                return pp.forward_backward_pipelining_with_interleaving(
+                    stage_fn, loss_fn, vparams, xs, strict=strict)
+            return jax.shard_map(
+                go, mesh=mesh,
+                in_specs=({"w": P(None, PIPE), "b": P(None, PIPE)},
+                          P(), P()),
+                out_specs=(P(), {"w": P(None, PIPE),
+                                 "b": P(None, PIPE)}))(vparams, xs, ys)
+
+        with pytest.warns(UserWarning, match="divisible by pipeline"):
+            loss, _ = run(strict=False)
+        assert np.isfinite(float(loss))
+        with pytest.raises(ValueError, match="divisible by pipeline"):
+            run(strict=True)
+
     def test_no_pipelining_grad_accumulation(self):
         key = jax.random.PRNGKey(5)
         params = {"w": jax.random.normal(key, (4, 4))}
