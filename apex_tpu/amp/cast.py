@@ -93,8 +93,14 @@ def master_copy(params: Any) -> Any:
 def restore_dtypes(src: Any, like: Any) -> Any:
     """Cast ``src`` leaf-wise to the dtypes of ``like`` (master -> model
     writeback, ref: apex/fp16_utils/fp16util.py
-    ``master_params_to_model_params``)."""
+    ``master_params_to_model_params``).  ``like`` may hold abstract
+    leaves (``jax.ShapeDtypeStruct`` templates) — only dtypes are
+    read."""
+    def _dtype(l):
+        d = getattr(l, "dtype", None)
+        return d if d is not None else jnp.asarray(l).dtype
+
     return jax.tree_util.tree_map(
-        lambda s, l: s.astype(l.dtype) if jnp.issubdtype(
-            jnp.asarray(l).dtype, jnp.floating) else s,
+        lambda s, l: s.astype(_dtype(l)) if jnp.issubdtype(
+            _dtype(l), jnp.floating) else s,
         src, like)

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import optax
 
 from ..ops import fused_optim, multi_tensor
-from .fused_adam import ScalarOrSchedule, _lr_at
+from .fused_adam import (FusedTransformation, ScalarOrSchedule,
+                         _assemble_model, _lowp_dtype_for, _lr_at)
 
 
 class FusedLAMBState(NamedTuple):
@@ -50,7 +51,7 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                adam_w_mode: bool = True,
                max_grad_norm: float = 1.0,
                use_nvlamb: bool = False,
-               use_pallas: bool = None) -> optax.GradientTransformation:
+               use_pallas: bool = None) -> "FusedTransformation":
     if eps <= 0.0:
         # Packed trust-ratio math needs phase-1 to map zero-filled
         # alignment gaps to exactly 0 (per_tensor_sumsq folds each gap
@@ -68,9 +69,10 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                               m=zeros,
                               v=tuple(jnp.zeros_like(z) for z in zeros))
 
-    def update(grads, state, params=None):
-        if params is None:
-            raise ValueError("fused_lamb requires params in update()")
+    def _deltas(grads, state, params):
+        """Shared LAMB math -> (metas, pbufs, group deltas, new state).
+        Grads may arrive in low precision (fused path): the packed /
+        phase-1 math upcasts per group."""
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)
@@ -102,13 +104,45 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
             deltas.append(-lr * adapted_u)
             new_m.append(m)
             new_v.append(v)
+        new_state = FusedLAMBState(count, tuple(new_m), tuple(new_v))
+        return metas, pbufs, deltas, new_state
 
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params in update()")
+        metas, _, deltas, new_state = _deltas(grads, state, params)
         leaves = jax.tree_util.tree_leaves(params)
         updates = multi_tensor.assemble(
             deltas, metas, out_dtypes=[l.dtype for l in leaves])
-        return updates, FusedLAMBState(count, tuple(new_m), tuple(new_v))
+        return updates, new_state
 
-    return optax.GradientTransformation(init, update)
+    def fused_step(grads, state, params, model_params=None):
+        """Single-pass step (+ optional model copy) — see
+        FusedTransformation; the apply and the amp master->model
+        writeback join the update's fusion scope."""
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        metas, pbufs, deltas, new_state = _deltas(grads, state, params)
+        model_leaves = (jax.tree_util.tree_leaves(model_params)
+                        if model_params is not None else None)
+        new_p, lowps = [], []
+        for i, meta in enumerate(metas):
+            p2 = (pbufs[i].astype(jnp.float32)
+                  + deltas[i]).astype(pbufs[i].dtype)
+            lowp_dt = _lowp_dtype_for(meta, pbufs[i], model_leaves)
+            new_p.append(p2)
+            lowps.append(p2.astype(lowp_dt) if lowp_dt is not None
+                         else None)
+        leaves = jax.tree_util.tree_leaves(params)
+        new_params = multi_tensor.assemble(
+            new_p, metas, out_dtypes=[l.dtype for l in leaves])
+        model_out = None
+        if model_leaves is not None:
+            model_out = _assemble_model(new_p, lowps, metas,
+                                        model_leaves)
+        return new_params, new_state, model_out
+
+    return FusedTransformation(init, update, fused_step)
 
 
 def _global_grad_clip(gbufs, max_norm):

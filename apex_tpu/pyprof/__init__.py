@@ -12,6 +12,11 @@ Three layers, mirroring the reference's nvtx -> parse -> prof pipeline
   walking the jaxpr directly (no offline SQLite parse needed on TPU),
   with ``report()`` producing the reference's TSV table and
   ``xla_cost_analysis``/``measure`` as cross-checks.
+- :mod:`.measured` — MEASURED per-op device times from
+  ``jax.profiler``'s xplane output joined onto the analytical rows
+  (the reference's parse stage, ref: apex/pyprof/parse/nvvp.py:282):
+  ``profile_measured(fn, *args)`` -> rows with flops AND microseconds;
+  ``measured_report`` prints the combined table.
 """
 from . import nvtx
 from .nvtx import annotate, pop, push
@@ -28,6 +33,13 @@ from .prof import (
     total_bytes,
     total_flops,
     xla_cost_analysis,
+)
+from .measured import (
+    MeasuredOp,
+    collect_device_ops,
+    join_measured,
+    measured_report,
+    profile_measured,
 )
 
 
@@ -55,4 +67,9 @@ __all__ = [
     "OpRecord",
     "DeviceSpec",
     "device_spec",
+    "MeasuredOp",
+    "collect_device_ops",
+    "join_measured",
+    "measured_report",
+    "profile_measured",
 ]

@@ -193,14 +193,13 @@ def gpt_loss(logits, labels, axis_name: Optional[str] = None,
             logits, labels, label_smoothing=label_smoothing,
             axis_name=axis_name)
     else:
-        lf = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(lf, axis=-1)
-        nll = lse - jnp.take_along_axis(
-            lf, labels[..., None], axis=-1)[..., 0]
-        if label_smoothing > 0.0:
-            smooth = lse - jnp.mean(lf, axis=-1)
-            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
-        losses = nll
+        # fused CE: single-consumer fp32 views in fwd AND bwd (the
+        # logsumexp/take pair materializes an fp32 copy of the
+        # (tokens, vocab) logits — see standalone_bert)
+        from ..contrib.xentropy import softmax_cross_entropy_loss
+
+        losses = softmax_cross_entropy_loss(
+            logits, labels, label_smoothing, True)
     return jnp.mean(losses)
 
 

@@ -253,6 +253,8 @@ class TestFusedStep:
         lambda: opt.fused_adam(1e-3, weight_decay=0.01),
         lambda: opt.fused_sgd(0.1, momentum=0.9),
         lambda: opt.fused_sgd(0.05),                    # no momentum
+        lambda: opt.fused_lamb(1e-2, weight_decay=0.01,
+                               use_pallas=False),
     ])
     def test_matches_update_apply(self, make_tx):
         params = make_params()

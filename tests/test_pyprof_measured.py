@@ -1,0 +1,127 @@
+"""Measured-profile ingestion: canonical keying + join logic.
+
+The on-device half (collect_device_ops -> xprof framework_op_stats) is
+exercised on real TPU hardware by the bench/verify drives; these tests
+cover the name canonicalization and the three-stage join against
+synthetic measured rows (the parse/prof join of
+ref: apex/pyprof/parse/nvvp.py:282 + prof/output.py).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.pyprof import prof
+from apex_tpu.pyprof.measured import (MeasuredOp, canonical_key,
+                                      join_measured, measured_report)
+
+
+def test_canonical_key_strips_wrappers():
+    assert canonical_key(
+        "jit(step)/jvp(Model)/mlp/dot_general.1") == \
+        ("dot_general", "jvp(Model)/mlp")
+    # bare walker-inserted call segments and profiler jit(...) agree
+    assert canonical_key("jvp(Model)/mlp/pjit/dot_general") == \
+        canonical_key("jit(f)/jvp(Model)/mlp/jit(inner)/dot_general")
+    # transpose(jvp(...)) is a REAL scope, not a wrapper
+    op, scope = canonical_key("transpose(jvp(M))/layer_0/dot_general")
+    assert scope == "transpose(jvp(M))/layer_0"
+
+
+def _loss(w, x):
+    return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+
+def test_join_exact_subtree_and_leftover():
+    w = jnp.ones((8, 8), jnp.float32)
+    x = jnp.ones((4, 8), jnp.float32)
+    records = prof.analyze(_loss, w, x)
+    assert any(r.op == "dot_general" for r in records)
+
+    dot_scope = next(r.scope for r in records if r.op == "dot_general")
+    name = (dot_scope + "/" if dot_scope else "") + "dot_general"
+    measured = [
+        MeasuredOp(name=f"jit(f)/{name}", op_type="dot",
+                   occurrences=1, total_us=100.0),
+        # infrastructure row with no analytical counterpart
+        MeasuredOp(name="copy-done.3", op_type="copy",
+                   occurrences=1, total_us=7.0),
+    ]
+    rows = join_measured(records, measured)
+    dot = next(r for r in rows if r.op == "dot_general")
+    assert dot.matched and dot.measured_us == 100.0 and dot.flops > 0
+    copy = next(r for r in rows if r.op == "copy-done")
+    assert not copy.matched and copy.flops == 0.0
+
+    rep = measured_report(rows, top=5)
+    assert "measured_us" in rep and "TOTAL" in rep
+    # attribution line reconciles matched vs device total
+    assert "% of device total" in rep
+
+
+def test_join_recursed_body_attribution():
+    """A measured row for a call the walker recursed into (its scope
+    ends at the call op) swallows the analytical subtree."""
+    records = [
+        prof.OpRecord(index=0, op="mul",
+                      scope="layer/attn/pallas_call", params="",
+                      flops=10.0, bytes=40.0, count=1),
+        prof.OpRecord(index=1, op="dot_general",
+                      scope="layer/attn/pallas_call", params="",
+                      flops=1000.0, bytes=400.0, count=1),
+    ]
+    measured = [MeasuredOp(name="jit(f)/layer/attn/pallas_call",
+                           op_type="custom-call", occurrences=1,
+                           total_us=55.0)]
+    rows = join_measured(records, measured)
+    pc = next(r for r in rows if r.op == "pallas_call")
+    assert pc.matched and pc.measured_us == 55.0
+    assert pc.flops == 1010.0  # subtree aggregated
+    # the subtree rows are consumed, not double counted
+    assert sum(r.flops for r in rows) == 1010.0
+
+
+def test_join_nested_recursed_rows_no_double_count():
+    records = [
+        prof.OpRecord(index=0, op="mul", scope="f/outer/inner/pallas_call",
+                      params="", flops=5.0, bytes=20.0, count=1),
+    ]
+    measured = [
+        MeasuredOp(name="f/outer", op_type="call", occurrences=1,
+                   total_us=30.0),
+        MeasuredOp(name="f/outer/inner", op_type="call", occurrences=1,
+                   total_us=20.0),
+    ]
+    rows = join_measured(records, measured)
+    # one of the two nested rows gets the subtree's flops, never both
+    assert sum(r.flops for r in rows) == 5.0
+    # both rows' measured time survives in the table
+    assert sum(r.measured_us for r in rows) == 50.0
+
+
+def test_join_consumed_key_keeps_measured_time():
+    records = [
+        prof.OpRecord(index=0, op="dot_general", scope="a/b", params="",
+                      flops=100.0, bytes=10.0, count=1),
+    ]
+    measured = [
+        # hoisted row consumes the a/b analytical entry...
+        MeasuredOp(name="a/dot_general", op_type="dot", occurrences=1,
+                   total_us=40.0),
+        # ...and the exact row must still keep its own device time
+        MeasuredOp(name="a/b/dot_general", op_type="dot", occurrences=1,
+                   total_us=9.0),
+    ]
+    rows = join_measured(records, measured)
+    assert sum(r.measured_us for r in rows) == 49.0
+    assert sum(r.flops for r in rows) == 100.0
+
+
+def test_join_sibling_scope_not_swallowed():
+    records = [
+        prof.OpRecord(index=0, op="add", scope="layer/attn2/mlp",
+                      params="", flops=7.0, bytes=4.0, count=1),
+    ]
+    measured = [MeasuredOp(name="layer/attn/add", op_type="add",
+                           occurrences=1, total_us=3.0)]
+    rows = join_measured(records, measured)
+    sib = next(r for r in rows if r.scope == "layer/attn2/mlp")
+    assert sib.flops == 7.0 and sib.measured_us == 0.0

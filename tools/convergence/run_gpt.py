@@ -1,0 +1,208 @@
+"""GPT convergence evidence on real text (byte-level) with mid-run
+checkpoint/resume bitwise verification — VERDICT round-2 item 3.
+
+Corpus: the repository's own source tree (real text, available without
+egress), byte-tokenized.  Model: the GPT-345M architecture at byte
+vocabulary.  Produces ``docs/convergence/gpt_loss.json`` with the loss
+curve and the resume check result.
+
+Run (on the TPU):  python tools/convergence/run_gpt.py [--steps 300]
+"""
+import argparse
+import functools
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+
+def load_corpus(root: str, limit_bytes: int = 4 << 20) -> np.ndarray:
+    """Byte-tokenize the repo's python/markdown sources (real text)."""
+    bufs = []
+    total = 0
+    for pattern in ("**/*.py", "**/*.md"):
+        for path in sorted(glob.glob(os.path.join(root, pattern),
+                                     recursive=True)):
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            bufs.append(np.frombuffer(data, np.uint8))
+            total += len(data)
+            if total >= limit_bytes:
+                break
+        if total >= limit_bytes:
+            break
+    corpus = np.concatenate(bufs)
+    return corpus.astype(np.int32)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=24)
+    p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--out", default=os.path.join(
+        REPO, "docs", "convergence", "gpt_loss.json"))
+    p.add_argument("--ckpt-dir", default="/tmp/apex_tpu_gpt_conv_ckpt")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.testing.standalone_gpt import GPTModel
+
+    corpus = load_corpus(REPO)
+    print(f"corpus: {corpus.size/1e6:.2f}M bytes of repo source")
+    vocab = 256
+    model = GPTModel(vocab_size=vocab, hidden_size=args.hidden,
+                     num_layers=args.layers, num_attention_heads=16,
+                     max_sequence_length=args.seq,
+                     attention_dropout=0.0, hidden_dropout=0.0,
+                     use_flash=True, dtype=jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    tok0 = jnp.zeros((args.batch, args.seq), jnp.int32)
+    variables = jax.jit(model.init)(key, tok0)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    print(f"params: {n_params/1e6:.1f}M")
+    params, opt, state = amp.initialize(
+        variables["params"], fused_adam(3e-4), opt_level="O5")
+    del variables
+    params, state = jax.tree_util.tree_map(jnp.array, (params, state))
+
+    # deterministic epoch-shuffled window sampler (host side)
+    rng = np.random.RandomState(0)
+    n_windows = (corpus.size - 1) // args.seq
+    order = rng.permutation(n_windows)
+
+    CHUNK = 10  # steps per dispatch: one tunnel RPC per 10 steps
+
+    def chunk_batches(c0):
+        toks = np.stack([np.stack([
+            corpus[i * args.seq:(i + 1) * args.seq + 1]
+            for i in (order[((c0 * CHUNK + s) * args.batch + j)
+                            % n_windows] for j in range(args.batch))])
+            for s in range(CHUNK)])
+        return jnp.asarray(toks[:, :, :-1]), jnp.asarray(toks[:, :, 1:])
+
+    def one_step(carry, batch):
+        params, state = carry
+        tokens, labels = batch
+
+        def loss_fn(pr):
+            logits = model.apply({"params": pr}, tokens,
+                                 deterministic=True)
+            l = jnp.mean(softmax_cross_entropy_loss(
+                logits.reshape(-1, vocab), labels.reshape(-1),
+                half_to_float=True))
+            return opt.scale_loss(l, state), l
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        pr2, st2, _ = opt.apply_gradients(grads, state, params)
+        return (pr2, st2), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_chunk(carry, tokens, labels):
+        return jax.lax.scan(one_step, carry, (tokens, labels))
+
+    from apex_tpu.utils import checkpoint as ckpt
+
+    assert args.steps % (2 * CHUNK) == 0, "steps must be multiple of 20"
+    n_chunks = args.steps // CHUNK
+    half_chunk = n_chunks // 2
+    losses = []
+    carry = (params, state)
+    for c in range(n_chunks):
+        toks, labs = chunk_batches(c)
+        carry, ls = train_chunk(carry, toks, labs)
+        if c == 0:
+            # the true starting point, not 10 steps in
+            losses.append({"step": 0, "loss": float(ls[0])})
+            print(f"step 0: loss {float(ls[0]):.4f}", flush=True)
+        lv = float(ls[-1])
+        losses.append({"step": (c + 1) * CHUNK - 1, "loss": lv})
+        print(f"step {(c + 1) * CHUNK - 1}: loss {lv:.4f}", flush=True)
+        if c + 1 == half_chunk:
+            params, state = carry
+            # mid-run checkpoint (Orbax sharded writer): masters +
+            # inner state + scalers through the amp-aware path
+            ckpt.save_checkpoint(args.ckpt_dir, half_chunk * CHUNK,
+                                 params, amp_opt=opt, amp_state=state)
+            carry = (params, state)
+    params, state = carry
+    resume_snapshot = half_chunk * CHUNK
+
+    # ---- resume bitwise check: digest the final params, FREE them
+    # (holding two full model+optimizer copies at once pressures host
+    # memory through the restore), restore the mid-run checkpoint,
+    # replay the SAME post-checkpoint batches, compare digests.
+    import hashlib
+
+    def digests(tree):
+        out = []
+        for leaf in jax.tree_util.tree_leaves(tree):
+            out.append(hashlib.sha256(
+                np.asarray(leaf).tobytes()).hexdigest())
+        return out
+    final_digest = digests(params)
+
+    def sds(tree):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+    # abstract templates so BOTH live copies (final params + optimizer
+    # state) are freed before the 3 GB restore allocates its own
+    p_t = sds(params)
+    st_t = state._replace(master_params=sds(state.master_params),
+                          inner_state=sds(state.inner_state))
+    del carry, params, state
+    r_params, r_state, _, r_step = ckpt.load_checkpoint(
+        args.ckpt_dir, p_t, amp_opt=opt, amp_state=st_t,
+        step=resume_snapshot)
+    assert r_step == resume_snapshot
+    r_carry = jax.tree_util.tree_map(jnp.array, (r_params, r_state))
+    del r_params, r_state
+    for c in range(half_chunk, n_chunks):
+        toks, labs = chunk_batches(c)
+        r_carry, _ = train_chunk(r_carry, toks, labs)
+    r_params, _ = r_carry
+    mismatch = sum(1 for a, b in zip(final_digest, digests(r_params))
+                   if a != b)
+    resume_ok = mismatch == 0
+    print(f"resume bitwise check: "
+          f"{'OK' if resume_ok else f'{mismatch} leaves differ'}")
+
+    first, last = losses[0]["loss"], losses[-1]["loss"]
+    out = {
+        "model": f"gpt_{args.layers}L_{args.hidden}h_byte_vocab",
+        "params_m": round(n_params / 1e6, 1),
+        "data": "repo source bytes (real text)",
+        "steps": args.steps,
+        "batch": args.batch, "seq": args.seq,
+        "losses": losses,
+        "first_loss": first, "final_loss": last,
+        "resume_bitwise_ok": resume_ok,
+        "device": str(jax.devices()[0].device_kind),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}: loss {first:.4f} -> {last:.4f}")
+    assert last < first * 0.7, "insufficient convergence"
+    assert resume_ok, "resume not bitwise identical"
+
+
+if __name__ == "__main__":
+    main()
