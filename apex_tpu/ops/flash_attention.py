@@ -1062,6 +1062,320 @@ _flash_qkv_masked.defvjp(_flash_qkv_masked_vjp_fwd,
                          _flash_qkv_masked_vjp_bwd)
 
 
+# --- E-layout (head-interleaved) self-attention ----------------------------
+#
+# Consumes the qkv projection's NATIVE output layout — (b, s, h, 3d),
+# lanes ordered [head][q(d) k(d) v(d)], exactly what
+# ``qkv.reshape(b, s, h, 3*d)`` of a fused projection yields — via
+# lane-blocked BlockSpecs, and emits the context as (b, s, h*d) plus (in
+# the vjp) ONE dqkv cotangent in the same interleaved layout.  No
+# (b, h, s, d) transpose and no dq/dk/dv concatenate exists anywhere on
+# this path: XLA cannot fuse transposes into a custom call, so the
+# per-tensor entry forces eight bf16[b,h,s,d] relayout copies per layer
+# (measured ~14 ms/step at GPT-345M, ~16 ms at BERT-large).  Heads are
+# sliced out of the wide block INSIDE the kernel — measured free on v5e
+# (the head-group microbench beat the per-head grid: lane slices
+# pipeline behind the MXU).
+#
+# Single-block only: the whole (128-aligned) sequence must fit one
+# q/k-block (ps <= 1024 keeps the fp32 score temporaries inside VMEM).
+# Longer sequences keep the transposing path — `flash_e_supported`
+# tells callers which side they're on.
+
+_E_MAX_SEQ = 1024
+# lane budget per head-group block (3*hg*d lanes): sized so the bwd's
+# score-shaped fp32 temporaries (~10 MB at ps=1024) plus double-buffered
+# qkv/do/dqkv blocks stay inside the 16 MB VMEM window.
+_E_LANE_BUDGET = _env_block("APEX_TPU_FLASH_E_LANES", 768)
+
+
+def _pick_heads_per_group(h: int, d: int, ps: int) -> Optional[int]:
+    """Largest divisor of ``h`` with 3*hg*d lanes within budget, lane-
+    aligned (3*hg*d % 128 == 0), and few enough unrolled heads that the
+    per-head (ps, ps) fp32 score temporaries stay inside VMEM — Mosaic
+    only partially reuses them across the unrolled loop (measured: hg=4
+    at ps=1024/d=64 fits with ~2 MB slack; hg=16 at ps=1024/d=16 asks
+    for 43.6 MB).  None when no grouping qualifies (callers fall back
+    to the transposing path)."""
+    cap = max(1, _E_LANE_BUDGET // (3 * d))
+    cap = min(cap, max(1, (4 * 1024 * 1024) // (ps * ps)))
+    for hg in range(min(cap, h), 0, -1):
+        if h % hg == 0 and (3 * hg * d) % 128 == 0:
+            return hg
+    return None
+
+
+def flash_e_supported(s: int, h: int, d: int) -> bool:
+    ps = -(-s // 128) * 128
+    return ps <= _E_MAX_SEQ and _pick_heads_per_group(h, d, ps) is not None
+
+
+def _fwd_e_kernel(scale, a, causal, has_kvm, kpad, s_real, hg, d,
+                  qkv_ref, *rest):
+    if has_kvm:
+        kvm_ref, o_ref, lse_ref = rest
+    else:
+        kvm_ref = None
+        o_ref, lse_ref = rest
+    blk = qkv_ref[0]                       # (ps, hg*3*d)
+    if has_kvm:
+        vm = kvm_ref[0, 0, 0, :][None, :] > 0
+    for j in range(hg):
+        off = j * 3 * d
+        qh = blk[:, off:off + d]
+        kh = blk[:, off + d:off + 2 * d]
+        vh = blk[:, off + 2 * d:off + 3 * d]
+        s = _dot(qh, kh, trans_b=True)     # (ps, ps) raw logits, fp32
+        mask = None
+        if causal:
+            mask = _tri_mask(s.shape, 0, 0)
+        if kpad and not has_kvm:
+            km = _kcol_mask(s.shape, 0, s_real)
+            mask = km if mask is None else (mask & km)
+        if has_kvm:
+            mask = vm if mask is None else (mask & vm)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp2((s - m) * a)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        if has_kvm:
+            dead = m <= _NEG * 0.5         # see _fwd_single_kernel
+            l = jnp.where(dead, 0.0, l)
+        acc = _dot(p.astype(blk.dtype), vh)
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o = acc / safe_l
+        if has_kvm:
+            o = jnp.where(dead, 0.0, o)
+        o_ref[0, :, j * d:(j + 1) * d] = o.astype(o_ref.dtype)
+        lse = m * scale + jnp.log(safe_l)
+        lse_ref[0, j] = jnp.broadcast_to(lse[:, 0][None, :],
+                                         lse_ref.shape[2:])
+
+
+def _flash_fwd_e(qkv_e, h, scale, causal, kv_mask=None):
+    b, s, width = qkv_e.shape
+    d = width // (3 * h)
+    ps = -(-s // 128) * 128
+    hg = _pick_heads_per_group(h, d, ps)
+    g = h // hg
+    qkv3 = _pad_to(qkv_e, 1, ps)
+    a = scale * _LOG2E
+    kpad = ps != s
+    has_kvm = kv_mask is not None
+
+    qkv_spec = pl.BlockSpec((1, ps, hg * 3 * d),
+                            lambda b_, g_: (b_, 0, g_),
+                            memory_space=pltpu.VMEM)
+    o_spec = pl.BlockSpec((1, ps, hg * d), lambda b_, g_: (b_, 0, g_),
+                          memory_space=pltpu.VMEM)
+    lse_spec = pl.BlockSpec((1, hg, 8, ps),
+                            lambda b_, g_: (b_, g_, 0, 0),
+                            memory_space=pltpu.VMEM)
+    in_specs = [qkv_spec]
+    operands = [qkv3]
+    if has_kvm:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, ps), lambda b_, g_: (b_, 0, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(_kvm8(kv_mask, b, ps, ps))
+    o, lse8 = pl.pallas_call(
+        functools.partial(_fwd_e_kernel, scale, a, causal, has_kvm,
+                          kpad, s, hg, d),
+        grid=(b, g),
+        in_specs=in_specs,
+        out_specs=[o_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, ps, h * d), qkv_e.dtype),
+            jax.ShapeDtypeStruct((b, h, 8, ps), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*operands)
+    lse = lse8[:, :, 0, :s]                # (b, h, s)
+    return o[:, :s], lse
+
+
+def _bwd_e_kernel(a, vscale, causal, has_kvm, kpad, s_real, hg, d,
+                  qkv_ref, do_ref, lse2_ref, delta_ref, *rest):
+    if has_kvm:
+        kvm_ref, dqkv_ref = rest
+    else:
+        kvm_ref = None
+        (dqkv_ref,) = rest
+    blk = qkv_ref[0]                       # (ps, hg*3*d)
+    do_blk = do_ref[0]                     # (ps, hg*d)
+    if has_kvm:
+        vm = kvm_ref[0, 0, 0, :][None, :] > 0
+    for j in range(hg):
+        off = j * 3 * d
+        qh = blk[:, off:off + d]
+        kh = blk[:, off + d:off + 2 * d]
+        vh = blk[:, off + 2 * d:off + 3 * d]
+        doh = do_blk[:, j * d:(j + 1) * d]
+        s = _dot(qh, kh, trans_b=True)
+        # NOTE: unlike _bwd_fused_kernel, dp is NOT hoisted before the
+        # softmax here — a third live fp32 score buffer puts the kernel
+        # ~124 KB over the VMEM stack limit at hg=4/ps=1024, and the
+        # unrolled head loop already overlaps head j's VPU work with
+        # head j+1's MXU passes.
+        lse2 = lse2_ref[0, j, 0, :][:, None]
+        arg = s * a - lse2
+        mask = None
+        if causal:
+            mask = _tri_mask(s.shape, 0, 0)
+        if kpad and not has_kvm:
+            km = _kcol_mask(s.shape, 0, s_real)
+            mask = km if mask is None else (mask & km)
+        if has_kvm:
+            mask = vm if mask is None else (mask & vm)
+        if mask is not None:
+            arg = jnp.where(mask, arg, _NEG)
+        p = jnp.exp2(arg)
+        dv = _dot_t0(p.astype(doh.dtype), doh)
+        vs = vh * jnp.asarray(vscale, vh.dtype)
+        dp = _dot(doh, vs, trans_b=True)
+        delta = delta_ref[0, j, 0, :][:, None]
+        ds = p * (dp - delta)
+        dq = _dot(ds.astype(kh.dtype), kh)
+        dk = _dot_t0(ds.astype(qh.dtype), qh)
+        dqkv_ref[0, :, off:off + d] = dq.astype(dqkv_ref.dtype)
+        dqkv_ref[0, :, off + d:off + 2 * d] = dk.astype(dqkv_ref.dtype)
+        dqkv_ref[0, :, off + 2 * d:off + 3 * d] = \
+            dv.astype(dqkv_ref.dtype)
+
+
+def _flash_bwd_e(h, scale, causal, res, do, kv_mask=None):
+    qkv3, o3, lse, b, s = res              # qkv3/o3 already ps-padded
+    ps, width = qkv3.shape[1], qkv3.shape[2]
+    d = width // (3 * h)
+    hg = _pick_heads_per_group(h, d, ps)
+    g = h // hg
+    a = scale * _LOG2E
+    kpad = ps != s
+    has_kvm = kv_mask is not None
+
+    do3 = _pad_to(do, 1, ps)
+    scale_v = float(np.asarray(scale).astype(qkv3.dtype))  # see _flash_bwd
+    delta = (do3.astype(jnp.float32) * o3.astype(jnp.float32)) \
+        .reshape(b, ps, h, d).sum(-1).transpose(0, 2, 1) * scale_v
+    delta8 = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, ps))
+    lse2 = _pad_to(lse * _LOG2E, 2, ps, value=_BIG)        # (b, h, ps)
+    lse28 = jnp.broadcast_to(lse2[:, :, None, :], (b, h, 8, ps))
+
+    qkv_spec = pl.BlockSpec((1, ps, hg * 3 * d),
+                            lambda b_, g_: (b_, 0, g_),
+                            memory_space=pltpu.VMEM)
+    do_spec = pl.BlockSpec((1, ps, hg * d), lambda b_, g_: (b_, 0, g_),
+                           memory_space=pltpu.VMEM)
+    r_spec = pl.BlockSpec((1, hg, 8, ps), lambda b_, g_: (b_, g_, 0, 0),
+                          memory_space=pltpu.VMEM)
+    in_specs = [qkv_spec, do_spec, r_spec, r_spec]
+    operands = [qkv3, do3, lse28, delta8]
+    if has_kvm:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, 8, ps), lambda b_, g_: (b_, 0, 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(_kvm8(kv_mask, b, ps, ps))
+    dqkv = pl.pallas_call(
+        functools.partial(_bwd_e_kernel, a, scale, causal, has_kvm,
+                          kpad, s, hg, d),
+        grid=(b, g),
+        in_specs=in_specs,
+        out_specs=qkv_spec,
+        out_shape=jax.ShapeDtypeStruct((b, ps, width), qkv3.dtype),
+        interpret=_interpret(),
+    )(*operands)
+    return dqkv[:, :s]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash_e_fused(qkv_e, h, scale, causal):
+    return _flash_fwd_e(qkv_e, h, scale, causal)[0]
+
+
+def _flash_e_vjp_fwd(qkv_e, h, scale, causal):
+    b, s, _ = qkv_e.shape
+    ps = -(-s // 128) * 128
+    o, lse = _flash_fwd_e(qkv_e, h, scale, causal)
+    o3 = _pad_to(o, 1, ps)
+    return o, (_pad_to(qkv_e, 1, ps), o3, lse, b, s)
+
+
+def _flash_e_vjp_bwd(h, scale, causal, res, do):
+    return (_flash_bwd_e(h, scale, causal, res, do),)
+
+
+_flash_e_fused.defvjp(_flash_e_vjp_fwd, _flash_e_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _flash_e_masked(qkv_e, kv_mask, h, scale, causal):
+    return _flash_fwd_e(qkv_e, h, scale, causal, kv_mask=kv_mask)[0]
+
+
+def _flash_e_masked_vjp_fwd(qkv_e, kv_mask, h, scale, causal):
+    b, s, _ = qkv_e.shape
+    ps = -(-s // 128) * 128
+    o, lse = _flash_fwd_e(qkv_e, h, scale, causal, kv_mask=kv_mask)
+    o3 = _pad_to(o, 1, ps)
+    return o, (_pad_to(qkv_e, 1, ps), o3, lse, b, s, kv_mask)
+
+
+def _flash_e_masked_vjp_bwd(h, scale, causal, res, do):
+    *core, kv_mask = res
+    dqkv = _flash_bwd_e(h, scale, causal, tuple(core), do,
+                        kv_mask=kv_mask)
+    return dqkv, jnp.zeros_like(kv_mask)
+
+
+_flash_e_masked.defvjp(_flash_e_masked_vjp_fwd, _flash_e_masked_vjp_bwd)
+
+
+def flash_attention_e(qkv: jnp.ndarray,
+                      scale: Optional[float] = None,
+                      causal: bool = False,
+                      kv_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Self-attention over the projection-native layout: ``qkv``
+    (b, s, h, 3*d) — lanes [head][q|k|v] exactly as
+    ``proj(x).reshape(b, s, h, 3*d)`` produces — returning the context
+    (b, s, h*d) ready for the output projection.  Semantically equal to
+    splitting/transposing and calling :func:`flash_attention`, but the
+    whole attention boundary carries ZERO relayout copies: inputs are
+    lane-blocked views of the projection output, and the backward emits
+    one dqkv array in the same layout.  Requirements (see
+    :func:`flash_e_supported`): 128-aligned-padded s <= 1024 and a
+    head grouping within the VMEM lane budget; otherwise this entry
+    falls back to the transposing path internally.
+    """
+    from ._context import in_manual_axis_context
+    from .._autocast_ctx import autocast_compute_dtype
+
+    b, s, h, td = qkv.shape
+    d = td // 3
+    if scale is None:
+        scale = d ** -0.5
+    act = autocast_compute_dtype()
+    if act is not None and qkv.dtype != act \
+            and jnp.issubdtype(qkv.dtype, jnp.floating):
+        qkv = qkv.astype(act)
+    manual = in_manual_axis_context(qkv)
+    if manual or not flash_e_supported(s, h, d):
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if manual:
+            ctx = mha_reference(q, k, v, scale=scale, causal=causal,
+                                kv_mask=kv_mask)
+        else:
+            ctx = flash_attention(q, k, v, scale=scale, causal=causal,
+                                  kv_mask=kv_mask)
+        return ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+    qkv_e = qkv.reshape(b, s, h * td)
+    if kv_mask is not None:
+        return _flash_e_masked(qkv_e, kv_mask.astype(jnp.float32), h,
+                               scale, causal)
+    return _flash_e_fused(qkv_e, h, scale, causal)
+
+
 def mha_reference(q, k, v, scale=None, causal=False, kv_mask=None):
     """Unfused reference (the [b,h,sq,sk]-materializing baseline the
     reference's standalone GPT uses) — for parity tests and benchmarks.

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from ..normalization import FusedLayerNorm
-from ..ops.flash_attention import flash_attention
+from ..ops.flash_attention import flash_attention_e
 from .enums import AttnMaskType
 from .functional.fused_softmax import FusedScaleMaskSoftmax
 from .tensor_parallel.layers import (ColumnParallelLinear,
@@ -119,23 +119,25 @@ class ParallelSelfAttention(nn.Module):
                 "both (fold padding into the attention_mask yourself)")
         # flash handles causal and/or key-padding masks; an arbitrary
         # (b, 1, sq, sk) attention_mask takes the materializing path.
-        # NOTE: a packed (3,b,h,s,d) route through flash_attention_qkv
-        # was measured end-to-end at GPT-345M and LOST ~5 ms/step: the
-        # single big 5-D transpose copies cost more than the per-tensor
-        # relayout copies they replace (the Pallas kernels themselves
-        # time identically).  Keep the per-tensor path here; the packed
-        # entry remains for callers that already hold packed qkv.
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        # (b, heads, s, d)
-        q = q.transpose(0, 2, 1, 3)
-        k = k.transpose(0, 2, 1, 3)
-        v = v.transpose(0, 2, 1, 3)
-
         if self.use_flash and attention_mask is None \
                 and (deterministic or self.attention_dropout == 0.0):
-            ctx = flash_attention(q, k, v, scale=scale, causal=causal,
-                                  kv_mask=key_padding_mask)
+            # E-layout entry: consumes qkv's native (b, s, h, 3d) lane
+            # order and emits (b, s, h*d) — the whole attention boundary
+            # carries no relayout copies (measured ~14/16 ms/step of
+            # bf16[b,h,s,d] transposes at GPT-345M/BERT-large on the
+            # per-tensor entry; a packed (3,b,h,s,d) route was also
+            # tried and LOST ~5 ms/step to its 5-D transpose).  Falls
+            # back to the transposing path internally when the shape
+            # doesn't qualify (see flash_e_supported).
+            ctx = flash_attention_e(qkv, scale=scale, causal=causal,
+                                    kv_mask=key_padding_mask)
         else:
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            # (b, heads, s, d)
+            q = q.transpose(0, 2, 1, 3)
+            k = k.transpose(0, 2, 1, 3)
+            v = v.transpose(0, 2, 1, 3)
+
             softmax_mask_type = self.attn_mask_type
             if key_padding_mask is not None:
                 # fold padding keys (and, for causal models, the
@@ -166,12 +168,13 @@ class ParallelSelfAttention(nn.Module):
                     key = model_parallel_rng_key(key, self.axis_name)
                 keep = jax.random.bernoulli(
                     key, 1.0 - self.attention_dropout, probs.shape)
-                probs = jnp.where(keep, probs / (1.0 - self.attention_dropout),
+                probs = jnp.where(keep,
+                                  probs / (1.0 - self.attention_dropout),
                                   jnp.zeros((), probs.dtype))
-            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(self.dtype), v)
-
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s,
-                                                heads_local * head_dim)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(self.dtype),
+                             v)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(
+                b, s, heads_local * head_dim)
         return RowParallelLinear(self.hidden_size, self.hidden_size,
                                  input_is_parallel=True, dtype=self.dtype,
                                  axis_name=self.axis_name, name="dense")(ctx)

@@ -243,3 +243,94 @@ def test_fully_masked_rows_zero_output_and_grads():
     assert np.isfinite(np.asarray(dq)).all()
     assert np.isfinite(np.asarray(dk)).all()
     assert np.isfinite(np.asarray(dv)).all()
+
+
+class TestELayout:
+    """flash_attention_e: the projection-native (b, s, h, 3d) entry —
+    no relayout copies at the attention boundary."""
+
+    @staticmethod
+    def _ref(qkv, causal=False, kv_mask=None):
+        b, s, h, td = qkv.shape
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        o = mha_reference(q, k, v, causal=causal, kv_mask=kv_mask)
+        return o.transpose(0, 2, 1, 3).reshape(b, s, h * (td // 3))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(2, 128, 4, 64),
+                                       (2, 200, 4, 64),    # padded s
+                                       (1, 256, 8, 32),    # d=32 grouping
+                                       (2, 128, 6, 64)])   # hg=2
+    def test_forward_and_grad_parity(self, causal, shape):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        b, s, h, d = shape
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+
+        def loss_e(qkv):
+            return jnp.sum(flash_attention_e(qkv, causal=causal) * w)
+
+        def loss_r(qkv):
+            return jnp.sum(self._ref(qkv, causal=causal) * w)
+
+        got = flash_attention_e(qkv, causal=causal)
+        want = self._ref(qkv, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        ge = jax.grad(loss_e)(qkv)
+        gr = jax.grad(loss_r)(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("s", [128, 200])
+    def test_kv_mask_parity(self, s):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        b, h, d = 2, 4, 64
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        lens = jnp.array([s // 2, s])
+        m = jnp.arange(s)[None, :] < lens[:, None]
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+
+        got = flash_attention_e(qkv, kv_mask=m)
+        want = self._ref(qkv, kv_mask=m)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+        def loss_e(qkv):
+            return jnp.sum(flash_attention_e(qkv, kv_mask=m) * w)
+
+        def loss_r(qkv):
+            return jnp.sum(self._ref(qkv, kv_mask=m) * w)
+
+        ge = jax.grad(loss_e)(qkv)
+        gr = jax.grad(loss_r)(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_long_sequence_falls_back(self):
+        """ps > 1024 doesn't qualify — the entry transparently takes the
+        transposing path and stays correct."""
+        from apex_tpu.ops.flash_attention import (flash_attention_e,
+                                                  flash_e_supported)
+        assert not flash_e_supported(1025, 4, 64)
+        b, s, h, d = 1, 1152, 2, 64
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        got = flash_attention_e(qkv, causal=True)
+        want = self._ref(qkv, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grouping_helper(self):
+        from apex_tpu.ops.flash_attention import _pick_heads_per_group
+        assert _pick_heads_per_group(16, 64, 1024) == 4  # 3*4*64 = 768
+        assert _pick_heads_per_group(6, 64, 1024) == 2   # 3*2*64 = 384
+        assert _pick_heads_per_group(8, 32, 256) == 8    # 3*8*32 = 768
+        # score-temp cap: tiny d would pack every head into one group
+        # and blow VMEM on the unrolled (ps, ps) fp32 temps
+        assert _pick_heads_per_group(16, 16, 1024) is None
+        # no divisor of h makes 3*hg*d lane-aligned -> None
+        assert _pick_heads_per_group(5, 24, 128) is None
