@@ -125,11 +125,20 @@ def _kcol_mask(shape, k_off, sk):
 
 # --- forward ---------------------------------------------------------------
 
-def _fwd_single_kernel(scale, a, causal, has_kvm, kpad, sq, sk,
-                       q_ref, k_ref, v_ref, *rest):
+def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
+                       *refs):
     """Whole-(padded)-sequence-in-one-block forward: plain softmax, no
     online-correction carries (the default 1024 blocks put GPT s=1024
-    and BERT s=512 here)."""
+    and BERT s=512 here).  ``has_off``: a leading SMEM ref carries
+    [q_offset, k_offset] GLOBAL positions for the causal mask (the
+    ring-attention partial — offsets are traced, so the mask compare
+    runs every call; VPU work is hidden behind the MXU)."""
+    if has_off:
+        off_ref, *refs = refs
+        qoff, koff = off_ref[0], off_ref[1]
+    else:
+        qoff = koff = 0
+    q_ref, k_ref, v_ref, *rest = refs
     if has_kvm:
         kvm_ref, o_ref, lse_ref = rest
     else:
@@ -140,7 +149,7 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, kpad, sq, sk,
     s = _dot(q, k, trans_b=True)                      # raw logits, fp32
     mask = None
     if causal:
-        mask = _tri_mask(s.shape, 0, 0)
+        mask = _tri_mask(s.shape, qoff, koff)
     if kpad and not has_kvm:
         # _kvm8 zero-pads, so kv_mask already masks pad columns
         km = _kcol_mask(s.shape, 0, sk)
@@ -153,16 +162,18 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, kpad, sq, sk,
     m = jnp.max(s, axis=1, keepdims=True)             # raw units
     p = jnp.exp2((s - m) * a)
     l = jnp.sum(p, axis=1, keepdims=True)
-    if has_kvm:
-        # fully-masked rows: m stayed at _NEG so (s - m) = 0 and p = 1
-        # spuriously; zero them via the row max instead of a
-        # score-shaped select.
+    guard_dead = has_kvm or (has_off and causal)
+    if guard_dead:
+        # fully-masked rows (all keys masked, or an offset block whose
+        # keys are all in the causal future): m stayed at _NEG so
+        # (s - m) = 0 and p = 1 spuriously; zero them via the row max
+        # instead of a score-shaped select.
         dead = m <= _NEG * 0.5
         l = jnp.where(dead, 0.0, l)
     acc = _dot(p.astype(v_ref.dtype), v_ref[0])
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o = acc / safe_l
-    if has_kvm:
+    if guard_dead:
         o = jnp.where(dead, 0.0, o)
     o_ref[0] = o.astype(o_ref.dtype)
     lse = m * scale + jnp.log(safe_l)
@@ -170,8 +181,14 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, kpad, sq, sk,
                                      lse_ref.shape[2:])
 
 
-def _fwd_kernel(scale, a, causal, has_kvm, kpad, sq, sk, bq, bk,
-                q_ref, k_ref, v_ref, *rest):
+def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
+                *refs):
+    if has_off:
+        off_ref, *refs = refs
+        qoff, koff = off_ref[0], off_ref[1]
+    else:
+        qoff = koff = 0
+    q_ref, k_ref, v_ref, *rest = refs
     if has_kvm:
         kvm_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
     else:
@@ -187,7 +204,8 @@ def _fwd_kernel(scale, a, causal, has_kvm, kpad, sq, sk, bq, bk,
         l_sc[:] = jnp.zeros_like(l_sc)
         acc[:] = jnp.zeros_like(acc)
 
-    run = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+    run = (j * bk + koff <= i * bq + qoff + bq - 1) if causal \
+        else (j >= 0)
 
     @pl.when(run)
     def _block():
@@ -196,7 +214,7 @@ def _fwd_kernel(scale, a, causal, has_kvm, kpad, sq, sk, bq, bk,
         s = _dot(q, k, trans_b=True)                  # raw logits, fp32
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, i * bq, j * bk)
+            mask = _tri_mask(s.shape, i * bq + qoff, j * bk + koff)
         if kpad and not has_kvm:
             # _kvm8 zero-pads, so kv_mask already masks pad columns
             km = _kcol_mask(s.shape, j * bk, sk)
@@ -210,11 +228,13 @@ def _fwd_kernel(scale, a, causal, has_kvm, kpad, sq, sk, bq, bk,
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp2((m_prev - m_cur) * a)
         p = jnp.exp2((s - m_cur) * a)
-        if has_kvm:
+        if has_kvm or (has_off and causal):
             # rows with every key masked so far keep m_cur = _NEG and
             # (s - m_cur) = 0 at masked entries — zero p explicitly so
             # such rows sum to l = 0 and emit exactly 0 (matching the
-            # backward, where the kv select already zeroes them).
+            # backward, where masked entries recompute p = 0).  The
+            # has_off case: a q-block straddling the k_offset boundary
+            # runs with some rows entirely in the causal future.
             p = jnp.where(mask, p, 0.0)
         l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
@@ -253,7 +273,8 @@ def _kvm8(kv_mask, b, psk, bk):
         m.reshape(b, psk // bk, 1, bk), (b, psk // bk, 8, bk))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
+               offsets=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
@@ -269,6 +290,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
     kpad = psk != sk
 
     has_kvm = kv_mask is not None
+    has_off = offsets is not None and causal
     if nq == 1 and nk == 1:
         qb_spec = pl.BlockSpec((1, psq, d), lambda b_: (b_, 0, 0),
                                memory_space=pltpu.VMEM)
@@ -278,6 +300,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
                                 memory_space=pltpu.VMEM)
         in_specs = [qb_spec, kb_spec, kb_spec]
         operands = [q3, k3, v3]
+        if has_off:
+            in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+            operands.insert(0, offsets)
         if has_kvm:
             in_specs.append(pl.BlockSpec(
                 (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
@@ -285,7 +310,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
             operands.append(_kvm8(kv_mask, b, psk, bk))
         o, lse8 = pl.pallas_call(
             functools.partial(_fwd_single_kernel, scale, a, causal,
-                              has_kvm, kpad, sq, sk),
+                              has_kvm, has_off, kpad, sq, sk),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, lse_spec],
@@ -306,6 +331,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
                             memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     operands = [q3, k3, v3]
+    if has_off:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, offsets)
     if has_kvm:
         kvm_spec = pl.BlockSpec(
             (1, 1, 8, bk), lambda b_, i, j: (b_ // h, j, 0, 0),
@@ -313,8 +341,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None):
         in_specs.append(kvm_spec)
         operands.append(_kvm8(kv_mask, b, psk, bk))
     o, lse8 = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, a, causal, has_kvm, kpad,
-                          sq, sk, bq, bk),
+        functools.partial(_fwd_kernel, scale, a, causal, has_kvm,
+                          has_off, kpad, sq, sk, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[q_spec, lse_spec],
@@ -375,7 +403,7 @@ def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
                               memory_space=pltpu.VMEM)
         o, lse8 = pl.pallas_call(
             functools.partial(_fwd_single_kernel, scale, a, causal,
-                              has_kvm, kpad, s, s),
+                              has_kvm, False, kpad, s, s),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[o_spec, lse_spec],
@@ -407,8 +435,8 @@ def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
             memory_space=pltpu.VMEM))
         operands.append(_kvm8(kv_mask, b, ps, bk))
     o, lse8 = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale, a, causal, has_kvm, kpad,
-                          s, s, bq, bk),
+        functools.partial(_fwd_kernel, scale, a, causal, has_kvm,
+                          False, kpad, s, s, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[qspec(0), lse_spec],
@@ -439,9 +467,14 @@ def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
 # and inf * the zero k-pad rows would NaN dq.  The kv_mask path needs
 # no kpad mask — _kvm8 zero-pads, masking pad columns for free.
 
-def _bwd_dq_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
-                   q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
-                   *rest):
+def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
+                   bq, bk, *refs):
+    if has_off:
+        off_ref, *refs = refs
+        qoff, koff = off_ref[0], off_ref[1]
+    else:
+        qoff = koff = 0
+    q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dq_ref, dq_acc = rest
     else:
@@ -455,7 +488,8 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = (j * bk <= i * bq + bq - 1) if causal else (j >= 0)
+    run = (j * bk + koff <= i * bq + qoff + bq - 1) if causal \
+        else (j >= 0)
 
     @pl.when(run)
     def _block():
@@ -466,7 +500,7 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
         arg = s * a - lse2
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, i * bq, j * bk)
+            mask = _tri_mask(s.shape, i * bq + qoff, j * bk + koff)
         if kpad and not has_kvm:
             km = _kcol_mask(s.shape, j * bk, sk)
             mask = km if mask is None else (mask & km)
@@ -487,9 +521,14 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
-                    q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
-                    *rest):
+def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
+                    bq, bk, *refs):
+    if has_off:
+        off_ref, *refs = refs
+        qoff, koff = off_ref[0], off_ref[1]
+    else:
+        qoff = koff = 0
+    q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
     else:
@@ -504,7 +543,8 @@ def _bwd_dkv_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (j * bq + bq - 1 >= i * bk) if causal else (j >= 0)
+    run = (j * bq + qoff + bq - 1 >= i * bk + koff) if causal \
+        else (j >= 0)
 
     @pl.when(run)
     def _block():
@@ -515,7 +555,7 @@ def _bwd_dkv_kernel(a, vscale, causal, has_kvm, kpad, sq, sk, bq, bk,
         arg = s * a - lse2
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, j * bq, i * bk)
+            mask = _tri_mask(s.shape, j * bq + qoff, i * bk + koff)
         if kpad and not has_kvm:
             km = _kcol_mask(s.shape, i * bk, sk)
             mask = km if mask is None else (mask & km)
@@ -546,15 +586,20 @@ def _rows8(x2d, bq):
         x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
 
 
-def _bwd_fused_kernel(a, vscale, causal, has_kvm, kpad, sq, sk,
-                      q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref,
-                      *rest):
+def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
+                      *refs):
     """Single-block backward: when the whole (padded) sequence fits one
     q-block and one k-block, dq/dk/dv come from ONE pass — the scores
     ``s`` and ``dp`` are computed once instead of once per kernel (the
     two-kernel flash backward recomputes both), removing 2 of the 7
     matmuls; the two it removes are the d-contracted (half-MXU-lane)
     ones, so the saving exceeds their FLOP share."""
+    if has_off:
+        off_ref, *refs = refs
+        qoff, koff = off_ref[0], off_ref[1]
+    else:
+        qoff = koff = 0
+    q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dq_ref, dk_ref, dv_ref = rest
     else:
@@ -572,7 +617,7 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, kpad, sq, sk,
     arg = s * a - lse2
     mask = None
     if causal:
-        mask = _tri_mask(s.shape, 0, 0)
+        mask = _tri_mask(s.shape, qoff, koff)
     if kpad and not has_kvm:
         km = _kcol_mask(s.shape, 0, sk)
         mask = km if mask is None else (mask & km)
@@ -589,7 +634,8 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, kpad, sq, sk,
     dk_ref[0] = _dot_t0(ds.astype(q.dtype), q).astype(dk_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
+def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
+               offsets=None, dlse=None):
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -616,7 +662,12 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     # ds = p*(dp'-delta') wherever dp ~ delta.
     scale_v = float(np.asarray(scale).astype(v.dtype))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(bh, sq) * scale_v
+                    axis=-1).reshape(bh, sq)
+    if dlse is not None:
+        # lse cotangent (the partial entry): dlse/ds_raw = scale*p, so
+        # it folds into delta — ds = p*(dp' - (delta - dlse)*scale_v)
+        delta = delta - dlse.reshape(bh, sq)
+    delta = delta * scale_v
     delta = _pad_to(delta, 1, bq)
     # +BIG pad: q-padded rows recompute p = exp2(s*a - BIG) = 0, so
     # they contribute nothing to dk/dv and need no position masks.
@@ -624,6 +675,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     lse8 = _rows8(lse2_p, bq)
     delta8 = _rows8(delta, bq)
     has_kvm = kv_mask is not None
+    has_off = offsets is not None and causal
     kvm = _kvm8(kv_mask, b, psk, bk) if has_kvm else None
 
     if nq == 1 and nk == 1 and d <= 64:
@@ -640,6 +692,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
         in_specs = [qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
                     rb_spec]
         operands = [q3, k3, vs3, do3, lse8, delta8]
+        if has_off:
+            in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+            operands.insert(0, offsets)
         if has_kvm:
             in_specs.append(pl.BlockSpec(
                 (1, 1, 8, bk), lambda b_: (b_ // h, 0, 0, 0),
@@ -647,7 +702,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
             operands.append(kvm)
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, a, scale, causal,
-                              has_kvm, kpad, sq, sk),
+                              has_kvm, has_off, kpad, sq, sk),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, kb_spec, kb_spec],
@@ -670,6 +725,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
                 r_spec_i]
     operands = [q3, k3, vs3, do3, lse8, delta8]
+    if has_off:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, offsets)
     if has_kvm:
         # kv mask indexed by the K block (grid dim 2 here)
         in_specs.append(pl.BlockSpec(
@@ -677,8 +735,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
             memory_space=pltpu.VMEM))
         operands.append(kvm)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm, kpad,
-                          sq, sk, bq, bk),
+        functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm,
+                          has_off, kpad, sq, sk, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec_i,
@@ -696,6 +754,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
     in_specs = [q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
                 r_spec_j]
     operands = [q3, k3, vs3, do3, lse8, delta8]
+    if has_off:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, offsets)
     if has_kvm:
         # kv mask indexed by the K block (grid dim 1 here)
         in_specs.append(pl.BlockSpec(
@@ -703,8 +764,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None):
             memory_space=pltpu.VMEM))
         operands.append(kvm)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm, kpad,
-                          sq, sk, bq, bk),
+        functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm,
+                          has_off, kpad, sq, sk, bq, bk),
         grid=(bh, nk, nq),
         in_specs=in_specs,
         out_specs=[k_spec_i, k_spec_i],
@@ -773,7 +834,7 @@ def _flash_bwd_packed(scale, causal, block_q, block_k, res, do,
             operands.append(kvm)
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, a, scale, causal,
-                              has_kvm, kpad, s, s),
+                              has_kvm, False, kpad, s, s),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[ob_spec, ob_spec, ob_spec],
@@ -805,7 +866,7 @@ def _flash_bwd_packed(scale, causal, block_q, block_k, res, do,
         operands.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm,
-                          kpad, s, s, bq, bk),
+                          False, kpad, s, s, bq, bk),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
@@ -840,7 +901,7 @@ def _flash_bwd_packed(scale, causal, block_q, block_k, res, do,
         operands.append(kvm)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm,
-                          kpad, s, s, bq, bk),
+                          False, kpad, s, s, bq, bk),
         grid=(bh, nk, nq),
         in_specs=in_specs,
         out_specs=[out_ki, out_ki],
@@ -1060,6 +1121,76 @@ def _flash_qkv_masked_vjp_bwd(scale, causal, block_q, block_k, res, do):
 
 _flash_qkv_masked.defvjp(_flash_qkv_masked_vjp_fwd,
                          _flash_qkv_masked_vjp_bwd)
+
+
+# --- partial (o, lse) entry: ring / blockwise composition -------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_partial(q, k, v, offsets, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        offsets=offsets)
+    return o, lse.reshape(q.shape[0], q.shape[1], -1)
+
+
+def _flash_partial_vjp_fwd(q, k, v, offsets, scale, causal, block_q,
+                           block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        offsets=offsets)
+    out = (o, lse.reshape(q.shape[0], q.shape[1], -1))
+    return out, (q, k, v, o, lse, offsets)
+
+
+def _flash_partial_vjp_bwd(scale, causal, block_q, block_k, res, cts):
+    q, k, v, o, lse, offsets = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
+                            (q, k, v, o, lse), do, offsets=offsets,
+                            dlse=dlse.reshape(lse.shape))
+    return dq, dk, dv, np.zeros(offsets.shape, dtype=jax.dtypes.float0)
+
+
+_flash_partial.defvjp(_flash_partial_vjp_fwd, _flash_partial_vjp_bwd)
+
+
+def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
+                            v: jnp.ndarray,
+                            scale: Optional[float] = None,
+                            causal: bool = False,
+                            q_offset=0, k_offset=0,
+                            block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K):
+    """Blockwise-attention PARTIAL: returns ``(o, lse)`` — the
+    softmax-normalized context of q against THIS k/v block plus the
+    per-row log-sum-exp — so callers can combine blocks exactly with
+    the flash merge ``lse = logaddexp(lse1, lse2); o = o1*exp(lse1-lse)
+    + o2*exp(lse2-lse)``.  This is the ring-attention building block
+    (and the general two-level flash composition primitive).
+
+    ``q_offset``/``k_offset`` (traced ints OK — they ride an SMEM
+    scalar into the kernels) place the block in GLOBAL coordinates for
+    ``causal``: row i is position ``q_offset + i``, key j is
+    ``k_offset + j``.  Fully-future blocks produce o = 0 and lse ~
+    -1e30 (annihilated by the merge).  Gradients flow through both
+    outputs (the lse cotangent folds into the backward's delta term).
+
+    Unlike :func:`flash_attention` there is NO automatic shard_map
+    fallback: this entry is designed to run inside
+    ``shard_map(..., check_vma=False)``, where Pallas calls are legal
+    (with ``check_vma=True`` the custom call is rejected by JAX —
+    use ``check_vma=False`` on the enclosing shard_map).
+    """
+    from .._autocast_ctx import autocast_compute_dtype
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    act = autocast_compute_dtype()
+    if act is not None and q.dtype != act \
+            and jnp.issubdtype(q.dtype, jnp.floating):
+        q, k, v = (x.astype(act) for x in (q, k, v))
+    offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                         jnp.asarray(k_offset, jnp.int32)])
+    return _flash_partial(q, k, v, offsets, scale, causal, block_q,
+                          block_k)
 
 
 # --- E-layout (head-interleaved) self-attention ----------------------------

@@ -46,22 +46,62 @@ def _block_attend(q, k, v, scale, qpos, kpos, causal):
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str,
                    scale: Optional[float] = None,
-                   causal: bool = False) -> jnp.ndarray:
+                   causal: bool = False,
+                   use_flash: bool = False) -> jnp.ndarray:
     """Exact attention with K/V rotating around ``axis_name``.
 
     Shapes (per shard): q, k, v are (b, h, s_local, d); the global
     sequence is ``axis_size * s_local`` with shard i owning positions
     ``[i*s_local, (i+1)*s_local)``.  Returns the local output shard
     (b, h, s_local, d).
+
+    ``use_flash=True`` computes each block with the Pallas flash
+    partial (:func:`..flash_attention.flash_attention_partial`) and
+    merges (o, lse) pairs — per-step attention memory drops from the
+    materialized O(s_local^2) fp32 scores to the kernel's blockwise
+    working set, and the MXU kernel replaces the unfused einsum
+    softmax.  Requires the enclosing ``shard_map`` to pass
+    ``check_vma=False`` (Pallas calls cannot carry VMA types).  Same
+    math either way; causal blocks wholly in the future still run
+    their (masked) matmuls in both modes — the merge annihilates them.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     nshards = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     s_local = q.shape[-2]
-    qpos = rank * s_local + jnp.arange(s_local)
-
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+
+    if use_flash:
+        from .flash_attention import flash_attention_partial
+
+        qoff = rank * s_local
+
+        def fstep(carry, i):
+            kk, vv, o, lse = carry
+            kk = jax.lax.ppermute(kk, axis_name, perm)
+            vv = jax.lax.ppermute(vv, axis_name, perm)
+            src = (rank - i) % nshards
+            bo, blse = flash_attention_partial(
+                q, kk, vv, scale=scale, causal=causal,
+                q_offset=qoff, k_offset=src * s_local)
+            lse_new = jnp.logaddexp(lse, blse)
+            o = (o * jnp.exp(lse - lse_new)[..., None]
+                 + bo.astype(o.dtype) * jnp.exp(blse - lse_new)[..., None])
+            return (kk, vv, o, lse_new), None
+
+        o0, lse0 = flash_attention_partial(
+            q, k, v, scale=scale, causal=causal,
+            q_offset=qoff, k_offset=qoff)
+        if nshards > 1:
+            (_, _, o, _), _ = jax.lax.scan(
+                fstep, (k, v, o0.astype(jnp.float32), lse0),
+                jnp.arange(1, nshards))
+        else:
+            o = o0
+        return o.astype(q.dtype)
+
+    qpos = rank * s_local + jnp.arange(s_local)
 
     def merge(m, l, acc, bm, bl, bacc):
         m_new = jnp.maximum(m, bm)
@@ -102,17 +142,23 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str,
                       scale: Optional[float] = None,
                       causal: bool = False,
-                      attention_fn=None) -> jnp.ndarray:
+                      attention_fn=None,
+                      use_flash: bool = False) -> jnp.ndarray:
     """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps
     the sharded axis from SEQUENCE to HEADS, runs full-sequence
-    attention locally on a head subset (the Pallas flash kernel by
-    default), and swaps back.
+    attention locally on a head subset, and swaps back.
 
     Per-shard shapes (b, h, s_local, d) with ``h %% axis_size == 0``.
     Two all-to-alls replace the ring's ``axis_size`` ppermutes —
     preferable when heads are plentiful and ICI all-to-all bandwidth is
     good; ring attention wins when s_local is large enough to overlap
     compute with the hops.
+
+    The default local core is ``flash_attention``, which inside
+    shard_map manual axes routes to its XLA reference implementation.
+    ``use_flash=True`` forces the real Pallas kernel for the local
+    attention — requires the enclosing ``shard_map`` to pass
+    ``check_vma=False``.
     """
     nshards = jax.lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
@@ -131,6 +177,15 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if attention_fn is None:
-        from .flash_attention import flash_attention as attention_fn
+        if use_flash:
+            # bypass flash_attention's manual-axis fallback: the Pallas
+            # call is legal under shard_map(check_vma=False)
+            from .flash_attention import flash_attention_partial
+
+            def attention_fn(q, k, v, scale=None, causal=False):
+                return flash_attention_partial(q, k, v, scale=scale,
+                                               causal=causal)[0]
+        else:
+            from .flash_attention import flash_attention as attention_fn
     out = attention_fn(qh, kh, vh, scale=scale, causal=causal)
     return heads_to_seq(out)

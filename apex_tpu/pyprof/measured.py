@@ -78,20 +78,39 @@ def canonical_key(name: str) -> Tuple[str, str]:
 
 def collect_device_ops(fn: Callable, *args, iters: int = 3,
                        trace_dir: Optional[str] = None,
+                       donate: bool = False,
                        **kwargs) -> List[MeasuredOp]:
     """Run ``jit(fn)`` under ``jax.profiler`` and return per-op device
-    self-times (the reference's parse stage; xplane instead of nvvp)."""
+    self-times (the reference's parse stage; xplane instead of nvvp).
+
+    ``donate=True`` profiles a TRAIN-STEP-shaped ``fn``: every
+    positional arg is donated and ``fn`` must return a tuple whose
+    first ``len(args)`` entries are the args' replacements (extra
+    returns like the loss are fine).  Without it, state-carrying steps
+    hold two copies of params+optimizer state on device — at
+    GPT-345M/O5 scale that alone exceeds HBM."""
     from xprof.convert import raw_to_tool_data as _r2t
 
-    jitted = jax.jit(lambda *a: fn(*a, **kwargs))
-    out = jitted(*args)
+    if donate:
+        jitted = jax.jit(lambda *a: fn(*a, **kwargs),
+                         donate_argnums=tuple(range(len(args))))
+    else:
+        jitted = jax.jit(lambda *a: fn(*a, **kwargs))
+
+    def run(args):
+        out = jitted(*args)
+        if donate:
+            args = tuple(out[:len(args)])
+        return out, args
+
+    out, args = run(args)
     jax.block_until_ready(out)
     tdir = trace_dir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
     try:
         jax.profiler.start_trace(tdir)
         try:
             for _ in range(iters):
-                out = jitted(*args)
+                out, args = run(args)
             jax.block_until_ready(out)
         finally:
             # always close the process-global profiler session, or every

@@ -68,10 +68,14 @@ def reduce_scatter_to_sequence_parallel_region(
 
 def ring_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                         scale: Optional[float] = None,
-                        causal: bool = False):
+                        causal: bool = False,
+                        use_flash: bool = False):
     """Exact self-attention with q/k/v sequence-sharded over
-    ``axis_name`` (b, h, s_local, d per shard)."""
-    return ring_attention(q, k, v, axis_name, scale=scale, causal=causal)
+    ``axis_name`` (b, h, s_local, d per shard).  ``use_flash=True``
+    runs each ring block through the Pallas flash partial — requires
+    the enclosing ``shard_map`` to pass ``check_vma=False``."""
+    return ring_attention(q, k, v, axis_name, scale=scale, causal=causal,
+                          use_flash=use_flash)
 
 
 class SequenceParallelSelfAttention:
@@ -92,7 +96,8 @@ class SequenceParallelSelfAttention:
 
     def __init__(self, hidden_size: int, num_attention_heads: int,
                  causal: bool = True, mode: str = "ring",
-                 axis_name: Optional[str] = SEQUENCE_AXIS):
+                 axis_name: Optional[str] = SEQUENCE_AXIS,
+                 use_flash: bool = False):
         assert hidden_size % num_attention_heads == 0
         assert mode in ("ring", "ulysses")
         self.hidden_size = hidden_size
@@ -101,6 +106,9 @@ class SequenceParallelSelfAttention:
         self.causal = causal
         self.mode = mode
         self.axis_name = axis_name
+        # Pallas cores per shard: legal only under
+        # shard_map(check_vma=False) — the caller owns that choice
+        self.use_flash = use_flash
 
     def init(self, key) -> dict:
         k1, k2 = jax.random.split(key)
@@ -132,19 +140,22 @@ class SequenceParallelSelfAttention:
             ctx = mha_reference(q, k, v, causal=self.causal)
         elif self.mode == "ring":
             ctx = ring_attention(q, k, v, self.axis_name,
-                                 causal=self.causal)
+                                 causal=self.causal,
+                                 use_flash=self.use_flash)
         else:
             ctx = ulysses_attention(q, k, v, self.axis_name,
-                                    causal=self.causal)
+                                    causal=self.causal,
+                                    use_flash=self.use_flash)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s_local, h)
         return ctx @ params["out_kernel"] + params["out_bias"]
 
 
 def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                            scale: Optional[float] = None,
-                           causal: bool = False):
+                           causal: bool = False,
+                           use_flash: bool = False):
     return ulysses_attention(q, k, v, axis_name, scale=scale,
-                             causal=causal)
+                             causal=causal, use_flash=use_flash)
 
 
 class SequenceParallelTransformerLayer:
@@ -165,13 +176,14 @@ class SequenceParallelTransformerLayer:
                  ffn_hidden_size: Optional[int] = None,
                  causal: bool = True, mode: str = "ring",
                  layernorm_epsilon: float = 1e-5,
-                 axis_name: Optional[str] = SEQUENCE_AXIS):
+                 axis_name: Optional[str] = SEQUENCE_AXIS,
+                 use_flash: bool = False):
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.eps = layernorm_epsilon
         self.attn = SequenceParallelSelfAttention(
             hidden_size, num_attention_heads, causal=causal, mode=mode,
-            axis_name=axis_name)
+            axis_name=axis_name, use_flash=use_flash)
 
     def init(self, key) -> dict:
         h, f = self.hidden_size, self.ffn_hidden_size

@@ -354,3 +354,145 @@ class TestSequenceParallelGPTEndToEnd:
             if i == 0:
                 l0 = float(loss)
         assert float(loss) < l0 * 0.5, (l0, float(loss))
+
+
+def _run_sharded_novma(fn, q, k, v, mesh):
+    """check_vma=False variant: the legality condition for Pallas cores
+    inside shard_map (interpret mode on the CPU mesh)."""
+    spec = P(None, None, "sequence", None)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False))(q, k, v)
+
+
+class TestFlashRing:
+    """ring/ulysses with use_flash=True: the Pallas flash partial per
+    block under shard_map(check_vma=False)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_matches_dense(self, causal):
+        mesh = seq_mesh()
+        q, k, v = _qkv()
+        out = _run_sharded_novma(
+            functools.partial(ring_self_attention, causal=causal,
+                              use_flash=True),
+            q, k, v, mesh)
+        want = _dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_gradients_match_dense(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(seed=5)
+        w = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+        def loss_ring(q, k, v):
+            o = _run_sharded_novma(
+                functools.partial(ring_self_attention, causal=True,
+                                  use_flash=True),
+                q, k, v, mesh)
+            return jnp.sum(o * w)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) * w)
+
+        gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_matches_dense(self, causal):
+        mesh = seq_mesh()
+        q, k, v = _qkv(seed=3)
+        out = _run_sharded_novma(
+            functools.partial(ulysses_self_attention, causal=causal,
+                              use_flash=True),
+            q, k, v, mesh)
+        want = _dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ulysses_gradients_match_dense(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(seed=7)
+        w = jax.random.normal(jax.random.PRNGKey(11), q.shape)
+
+        def loss_u(q, k, v):
+            o = _run_sharded_novma(
+                functools.partial(ulysses_self_attention, causal=True,
+                                  use_flash=True),
+                q, k, v, mesh)
+            return jnp.sum(o * w)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(_dense(q, k, v, True) * w)
+
+        gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+
+class TestFlashPartial:
+    """flash_attention_partial single-device composition semantics."""
+
+    def test_two_block_merge_matches_dense(self):
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        q, k, v = _qkv(seed=13)
+        sl = S // 2
+        o1, l1 = flash_attention_partial(q, k[:, :, :sl], v[:, :, :sl],
+                                         causal=True, q_offset=0,
+                                         k_offset=0)
+        o2, l2 = flash_attention_partial(q, k[:, :, sl:], v[:, :, sl:],
+                                         causal=True, q_offset=0,
+                                         k_offset=sl)
+        lse = jnp.logaddexp(l1, l2)
+        o = (o1 * jnp.exp(l1 - lse)[..., None]
+             + o2 * jnp.exp(l2 - lse)[..., None])
+        want = _dense(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_future_block_is_annihilated(self):
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        q, k, v = _qkv(seed=17)
+        sl = S // 2
+        # q rows 0..sl-1 against keys sl.. -> all in the causal future
+        o2, l2 = flash_attention_partial(
+            q[:, :, :sl], k[:, :, sl:], v[:, :, sl:], causal=True,
+            q_offset=0, k_offset=sl)
+        assert float(jnp.abs(o2).max()) == 0.0
+        assert float(l2.max()) < -1e29
+
+    def test_multiblock_straddling_future_rows_are_zero(self):
+        """Tiled path (blocks < s): a q-block straddling the k_offset
+        boundary has rows wholly in the causal future — they must emit
+        exactly 0 (the dead-row guard, not just merge annihilation)."""
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        b, h, s, d = 1, 2, 256, 64
+        ks = jax.random.split(jax.random.PRNGKey(19), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d)) * 0.3
+                   for kk in ks)
+        koff = 192   # rows 128..191 of q-block 1 are fully future
+        o, lse = flash_attention_partial(q, k, v, causal=True,
+                                         q_offset=0, k_offset=koff,
+                                         block_q=128, block_k=128)
+        np.testing.assert_array_equal(np.asarray(o[:, :, :koff]), 0.0)
+        assert float(lse[:, :, :koff].max()) < -1e29
+        # live rows match the dense slice
+        full_k = jnp.concatenate(
+            [jnp.zeros((b, h, koff, d)), k[:, :, :s - koff]], axis=2)
+        # rows koff.. attend keys koff..s-1 at positions koff..s-1
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q[:, :, koff:],
+                        k) * (d ** -0.5)
+        qpos = jnp.arange(koff, s)[:, None]
+        kpos = jnp.arange(koff, koff + s)[None, :]
+        s_ = jnp.where((kpos <= qpos)[None, None], s_, -1e30)
+        want = jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(s_, axis=-1), v)
+        np.testing.assert_allclose(np.asarray(o[:, :, koff:]),
+                                   np.asarray(want), rtol=2e-5,
+                                   atol=2e-5)
