@@ -1125,26 +1125,29 @@ _flash_qkv_masked.defvjp(_flash_qkv_masked_vjp_fwd,
 
 # --- partial (o, lse) entry: ring / blockwise composition -------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_partial(q, k, v, offsets, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_partial(q, k, v, offsets, scale, causal, use_off, block_q,
+                   block_k):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        offsets=offsets)
+                        offsets=offsets if use_off else None)
     return o, lse.reshape(q.shape[0], q.shape[1], -1)
 
 
-def _flash_partial_vjp_fwd(q, k, v, offsets, scale, causal, block_q,
-                           block_k):
+def _flash_partial_vjp_fwd(q, k, v, offsets, scale, causal, use_off,
+                           block_q, block_k):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        offsets=offsets)
+                        offsets=offsets if use_off else None)
     out = (o, lse.reshape(q.shape[0], q.shape[1], -1))
     return out, (q, k, v, o, lse, offsets)
 
 
-def _flash_partial_vjp_bwd(scale, causal, block_q, block_k, res, cts):
+def _flash_partial_vjp_bwd(scale, causal, use_off, block_q, block_k,
+                           res, cts):
     q, k, v, o, lse, offsets = res
     do, dlse = cts
     dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
-                            (q, k, v, o, lse), do, offsets=offsets,
+                            (q, k, v, o, lse), do,
+                            offsets=offsets if use_off else None,
                             dlse=dlse.reshape(lse.shape))
     return dq, dk, dv, np.zeros(offsets.shape, dtype=jax.dtypes.float0)
 
@@ -1187,10 +1190,15 @@ def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
     if act is not None and q.dtype != act \
             and jnp.issubdtype(q.dtype, jnp.floating):
         q, k, v = (x.astype(act) for x in (q, k, v))
+    # static-zero offsets (e.g. Ulysses' plain full-sequence causal
+    # local attention) take the static-mask kernels — the dynamic
+    # SMEM-offset masks cost ~10% kernel time (ROUND3_NOTES)
+    use_off = not (isinstance(q_offset, int) and q_offset == 0
+                   and isinstance(k_offset, int) and k_offset == 0)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32)])
-    return _flash_partial(q, k, v, offsets, scale, causal, block_q,
-                          block_k)
+    return _flash_partial(q, k, v, offsets, scale, causal, use_off,
+                          block_q, block_k)
 
 
 # --- E-layout (head-interleaved) self-attention ----------------------------

@@ -287,6 +287,48 @@ def bench_optimizers():
 # Extra 2: collective / memory bandwidth
 # --------------------------------------------------------------------------
 
+def bench_long_context():
+    """Long-context single-chip capability: flash attention fwd+bwd at
+    sequence lengths where the materializing [b,h,s,s] reference OOMs
+    (s=16384: 16 GB of fp32 scores alone; the reference's own kernels
+    cap at s=512 FMHA / 2048 fused softmax).  Reports achieved model
+    TFLOP/s of the attention train substep (causal FLOPs: fwd 2*2/2 +
+    bwd 5*2/2 matmul terms = 7*b*h*s^2*d total)."""
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    out = {}
+    b, h, d = 1, 16, 64
+    for s in (8192, 16384):
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d),
+                                     jnp.bfloat16) * 0.5
+                   for i in range(3))
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def run(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = step(q, k, v)
+            _force(r[0])
+            return time.perf_counter() - t0
+
+        step(q, k, v)           # compile
+        k1, k2 = 2, 6
+        t1 = min(run(k1) for _ in range(3))
+        t2 = min(run(k2) for _ in range(3))
+        sec = max((t2 - t1) / (k2 - k1), 1e-9)
+        # 7*b*h*s^2*d ALREADY includes the causal half (full
+        # fwd+bwd attention is 14*b*h*s^2*d)
+        flops = 7.0 * b * h * s * s * d
+        out[f"s{s}"] = {"ms": round(sec * 1e3, 2),
+                        "tflops_per_sec": round(flops / sec / 1e12, 1)}
+    return out
+
+
 def bench_collective():
     n_dev = jax.device_count()
     out = {"devices": n_dev}
@@ -603,6 +645,11 @@ def main():
             extras["optimizer_step"] = bench_optimizers()
             print("[bench] collective...", file=sys.stderr)
             extras["collective"] = bench_collective()
+            print("[bench] long_context...", file=sys.stderr)
+            try:
+                extras["long_context"] = bench_long_context()
+            except Exception as e:    # never sink the headline metric
+                extras["long_context"] = {"error": str(e)[:200]}
             print("[bench] gpt2_345m...", file=sys.stderr)
             extras["gpt2_345m"] = bench_gpt345m()
             print("[bench] bert_large...", file=sys.stderr)

@@ -482,10 +482,8 @@ class TestFlashPartial:
                                          block_q=128, block_k=128)
         np.testing.assert_array_equal(np.asarray(o[:, :, :koff]), 0.0)
         assert float(lse[:, :, :koff].max()) < -1e29
-        # live rows match the dense slice
-        full_k = jnp.concatenate(
-            [jnp.zeros((b, h, koff, d)), k[:, :, :s - koff]], axis=2)
-        # rows koff.. attend keys koff..s-1 at positions koff..s-1
+        # live rows match the dense reference: rows koff.. attend
+        # keys 0..s-1 at global positions koff..koff+s-1
         s_ = jnp.einsum("bhqd,bhkd->bhqk", q[:, :, koff:],
                         k) * (d ** -0.5)
         qpos = jnp.arange(koff, s)[:, None]
