@@ -152,24 +152,15 @@ def _global_grad_clip(gbufs, max_norm):
     ``max_grad_norm * loss_scale`` because its norm is of scaled grads
     (ref: fused_mixed_precision_lamb.py:182-184).
 
-    The norm reduces over per-dtype CONCATENATED buffers, not per leaf:
-    ~400 separate per-leaf reduce fusions each pay fixed dispatch cost
-    AND force the grads' fp32 upcasts to materialize (phase 1 then
-    re-reads fp32 instead of bf16) — measured 10.6 ms/step of the
-    BERT-large train step vs ~2 ms for the packed form (same math;
-    zero padding contributes 0 to the sum)."""
-    from collections import defaultdict
-
-    groups = defaultdict(list)
-    for g in gbufs:
-        groups[jnp.dtype(g.dtype)].append(g.reshape(-1))
-    gsq = jnp.float32(0)
-    for fs in groups.values():
-        cat = fs[0] if len(fs) == 1 else jnp.concatenate(fs)
-        pad = (-cat.size) % multi_tensor.LANE
-        if pad:
-            cat = jnp.pad(cat, (0, pad))
-        gsq = gsq + multi_tensor.sumsq(cat)
+    Norm structure note (measured, BERT-large step): the per-leaf
+    reduces below cost ~10.6 ms/step in the UNROLLED step (~400 small
+    fusions x ~25 us dispatch + forced fp32 grad materialization) and a
+    per-dtype concatenated variant won ~2 ms there — but inside the
+    shipping ``lax.scan`` training form the concat REGRESSED the step
+    134 -> 144 ms (the scan body re-copies the concat buffer every
+    iteration).  Per-leaf is the better shipping form; see
+    ROUND3_NOTES "LAMB step anatomy"."""
+    gsq = sum(multi_tensor.sumsq(g) for g in gbufs)
     gnorm = jnp.sqrt(gsq)
     # The enable decision must be static (max_norm may be a traced value
     # when the caller scales it by a traced loss scale — pass None to
