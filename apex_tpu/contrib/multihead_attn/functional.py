@@ -17,7 +17,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ...ops.flash_attention import flash_attention
+from ...ops.flash_attention import (flash_attention,
+                                    flash_attention_e,
+                                    flash_e_supported)
 from ...ops.scaled_softmax import (scaled_masked_softmax,
                                    scaled_upper_triang_masked_softmax)
 
@@ -88,6 +90,79 @@ def mask_softmax_dropout(inputs: jnp.ndarray,
     return probs
 
 
+def _flash_route(mask, mask_additive, use_time_mask, mask_is_causal,
+                 b, sq, sk):
+    """Which flash lane a mask qualifies for: returns
+    ``(causal, kpm)`` — ``causal`` when the time mask is concretely the
+    strict upper triangle (or asserted via ``mask_is_causal``), ``kpm``
+    the (b, sk) key-padding byte mask (1 = masked out) when the mask is
+    key-padding-shaped.  The single source of truth for both the split
+    (:func:`attn_core`) and packed (:func:`attn_core_qkv`) entries."""
+    if mask_is_causal is None:
+        mask_is_causal = _is_causal_mask(mask, sq, sk) \
+            if mask is not None else False
+    causal = (use_time_mask and mask is not None and not mask_additive
+              and mask_is_causal)
+    kpm = None
+    if mask is not None and not mask_additive and not use_time_mask:
+        # key-padding masks only: (b, sk), or the modules' pre-expanded
+        # (b, 1, 1, sk).  A (sq, sk) attention mask stays on the
+        # generic path (it is per-query, not per-key).
+        if mask.ndim == 2 and mask.shape == (b, sk):
+            kpm = mask
+        elif mask.ndim == 4 and mask.shape == (b, 1, 1, sk):
+            kpm = mask[:, 0, 0, :]
+    return causal, kpm
+
+
+def attn_core_qkv(qkv: jnp.ndarray,
+                  scaling: float,
+                  mask: Optional[jnp.ndarray] = None,
+                  mask_additive: bool = False,
+                  use_time_mask: bool = False,
+                  dropout_prob: float = 0.0,
+                  rng: Optional[jax.Array] = None,
+                  is_training: bool = True,
+                  use_fast: bool = True,
+                  mask_is_causal: Optional[bool] = None) -> jnp.ndarray:
+    """:func:`attn_core` over the module-native PACKED projection:
+    ``qkv`` (sq, b, h, 3, d) — the reference's per-head-interleaved
+    in-proj layout (ref: self_attn_func.py:31-38) — returning
+    (sq, b, h*d).
+
+    Flash-eligible dispatches (no mask / causal time mask / key-padding
+    byte mask, no attention dropout) ride ``flash_attention_e``: ONE
+    (sq, b) <-> (b, sq) relayout on each side replaces the four
+    per-tensor (b, h, s, d) transposes the split path pays (the E
+    kernel consumes the interleaved lanes directly).  Everything else
+    splits and delegates to :func:`attn_core` unchanged.
+    """
+    sq, b, h, three, d = qkv.shape
+    dropping = dropout_prob > 0.0 and is_training
+    causal, kpm = _flash_route(mask, mask_additive, use_time_mask,
+                               mask_is_causal, b, sq, sq)
+    flash_ok = (use_fast and not dropping
+                and (mask is None or causal or kpm is not None)
+                and flash_e_supported(sq, h, d))
+    if flash_ok:
+        qkv_e = qkv.reshape(sq, b, h * 3 * d).transpose(1, 0, 2) \
+            .reshape(b, sq, h, 3 * d)
+        kv_mask = None if kpm is None else ~kpm.astype(bool)
+        ctx = flash_attention_e(qkv_e, scale=scaling, causal=causal,
+                                kv_mask=kv_mask)       # (b, sq, h*d)
+        return ctx.transpose(1, 0, 2)
+    q = jnp.transpose(qkv[:, :, :, 0], (1, 2, 0, 3))
+    k = jnp.transpose(qkv[:, :, :, 1], (1, 2, 0, 3))
+    v = jnp.transpose(qkv[:, :, :, 2], (1, 2, 0, 3))
+    ctx = attn_core(q, k, v, scaling, mask=mask,
+                    mask_additive=mask_additive,
+                    use_time_mask=use_time_mask,
+                    dropout_prob=dropout_prob, rng=rng,
+                    is_training=is_training, use_fast=use_fast,
+                    mask_is_causal=mask_is_causal)
+    return jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, h * d)
+
+
 def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
               scaling: float,
               mask: Optional[jnp.ndarray] = None,
@@ -117,24 +192,11 @@ def attn_core(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # specialized causal kernels.  Under jit the mask is a tracer and
     # the content check cannot run — pass ``mask_is_causal=True`` to
     # assert causality and keep the flash path.
-    if mask_is_causal is None:
-        mask_is_causal = _is_causal_mask(mask, sq, sk) \
-            if mask is not None else False
-    causal = (use_time_mask and mask is not None and not mask_additive
-              and mask_is_causal)
+    causal, kpm = _flash_route(mask, mask_additive, use_time_mask,
+                               mask_is_causal, q.shape[0], sq, sk)
     if use_fast and not dropping and (mask is None or causal):
         return flash_attention(q, k, v, scale=scaling,
                                causal=causal)
-    kpm = None
-    if mask is not None and not mask_additive and not use_time_mask:
-        bsz = q.shape[0]
-        # key-padding masks only: (b, sk), or the modules' pre-expanded
-        # (b, 1, 1, sk).  A (sq, sk) attention mask stays on the
-        # generic path (it is per-query, not per-key).
-        if mask.ndim == 2 and mask.shape == (bsz, sk):
-            kpm = mask
-        elif mask.ndim == 4 and mask.shape == (bsz, 1, 1, sk):
-            kpm = mask[:, 0, 0, :]
     if use_fast and not dropping and kpm is not None:
         # (1 = masked out, the reference's boolean convention) rides
         # the flash kernel's kv_mask lane — no [b, h, sq, sk] score

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ...normalization import FusedLayerNorm
-from .functional import attn_core
+from .functional import attn_core_qkv
 
 
 class SelfMultiheadAttn(nn.Module):
@@ -130,12 +130,11 @@ class SelfMultiheadAttn(nn.Module):
         if bias_ is not None:
             qkv = qkv + bias_
         # reference layout: [sq, b, h, 3, d] — q/k/v interleaved per head
-        # (ref: self_attn_func.py:31-38)
+        # (ref: self_attn_func.py:31-38); attn_core_qkv consumes it
+        # directly (flash-eligible cases take the E-layout kernel with
+        # one batch-time relayout per side instead of four per-tensor
+        # head transposes)
         qkv = qkv.reshape(sq, b, h, 3, d)
-        # -> (b, h, sq, d)
-        q = jnp.transpose(qkv[:, :, :, 0], (1, 2, 0, 3))
-        k = jnp.transpose(qkv[:, :, :, 1], (1, 2, 0, 3))
-        v = jnp.transpose(qkv[:, :, :, 2], (1, 2, 0, 3))
 
         mask = None
         use_time_mask = False
@@ -150,15 +149,13 @@ class SelfMultiheadAttn(nn.Module):
         if self.dropout > 0.0 and is_training:
             rng = self.make_rng("dropout")
 
-        ctx = attn_core(q, k, v, scaling, mask=mask,
-                        mask_additive=self.mask_additive,
-                        use_time_mask=use_time_mask,
-                        dropout_prob=self.dropout, rng=rng,
-                        is_training=is_training,
-                        use_fast=self.impl == "fast")
+        ctx = attn_core_qkv(qkv, scaling, mask=mask,
+                            mask_additive=self.mask_additive,
+                            use_time_mask=use_time_mask,
+                            dropout_prob=self.dropout, rng=rng,
+                            is_training=is_training,
+                            use_fast=self.impl == "fast")
 
-        # (b, h, sq, d) -> (sq, b, e)
-        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
         out = ctx @ self.out_proj_weight.T
         if self.bias:
             out = out + self.out_proj_bias
