@@ -1023,10 +1023,16 @@ def flash_attention_qkv(qkv: jnp.ndarray,
     """Self-attention over PACKED projections: ``qkv`` (3, b, h, s, d),
     returns the context (b, h, s, d).
 
-    One transposed copy of the fused qkv projection replaces the three
-    per-tensor (b,h,s,d) relayout copies the unpacked entry forces at
-    the Pallas custom-call boundary (XLA cannot fuse transposes into a
-    custom call; measured 7.5 ms/step of such copies at GPT-345M).
+    .. warning:: **Measured to LOSE ~5 ms/step end-to-end at the
+       framework's own bench shapes** (GPT-345M, ROUND3_NOTES): the big
+       (3,b,h,s,d) transpose XLA emits to build the packed operand
+       costs more than the three per-tensor relayout copies it
+       replaces.  Prefer :func:`flash_attention_e` — the
+       projection-native layout with ZERO boundary copies — for
+       self-attention; use this entry only if your model already holds
+       qkv in this exact packed layout (the kernels themselves time
+       identically to the per-tensor entry).
+
     Inside the kernel q/k/v are row-ranges of one contiguous array read
     via index-map offsets.  Semantics match
     ``flash_attention(qkv[0], qkv[1], qkv[2], ...)``.
@@ -1125,34 +1131,63 @@ _flash_qkv_masked.defvjp(_flash_qkv_masked_vjp_fwd,
 
 # --- partial (o, lse) entry: ring / blockwise composition -------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_partial(q, k, v, offsets, scale, causal, use_off, block_q,
-                   block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_partial(q, k, v, offsets, scale, causal, block_q, block_k):
+    """Dynamic-offset partial (the ring path); static-zero offsets take
+    :func:`_flash_partial_nooff` instead."""
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        offsets=offsets if use_off else None)
+                        offsets=offsets)
     return o, lse.reshape(q.shape[0], q.shape[1], -1)
 
 
-def _flash_partial_vjp_fwd(q, k, v, offsets, scale, causal, use_off,
-                           block_q, block_k):
+def _flash_partial_vjp_fwd(q, k, v, offsets, scale, causal, block_q,
+                           block_k):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        offsets=offsets if use_off else None)
+                        offsets=offsets)
     out = (o, lse.reshape(q.shape[0], q.shape[1], -1))
     return out, (q, k, v, o, lse, offsets)
 
 
-def _flash_partial_vjp_bwd(scale, causal, use_off, block_q, block_k,
-                           res, cts):
+def _flash_partial_vjp_bwd(scale, causal, block_q, block_k, res, cts):
     q, k, v, o, lse, offsets = res
     do, dlse = cts
     dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
-                            (q, k, v, o, lse), do,
-                            offsets=offsets if use_off else None,
+                            (q, k, v, o, lse), do, offsets=offsets,
                             dlse=dlse.reshape(lse.shape))
     return dq, dk, dv, np.zeros(offsets.shape, dtype=jax.dtypes.float0)
 
 
 _flash_partial.defvjp(_flash_partial_vjp_fwd, _flash_partial_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_partial_nooff(q, k, v, scale, causal, block_q, block_k):
+    """Static-zero-offset partial: same (o, lse) contract as
+    :func:`_flash_partial` without the offsets operand (no dead input /
+    float0 cotangent on the non-ring path, e.g. the Ulysses wrapper)."""
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, lse.reshape(q.shape[0], q.shape[1], -1)
+
+
+def _flash_partial_nooff_vjp_fwd(q, k, v, scale, causal, block_q,
+                                 block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    out = (o, lse.reshape(q.shape[0], q.shape[1], -1))
+    return out, (q, k, v, o, lse)
+
+
+def _flash_partial_nooff_vjp_bwd(scale, causal, block_q, block_k, res,
+                                 cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
+                            (q, k, v, o, lse), do,
+                            dlse=dlse.reshape(lse.shape))
+    return dq, dk, dv
+
+
+_flash_partial_nooff.defvjp(_flash_partial_nooff_vjp_fwd,
+                            _flash_partial_nooff_vjp_bwd)
 
 
 def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
@@ -1195,10 +1230,13 @@ def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
     # SMEM-offset masks cost ~10% kernel time (ROUND3_NOTES)
     use_off = not (isinstance(q_offset, int) and q_offset == 0
                    and isinstance(k_offset, int) and k_offset == 0)
+    if not use_off:
+        return _flash_partial_nooff(q, k, v, scale, causal, block_q,
+                                    block_k)
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(k_offset, jnp.int32)])
-    return _flash_partial(q, k, v, offsets, scale, causal, use_off,
-                          block_q, block_k)
+    return _flash_partial(q, k, v, offsets, scale, causal, block_q,
+                          block_k)
 
 
 # --- E-layout (head-interleaved) self-attention ----------------------------

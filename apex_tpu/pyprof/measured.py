@@ -100,6 +100,13 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
     def run(args):
         out = jitted(*args)
         if donate:
+            if not isinstance(out, (tuple, list)) or len(out) < len(args):
+                raise TypeError(
+                    "donate=True requires fn to return a tuple whose "
+                    f"first {len(args)} entries replace the donated args; "
+                    f"got {type(out).__name__}"
+                    + ("" if not isinstance(out, (tuple, list))
+                       else f" of length {len(out)}"))
             args = tuple(out[:len(args)])
         return out, args
 
