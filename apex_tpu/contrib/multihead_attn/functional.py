@@ -17,7 +17,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ...ops.flash_attention import (flash_attention,
+from ...ops.flash_attention import (dropout_seed_from_key,
+                                    flash_attention,
                                     flash_attention_e,
                                     flash_e_supported)
 from ...ops.scaled_softmax import (scaled_masked_softmax,
@@ -131,25 +132,36 @@ def attn_core_qkv(qkv: jnp.ndarray,
     (sq, b, h*d).
 
     Flash-eligible dispatches (no mask / causal time mask / key-padding
-    byte mask, no attention dropout) ride ``flash_attention_e``: ONE
-    (sq, b) <-> (b, sq) relayout on each side replaces the four
-    per-tensor (b, h, s, d) transposes the split path pays (the E
-    kernel consumes the interleaved lanes directly).  Everything else
-    splits and delegates to :func:`attn_core` unchanged.
+    byte mask — attention dropout INCLUDED, applied in-kernel) ride
+    ``flash_attention_e``: ONE (sq, b) <-> (b, sq) relayout on each
+    side replaces the four per-tensor (b, h, s, d) transposes the split
+    path pays (the E kernel consumes the interleaved lanes directly).
+    Everything else splits and delegates to :func:`attn_core` unchanged.
     """
     sq, b, h, three, d = qkv.shape
     dropping = dropout_prob > 0.0 and is_training
     causal, kpm = _flash_route(mask, mask_additive, use_time_mask,
                                mask_is_causal, b, sq, sq)
-    flash_ok = (use_fast and not dropping
+    flash_ok = (use_fast
                 and (mask is None or causal or kpm is not None)
                 and flash_e_supported(sq, h, d))
     if flash_ok:
         qkv_e = qkv.reshape(sq, b, h * 3 * d).transpose(1, 0, 2) \
             .reshape(b, sq, h, 3 * d)
         kv_mask = None if kpm is None else ~kpm.astype(bool)
+        drop = 0.0
+        seed = None
+        if dropping:
+            # attention dropout stays in-kernel on the E route (the
+            # reference's fused MHA kernels apply philox dropout
+            # in-kernel, ref: apex/contrib/csrc/multihead_attn)
+            if rng is None:
+                raise ValueError("attention dropout requires an rng key")
+            seed = dropout_seed_from_key(rng)
+            drop = dropout_prob
         ctx = flash_attention_e(qkv_e, scale=scaling, causal=causal,
-                                kv_mask=kv_mask)       # (b, sq, h*d)
+                                kv_mask=kv_mask, dropout_rate=drop,
+                                dropout_seed=seed)     # (b, sq, h*d)
         return ctx.transpose(1, 0, 2)
     q = jnp.transpose(qkv[:, :, :, 0], (1, 2, 0, 3))
     k = jnp.transpose(qkv[:, :, :, 1], (1, 2, 0, 3))

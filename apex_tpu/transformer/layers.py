@@ -37,7 +37,8 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from ..normalization import FusedLayerNorm
-from ..ops.flash_attention import flash_attention_e
+from ..ops.flash_attention import (dropout_seed_from_key,
+                                   flash_attention_e)
 from .enums import AttnMaskType
 from .functional.fused_softmax import FusedScaleMaskSoftmax
 from .tensor_parallel.layers import (ColumnParallelLinear,
@@ -119,8 +120,7 @@ class ParallelSelfAttention(nn.Module):
                 "both (fold padding into the attention_mask yourself)")
         # flash handles causal and/or key-padding masks; an arbitrary
         # (b, 1, sq, sk) attention_mask takes the materializing path.
-        if self.use_flash and attention_mask is None \
-                and (deterministic or self.attention_dropout == 0.0):
+        if self.use_flash and attention_mask is None:
             # E-layout entry: consumes qkv's native (b, s, h, 3d) lane
             # order and emits (b, s, h*d) — the whole attention boundary
             # carries no relayout copies (measured ~14/16 ms/step of
@@ -128,9 +128,22 @@ class ParallelSelfAttention(nn.Module):
             # per-tensor entry; a packed (3,b,h,s,d) route was also
             # tried and LOST ~5 ms/step to its 5-D transpose).  Falls
             # back to the transposing path internally when the shape
-            # doesn't qualify (see flash_e_supported).
+            # doesn't qualify (see flash_e_supported).  Attention
+            # dropout runs IN-KERNEL (counter-hash keep mask, the
+            # reference's fused-MHA philox role) — training configs
+            # with dropout keep the zero-relayout route.
+            drop = 0.0
+            seed = None
+            if not deterministic and self.attention_dropout > 0.0:
+                key = self.make_rng("dropout")
+                if self.axis_name is not None:
+                    key = model_parallel_rng_key(key, self.axis_name)
+                seed = dropout_seed_from_key(key)
+                drop = self.attention_dropout
             ctx = flash_attention_e(qkv, scale=scale, causal=causal,
-                                    kv_mask=key_padding_mask)
+                                    kv_mask=key_padding_mask,
+                                    dropout_rate=drop,
+                                    dropout_seed=seed)
         else:
             q, k, v = jnp.split(qkv, 3, axis=-1)
             # (b, heads, s, d)

@@ -41,7 +41,6 @@ A100_BASELINE_IPS = 2500.0
 
 BATCH = int(os.environ.get("BENCH_BATCH", "256"))
 IMAGE = 224
-WARMUP = 3
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
 SKIP_EXTRAS = os.environ.get("BENCH_SKIP_EXTRAS", "") == "1"
 
@@ -94,8 +93,9 @@ def bench_resnet50():
                                policy.compute_dtype)
     labels = jax.random.randint(jax.random.PRNGKey(2), (BATCH,), 0, 1000)
 
-    @jax.jit
-    def train_step(params, batch_stats, amp_state, images, labels):
+    def train_step(carry, _):
+        params, batch_stats, amp_state = carry
+
         def loss_fn(p):
             logits, mutated = model.apply(
                 {"params": p, "batch_stats": batch_stats}, images,
@@ -107,18 +107,45 @@ def bench_resnet50():
         grads, (loss, mutated) = jax.grad(loss_fn, has_aux=True)(params)
         new_params, new_amp_state, _ = amp_opt.apply_gradients(
             grads, amp_state, params)
-        return new_params, mutated["batch_stats"], new_amp_state, loss
+        return (new_params, mutated["batch_stats"], new_amp_state), loss
 
-    p, bs, st = params, batch_stats, amp_state
-    for _ in range(WARMUP):
-        p, bs, st, loss = train_step(p, bs, st, images, labels)
-    float(loss)
-    t0 = time.time()
-    for _ in range(ITERS):
-        p, bs, st, loss = train_step(p, bs, st, images, labels)
-    float(loss)
-    dt = time.time() - t0
-    return BATCH * ITERS / dt
+    # Two-K scanned slope + best-of-3 (the gpt/bert methodology, folded
+    # in here so the DRIVER-RUN artifact is the stable number — round-3
+    # recorded a single Python-loop draw that disagreed with the
+    # by-hand best-of-3 by 1.4%): K steps in one jitted lax.scan, step
+    # time = (best t[k2] - best t[k1]) / (k2 - k1), cancelling the
+    # ~112 ms tunnel dispatch constant and the chip-contention tail.
+    k1, k2 = max(2, ITERS // 8), max(6, ITERS // 2)
+
+    def make_steps(n):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_steps(carry):
+            return jax.lax.scan(train_step, carry, None, length=n)
+        return run_steps
+
+    run1, run2 = make_steps(k1), make_steps(k2)
+    carry = (params, batch_stats, amp_state)
+    carry, losses = run1(carry)
+    float(losses[-1])
+    carry, losses = run2(carry)
+    float(losses[-1])
+    best1 = best2 = float("inf")
+    for _rep in range(3):
+        t0 = time.time()
+        carry, losses = run1(carry)
+        float(losses[-1])
+        best1 = min(best1, time.time() - t0)
+        t0 = time.time()
+        carry, losses = run2(carry)
+        float(losses[-1])
+        best2 = min(best2, time.time() - t0)
+    if best2 <= best1:
+        print("[bench] WARNING: rn50 slope invalid (noise); using "
+              "k2-run upper bound", file=sys.stderr)
+        dt = best2 / k2
+    else:
+        dt = (best2 - best1) / (k2 - k1)
+    return BATCH / dt
 
 
 # --------------------------------------------------------------------------
@@ -293,12 +320,20 @@ def bench_long_context():
     (s=16384: 16 GB of fp32 scores alone; the reference's own kernels
     cap at s=512 FMHA / 2048 fused softmax).  Reports achieved model
     TFLOP/s of the attention train substep (causal FLOPs: fwd 2*2/2 +
-    bwd 5*2/2 matmul terms = 7*b*h*s^2*d total)."""
+    bwd 5*2/2 matmul terms = 7*b*h*s^2*d total).
+
+    Sweep covers d=64 (the reference FMHA's only head dim) AND d=128
+    (the modern default — Llama-class h=32/d=128/s=4096 plus a long
+    d=128 row): at d=64 every backward matmul has a 64-wide operand, so
+    half the MXU lanes idle (~95 TF/s raw, ROUND3_NOTES); d=128 fills
+    the lanes and is the proof of that structural claim."""
     from apex_tpu.ops.flash_attention import flash_attention
 
     out = {}
-    b, h, d = 1, 16, 64
-    for s in (8192, 16384):
+    for label, b, h, d, s in (("s8192", 1, 16, 64, 8192),
+                              ("s16384", 1, 16, 64, 16384),
+                              ("llama_d128_s4096", 1, 32, 128, 4096),
+                              ("d128_s8192", 1, 16, 128, 8192)):
         q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d),
                                      jnp.bfloat16) * 0.5
                    for i in range(3))
@@ -324,8 +359,9 @@ def bench_long_context():
         # 7*b*h*s^2*d ALREADY includes the causal half (full
         # fwd+bwd attention is 14*b*h*s^2*d)
         flops = 7.0 * b * h * s * s * d
-        out[f"s{s}"] = {"ms": round(sec * 1e3, 2),
-                        "tflops_per_sec": round(flops / sec / 1e12, 1)}
+        out[label] = {"h": h, "d": d, "s": s,
+                      "ms": round(sec * 1e3, 2),
+                      "tflops_per_sec": round(flops / sec / 1e12, 1)}
     return out
 
 

@@ -310,19 +310,59 @@ class TestELayout:
         np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_long_sequence_falls_back(self):
-        """ps > 1024 doesn't qualify — the entry transparently takes the
-        transposing path and stays correct."""
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(1, 1152, 2, 64),   # padded s
+                                       (1, 2048, 4, 64),
+                                       (1, 1536, 3, 128)])  # odd h, hg=1
+    def test_blocked_long_sequence(self, causal, shape):
+        """ps > 1024 streams (bs, bs) tiles — same zero-relayout layout,
+        online softmax, one-kernel combined backward."""
         from apex_tpu.ops.flash_attention import (flash_attention_e,
                                                   flash_e_supported)
-        assert not flash_e_supported(1025, 4, 64)
-        b, s, h, d = 1, 1152, 2, 64
+        b, s, h, d = shape
+        assert flash_e_supported(s, h, d)
         qkv = jax.random.normal(jax.random.PRNGKey(0),
                                 (b, s, h, 3 * d)) * 0.5
-        got = flash_attention_e(qkv, causal=True)
-        want = self._ref(qkv, causal=True)
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+        got = flash_attention_e(qkv, causal=causal)
+        want = self._ref(qkv, causal=causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
+
+        def loss_e(qkv):
+            return jnp.sum(flash_attention_e(qkv, causal=causal) * w)
+
+        def loss_r(qkv):
+            return jnp.sum(self._ref(qkv, causal=causal) * w)
+
+        ge = jax.grad(loss_e)(qkv)
+        gr = jax.grad(loss_r)(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_blocked_kv_mask(self):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        b, s, h, d = 2, 1536, 2, 64
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        lens = jnp.array([700, s])
+        m = jnp.arange(s)[None, :] < lens[:, None]
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+        got = flash_attention_e(qkv, kv_mask=m)
+        want = self._ref(qkv, kv_mask=m)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        ge = jax.grad(lambda q: jnp.sum(
+            flash_attention_e(q, kv_mask=m) * w))(qkv)
+        gr = jax.grad(lambda q: jnp.sum(self._ref(q, kv_mask=m) * w))(
+            qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_very_long_sequence_falls_back(self):
+        from apex_tpu.ops.flash_attention import (_E_MAX_SEQ_BLOCKED,
+                                                  flash_e_supported)
+        assert not flash_e_supported(_E_MAX_SEQ_BLOCKED + 128, 4, 64)
 
     def test_grouping_helper(self):
         from apex_tpu.ops.flash_attention import _pick_heads_per_group
@@ -334,3 +374,113 @@ class TestELayout:
         assert _pick_heads_per_group(16, 16, 1024) is None
         # no divisor of h makes 3*hg*d lane-aligned -> None
         assert _pick_heads_per_group(5, 24, 128) is None
+
+
+class TestELayoutDropout:
+    """In-kernel attention dropout on the E route: the keep mask is a
+    deterministic counter-hash of (seed, batch, head, q-block, k-block),
+    so a dense reference can regenerate the EXACT mask and the kernel
+    must match it bitwise-in-expectation — forward and gradients."""
+
+    @staticmethod
+    def _dense_with_mask(qkv, keep, rate, causal):
+        b, s, h, td = qkv.shape
+        d = td // 3
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.transpose(0, 2, 1, 3).astype(jnp.float32)
+                   for t in (q, k, v))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+        if causal:
+            scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)),
+                               scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        pd = jnp.where(keep, p, 0.0) / (1.0 - rate)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pd.astype(qkv.dtype)
+                       .astype(jnp.float32), v)
+        return o.astype(qkv.dtype).transpose(0, 2, 1, 3).reshape(
+            b, s, h * d)
+
+    @staticmethod
+    def _expected_keep(b, h, s, seed, rate, bs):
+        """Reassemble the kernels' keep mask outside the kernel."""
+        from apex_tpu.ops.flash_attention import _rand_keep
+        nb = -(-s // bs)
+        ps = nb * bs
+        keep = np.ones((b, h, ps, ps), bool)
+        for bi in range(b):
+            for hh in range(h):
+                for i in range(nb):
+                    for j in range(nb):
+                        blk = _rand_keep((bs, bs), seed, bi, hh, i, j,
+                                         rate)
+                        keep[bi, hh, i * bs:(i + 1) * bs,
+                             j * bs:(j + 1) * bs] = np.asarray(blk)
+        return jnp.asarray(keep[:, :, :s, :s])
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_single_block_dropout_parity(self, causal):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        b, s, h, d, rate = 2, 128, 4, 64, 0.3
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+        seed = 1234
+        # single-block path: one (ps, ps) tile, salts (i, j) = (0, 0)
+        keep = self._expected_keep(b, h, s, seed, rate, bs=s)
+        got = flash_attention_e(qkv, causal=causal, dropout_rate=rate,
+                                dropout_seed=seed)
+        want = self._dense_with_mask(qkv, keep, rate, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+        ge = jax.grad(lambda x: jnp.sum(flash_attention_e(
+            x, causal=causal, dropout_rate=rate, dropout_seed=seed)
+            * w))(qkv)
+        gr = jax.grad(lambda x: jnp.sum(self._dense_with_mask(
+            x, keep, rate, causal) * w))(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_blocked_dropout_parity(self):
+        from apex_tpu.ops.flash_attention import (_E_BLOCK,
+                                                  flash_attention_e)
+        b, s, h, d, rate = 1, 1536, 2, 64, 0.2
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+        seed = 77
+        keep = self._expected_keep(b, h, s, seed, rate,
+                                   bs=min(_E_BLOCK, s))
+        got = flash_attention_e(qkv, causal=True, dropout_rate=rate,
+                                dropout_seed=seed)
+        want = self._dense_with_mask(qkv, keep, rate, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+        ge = jax.grad(lambda x: jnp.sum(flash_attention_e(
+            x, causal=True, dropout_rate=rate, dropout_seed=seed)
+            * w))(qkv)
+        gr = jax.grad(lambda x: jnp.sum(self._dense_with_mask(
+            x, keep, rate, True) * w))(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_dropout_statistics_and_determinism(self):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        b, s, h, d, rate = 1, 256, 4, 64, 0.5
+        qkv = jnp.ones((b, s, h, 3 * d)) * 0.1
+        o1 = flash_attention_e(qkv, dropout_rate=rate, dropout_seed=3)
+        o2 = flash_attention_e(qkv, dropout_rate=rate, dropout_seed=3)
+        o3 = flash_attention_e(qkv, dropout_rate=rate, dropout_seed=4)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 0
+        # E[dropout(P)] = P: with uniform inputs the mean output stays
+        # ~the no-dropout value
+        o0 = flash_attention_e(qkv)
+        assert abs(float(jnp.mean(o1)) - float(jnp.mean(o0))) \
+            < 5e-2 * abs(float(jnp.mean(o0))) + 1e-3
+
+    def test_seed_required(self):
+        from apex_tpu.ops.flash_attention import flash_attention_e
+        qkv = jnp.ones((1, 128, 4, 192))
+        with pytest.raises(ValueError, match="dropout_seed"):
+            flash_attention_e(qkv, dropout_rate=0.1)
