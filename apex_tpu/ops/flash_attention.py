@@ -65,7 +65,8 @@ _LOG2E = math.log2(math.e)
 import os as _os
 
 
-def _env_block(var: str, default: int) -> int:
+def _env_block(var: str, default: int, lo: int = 8,
+               hi: int = 4096) -> int:
     raw = _os.environ.get(var)
     if raw is None:
         return default
@@ -73,8 +74,8 @@ def _env_block(var: str, default: int) -> int:
         val = int(raw.strip())
     except ValueError:
         raise ValueError(f"{var}={raw!r} is not an integer") from None
-    if not 8 <= val <= 4096:
-        raise ValueError(f"{var}={val} out of range [8, 4096]")
+    if not lo <= val <= hi:
+        raise ValueError(f"{var}={val} out of range [{lo}, {hi}]")
     return val
 
 
@@ -1265,8 +1266,12 @@ _E_MAX_SEQ = 1024
 # instead of falling back to the transposing path (the fallback re-pays
 # the ~14-16 ms/step of (b,h,s,d) relayout glue the E layout exists to
 # kill).  The cap bounds the lse/delta sideband arrays, not VMEM.
-_E_MAX_SEQ_BLOCKED = _env_block("APEX_TPU_FLASH_E_MAX_SEQ", 8192)
-_E_BLOCK = _env_block("APEX_TPU_FLASH_E_BLOCK", 512)
+_E_MAX_SEQ_BLOCKED = _env_block("APEX_TPU_FLASH_E_MAX_SEQ", 8192,
+                                lo=128, hi=1 << 20)
+_E_BLOCK = _env_block("APEX_TPU_FLASH_E_BLOCK", 512, lo=128)
+if _E_BLOCK % 128:
+    raise ValueError(f"APEX_TPU_FLASH_E_BLOCK={_E_BLOCK} must be a "
+                     "multiple of 128 (TPU lane grain)")
 # lane budget per head-group block (3*hg*d lanes): sized so the bwd's
 # score-shaped fp32 temporaries (~10 MB at ps=1024) plus double-buffered
 # qkv/do/dqkv blocks stay inside the 16 MB VMEM window.
@@ -1306,20 +1311,20 @@ def _pick_heads_per_group_blocked(h: int, d: int,
 def _e_mode(s: int, h: int, d: int):
     """('single'|'blocked', hg) when the E-layout kernels can run this
     shape, else (None, reason) — the reason string is what fallback
-    sites log."""
+    sites log.  Short sequences whose whole-block grouping misfits
+    (e.g. tiny d where the unrolled (ps, ps) temps blow VMEM) still
+    take the blocked walk — its (bs, bs) tiles admit more shapes."""
     ps = -(-s // 128) * 128
     if ps <= _E_MAX_SEQ:
         hg = _pick_heads_per_group(h, d, ps)
         if hg is not None:
             return "single", hg
-        return None, (f"no head grouping for h={h} d={d} within the "
-                      f"VMEM lane budget (need 3*hg*d lanes % 128 == 0)")
     if ps <= _E_MAX_SEQ_BLOCKED:
         hg = _pick_heads_per_group_blocked(h, d, min(_E_BLOCK, ps))
         if hg is not None:
             return "blocked", hg
-        return None, (f"no blocked head grouping for h={h} d={d} at "
-                      f"block {_E_BLOCK}")
+        return None, (f"no head grouping for h={h} d={d} within the "
+                      f"VMEM lane budget (need 3*hg*d lanes % 128 == 0)")
     return None, (f"padded seq {ps} > APEX_TPU_FLASH_E_MAX_SEQ="
                   f"{_E_MAX_SEQ_BLOCKED}")
 
@@ -1424,11 +1429,11 @@ def _flash_fwd_e(qkv_e, h, scale, causal, kv_mask=None, drop=0.0,
     b, s, width = qkv_e.shape
     d = width // (3 * h)
     ps = -(-s // 128) * 128
-    if ps > _E_MAX_SEQ:
+    hg = _pick_heads_per_group(h, d, ps) if ps <= _E_MAX_SEQ else None
+    if hg is None:                   # matches _e_mode's 'blocked' arm
         return _flash_fwd_e_blocked(qkv_e, h, scale, causal,
                                     kv_mask=kv_mask, drop=drop,
                                     seed=seed)
-    hg = _pick_heads_per_group(h, d, ps)
     g = h // hg
     qkv3 = _pad_to(qkv_e, 1, ps)
     a = scale * _LOG2E
@@ -1701,11 +1706,11 @@ def _flash_bwd_e(h, scale, causal, res, do, kv_mask=None, drop=0.0,
     qkv3, o3, lse, b, s = res              # qkv3/o3 already ps-padded
     ps, width = qkv3.shape[1], qkv3.shape[2]
     d = width // (3 * h)
-    if ps > _E_MAX_SEQ:
+    hg = _pick_heads_per_group(h, d, ps) if ps <= _E_MAX_SEQ else None
+    if hg is None:                   # same dispatch as _flash_fwd_e
         return _flash_bwd_e_blocked(h, scale, causal, res, do,
                                     kv_mask=kv_mask, drop=drop,
                                     seed=seed)
-    hg = _pick_heads_per_group(h, d, ps)
     g = h // hg
     a = scale * _LOG2E
     kpad = ps != s

@@ -25,6 +25,26 @@ import jax.numpy as jnp
 _NEG = -1e30
 
 
+def flash_legal_here(*operands) -> bool:
+    """True when a Pallas call on these operands is legal in the current
+    trace context — i.e. the enclosing ``shard_map`` runs with
+    ``check_vma=False`` (no operand carries a varying-mesh-axis type).
+    Under ``check_vma=True`` sequence-sharded operands are vma-typed and
+    pallas_call is rejected by JAX, so the einsum path must run.
+
+    This is what lets ``use_flash=None`` (the default) pick the fast
+    kernel automatically: probed on the CPU mesh, a ``P('sp')`` operand
+    shows ``vma={'sp'}`` under ``check_vma=True`` and ``vma=set()``
+    under ``check_vma=False``."""
+    for x in operands:
+        try:
+            if jax.typeof(x).vma:
+                return False
+        except (AttributeError, TypeError):
+            continue
+    return True
+
+
 def _block_attend(q, k, v, scale, qpos, kpos, causal):
     """One blockwise partial: returns (m, l, acc) for local q against
     this k/v block, with causal masking by GLOBAL positions."""
@@ -47,7 +67,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str,
                    scale: Optional[float] = None,
                    causal: bool = False,
-                   use_flash: bool = False) -> jnp.ndarray:
+                   use_flash: Optional[bool] = None) -> jnp.ndarray:
     """Exact attention with K/V rotating around ``axis_name``.
 
     Shapes (per shard): q, k, v are (b, h, s_local, d); the global
@@ -55,18 +75,25 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ``[i*s_local, (i+1)*s_local)``.  Returns the local output shard
     (b, h, s_local, d).
 
-    ``use_flash=True`` computes each block with the Pallas flash
-    partial (:func:`..flash_attention.flash_attention_partial`) and
-    merges (o, lse) pairs — per-step attention memory drops from the
+    ``use_flash=None`` (default) picks automatically: the Pallas flash
+    partial runs whenever the enclosing ``shard_map`` legality allows
+    it (``check_vma=False`` — detected via :func:`flash_legal_here`),
+    else the einsum path.  ``use_flash=True`` asserts the flash path
+    (errors loudly under ``check_vma=True``); ``False`` forces einsum.
+
+    The flash mode computes each block with
+    :func:`..flash_attention.flash_attention_partial` and merges
+    (o, lse) pairs — per-step attention memory drops from the
     materialized O(s_local^2) fp32 scores to the kernel's blockwise
     working set, and the MXU kernel replaces the unfused einsum
-    softmax.  Requires the enclosing ``shard_map`` to pass
-    ``check_vma=False`` (Pallas calls cannot carry VMA types).  Same
-    math either way; causal blocks wholly in the future still run
-    their (masked) matmuls in both modes — the merge annihilates them.
+    softmax.  Same math either way; causal blocks wholly in the future
+    still run their (masked) matmuls in both modes — the merge
+    annihilates them.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if use_flash is None:
+        use_flash = flash_legal_here(q, k, v)
     nshards = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     s_local = q.shape[-2]
@@ -143,7 +170,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       scale: Optional[float] = None,
                       causal: bool = False,
                       attention_fn=None,
-                      use_flash: bool = False) -> jnp.ndarray:
+                      use_flash: Optional[bool] = None) -> jnp.ndarray:
     """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps
     the sharded axis from SEQUENCE to HEADS, runs full-sequence
     attention locally on a head subset, and swaps back.
@@ -154,16 +181,19 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     good; ring attention wins when s_local is large enough to overlap
     compute with the hops.
 
-    The default local core is ``flash_attention``, which inside
-    shard_map manual axes routes to its XLA reference implementation.
-    ``use_flash=True`` forces the real Pallas kernel for the local
-    attention — requires the enclosing ``shard_map`` to pass
-    ``check_vma=False``.
+    ``use_flash=None`` (default) runs the real Pallas kernel for the
+    local full-sequence attention whenever the enclosing ``shard_map``
+    legality allows it (``check_vma=False``, via
+    :func:`flash_legal_here`); under ``check_vma=True`` the local core
+    is ``flash_attention``'s XLA reference fallback.  ``True`` asserts
+    the kernel, ``False`` forces the fallback core.
     """
     nshards = jax.lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
     assert h % nshards == 0, (
         f"heads {h} not divisible by axis size {nshards}")
+    if use_flash is None:
+        use_flash = flash_legal_here(q, k, v)
 
     def seq_to_heads(x):
         # (b, h, s_local, d) -> (b, h/P, P*s_local, d)

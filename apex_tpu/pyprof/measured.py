@@ -83,6 +83,16 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
     """Run ``jit(fn)`` under ``jax.profiler`` and return per-op device
     self-times (the reference's parse stage; xplane instead of nvvp).
 
+    .. warning:: Totals are **per execution of fn**, NOT summed over
+       ``iters``: xprof's framework_op_stats reports one program
+       execution even when the trace window holds several identical
+       dispatches (calibrated against the 4096^3 bf16 matmul anchor —
+       iters 1/3/6 all report the same 718 us ~ 191 TF/s).  Do NOT
+       divide by ``iters``.  Occurrences INSIDE one program (e.g. a
+       ``lax.scan`` body) do sum — to get a stable per-step time,
+       profile a K-step scan and divide by K.  ``iters`` only keeps
+       the trace warm.
+
     ``donate=True`` profiles a TRAIN-STEP-shaped ``fn``: every
     positional arg is donated and ``fn`` must return a tuple whose
     first ``len(args)`` entries are the args' replacements (extra

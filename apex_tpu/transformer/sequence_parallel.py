@@ -69,7 +69,7 @@ def reduce_scatter_to_sequence_parallel_region(
 def ring_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                         scale: Optional[float] = None,
                         causal: bool = False,
-                        use_flash: bool = False):
+                        use_flash: Optional[bool] = None):
     """Exact self-attention with q/k/v sequence-sharded over
     ``axis_name`` (b, h, s_local, d per shard).  ``use_flash=True``
     runs each ring block through the Pallas flash partial — requires
@@ -97,7 +97,7 @@ class SequenceParallelSelfAttention:
     def __init__(self, hidden_size: int, num_attention_heads: int,
                  causal: bool = True, mode: str = "ring",
                  axis_name: Optional[str] = SEQUENCE_AXIS,
-                 use_flash: bool = False):
+                 use_flash: Optional[bool] = None):
         assert hidden_size % num_attention_heads == 0
         assert mode in ("ring", "ulysses")
         self.hidden_size = hidden_size
@@ -153,7 +153,7 @@ class SequenceParallelSelfAttention:
 def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                            scale: Optional[float] = None,
                            causal: bool = False,
-                           use_flash: bool = False):
+                           use_flash: Optional[bool] = None):
     return ulysses_attention(q, k, v, axis_name, scale=scale,
                              causal=causal, use_flash=use_flash)
 
@@ -177,7 +177,7 @@ class SequenceParallelTransformerLayer:
                  causal: bool = True, mode: str = "ring",
                  layernorm_epsilon: float = 1e-5,
                  axis_name: Optional[str] = SEQUENCE_AXIS,
-                 use_flash: bool = False):
+                 use_flash: Optional[bool] = None):
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.eps = layernorm_epsilon

@@ -464,6 +464,61 @@ class TestELayoutDropout:
         np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
                                    rtol=5e-4, atol=5e-4)
 
+    @pytest.mark.parametrize("s", [128, 1536])   # single-block, blocked
+    def test_kv_mask_with_dropout_parity(self, s):
+        from apex_tpu.ops.flash_attention import (_E_BLOCK, _E_MAX_SEQ,
+                                                  flash_attention_e)
+        b, h, d, rate = 2, 2, 64, 0.25
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (b, s, h, 3 * d)) * 0.5
+        lens = jnp.array([s // 2, s])
+        m = jnp.arange(s)[None, :] < lens[:, None]
+        w = jax.random.normal(jax.random.PRNGKey(1), (b, s, h * d))
+        seed = 99
+        bs = s if s <= _E_MAX_SEQ else min(_E_BLOCK, s)
+        keep = self._expected_keep(b, h, s, seed, rate, bs=bs)
+
+        def dense(x):
+            bq, sq, hq, td = x.shape
+            dq = td // 3
+            q, k, v = jnp.split(x, 3, axis=-1)
+            q, k, v = (t.transpose(0, 2, 1, 3).astype(jnp.float32)
+                       for t in (q, k, v))
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (dq ** -0.5)
+            scores = jnp.where(m[:, None, None, :], scores, -1e30)
+            p = jax.nn.softmax(scores, axis=-1)
+            pd = jnp.where(keep, p, 0.0) / (1.0 - rate)
+            o = jnp.einsum("bhqk,bhkd->bhqd", pd, v)
+            return o.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+                bq, sq, hq * dq)
+
+        got = flash_attention_e(qkv, kv_mask=m, dropout_rate=rate,
+                                dropout_seed=seed)
+        want = dense(qkv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+        ge = jax.grad(lambda x: jnp.sum(flash_attention_e(
+            x, kv_mask=m, dropout_rate=rate, dropout_seed=seed) * w))(
+            qkv)
+        gr = jax.grad(lambda x: jnp.sum(dense(x) * w))(qkv)
+        np.testing.assert_allclose(np.asarray(ge), np.asarray(gr),
+                                   rtol=7e-4, atol=7e-4)
+
+    def test_short_seq_small_d_routes_blocked(self):
+        """h=16/d=16 at s=1024: the whole-block grouping misfits VMEM
+        but the (bs, bs) blocked walk qualifies — no transposing
+        fallback at short sequences of an eligible shape."""
+        from apex_tpu.ops.flash_attention import _e_mode, \
+            flash_attention_e
+        mode, hg = _e_mode(1024, 16, 16)
+        assert mode == "blocked"
+        qkv = jax.random.normal(jax.random.PRNGKey(0),
+                                (1, 1024, 16, 48)) * 0.5
+        got = flash_attention_e(qkv, causal=True)
+        want = TestELayout._ref(qkv, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_dropout_statistics_and_determinism(self):
         from apex_tpu.ops.flash_attention import flash_attention_e
         b, s, h, d, rate = 1, 256, 4, 64, 0.5

