@@ -10,6 +10,9 @@ on the fly, where XLA fuses it into the gradient expression.
 """
 from __future__ import annotations
 
+__all__ = ["SoftmaxCrossEntropyLoss", "linear_cross_entropy_loss",
+           "softmax_cross_entropy_loss"]
+
 import functools
 
 import jax

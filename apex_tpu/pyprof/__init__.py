@@ -39,6 +39,7 @@ from .measured import (
     collect_device_ops,
     join_measured,
     measured_report,
+    parse_op_stats,
     profile_measured,
 )
 
@@ -71,5 +72,6 @@ __all__ = [
     "collect_device_ops",
     "join_measured",
     "measured_report",
+    "parse_op_stats",
     "profile_measured",
 ]

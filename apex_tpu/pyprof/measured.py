@@ -83,15 +83,14 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
     """Run ``jit(fn)`` under ``jax.profiler`` and return per-op device
     self-times (the reference's parse stage; xplane instead of nvvp).
 
-    .. warning:: Totals are **per execution of fn**, NOT summed over
-       ``iters``: xprof's framework_op_stats reports one program
-       execution even when the trace window holds several identical
-       dispatches (calibrated against the 4096^3 bf16 matmul anchor —
-       iters 1/3/6 all report the same 718 us ~ 191 TF/s).  Do NOT
-       divide by ``iters``.  Occurrences INSIDE one program (e.g. a
-       ``lax.scan`` body) do sum — to get a stable per-step time,
-       profile a K-step scan and divide by K.  ``iters`` only keeps
-       the trace warm.
+    .. warning:: Totals come back **already normalized to one
+       execution of fn** (the trace sums all ``iters`` dispatches and
+       this function divides by ``iters``) — do NOT divide by
+       ``iters`` again.  Calibration anchor: a 4096^3 bf16 matmul
+       reports the same 718 us ~ 191 TF/s at iters 1/3/6.
+       Occurrences INSIDE one program (e.g. a ``lax.scan`` body) still
+       sum within the execution — for a per-step time, profile a
+       K-step scan and divide the total by K.
 
     ``donate=True`` profiles a TRAIN-STEP-shaped ``fn``: every
     positional arg is donated and ``fn`` must return a tuple whose
@@ -140,15 +139,27 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
             raise RuntimeError(f"no xplane.pb written under {tdir}")
         data, _ = _r2t.xspace_to_tool_data(xplanes,
                                            "framework_op_stats", {})
-        text = data.decode() if isinstance(data, bytes) else data
-        tables = json.loads(text)
-        table = tables[0] if isinstance(tables, list) else tables
-        cols = [c["label"] for c in table["cols"]]
-        rows = [dict(zip(cols, [c.get("v") for c in r["c"]]))
-                for r in table["rows"]]
     finally:
         if trace_dir is None:
             shutil.rmtree(tdir, ignore_errors=True)
+    return parse_op_stats(data, iters=iters)
+
+
+def parse_op_stats(data, iters: int = 1) -> List[MeasuredOp]:
+    """Parse xprof's ``framework_op_stats`` tool output (gviz JSON —
+    bytes or str, a table or a list of tables) into device
+    :class:`MeasuredOp` rows, normalized to one execution.
+
+    Split out of :func:`collect_device_ops` so the parse is
+    regression-testable without TPU hardware: a recorded tool output
+    lives at ``tests/data/framework_op_stats_gpt.json`` (the round-4
+    GPT-345M train-step capture)."""
+    text = data.decode() if isinstance(data, bytes) else data
+    tables = json.loads(text)
+    table = tables[0] if isinstance(tables, list) else tables
+    cols = [c["label"] for c in table["cols"]]
+    rows = [dict(zip(cols, [c.get("v") for c in r["c"]]))
+            for r in table["rows"]]
     out_rows = []
     for r in rows:
         if r.get("Host/device") != "Device":

@@ -46,6 +46,49 @@ def top1_router(logits: jnp.ndarray) -> RouterOutput:
     return RouterOutput(idx.astype(jnp.int32), gate, aux)
 
 
+class Top2RouterOutput(NamedTuple):
+    expert_index: jnp.ndarray   # (2, T) int32 chosen experts per token
+    gate: jnp.ndarray           # (2, T) f32 normalized gates
+    load_balancing_loss: jnp.ndarray  # scalar aux loss
+
+
+def top2_router(logits: jnp.ndarray,
+                second_policy: str = "all") -> Top2RouterOutput:
+    """Top-2 gating with the GShard algebra the module docstring cites
+    (Lepikhin et al. 2020, eq. for Algorithm 1): each token routes to
+    its two highest-probability experts, gates renormalized over the
+    pair; the auxiliary loss is the top-1 fraction x mean-prob product
+    (the differentiable load estimator, GShard l_aux).
+
+    ``second_policy``: ``"all"`` always keeps the second expert;
+    ``"random"`` keeps it with probability ``2 * gate2`` (the GShard
+    dispatch-saving trick) — deterministic policy "all" is the default
+    (no RNG threading; capacity still bounds overflow).
+    """
+    if second_policy not in ("all",):
+        raise NotImplementedError(
+            "second_policy='random' needs an rng; the deterministic "
+            "'all' policy ships (capacity still bounds load)")
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    num_experts = logits.shape[-1]
+    idx1 = jnp.argmax(probs, axis=-1)
+    gate1 = jnp.take_along_axis(probs, idx1[:, None], axis=1)[:, 0]
+    masked = probs * (1.0 - jax.nn.one_hot(idx1, num_experts,
+                                           dtype=probs.dtype))
+    idx2 = jnp.argmax(masked, axis=-1)
+    gate2 = jnp.take_along_axis(masked, idx2[:, None], axis=1)[:, 0]
+    denom = jnp.maximum(gate1 + gate2, 1e-9)
+    # aux loss over the FIRST choice (GShard: top-2's second choice is
+    # excluded from the load estimator)
+    frac = jnp.mean(
+        jax.nn.one_hot(idx1, num_experts, dtype=jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return Top2RouterOutput(
+        jnp.stack([idx1, idx2]).astype(jnp.int32),
+        jnp.stack([gate1 / denom, gate2 / denom]), aux)
+
+
 def _dispatch_indices(expert_index: jnp.ndarray, num_experts: int,
                       capacity: int):
     """Position of each token within its expert's capacity slots.
@@ -78,16 +121,27 @@ def moe_dispatch_combine(x: jnp.ndarray,
     (``num_experts %% axis_size == 0``) and dispatch/return each ride one
     ``all_to_all``; ``axis_name=None`` runs all experts locally (the
     dense-equivalent used for parity tests).
+
+    ``router`` may be top-1 (``(T,)`` index/gate) or top-k
+    (``(k, T)``, e.g. :func:`top2_router`): the k choices share the
+    capacity buffer with first choices taking priority (choice-major
+    cumsum — the GShard Algorithm 1 slotting), and the combine sums the
+    gate-weighted expert outputs per token.
     """
     T, H = x.shape
-    capacity = max(1, int(capacity_factor * T / num_experts))
-    slot, keep = _dispatch_indices(router.expert_index, num_experts,
-                                   capacity)
+    idx = jnp.atleast_2d(router.expert_index)          # (k, T)
+    gates = jnp.atleast_2d(router.gate)
+    k = idx.shape[0]
+    capacity = max(1, int(capacity_factor * k * T / num_experts))
+    slot, keep = _dispatch_indices(idx.reshape(-1), num_experts,
+                                   capacity)           # choice-major
 
-    # scatter tokens into (num_experts, capacity, H)
+    # scatter tokens into (num_experts, capacity, H); each of a token's
+    # k choices occupies its own slot
     buf = jnp.zeros((num_experts, capacity, H), x.dtype)
-    buf = buf.at[router.expert_index, slot].add(
-        jnp.where(keep[:, None], x, 0))
+    xk = jnp.broadcast_to(x[None], (k, T, H)).reshape(k * T, H)
+    buf = buf.at[idx.reshape(-1), slot].add(
+        jnp.where(keep[:, None], xk, 0))
 
     if axis_name is not None:
         n_shards = jax.lax.axis_size(axis_name)
@@ -103,10 +157,13 @@ def moe_dispatch_combine(x: jnp.ndarray,
         out = jax.lax.all_to_all(out, axis_name, split_axis=1,
                                  concat_axis=0, tiled=True)
 
-    # combine: gather each token's slot output, weight by its gate
-    tok_out = out[router.expert_index, slot]
-    gate = jnp.where(keep, router.gate, 0.0).astype(jnp.float32)
-    return (tok_out.astype(jnp.float32) * gate[:, None]).astype(x.dtype)
+    # combine: gather each choice's slot output, weight by its gate,
+    # sum over choices
+    tok_out = out[idx.reshape(-1), slot]               # (k*T, H)
+    gate = jnp.where(keep, gates.reshape(-1), 0.0).astype(jnp.float32)
+    combined = (tok_out.astype(jnp.float32) * gate[:, None]) \
+        .reshape(k, T, H).sum(0)
+    return combined.astype(x.dtype)
 
 
 class ExpertParallelMLP:
@@ -123,12 +180,16 @@ class ExpertParallelMLP:
 
     def __init__(self, hidden_size: int, ffn_hidden_size: int,
                  num_experts: int, capacity_factor: float = 1.25,
-                 axis_name: Optional[str] = EXPERT_AXIS):
+                 axis_name: Optional[str] = EXPERT_AXIS,
+                 router: str = "top1"):
+        if router not in ("top1", "top2"):
+            raise ValueError(f"router must be top1|top2, got {router!r}")
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.axis_name = axis_name
+        self.router = router
 
     def init(self, key: jax.Array) -> dict:
         kr, k1, k2 = jax.random.split(key, 3)
@@ -147,7 +208,8 @@ class ExpertParallelMLP:
         router replicated; tokens may be data-sharded on any other
         axis."""
         logits = x.astype(jnp.float32) @ params["router"]
-        router = top1_router(logits)
+        router = (top2_router(logits) if self.router == "top2"
+                  else top1_router(logits))
 
         def expert_fn(buf):  # (local_e, rows, H)
             h = jnp.einsum("erh,ehf->erf", buf.astype(jnp.float32),

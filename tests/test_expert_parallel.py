@@ -262,3 +262,103 @@ class TestMoEFlaxLayer:
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    rtol=2e-5, atol=1e-5)
         np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+class TestTop2Router:
+    """GShard-style top-2 gating (round-3 VERDICT item 9): pair
+    selection, gate normalization, shared-capacity dispatch, and
+    EP-sharded parity with the local-dense execution."""
+
+    def test_picks_two_distinct_argmax(self):
+        from apex_tpu.transformer.expert_parallel import top2_router
+        logits = jax.random.normal(jax.random.PRNGKey(0), (T, E))
+        r = top2_router(logits)
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        order = probs.argsort(axis=-1)
+        np.testing.assert_array_equal(np.asarray(r.expert_index[0]),
+                                      order[:, -1])
+        np.testing.assert_array_equal(np.asarray(r.expert_index[1]),
+                                      order[:, -2])
+        # gates renormalized over the pair, first >= second
+        g = np.asarray(r.gate)
+        np.testing.assert_allclose(g.sum(0), 1.0, rtol=1e-5)
+        assert (g[0] >= g[1] - 1e-6).all()
+        assert float(r.load_balancing_loss) >= 1.0 - 1e-5
+
+    def test_dense_mixture_parity(self):
+        """With ample capacity, top-2 MoE equals the explicit two-expert
+        gate-weighted mixture computed densely."""
+        from apex_tpu.transformer.expert_parallel import top2_router
+        key = jax.random.PRNGKey(1)
+        x = jax.random.normal(key, (T, H))
+        wi = jax.random.normal(jax.random.fold_in(key, 1), (E, H, F))
+        wo = jax.random.normal(jax.random.fold_in(key, 2), (E, F, H))
+        logits = jax.random.normal(jax.random.fold_in(key, 3), (T, E))
+        r = top2_router(logits)
+
+        def expert_fn(buf):
+            h = jnp.einsum("erh,ehf->erf", buf, wi)
+            return jnp.einsum("erf,efh->erh", jax.nn.gelu(h), wo)
+
+        got = moe_dispatch_combine(x, r, expert_fn, E,
+                                   capacity_factor=4.0, axis_name=None)
+        # dense: run every expert on every token, mix the two chosen
+        h = jnp.einsum("th,ehf->etf", x, wi)
+        dense = jnp.einsum("etf,efh->eth", jax.nn.gelu(h), wo)
+        idx = np.asarray(r.expert_index)
+        g = np.asarray(r.gate)
+        want = (np.asarray(dense)[idx[0], np.arange(T)] * g[0][:, None]
+                + np.asarray(dense)[idx[1], np.arange(T)]
+                * g[1][:, None])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_sharded_matches_local_top2(self):
+        from apex_tpu.transformer.expert_parallel import top2_router
+        mesh = expert_mesh()
+        key = jax.random.PRNGKey(2)
+        layer_l = ExpertParallelMLP(H, F, E, capacity_factor=8.0,
+                                    axis_name=None, router="top2")
+        layer_s = ExpertParallelMLP(H, F, E, capacity_factor=8.0,
+                                    router="top2")
+        params = layer_l.init(key)
+        x = jax.random.normal(jax.random.fold_in(key, 9), (T, H))
+        y_local, _ = layer_l.apply(params, x)
+
+        # production topology (same as the top-1 test): tokens
+        # data-sharded over the expert axis, experts weight-sharded
+        y_shard = jax.jit(jax.shard_map(
+            lambda p, x: layer_s.apply(p, x)[0], mesh=mesh,
+            in_specs=({"router": P(), "wi": P("expert"),
+                       "wo": P("expert")}, P("expert")),
+            out_specs=P("expert")))(params, x)
+        np.testing.assert_allclose(np.asarray(y_shard),
+                                   np.asarray(y_local), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_top2_trains(self):
+        import optax
+        from apex_tpu.transformer.expert_parallel import top2_router
+        key = jax.random.PRNGKey(3)
+        layer = ExpertParallelMLP(H, F, E, capacity_factor=4.0,
+                                  axis_name=None, router="top2")
+        params = layer.init(key)
+        x = jax.random.normal(jax.random.fold_in(key, 1), (T, H))
+        tgt = jnp.roll(x, 1, axis=0)
+        tx = optax.adam(3e-3)
+        s = tx.init(params)
+
+        @jax.jit
+        def step(params, s):
+            def loss_fn(p):
+                y, aux = layer.apply(p, x)
+                return jnp.mean((y - tgt) ** 2) + 0.01 * aux
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            u, s2 = tx.update(g, s, params)
+            return optax.apply_updates(params, u), s2, loss
+
+        losses = []
+        for _ in range(40):
+            params, s, loss = step(params, s)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses

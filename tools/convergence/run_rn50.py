@@ -43,6 +43,11 @@ def main(argv=None):
         REPO, "docs", "convergence", "rn50_loss.json"))
     p.add_argument("--ckpt-dir", default="/tmp/apex_tpu_rn50_conv_ckpt")
     args = p.parse_args(argv)
+    # wipe stale scratch checkpoints (see run_gpt._clear_scratch_ckpts:
+    # a previous run's latest step makes Orbax skip this run's save);
+    # user-supplied dirs are refused, never deleted
+    from run_gpt import _clear_scratch_ckpts
+    _clear_scratch_ckpts(args.ckpt_dir, p.get_default("ckpt_dir"))
 
     import jax
     import jax.numpy as jnp
@@ -54,8 +59,13 @@ def main(argv=None):
     from apex_tpu.utils import checkpoint as ckpt
 
     images, labels = load_digits_rgb(args.image_size)
+    # held-out split (round-3 VERDICT weak #5: report accuracy, not
+    # just train loss): last 297 scans never train
+    n_eval = 297
+    ev_images, ev_labels = images[-n_eval:], labels[-n_eval:]
+    images, labels = images[:-n_eval], labels[:-n_eval]
     n = images.shape[0]
-    print(f"data: {n} real digit scans at "
+    print(f"data: {n} train + {n_eval} held-out real digit scans at "
           f"{args.image_size}x{args.image_size}")
 
     policy = amp.get_policy("O5")
@@ -97,7 +107,21 @@ def main(argv=None):
         pr2, st2, _ = opt.apply_gradients(grads, state, params)
         return pr2, mutated["batch_stats"], st2, loss
 
+    @jax.jit
+    def eval_logits(params, batch_stats, x):
+        return model.apply({"params": params,
+                            "batch_stats": batch_stats}, x, train=False)
+
+    ev_x = jnp.asarray(ev_images, policy.compute_dtype)
+    ev_y = np.asarray(ev_labels)
+
+    def eval_top1(params, batch_stats):
+        logits = np.asarray(eval_logits(params, batch_stats, ev_x),
+                            np.float32)
+        return float((logits.argmax(-1) == ev_y).mean())
+
     losses = []
+    accs = []
     half = args.steps // 2
     for step in range(args.steps):
         x, y = batch_at(step)
@@ -107,6 +131,10 @@ def main(argv=None):
             lv = float(loss)
             losses.append({"step": step, "loss": lv})
             print(f"step {step}: loss {lv:.4f}", flush=True)
+        if step % 50 == 0 or step == args.steps - 1:
+            acc = eval_top1(params, batch_stats)
+            accs.append({"step": step, "top1": round(acc, 4)})
+            print(f"step {step}: held-out top-1 {acc:.3f}", flush=True)
         if step == half:
             ckpt.save_checkpoint(args.ckpt_dir, step, params,
                                  amp_opt=opt, amp_state=state,
@@ -132,20 +160,26 @@ def main(argv=None):
           f"{'OK' if resume_ok else f'{mismatch} leaves differ'}")
 
     first, last = losses[0]["loss"], losses[-1]["loss"]
+    final_acc = accs[-1]["top1"]
     out = {
         "model": "resnet50_o5", "params_m": round(n_params / 1e6, 1),
-        "data": "sklearn digits (real scans), 64x64 RGB",
+        "data": ("sklearn digits (real scans), 64x64 RGB, "
+                 f"{n} train / {n_eval} held out"),
         "steps": args.steps, "batch": args.batch,
         "losses": losses,
+        "eval_top1": accs,
         "first_loss": first, "final_loss": last,
+        "final_eval_top1": final_acc,
         "resume_bitwise_ok": resume_ok,
         "device": str(jax.devices()[0].device_kind),
     }
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
-    print(f"wrote {args.out}: loss {first:.4f} -> {last:.4f}")
+    print(f"wrote {args.out}: loss {first:.4f} -> {last:.4f}, "
+          f"held-out top-1 {final_acc:.3f}")
     assert last < first * 0.5, "insufficient convergence"
+    assert final_acc > 0.8, f"held-out top-1 {final_acc} too low"
     assert resume_ok, "resume not bitwise identical"
 
 
