@@ -40,6 +40,7 @@ from .measured import (
     join_measured,
     measured_report,
     parse_op_stats,
+    profile_call,
     profile_measured,
 )
 
@@ -73,5 +74,6 @@ __all__ = [
     "join_measured",
     "measured_report",
     "parse_op_stats",
+    "profile_call",
     "profile_measured",
 ]

@@ -145,6 +145,41 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
     return parse_op_stats(data, iters=iters)
 
 
+def profile_call(thunk: Callable[[], object], iters: int = 1,
+                 trace_dir: Optional[str] = None) -> List[MeasuredOp]:
+    """Trace ``iters`` calls of an ALREADY-COMPILED zero-arg callable
+    and return per-op device self-times normalized to one call.
+
+    Unlike :func:`collect_device_ops` this wraps nothing in a new
+    ``jax.jit`` — use it to profile an existing executable with its
+    live (possibly donated) buffers without paying a retrace/recompile
+    (the bench's optimizer rows re-used their timed executables this
+    way).  The caller is responsible for warmup (typically the timing
+    loop that just ran)."""
+    from xprof.convert import raw_to_tool_data as _r2t
+
+    tdir = trace_dir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
+    try:
+        jax.profiler.start_trace(tdir)
+        try:
+            out = None
+            for _ in range(iters):
+                out = thunk()
+            jax.block_until_ready(out)
+        finally:
+            jax.profiler.stop_trace()
+        xplanes = glob.glob(os.path.join(tdir, "**", "*.xplane.pb"),
+                            recursive=True)
+        if not xplanes:
+            raise RuntimeError(f"no xplane.pb written under {tdir}")
+        data, _ = _r2t.xspace_to_tool_data(xplanes,
+                                           "framework_op_stats", {})
+    finally:
+        if trace_dir is None:
+            shutil.rmtree(tdir, ignore_errors=True)
+    return parse_op_stats(data, iters=iters)
+
+
 def parse_op_stats(data, iters: int = 1) -> List[MeasuredOp]:
     """Parse xprof's ``framework_op_stats`` tool output (gviz JSON —
     bytes or str, a table or a list of tables) into device
