@@ -35,7 +35,8 @@ import jax
 from .prof import OpRecord, analyze, device_spec
 
 __all__ = ["MeasuredOp", "collect_device_ops", "canonical_key",
-           "join_measured", "profile_measured", "measured_report"]
+           "join_measured", "parse_op_stats", "profile_call",
+           "profile_measured", "measured_report"]
 
 
 @dataclass
@@ -121,13 +122,29 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
 
     out, args = run(args)
     jax.block_until_ready(out)
+
+    def loop():
+        out = None
+        a = args
+        for _ in range(iters):
+            out, a = run(a)
+        return out
+
+    data = _traced_op_stats(loop, trace_dir)
+    return parse_op_stats(data, iters=iters)
+
+
+def _traced_op_stats(loop: Callable[[], object],
+                     trace_dir: Optional[str]):
+    """Shared tracing core: run ``loop()`` under ``jax.profiler`` and
+    return the raw framework_op_stats tool output."""
+    from xprof.convert import raw_to_tool_data as _r2t
+
     tdir = trace_dir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
     try:
         jax.profiler.start_trace(tdir)
         try:
-            for _ in range(iters):
-                out, args = run(args)
-            jax.block_until_ready(out)
+            jax.block_until_ready(loop())
         finally:
             # always close the process-global profiler session, or every
             # later collect in this process fails with "only one
@@ -139,10 +156,10 @@ def collect_device_ops(fn: Callable, *args, iters: int = 3,
             raise RuntimeError(f"no xplane.pb written under {tdir}")
         data, _ = _r2t.xspace_to_tool_data(xplanes,
                                            "framework_op_stats", {})
+        return data
     finally:
         if trace_dir is None:
             shutil.rmtree(tdir, ignore_errors=True)
-    return parse_op_stats(data, iters=iters)
 
 
 def profile_call(thunk: Callable[[], object], iters: int = 1,
@@ -155,28 +172,21 @@ def profile_call(thunk: Callable[[], object], iters: int = 1,
     live (possibly donated) buffers without paying a retrace/recompile
     (the bench's optimizer rows re-used their timed executables this
     way).  The caller is responsible for warmup (typically the timing
-    loop that just ran)."""
-    from xprof.convert import raw_to_tool_data as _r2t
+    loop that just ran).
 
-    tdir = trace_dir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
-    try:
-        jax.profiler.start_trace(tdir)
-        try:
-            out = None
-            for _ in range(iters):
-                out = thunk()
-            jax.block_until_ready(out)
-        finally:
-            jax.profiler.stop_trace()
-        xplanes = glob.glob(os.path.join(tdir, "**", "*.xplane.pb"),
-                            recursive=True)
-        if not xplanes:
-            raise RuntimeError(f"no xplane.pb written under {tdir}")
-        data, _ = _r2t.xspace_to_tool_data(xplanes,
-                                           "framework_op_stats", {})
-    finally:
-        if trace_dir is None:
-            shutil.rmtree(tdir, ignore_errors=True)
+    .. note:: With ``iters > 1`` a thunk over a DONATING executable
+       must rebind its own operands from each call's outputs (e.g. the
+       bench's rn50 ``holder`` pattern) — a closure over fixed donated
+       buffers works only at ``iters=1``; the second call would
+       dispatch on deleted buffers."""
+
+    def loop():
+        out = None
+        for _ in range(iters):
+            out = thunk()
+        return out
+
+    data = _traced_op_stats(loop, trace_dir)
     return parse_op_stats(data, iters=iters)
 
 
