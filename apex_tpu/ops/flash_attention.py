@@ -1278,49 +1278,61 @@ if _E_BLOCK % 128:
 _E_LANE_BUDGET = _env_block("APEX_TPU_FLASH_E_LANES", 768)
 
 
-def _pick_heads_per_group(h: int, d: int, ps: int) -> Optional[int]:
+def _pick_heads_per_group(h: int, d: int, ps: int,
+                          drop: bool = False) -> Optional[int]:
     """Largest divisor of ``h`` with 3*hg*d lanes within budget, lane-
     aligned (3*hg*d % 128 == 0), and few enough unrolled heads that the
     per-head (ps, ps) fp32 score temporaries stay inside VMEM — Mosaic
     only partially reuses them across the unrolled loop (measured: hg=4
     at ps=1024/d=64 fits with ~2 MB slack; hg=16 at ps=1024/d=16 asks
-    for 43.6 MB).  None when no grouping qualifies (callers fall back
-    to the transposing path)."""
+    for 43.6 MB).  ``drop`` halves the temp budget: the in-kernel keep
+    mask adds score-shaped uint32/f32 temporaries per head (measured:
+    hg=4/ps=1024/d=64 with dropout overflows scoped VMEM by 600 KB on
+    hardware).  None when no grouping qualifies (callers fall back to
+    the blocked walk or the transposing path)."""
     cap = max(1, _E_LANE_BUDGET // (3 * d))
-    cap = min(cap, max(1, (4 * 1024 * 1024) // (ps * ps)))
+    budget = (2 if drop else 4) * 1024 * 1024
+    cap = min(cap, max(1, budget // (ps * ps)))
     for hg in range(min(cap, h), 0, -1):
         if h % hg == 0 and (3 * hg * d) % 128 == 0:
             return hg
     return None
 
 
-def _pick_heads_per_group_blocked(h: int, d: int,
-                                  bs: int) -> Optional[int]:
+def _pick_heads_per_group_blocked(h: int, d: int, bs: int,
+                                  drop: bool = False) -> Optional[int]:
     """Head grouping for the BLOCKED E walk: same lane constraints as
     :func:`_pick_heads_per_group`, but the score-temporary budget counts
     (bs, bs) tiles and halves (the combined backward keeps both the dq
-    and dk/dv sides' temporaries live in one kernel)."""
+    and dk/dv sides' temporaries live in one kernel).  ``drop`` halves
+    it again for the keep-mask temporaries (same VMEM class the
+    single-block picker budgets for; hg=4 at bs=512 with dropout is
+    measured to fit on hardware — the halved cap keeps exactly that)."""
     cap = max(1, _E_LANE_BUDGET // (3 * d))
-    cap = min(cap, max(1, (2 * 1024 * 1024) // (bs * bs)))
+    budget = (1 if drop else 2) * 1024 * 1024
+    cap = min(cap, max(1, budget // (bs * bs)))
     for hg in range(min(cap, h), 0, -1):
         if h % hg == 0 and (3 * hg * d) % 128 == 0:
             return hg
     return None
 
 
-def _e_mode(s: int, h: int, d: int):
+def _e_mode(s: int, h: int, d: int, drop: bool = False):
     """('single'|'blocked', hg) when the E-layout kernels can run this
     shape, else (None, reason) — the reason string is what fallback
     sites log.  Short sequences whose whole-block grouping misfits
     (e.g. tiny d where the unrolled (ps, ps) temps blow VMEM) still
-    take the blocked walk — its (bs, bs) tiles admit more shapes."""
+    take the blocked walk — its (bs, bs) tiles admit more shapes.
+    ``drop`` mirrors the kernels' dropout-halved temp budgets so the
+    reported mode/hg are the ones that actually execute."""
     ps = -(-s // 128) * 128
     if ps <= _E_MAX_SEQ:
-        hg = _pick_heads_per_group(h, d, ps)
+        hg = _pick_heads_per_group(h, d, ps, drop=drop)
         if hg is not None:
             return "single", hg
     if ps <= _E_MAX_SEQ_BLOCKED:
-        hg = _pick_heads_per_group_blocked(h, d, min(_E_BLOCK, ps))
+        hg = _pick_heads_per_group_blocked(h, d, min(_E_BLOCK, ps),
+                                           drop=drop)
         if hg is not None:
             return "blocked", hg
         return None, (f"no head grouping for h={h} d={d} within the "
@@ -1429,7 +1441,8 @@ def _flash_fwd_e(qkv_e, h, scale, causal, kv_mask=None, drop=0.0,
     b, s, width = qkv_e.shape
     d = width // (3 * h)
     ps = -(-s // 128) * 128
-    hg = _pick_heads_per_group(h, d, ps) if ps <= _E_MAX_SEQ else None
+    hg = _pick_heads_per_group(h, d, ps, drop=drop > 0.0) \
+        if ps <= _E_MAX_SEQ else None
     if hg is None:                   # matches _e_mode's 'blocked' arm
         return _flash_fwd_e_blocked(qkv_e, h, scale, causal,
                                     kv_mask=kv_mask, drop=drop,
@@ -1582,7 +1595,7 @@ def _flash_fwd_e_blocked(qkv_e, h, scale, causal, kv_mask=None,
         bs = 1024
         hg = _pick_heads_per_group_blocked(h, d, 1024)
     else:
-        hg = _pick_heads_per_group_blocked(h, d, bs)
+        hg = _pick_heads_per_group_blocked(h, d, bs, drop=drop > 0.0)
     g = h // hg
     qkv3 = _pad_to(qkv_e, 1, bs)
     ps = qkv3.shape[1]
@@ -1706,7 +1719,8 @@ def _flash_bwd_e(h, scale, causal, res, do, kv_mask=None, drop=0.0,
     qkv3, o3, lse, b, s = res              # qkv3/o3 already ps-padded
     ps, width = qkv3.shape[1], qkv3.shape[2]
     d = width // (3 * h)
-    hg = _pick_heads_per_group(h, d, ps) if ps <= _E_MAX_SEQ else None
+    hg = _pick_heads_per_group(h, d, ps, drop=drop > 0.0) \
+        if ps <= _E_MAX_SEQ else None
     if hg is None:                   # same dispatch as _flash_fwd_e
         return _flash_bwd_e_blocked(h, scale, causal, res, do,
                                     kv_mask=kv_mask, drop=drop,
@@ -1903,7 +1917,7 @@ def _flash_bwd_e_blocked(h, scale, causal, res, do, kv_mask=None,
     qkv3 = _pad_to(qkv3, 1, bs)
     o3 = _pad_to(o3, 1, bs)
     ps = qkv3.shape[1]
-    hg = _pick_heads_per_group_blocked(h, d, bs)
+    hg = _pick_heads_per_group_blocked(h, d, bs, drop=drop > 0.0)
     g = h // hg
     nb = ps // bs
     a = scale * _LOG2E
@@ -2111,7 +2125,7 @@ def flash_attention_e(qkv: jnp.ndarray,
             and jnp.issubdtype(qkv.dtype, jnp.floating):
         qkv = qkv.astype(act)
     manual = in_manual_axis_context(qkv)
-    mode, why = _e_mode(s, h, d)
+    mode, why = _e_mode(s, h, d, drop=dropout_rate > 0.0)
     if manual or mode is None:
         reason = "inside shard_map manual axes" if manual else why
         _log_e_fallback(reason, b, s, h, d)

@@ -655,19 +655,22 @@ def bench_collective():
 # Extra 3: GPT-2 345M single-chip train step (transformer Pallas path)
 # --------------------------------------------------------------------------
 
-def bench_gpt345m():
+def bench_gpt345m(seq=None, batch=None, dropout=0.0,
+                  with_profile=True):
     from apex_tpu.optimizers import fused_adam
     from apex_tpu.testing.standalone_gpt import GPTModel
 
-    seq = int(os.environ.get("BENCH_GPT_SEQ", "1024"))
-    batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
+    if seq is None:
+        seq = int(os.environ.get("BENCH_GPT_SEQ", "1024"))
+    if batch is None:
+        batch = int(os.environ.get("BENCH_GPT_BATCH", "8"))
     vocab, hidden, layers, heads = 50304, 1024, 24, 16
     if os.environ.get("BENCH_SMOKE") == "1":
         vocab, hidden, layers, heads = 1024, 256, 2, 4
     model = GPTModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_attention_heads=heads, max_sequence_length=seq,
-        attention_dropout=0.0, hidden_dropout=0.0, use_flash=True,
+        attention_dropout=dropout, hidden_dropout=0.0, use_flash=True,
         # remat off by default: batch 8 fits v5e HBM without it and
         # measures 91.6 TFLOP/s vs 59.8 fully-rematerialized.
         # BENCH_GPT_REMAT=1 turns remat on; BENCH_GPT_REMAT_POLICY picks
@@ -700,8 +703,13 @@ def bench_gpt345m():
     # was exactly those buffers).  0 = dense logits path.
     ce_chunks = int(os.environ.get("BENCH_GPT_CHUNKED_CE", "0"))
 
-    def train_step(carry, _):
+    def train_step(carry, step_key):
         params, amp_state = carry
+        # attention dropout (the in-kernel E-route): a fresh key per
+        # scan step; deterministic when dropout == 0 (the headline
+        # config — matches the reference bench convention)
+        rngs = ({"dropout": step_key} if dropout > 0.0 else None)
+        det = dropout == 0.0
 
         def loss_fn(p):
             if ce_chunks > 0:
@@ -709,7 +717,7 @@ def bench_gpt345m():
                     linear_cross_entropy_loss)
 
                 h = model.apply({"params": p}, tokens,
-                                deterministic=True,
+                                deterministic=det, rngs=rngs,
                                 method="hidden_states")
                 emb = p["embedding"]["word_embeddings"]["embedding"]
                 if hasattr(emb, "unbox"):  # flax Partitioned metadata
@@ -719,7 +727,7 @@ def bench_gpt345m():
                     labels.reshape(-1), chunks=ce_chunks)
             else:
                 logits = model.apply({"params": p}, tokens,
-                                     deterministic=True)
+                                     deterministic=det, rngs=rngs)
                 loss = jnp.mean(softmax_cross_entropy_loss(
                     logits.reshape(-1, logits.shape[-1]),
                     labels.reshape(-1), half_to_float=True))
@@ -738,9 +746,11 @@ def bench_gpt345m():
     k1, k2 = 4, 16
 
     def make_steps(n):
+        keys = jax.random.split(jax.random.fold_in(key, 999 + n), n)
+
         @functools.partial(jax.jit, donate_argnums=(0,))
         def run_steps(carry):
-            return jax.lax.scan(train_step, carry, None, length=n)
+            return jax.lax.scan(train_step, carry, keys)
         return run_steps
 
     run1, run2 = make_steps(k1), make_steps(k2)
@@ -772,7 +782,7 @@ def bench_gpt345m():
            "batch": batch, "step_ms": round(dt * 1e3, 1),
            "tokens_per_sec": round(tokens_per_sec, 0),
            "model_tflops_per_sec": round(flops / dt / 1e12, 1)}
-    if jax.default_backend() == "tpu" \
+    if jax.default_backend() == "tpu" and with_profile \
             and os.environ.get("BENCH_SKIP_PROFILE", "") != "1":
         # measured-profile artifact: analytical jaxpr walk + xprof
         # device times joined per op, written as PROFILE_gpt.tsv — the
@@ -788,7 +798,8 @@ def bench_gpt345m():
             params2, state2 = carry
 
             def one_step(params, amp_state):
-                (p2, s2), loss = train_step((params, amp_state), None)
+                (p2, s2), loss = train_step((params, amp_state),
+                                            jax.random.PRNGKey(7))
                 return p2, s2, loss
 
             records = analyze(one_step, params2, state2)
@@ -932,6 +943,21 @@ def main():
                 extras["ring_flash"] = {"error": str(e)[:200]}
             print("[bench] gpt2_345m...", file=sys.stderr)
             extras["gpt2_345m"] = bench_gpt345m()
+            # model-level long-sequence row (blocked E-layout kernels
+            # end-to-end) and the training config with attention
+            # dropout (in-kernel E-route — round 4's eligibility work)
+            print("[bench] gpt2_345m_s2048...", file=sys.stderr)
+            try:
+                extras["gpt2_345m_s2048"] = bench_gpt345m(
+                    seq=2048, batch=4, with_profile=False)
+            except Exception as e:
+                extras["gpt2_345m_s2048"] = {"error": str(e)[:200]}
+            print("[bench] gpt2_345m_dropout...", file=sys.stderr)
+            try:
+                extras["gpt2_345m_dropout"] = bench_gpt345m(
+                    dropout=0.1, with_profile=False)
+            except Exception as e:
+                extras["gpt2_345m_dropout"] = {"error": str(e)[:200]}
             print("[bench] bert_large...", file=sys.stderr)
             extras["bert_large"] = bench_bert_large()
 
