@@ -124,21 +124,90 @@ def _kcol_mask(shape, k_off, sk):
     return jnp.broadcast_to(k_pos < sk, shape)
 
 
+def _u32of(x):
+    """Non-negative int -> uint32 view (mask to 31 bits first: Mosaic
+    has no checked int32->uint32 cast; see the seed contract note in
+    :func:`flash_attention_e`)."""
+    return jnp.bitwise_and(jnp.asarray(x, jnp.int32),
+                           jnp.int32(0x7FFFFFFF)).astype(jnp.uint32)
+
+
+def _keep_from_x(x, rate):
+    """fmix32 + top-24-bit uniform -> keep mask (prob. 1 - rate)."""
+    u32 = functools.partial(jnp.asarray, dtype=jnp.uint32)
+    x = (x ^ (x >> 16)) * u32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * u32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    # bitcast to int32 before the float convert — Mosaic has no
+    # uint32->f32 cast, and after >> 8 the sign bit is 0
+    f = jax.lax.bitcast_convert_type(x >> 8, jnp.int32) \
+        .astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    return f >= jnp.float32(rate)
+
+
+def _rand_keep_coords(shape, seed, salt_b, salt_head, row0, col0, rate):
+    """Tiling-INDEPENDENT dropout keep mask: a pure function of
+    (seed, batch, global head, GLOBAL row, GLOBAL col), so any block
+    decomposition of the score matrix regenerates identical bits.  The
+    sequence-parallel paths need exactly this: ring shards evaluate
+    disjoint (row, col) windows of one global score matrix across
+    differently-tiled fwd/bwd kernels, and the union must equal the
+    mask a dense evaluation would draw (Liu et al. ring attention +
+    the reference's in-kernel philox role, ref:
+    apex/contrib/csrc/multihead_attn/dropout.h).
+
+    ``row0``/``col0`` place ``shape`` in global coordinates (traced
+    OK).  Global cols must stay below the 0x01000193 row stride for
+    per-element uniqueness — 16.7M, far past any sequence here."""
+    u32 = functools.partial(jnp.asarray, dtype=jnp.uint32)
+    salt = (_u32of(seed) * u32(0x85EBCA6B)
+            ^ _u32of(salt_b) * u32(0xC2B2AE35)
+            ^ _u32of(salt_head) * u32(0x27D4EB2F))
+    r = _u32of(row0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    c = _u32of(col0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    return _keep_from_x(r * u32(0x01000193) + c + salt, rate)
+
+
+def rand_keep_global(shape, seed, rate, batch_offset=0, head_offset=0,
+                     q_offset=0, k_offset=0):
+    """(b, h, sq, sk) version of :func:`_rand_keep_coords` —
+    bit-identical to the dropout partial kernels' masks, for the
+    einsum sequence-parallel paths and for tests reassembling the
+    expected global mask."""
+    u32 = functools.partial(jnp.asarray, dtype=jnp.uint32)
+    bi = _u32of(batch_offset) \
+        + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    hi = _u32of(head_offset) \
+        + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    salt = (_u32of(seed) * u32(0x85EBCA6B)
+            ^ bi * u32(0xC2B2AE35)
+            ^ hi * u32(0x27D4EB2F))
+    r = _u32of(q_offset) + jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+    c = _u32of(k_offset) + jax.lax.broadcasted_iota(jnp.uint32, shape, 3)
+    return _keep_from_x(r * u32(0x01000193) + c + salt, rate)
+
+
 # --- forward ---------------------------------------------------------------
 
 def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
-                       *refs):
+                       *refs, drop=0.0, h=1):
     """Whole-(padded)-sequence-in-one-block forward: plain softmax, no
     online-correction carries (the default 1024 blocks put GPT s=1024
     and BERT s=512 here).  ``has_off``: a leading SMEM ref carries
     [q_offset, k_offset] GLOBAL positions for the causal mask (the
     ring-attention partial — offsets are traced, so the mask compare
-    runs every call; VPU work is hidden behind the MXU)."""
+    runs every call; VPU work is hidden behind the MXU).  ``drop``:
+    after the (optional) off ref an SMEM [seed, head_offset, q_offset,
+    k_offset] ref salts the coordinate-hash keep mask (the SP dropout
+    route; dropout's own offsets are separate from ``has_off`` because
+    non-causal ring blocks drop the causal offsets entirely)."""
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
     else:
         qoff = koff = 0
+    if drop > 0.0:
+        dsalt_ref, *refs = refs
     q_ref, k_ref, v_ref, *rest = refs
     if has_kvm:
         kvm_ref, o_ref, lse_ref = rest
@@ -171,7 +240,17 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
         # instead of a score-shaped select.
         dead = m <= _NEG * 0.5
         l = jnp.where(dead, 0.0, l)
-    acc = _dot(p.astype(v_ref.dtype), v_ref[0])
+    pa = p
+    if drop > 0.0:
+        # l stays undropped (normalization by the true denominator);
+        # only the accumulated values drop — the lse-merge across ring
+        # blocks then reproduces dense in-kernel dropout exactly.
+        bh_i = pl.program_id(0)
+        keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
+                                 dsalt_ref[1] + bh_i % h,
+                                 dsalt_ref[2], dsalt_ref[3], drop)
+        pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+    acc = _dot(pa.astype(v_ref.dtype), v_ref[0])
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o = acc / safe_l
     if guard_dead:
@@ -183,12 +262,14 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
 
 
 def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
-                *refs):
+                *refs, drop=0.0, h=1):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
     else:
         qoff = koff = 0
+    if drop > 0.0:
+        dsalt_ref, *refs = refs
     q_ref, k_ref, v_ref, *rest = refs
     if has_kvm:
         kvm_ref, o_ref, lse_ref, acc, m_sc, l_sc = rest
@@ -238,7 +319,16 @@ def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
             # runs with some rows entirely in the causal future.
             p = jnp.where(mask, p, 0.0)
         l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc[:] = acc[:] * corr + _dot(p.astype(v_ref.dtype), v_ref[0])
+        pa = p
+        if drop > 0.0:
+            # see _fwd_single_kernel: values drop, l does not
+            bh_i = pl.program_id(0)
+            keep = _rand_keep_coords(
+                p.shape, dsalt_ref[0], bh_i // h,
+                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + i * bq,
+                dsalt_ref[3] + j * bk, drop)
+            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+        acc[:] = acc[:] * corr + _dot(pa.astype(v_ref.dtype), v_ref[0])
         m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
         l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
 
@@ -275,7 +365,7 @@ def _kvm8(kv_mask, b, psk, bk):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
-               offsets=None):
+               offsets=None, drop=0.0, dsalt=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
@@ -301,6 +391,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
                                 memory_space=pltpu.VMEM)
         in_specs = [qb_spec, kb_spec, kb_spec]
         operands = [q3, k3, v3]
+        if drop > 0.0:
+            in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+            operands.insert(0, dsalt)
         if has_off:
             in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
             operands.insert(0, offsets)
@@ -311,7 +404,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
             operands.append(_kvm8(kv_mask, b, psk, bk))
         o, lse8 = pl.pallas_call(
             functools.partial(_fwd_single_kernel, scale, a, causal,
-                              has_kvm, has_off, kpad, sq, sk),
+                              has_kvm, has_off, kpad, sq, sk,
+                              drop=drop, h=h),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, lse_spec],
@@ -332,6 +426,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
                             memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     operands = [q3, k3, v3]
+    if drop > 0.0:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, dsalt)
     if has_off:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.insert(0, offsets)
@@ -343,7 +440,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
         operands.append(_kvm8(kv_mask, b, psk, bk))
     o, lse8 = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, a, causal, has_kvm,
-                          has_off, kpad, sq, sk, bq, bk),
+                          has_off, kpad, sq, sk, bq, bk,
+                          drop=drop, h=h),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[q_spec, lse_spec],
@@ -469,12 +567,14 @@ def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
 # no kpad mask — _kvm8 zero-pads, masking pad columns for free.
 
 def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                   bq, bk, *refs):
+                   bq, bk, *refs, drop=0.0, h=1):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
     else:
         qoff = koff = 0
+    if drop > 0.0:
+        dsalt_ref, *refs = refs
     q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dq_ref, dq_acc = rest
@@ -513,6 +613,15 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
         p = jnp.exp2(arg)
         vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
         dp = _dot(do_ref[0], vs, trans_b=True)
+        if drop > 0.0:
+            # regenerate the forward's keep mask from the same global
+            # coordinates; ds = p*(keep*dp/(1-r) - delta)
+            bh_i = pl.program_id(0)
+            keep = _rand_keep_coords(
+                p.shape, dsalt_ref[0], bh_i // h,
+                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + i * bq,
+                dsalt_ref[3] + j * bk, drop)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
         delta = delta_ref[0, 0, 0, :][:, None]
         ds = p * (dp - delta)
         dq_acc[:] += _dot(ds.astype(k.dtype), k)
@@ -523,12 +632,14 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
 
 
 def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                    bq, bk, *refs):
+                    bq, bk, *refs, drop=0.0, h=1):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
     else:
         qoff = koff = 0
+    if drop > 0.0:
+        dsalt_ref, *refs = refs
     q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
@@ -567,9 +678,21 @@ def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
             arg = jnp.where(mask, arg, _NEG)
         p = jnp.exp2(arg)
         do = do_ref[0]
-        dv_acc[:] += _dot_t0(p.astype(do.dtype), do)
+        pa = p
+        if drop > 0.0:
+            # rows are q-block j, cols k-block i on this side — the
+            # coordinate hash makes the orientation swap free
+            bh_i = pl.program_id(0)
+            keep = _rand_keep_coords(
+                p.shape, dsalt_ref[0], bh_i // h,
+                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + j * bq,
+                dsalt_ref[3] + i * bk, drop)
+            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+        dv_acc[:] += _dot_t0(pa.astype(do.dtype), do)
         vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
         dp = _dot(do, vs, trans_b=True)
+        if drop > 0.0:
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
         delta = delta_ref[0, 0, 0, :][:, None]
         ds = p * (dp - delta)                         # (bq, bk)
         dk_acc[:] += _dot_t0(ds.astype(q.dtype), q)
@@ -588,7 +711,7 @@ def _rows8(x2d, bq):
 
 
 def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                      *refs):
+                      *refs, drop=0.0, h=1):
     """Single-block backward: when the whole (padded) sequence fits one
     q-block and one k-block, dq/dk/dv come from ONE pass — the scores
     ``s`` and ``dp`` are computed once instead of once per kernel (the
@@ -600,6 +723,8 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
         qoff, koff = off_ref[0], off_ref[1]
     else:
         qoff = koff = 0
+    if drop > 0.0:
+        dsalt_ref, *refs = refs
     q_ref, k_ref, v_ref, do_ref, lse2_ref, delta_ref, *rest = refs
     if has_kvm:
         kvm_ref, dq_ref, dk_ref, dv_ref = rest
@@ -628,7 +753,15 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     if mask is not None:
         arg = jnp.where(mask, arg, _NEG)
     p = jnp.exp2(arg)
-    dv_ref[0] = _dot_t0(p.astype(do.dtype), do).astype(dv_ref.dtype)
+    pa = p
+    if drop > 0.0:
+        bh_i = pl.program_id(0)
+        keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
+                                 dsalt_ref[1] + bh_i % h,
+                                 dsalt_ref[2], dsalt_ref[3], drop)
+        pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
+    dv_ref[0] = _dot_t0(pa.astype(do.dtype), do).astype(dv_ref.dtype)
     delta = delta_ref[0, 0, 0, :][:, None]
     ds = p * (dp - delta)
     dq_ref[0] = _dot(ds.astype(k.dtype), k).astype(dq_ref.dtype)
@@ -636,7 +769,7 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
-               offsets=None, dlse=None):
+               offsets=None, dlse=None, drop=0.0, dsalt=None):
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -693,6 +826,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
         in_specs = [qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
                     rb_spec]
         operands = [q3, k3, vs3, do3, lse8, delta8]
+        if drop > 0.0:
+            in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+            operands.insert(0, dsalt)
         if has_off:
             in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
             operands.insert(0, offsets)
@@ -703,7 +839,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
             operands.append(kvm)
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, a, scale, causal,
-                              has_kvm, has_off, kpad, sq, sk),
+                              has_kvm, has_off, kpad, sq, sk,
+                              drop=drop, h=h),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, kb_spec, kb_spec],
@@ -726,6 +863,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
                 r_spec_i]
     operands = [q3, k3, vs3, do3, lse8, delta8]
+    if drop > 0.0:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, dsalt)
     if has_off:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.insert(0, offsets)
@@ -737,7 +877,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
         operands.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm,
-                          has_off, kpad, sq, sk, bq, bk),
+                          has_off, kpad, sq, sk, bq, bk,
+                          drop=drop, h=h),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec_i,
@@ -755,6 +896,9 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     in_specs = [q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
                 r_spec_j]
     operands = [q3, k3, vs3, do3, lse8, delta8]
+    if drop > 0.0:
+        in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.insert(0, dsalt)
     if has_off:
         in_specs.insert(0, pl.BlockSpec(memory_space=pltpu.SMEM))
         operands.insert(0, offsets)
@@ -766,7 +910,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
         operands.append(kvm)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm,
-                          has_off, kpad, sq, sk, bq, bk),
+                          has_off, kpad, sq, sk, bq, bk,
+                          drop=drop, h=h),
         grid=(bh, nk, nq),
         in_specs=in_specs,
         out_specs=[k_spec_i, k_spec_i],
@@ -1191,13 +1336,55 @@ _flash_partial_nooff.defvjp(_flash_partial_nooff_vjp_fwd,
                             _flash_partial_nooff_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_partial_drop(q, k, v, offsets, dsalt, scale, causal, drop,
+                        block_q, block_k):
+    """Partial with IN-KERNEL dropout: ``dsalt`` = int32[4] of
+    [seed, head_offset, q_offset, k_offset] salting the coordinate-hash
+    keep mask in GLOBAL positions — ring/Ulysses shards draw
+    non-repeating windows of one global mask, and the lse merge of
+    value-dropped partials reproduces dense in-kernel dropout exactly
+    (l and lse stay undropped; see _fwd_single_kernel)."""
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        offsets=offsets, drop=drop, dsalt=dsalt)
+    return o, lse.reshape(q.shape[0], q.shape[1], -1)
+
+
+def _flash_partial_drop_vjp_fwd(q, k, v, offsets, dsalt, scale, causal,
+                                drop, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        offsets=offsets, drop=drop, dsalt=dsalt)
+    out = (o, lse.reshape(q.shape[0], q.shape[1], -1))
+    return out, (q, k, v, o, lse, offsets, dsalt)
+
+
+def _flash_partial_drop_vjp_bwd(scale, causal, drop, block_q, block_k,
+                                res, cts):
+    q, k, v, o, lse, offsets, dsalt = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd(scale, causal, block_q, block_k,
+                            (q, k, v, o, lse), do, offsets=offsets,
+                            dlse=dlse.reshape(lse.shape), drop=drop,
+                            dsalt=dsalt)
+    return (dq, dk, dv,
+            np.zeros(offsets.shape, dtype=jax.dtypes.float0),
+            np.zeros(dsalt.shape, dtype=jax.dtypes.float0))
+
+
+_flash_partial_drop.defvjp(_flash_partial_drop_vjp_fwd,
+                           _flash_partial_drop_vjp_bwd)
+
+
 def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
                             v: jnp.ndarray,
                             scale: Optional[float] = None,
                             causal: bool = False,
                             q_offset=0, k_offset=0,
                             block_q: int = DEFAULT_BLOCK_Q,
-                            block_k: int = DEFAULT_BLOCK_K):
+                            block_k: int = DEFAULT_BLOCK_K,
+                            dropout_rate: float = 0.0,
+                            dropout_seed=None,
+                            head_offset=0):
     """Blockwise-attention PARTIAL: returns ``(o, lse)`` — the
     softmax-normalized context of q against THIS k/v block plus the
     per-row log-sum-exp — so callers can combine blocks exactly with
@@ -1217,6 +1404,16 @@ def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
     ``shard_map(..., check_vma=False)``, where Pallas calls are legal
     (with ``check_vma=True`` the custom call is rejected by JAX —
     use ``check_vma=False`` on the enclosing shard_map).
+
+    ``dropout_rate`` applies IN-KERNEL attention dropout from a
+    coordinate-hash keep mask in GLOBAL positions: bit-identical to
+    :func:`rand_keep_global` evaluated at (``q_offset``,
+    ``head_offset``, ``k_offset``), so sequence-parallel shards draw
+    non-repeating windows of one global mask and the lse merge of the
+    value-dropped partials equals dense in-kernel dropout exactly.
+    ``dropout_seed``: non-negative int32 (traced OK; same contract as
+    :func:`flash_attention_e`).  ``head_offset``: global index of head
+    0 of this shard (the Ulysses head-sharded case).
     """
     from .._autocast_ctx import autocast_compute_dtype
 
@@ -1226,6 +1423,18 @@ def flash_attention_partial(q: jnp.ndarray, k: jnp.ndarray,
     if act is not None and q.dtype != act \
             and jnp.issubdtype(q.dtype, jnp.floating):
         q, k, v = (x.astype(act) for x in (q, k, v))
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                             jnp.asarray(k_offset, jnp.int32)])
+        dsalt = jnp.stack([jnp.asarray(dropout_seed, jnp.int32),
+                           jnp.asarray(head_offset, jnp.int32),
+                           jnp.asarray(q_offset, jnp.int32),
+                           jnp.asarray(k_offset, jnp.int32)])
+        return _flash_partial_drop(q, k, v, offsets, dsalt, scale,
+                                   causal, float(dropout_rate),
+                                   block_q, block_k)
     # static-zero offsets (e.g. Ulysses' plain full-sequence causal
     # local attention) take the static-mask kernels — the dynamic
     # SMEM-offset masks cost ~10% kernel time (ROUND3_NOTES)
@@ -1265,8 +1474,11 @@ _E_MAX_SEQ = 1024
 # _E_MAX_SEQ (one VMEM block) stream (bs, bs) tiles with online softmax
 # instead of falling back to the transposing path (the fallback re-pays
 # the ~14-16 ms/step of (b,h,s,d) relayout glue the E layout exists to
-# kill).  The cap bounds the lse/delta sideband arrays, not VMEM.
-_E_MAX_SEQ_BLOCKED = _env_block("APEX_TPU_FLASH_E_MAX_SEQ", 8192,
+# kill).  The cap bounds the lse/delta sideband arrays, not VMEM —
+# at s=32768/h=16 the (b, h, 8, ps) fp32 sidebands are 64 MB of HBM
+# per batch row, a sane ceiling; the walk itself is shape-generic
+# (hardware-verified blocked parity at s=16384 for d in {64, 128}).
+_E_MAX_SEQ_BLOCKED = _env_block("APEX_TPU_FLASH_E_MAX_SEQ", 32768,
                                 lo=128, hi=1 << 20)
 _E_BLOCK = _env_block("APEX_TPU_FLASH_E_BLOCK", 512, lo=128)
 if _E_BLOCK % 128:
@@ -1596,6 +1808,12 @@ def _flash_fwd_e_blocked(qkv_e, h, scale, causal, kv_mask=None,
         hg = _pick_heads_per_group_blocked(h, d, 1024)
     else:
         hg = _pick_heads_per_group_blocked(h, d, bs, drop=drop > 0.0)
+    if hg is None:
+        raise ValueError(
+            f"blocked E-layout kernel cannot run h={h} d={d} bs={bs} "
+            f"(no head grouping with 3*hg*d lanes % 128 == 0 inside "
+            f"the VMEM budget); route through flash_attention_e, which "
+            f"checks _e_mode and falls back")
     g = h // hg
     qkv3 = _pad_to(qkv_e, 1, bs)
     ps = qkv3.shape[1]
@@ -1918,6 +2136,12 @@ def _flash_bwd_e_blocked(h, scale, causal, res, do, kv_mask=None,
     o3 = _pad_to(o3, 1, bs)
     ps = qkv3.shape[1]
     hg = _pick_heads_per_group_blocked(h, d, bs, drop=drop > 0.0)
+    if hg is None:
+        raise ValueError(
+            f"blocked E-layout backward cannot run h={h} d={d} bs={bs} "
+            f"(no head grouping with 3*hg*d lanes % 128 == 0 inside "
+            f"the VMEM budget); route through flash_attention_e, which "
+            f"checks _e_mode and falls back")
     g = h // hg
     nb = ps // bs
     a = scale * _LOG2E
@@ -2099,7 +2323,7 @@ def flash_attention_e(qkv: jnp.ndarray,
 
     Eligibility (:func:`flash_e_supported`): 128-aligned-padded
     s <= 1024 runs whole-sequence blocks; longer sequences (up to
-    ``APEX_TPU_FLASH_E_MAX_SEQ``, default 8192) stream (bs, bs) tiles
+    ``APEX_TPU_FLASH_E_MAX_SEQ``, default 32768) stream (bs, bs) tiles
     with online softmax — both keep the zero-relayout property.
     Remaining fallbacks (head/lane-budget misfits, very long s, manual
     shard_map axes) log their reason once and take the transposing
@@ -2110,6 +2334,12 @@ def flash_attention_e(qkv: jnp.ndarray,
     apex/contrib/csrc/multihead_attn/dropout.h): the backward
     regenerates the forward's keep mask from ``dropout_seed`` (an int32
     scalar, traced OK) instead of materializing O(s^2) mask bits.
+
+    ``dropout_seed`` contract: NON-NEGATIVE int32.  The counter hash
+    folds the seed through a 31-bit mask (Mosaic-safe uint32 view), so
+    a negative seed silently aliases the mask of ``seed & 0x7FFFFFFF``.
+    :func:`dropout_seed_from_key` — the canonical derivation — only
+    produces non-negative seeds; hand-built seeds must do the same.
     """
     from ._context import in_manual_axis_context
     from .._autocast_ctx import autocast_compute_dtype

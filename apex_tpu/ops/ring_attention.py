@@ -38,16 +38,30 @@ def flash_legal_here(*operands) -> bool:
     under ``check_vma=False``."""
     for x in operands:
         try:
-            if jax.typeof(x).vma:
-                return False
+            vma = getattr(jax.typeof(x), "vma", None)
         except (AttributeError, TypeError):
-            continue
+            # jax.typeof itself absent (older JAX) or operand untypable
+            return False
+        if vma is None:
+            # VMA types unavailable (older JAX): we cannot PROVE the
+            # Pallas call is legal here, so fail safe to the einsum
+            # path — a slow correct fallback beats a hard trace error.
+            return False
+        if vma:
+            return False
     return True
 
 
-def _block_attend(q, k, v, scale, qpos, kpos, causal):
+def _block_attend(q, k, v, scale, qpos, kpos, causal, drop=0.0,
+                  seed=None, q_off=0, k_off=0, head_off=0):
     """One blockwise partial: returns (m, l, acc) for local q against
-    this k/v block, with causal masking by GLOBAL positions."""
+    this k/v block, with causal masking by GLOBAL positions.  ``drop``
+    applies the coordinate-hash keep mask (bit-identical to the flash
+    kernels' — :func:`..flash_attention.rand_keep_global` at global
+    offsets ``q_off``/``k_off``/``head_off``) to the VALUE accumulation
+    only; ``l`` stays undropped so the cross-block merge normalizes by
+    the true softmax denominator, exactly like dense in-kernel
+    dropout."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -58,7 +72,14 @@ def _block_attend(q, k, v, scale, qpos, kpos, causal):
     # fully-masked rows: m = _NEG -> p rows would be exp(0)=1; zero them
     p = jnp.where((m > _NEG / 2)[..., None], p, 0.0)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+    pa = p
+    if drop > 0.0:
+        from .flash_attention import rand_keep_global
+
+        keep = rand_keep_global(s.shape, seed, drop, q_offset=q_off,
+                                k_offset=k_off, head_offset=head_off)
+        pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+    acc = jnp.einsum("bhqk,bhkd->bhqd", pa.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return m, l, acc
 
@@ -67,7 +88,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    axis_name: str,
                    scale: Optional[float] = None,
                    causal: bool = False,
-                   use_flash: Optional[bool] = None) -> jnp.ndarray:
+                   use_flash: Optional[bool] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_seed=None) -> jnp.ndarray:
     """Exact attention with K/V rotating around ``axis_name``.
 
     Shapes (per shard): q, k, v are (b, h, s_local, d); the global
@@ -89,15 +112,29 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     softmax.  Same math either way; causal blocks wholly in the future
     still run their (masked) matmuls in both modes — the merge
     annihilates them.
+
+    ``dropout_rate`` applies attention dropout with GLOBAL-position
+    keep masks (the round-4 in-kernel dropout, threaded through SP):
+    shard r draws rows [r*s_local, ...) and rotated-block columns of
+    ONE global mask — bit-identical in both modes and equal to a dense
+    evaluation of :func:`..flash_attention.rand_keep_global` — so
+    long-context SP training configs get the same dropout semantics as
+    the single-chip kernels.  ``dropout_seed``: non-negative int32
+    (see :func:`..flash_attention.dropout_seed_from_key`).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     if use_flash is None:
         use_flash = flash_legal_here(q, k, v)
     nshards = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     s_local = q.shape[-2]
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
+    drop_kw = (dict(dropout_rate=dropout_rate,
+                    dropout_seed=dropout_seed)
+               if dropout_rate > 0.0 else {})
 
     if use_flash:
         from .flash_attention import flash_attention_partial
@@ -111,7 +148,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             src = (rank - i) % nshards
             bo, blse = flash_attention_partial(
                 q, kk, vv, scale=scale, causal=causal,
-                q_offset=qoff, k_offset=src * s_local)
+                q_offset=qoff, k_offset=src * s_local, **drop_kw)
             lse_new = jnp.logaddexp(lse, blse)
             o = (o * jnp.exp(lse - lse_new)[..., None]
                  + bo.astype(o.dtype) * jnp.exp(blse - lse_new)[..., None])
@@ -119,7 +156,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
         o0, lse0 = flash_attention_partial(
             q, k, v, scale=scale, causal=causal,
-            q_offset=qoff, k_offset=qoff)
+            q_offset=qoff, k_offset=qoff, **drop_kw)
         if nshards > 1:
             (_, _, o, _), _ = jax.lax.scan(
                 fstep, (k, v, o0.astype(jnp.float32), lse0),
@@ -150,12 +187,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         src = (rank - i) % nshards
         kpos = src * s_local + jnp.arange(s_local)
         bm, bl, bacc = _block_attend(q, kk, vv, scale, qpos, kpos,
-                                     causal)
+                                     causal, drop=dropout_rate,
+                                     seed=dropout_seed,
+                                     q_off=rank * s_local,
+                                     k_off=src * s_local)
         m, l, acc = merge(m, l, acc, bm, bl, bacc)
         return (kk, vv, m, l, acc), None
 
     # step 0: the local block, no hop
-    m0, l0, acc0 = _block_attend(q, k, v, scale, qpos, qpos, causal)
+    m0, l0, acc0 = _block_attend(q, k, v, scale, qpos, qpos, causal,
+                                 drop=dropout_rate, seed=dropout_seed,
+                                 q_off=rank * s_local,
+                                 k_off=rank * s_local)
     if nshards > 1:
         (_, _, m, l, acc), _ = jax.lax.scan(
             step, (k, v, m0, l0, acc0), jnp.arange(1, nshards))
@@ -170,7 +213,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       scale: Optional[float] = None,
                       causal: bool = False,
                       attention_fn=None,
-                      use_flash: Optional[bool] = None) -> jnp.ndarray:
+                      use_flash: Optional[bool] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_seed=None) -> jnp.ndarray:
     """DeepSpeed-Ulysses style sequence parallelism: all-to-all swaps
     the sharded axis from SEQUENCE to HEADS, runs full-sequence
     attention locally on a head subset, and swaps back.
@@ -187,11 +232,20 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     :func:`flash_legal_here`); under ``check_vma=True`` the local core
     is ``flash_attention``'s XLA reference fallback.  ``True`` asserts
     the kernel, ``False`` forces the fallback core.
+
+    ``dropout_rate``: attention dropout with the SAME global
+    coordinate-hash mask as :func:`ring_attention` — here the shard
+    owns a HEAD subset of the full sequence, so the mask window is
+    selected by ``head_offset = rank * h_local`` instead of sequence
+    offsets.  A fixed seed draws identical global masks in ring and
+    Ulysses mode.
     """
     nshards = jax.lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
     assert h % nshards == 0, (
         f"heads {h} not divisible by axis size {nshards}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     if use_flash is None:
         use_flash = flash_legal_here(q, k, v)
 
@@ -206,6 +260,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                   concat_axis=1, tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    head_off = jax.lax.axis_index(axis_name) * (h // nshards)
     if attention_fn is None:
         if use_flash:
             # bypass flash_attention's manual-axis fallback: the Pallas
@@ -213,8 +268,28 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             from .flash_attention import flash_attention_partial
 
             def attention_fn(q, k, v, scale=None, causal=False):
+                kw = (dict(dropout_rate=dropout_rate,
+                           dropout_seed=dropout_seed,
+                           head_offset=head_off)
+                      if dropout_rate > 0.0 else {})
                 return flash_attention_partial(q, k, v, scale=scale,
-                                               causal=causal)[0]
+                                               causal=causal, **kw)[0]
+        elif dropout_rate > 0.0:
+            # einsum core with the same global coordinate-hash mask
+            # (the check_vma=True context, e.g. the CPU-mesh dryrun);
+            # head_off selects this shard's window of the global mask.
+            # Reuses the ring path's _block_attend (whole sequence as
+            # one block) so the attention/dropout math lives once.
+            def attention_fn(q, k, v, scale=None, causal=False):
+                if scale is None:
+                    scale = q.shape[-1] ** -0.5
+                pos = jnp.arange(q.shape[-2])
+                _, l, acc = _block_attend(
+                    q, k, v, scale, pos, pos, causal,
+                    drop=dropout_rate, seed=dropout_seed,
+                    head_off=head_off)
+                out = acc / jnp.maximum(l, 1e-30)[..., None]
+                return out.astype(q.dtype)
         else:
             from .flash_attention import flash_attention as attention_fn
     out = attention_fn(qh, kh, vh, scale=scale, causal=causal)

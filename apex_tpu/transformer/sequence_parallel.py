@@ -69,13 +69,19 @@ def reduce_scatter_to_sequence_parallel_region(
 def ring_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                         scale: Optional[float] = None,
                         causal: bool = False,
-                        use_flash: Optional[bool] = None):
+                        use_flash: Optional[bool] = None,
+                        dropout_rate: float = 0.0,
+                        dropout_seed=None):
     """Exact self-attention with q/k/v sequence-sharded over
     ``axis_name`` (b, h, s_local, d per shard).  ``use_flash=True``
     runs each ring block through the Pallas flash partial — requires
-    the enclosing ``shard_map`` to pass ``check_vma=False``."""
+    the enclosing ``shard_map`` to pass ``check_vma=False``.
+    ``dropout_rate``/``dropout_seed``: global-mask attention dropout
+    (see :func:`apex_tpu.ops.ring_attention.ring_attention`)."""
     return ring_attention(q, k, v, axis_name, scale=scale, causal=causal,
-                          use_flash=use_flash)
+                          use_flash=use_flash,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
 
 
 class SequenceParallelSelfAttention:
@@ -97,7 +103,8 @@ class SequenceParallelSelfAttention:
     def __init__(self, hidden_size: int, num_attention_heads: int,
                  causal: bool = True, mode: str = "ring",
                  axis_name: Optional[str] = SEQUENCE_AXIS,
-                 use_flash: Optional[bool] = None):
+                 use_flash: Optional[bool] = None,
+                 attention_dropout: float = 0.0):
         assert hidden_size % num_attention_heads == 0
         assert mode in ("ring", "ulysses")
         self.hidden_size = hidden_size
@@ -109,6 +116,7 @@ class SequenceParallelSelfAttention:
         # Pallas cores per shard: legal only under
         # shard_map(check_vma=False) — the caller owns that choice
         self.use_flash = use_flash
+        self.attention_dropout = attention_dropout
 
     def init(self, key) -> dict:
         k1, k2 = jax.random.split(key)
@@ -123,9 +131,12 @@ class SequenceParallelSelfAttention:
             "out_bias": jnp.zeros((h,), jnp.float32),
         }
 
-    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    def apply(self, params: dict, x: jnp.ndarray,
+              dropout_seed=None) -> jnp.ndarray:
         b, s_local, h = x.shape
         nh, d = self.num_heads, self.head_dim
+        rate = self.attention_dropout if dropout_seed is not None \
+            else 0.0
         qkv = x @ params["qkv_kernel"] + params["qkv_bias"]
         qkv = qkv.reshape(b, s_local, 3, nh, d)
         # (b, nh, s_local, d)
@@ -137,15 +148,21 @@ class SequenceParallelSelfAttention:
             # tests)
             from ..ops.flash_attention import mha_reference
 
+            assert rate == 0.0, (
+                "dense reference path has no dropout; use an SP mode")
             ctx = mha_reference(q, k, v, causal=self.causal)
         elif self.mode == "ring":
             ctx = ring_attention(q, k, v, self.axis_name,
                                  causal=self.causal,
-                                 use_flash=self.use_flash)
+                                 use_flash=self.use_flash,
+                                 dropout_rate=rate,
+                                 dropout_seed=dropout_seed)
         else:
             ctx = ulysses_attention(q, k, v, self.axis_name,
                                     causal=self.causal,
-                                    use_flash=self.use_flash)
+                                    use_flash=self.use_flash,
+                                    dropout_rate=rate,
+                                    dropout_seed=dropout_seed)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s_local, h)
         return ctx @ params["out_kernel"] + params["out_bias"]
 
@@ -153,9 +170,13 @@ class SequenceParallelSelfAttention:
 def ulysses_self_attention(q, k, v, axis_name: str = SEQUENCE_AXIS,
                            scale: Optional[float] = None,
                            causal: bool = False,
-                           use_flash: Optional[bool] = None):
+                           use_flash: Optional[bool] = None,
+                           dropout_rate: float = 0.0,
+                           dropout_seed=None):
     return ulysses_attention(q, k, v, axis_name, scale=scale,
-                             causal=causal, use_flash=use_flash)
+                             causal=causal, use_flash=use_flash,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)
 
 
 class SequenceParallelTransformerLayer:
@@ -177,13 +198,15 @@ class SequenceParallelTransformerLayer:
                  causal: bool = True, mode: str = "ring",
                  layernorm_epsilon: float = 1e-5,
                  axis_name: Optional[str] = SEQUENCE_AXIS,
-                 use_flash: Optional[bool] = None):
+                 use_flash: Optional[bool] = None,
+                 attention_dropout: float = 0.0):
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.eps = layernorm_epsilon
         self.attn = SequenceParallelSelfAttention(
             hidden_size, num_attention_heads, causal=causal, mode=mode,
-            axis_name=axis_name, use_flash=use_flash)
+            axis_name=axis_name, use_flash=use_flash,
+            attention_dropout=attention_dropout)
 
     def init(self, key) -> dict:
         h, f = self.hidden_size, self.ffn_hidden_size
@@ -202,7 +225,8 @@ class SequenceParallelTransformerLayer:
             "mlp_bo": jnp.zeros((h,), jnp.float32),
         }
 
-    def apply(self, params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    def apply(self, params: dict, x: jnp.ndarray,
+              dropout_seed=None) -> jnp.ndarray:
         from ..ops.layer_norm import layer_norm
 
         # layer_norm returns x.dtype (fp32 internal math); both residual
@@ -210,7 +234,9 @@ class SequenceParallelTransformerLayer:
         # ParallelTransformerLayer convention, layers.py).
         h = layer_norm(x, params["ln1_weight"], params["ln1_bias"],
                        eps=self.eps)
-        x = x + self.attn.apply(params["attention"], h).astype(x.dtype)
+        x = x + self.attn.apply(params["attention"], h,
+                                dropout_seed=dropout_seed
+                                ).astype(x.dtype)
         h = layer_norm(x, params["ln2_weight"], params["ln2_bias"],
                        eps=self.eps)
         m = jax.nn.gelu(h @ params["mlp_wi"] + params["mlp_bi"])

@@ -364,6 +364,21 @@ class TestELayout:
                                                   flash_e_supported)
         assert not flash_e_supported(_E_MAX_SEQ_BLOCKED + 128, 4, 64)
 
+    def test_e_mode_routes_s16384_blocked(self):
+        """Round-5: the blocked walk owns s=16384 for BOTH head dims —
+        no transposing-path fallback on the framework's scaling axis.
+        (Numeric parity at this length is hardware-verified:
+        tools/hw_checks/flash_e_s16384.py, grad maxabs diff <= 2e-3 in
+        bf16 vs the independently-implemented transposing kernels.)"""
+        from apex_tpu.ops.flash_attention import _e_mode
+        for h, d in ((16, 64), (16, 128), (8, 64), (8, 128)):
+            mode, hg = _e_mode(16384, h, d)
+            assert mode == "blocked", (h, d, mode, hg)
+            assert h % hg == 0 and (3 * hg * d) % 128 == 0
+            # dropout configs stay eligible too (halved temp budget)
+            mode_d, _ = _e_mode(16384, h, d, drop=True)
+            assert mode_d == "blocked", (h, d, mode_d)
+
     def test_grouping_helper(self):
         from apex_tpu.ops.flash_attention import _pick_heads_per_group
         assert _pick_heads_per_group(16, 64, 1024) == 4  # 3*4*64 = 768

@@ -61,6 +61,8 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
+
+    @pytest.mark.slow
     def test_gradients_match_dense(self):
         mesh = seq_mesh()
         q, k, v = _qkv(1)
@@ -152,6 +154,7 @@ class TestSPRegionMappings:
 
 
 class TestUlyssesGradients:
+    @pytest.mark.slow
     def test_gradients_match_dense(self):
         mesh = seq_mesh()
         q, k, v = _qkv(5)
@@ -198,6 +201,8 @@ class TestSequenceParallelSelfAttention:
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    rtol=3e-4, atol=3e-5)
 
+
+    @pytest.mark.slow
     def test_trains_sequence_parallel(self):
         from apex_tpu.transformer.sequence_parallel import (
             SequenceParallelSelfAttention)
@@ -275,6 +280,8 @@ class TestSequenceParallelGPTEndToEnd:
         return lse - jnp.take_along_axis(
             lf, labels[..., None], axis=-1)[..., 0]
 
+
+    @pytest.mark.slow
     def test_sp_gpt_loss_and_grads_match_dense(self):
         mesh = seq_mesh()
         params, dense_layers, sp_layers, HID = self._params(
@@ -319,6 +326,8 @@ class TestSequenceParallelGPTEndToEnd:
         y = layer.apply(params, x)
         assert y.dtype == jnp.bfloat16
 
+
+    @pytest.mark.slow
     def test_sp_gpt_trains(self):
         from apex_tpu.optimizers import fused_adam
 
@@ -381,6 +390,8 @@ class TestFlashRing:
         np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+
+    @pytest.mark.slow
     def test_ring_gradients_match_dense(self):
         mesh = seq_mesh()
         q, k, v = _qkv(seed=5)
@@ -536,3 +547,115 @@ class TestAutoFlash:
 
     def test_einsum_when_vma_checked(self):
         assert self._count_flash_calls(check_vma=True) == 0
+
+
+class TestSPDropout:
+    """Round-5: attention dropout through the SP paths.  Ring and
+    Ulysses shards draw disjoint windows of ONE global coordinate-hash
+    keep mask (``rand_keep_global``), so a dense evaluation with that
+    exact mask is a bit-level reference for BOTH modes, and the two
+    modes must agree with each other at a fixed seed."""
+
+    RATE, SEED = 0.3, 123
+
+    @classmethod
+    def _dense_drop(cls, q, k, v, causal):
+        from apex_tpu.ops.flash_attention import rand_keep_global
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        if causal:
+            tri = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(tri[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        keep = rand_keep_global(s.shape, cls.SEED, cls.RATE)
+        pd = jnp.where(keep, p, 0.0) / (1.0 - cls.RATE)
+        return jnp.einsum("bhqk,bhkd->bhqd", pd, v)
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_mask(self, mode, causal):
+        mesh = seq_mesh()
+        q, k, v = _qkv(7)
+        fn = ring_self_attention if mode == "ring" \
+            else ulysses_self_attention
+        out = _run_sharded(
+            functools.partial(fn, causal=causal, dropout_rate=self.RATE,
+                              dropout_seed=self.SEED), q, k, v, mesh)
+        want = self._dense_drop(q, k, v, causal)
+        # tolerance: the 1/(1-rate)-scaled probabilities ride
+        # bf16-truncating matmuls on both sides in different
+        # formulations; a mask flip would show as an O(0.1) error
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_ring_equals_ulysses_at_fixed_seed(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(8)
+        outs = [
+            _run_sharded(functools.partial(
+                fn, causal=True, dropout_rate=self.RATE,
+                dropout_seed=self.SEED), q, k, v, mesh)
+            for fn in (ring_self_attention, ulysses_self_attention)]
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.asarray(outs[1]),
+                                   rtol=5e-3, atol=5e-3)
+
+
+    @pytest.mark.slow
+    def test_ring_gradients_match_dense_mask(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(9)
+
+        def ring_loss(q, k, v):
+            out = _run_sharded(functools.partial(
+                ring_self_attention, causal=True,
+                dropout_rate=self.RATE, dropout_seed=self.SEED),
+                q, k, v, mesh)
+            return jnp.sum(out ** 2)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(self._dense_drop(q, k, v, True) ** 2)
+
+        gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-2, atol=1e-2)
+
+    def test_flash_partial_ring_dropout(self):
+        """check_vma=False routes the Pallas dropout partial (interpret
+        mode here) — must equal the dense global mask too."""
+        from apex_tpu.ops import ring_attention as ra
+
+        mesh = seq_mesh()
+        q, k, v = _qkv(10)
+        out = jax.jit(jax.shard_map(
+            lambda q, k, v: ra.ring_attention(
+                q, k, v, "sequence", causal=True,
+                dropout_rate=self.RATE, dropout_seed=self.SEED),
+            mesh=mesh, in_specs=(P(None, None, "sequence"),) * 3,
+            out_specs=P(None, None, "sequence"),
+            check_vma=False))(q, k, v)
+        want = self._dense_drop(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=5e-3, atol=5e-3)
+
+    def test_determinism_and_seed_sensitivity(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(11)
+
+        def run(seed):
+            return _run_sharded(functools.partial(
+                ring_self_attention, causal=True, dropout_rate=0.5,
+                dropout_seed=seed), q, k, v, mesh)
+
+        o1, o2, o3 = run(3), run(3), run(4)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 0
+
+    def test_seed_required(self):
+        mesh = seq_mesh()
+        q, k, v = _qkv(12)
+        with pytest.raises(ValueError, match="dropout_seed"):
+            _run_sharded(functools.partial(
+                ring_self_attention, dropout_rate=0.1), q, k, v, mesh)
