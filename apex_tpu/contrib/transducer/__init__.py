@@ -2,13 +2,17 @@
 from .transducer import (
     TransducerJoint,
     TransducerLoss,
+    pack_joint_output,
     transducer_joint,
     transducer_loss,
+    unpack_loss_input,
 )
 
 __all__ = [
     "TransducerJoint",
     "TransducerLoss",
+    "pack_joint_output",
     "transducer_joint",
     "transducer_loss",
+    "unpack_loss_input",
 ]

@@ -18,10 +18,16 @@ Parity surface for ``apex/contrib/transducer/transducer.py:1-195``
   kernel; ``fuse_softmax_backward`` is accepted for parity — XLA fuses
   the log-softmax backward on its own).
 
-Packed (ragged) input/output layouts are a GPU memory optimization built
-on dynamic shapes; under XLA's static-shape model the equivalent is the
-padded layout with length masking used here, so ``pack_output`` /
-``packed_input`` raise ``NotImplementedError`` with this rationale.
+Packed (ragged) layouts (ref: transducer.py:51-63 joint ``pack_output``,
+:99-116 loss ``packed_input``): the reference's CUDA kernels consume the
+ragged buffer natively; under XLA's static-shape model the packed buffer
+is a STATIC-length (packed_batch, ...) array and conversion is gather /
+scatter index arithmetic (:func:`pack_joint_output` /
+:func:`unpack_loss_input`).  ``TransducerJoint(pack_output=True)`` and
+``TransducerLoss(packed_input=True)`` accept the reference's packed
+tensors and ``batch_offset`` convention (cumsum of per-batch row
+counts, t-major rows within a batch) — capability parity; the compute
+itself runs the padded wavefront.
 """
 from __future__ import annotations
 
@@ -121,48 +127,107 @@ def transducer_loss(x: jnp.ndarray, label: jnp.ndarray,
     return -(a_T + pb_T)
 
 
+def pack_joint_output(out: jnp.ndarray, f_len: jnp.ndarray,
+                      g_len: jnp.ndarray, batch_offset: jnp.ndarray,
+                      packed_batch: int) -> jnp.ndarray:
+    """Padded joint output (B, T, U, H) -> the reference's packed
+    layout (packed_batch, H) (ref: transducer.py:51-63): batch b's
+    valid (t, u) pairs occupy rows [batch_offset[b-1], batch_offset[b])
+    in t-major order (row = offset_b + t * g_len[b] + u), with
+    ``batch_offset = cumsum(f_len * g_len)``.  ``packed_batch`` is the
+    STATIC buffer length (>= batch_offset[-1]); tail rows are zero."""
+    B, T, U, H = out.shape
+    p = jnp.arange(packed_batch)
+    b = jnp.clip(jnp.searchsorted(batch_offset, p, side="right"),
+                 0, B - 1)
+    start = jnp.where(b > 0, batch_offset[jnp.maximum(b - 1, 0)], 0)
+    r = p - start
+    g = jnp.maximum(g_len[b], 1)
+    t = jnp.clip(r // g, 0, T - 1)
+    u = jnp.clip(r % g, 0, U - 1)
+    valid = p < batch_offset[B - 1]
+    return jnp.where(valid[:, None], out[b, t, u], 0)
+
+
+def unpack_loss_input(x_packed: jnp.ndarray, f_len: jnp.ndarray,
+                      g_len: jnp.ndarray, batch_offset: jnp.ndarray,
+                      max_f_len: int, U: int) -> jnp.ndarray:
+    """The reference's packed loss input (N, V) -> padded (B, T, U, V)
+    (ref: transducer.py:99-116; ``batch_offset = cumsum(f_len *
+    (y_len + 1))``, t-major rows).  Invalid (padding) positions come
+    back 0 — the wavefront only reads t < f_len, u <= y_len, which is
+    exactly the packed region."""
+    N, V = x_packed.shape
+    B = f_len.shape[0]
+    T = max_f_len
+    start = jnp.concatenate([jnp.zeros((1,), batch_offset.dtype),
+                             batch_offset[:-1]])
+    t_ar = jnp.arange(T)[None, :, None]
+    u_ar = jnp.arange(U)[None, None, :]
+    idx = start[:, None, None] + t_ar * g_len[:, None, None] + u_ar
+    valid = (t_ar < f_len[:, None, None]) \
+        & (u_ar < g_len[:, None, None])
+    vals = x_packed[jnp.clip(idx, 0, N - 1)]
+    return jnp.where(valid[..., None], vals, 0.0)
+
+
 class TransducerJoint:
-    """Module wrapper (ref: transducer.py:5-66).  ``pack_output`` is a
-    dynamic-shape GPU memory optimization; the XLA equivalent is the
-    masked padded layout (see module docstring), so packing raises."""
+    """Module wrapper (ref: transducer.py:5-66).  ``pack_output=True``
+    emits the reference's packed (packed_batch, H) layout via
+    :func:`pack_joint_output` (static-length buffer; the ragged CUDA
+    kernel's role is played by gather index arithmetic)."""
 
     def __init__(self, pack_output: bool = False, relu: bool = False,
                  dropout: bool = False, opt: int = 1,
                  fwd_tile_size: int = 4, dropout_prob: float = 0.0,
                  probe_mask: bool = False):
-        if pack_output:
-            raise NotImplementedError(
-                "pack_output builds ragged batches via dynamic shapes; "
-                "XLA requires static shapes — use the padded layout with "
-                "f_len/g_len masking (capability-equivalent)")
         del opt, fwd_tile_size, probe_mask  # GPU tiling knobs
+        self.pack_output = pack_output
         self.relu = relu
         self.dropout = dropout
         self.dropout_prob = dropout_prob
 
     def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
                  packed_batch=0, rng=None, is_training=True):
-        del batch_offset, packed_batch
-        return transducer_joint(
+        out = transducer_joint(
             f, g, f_len, g_len, relu=self.relu,
             dropout_prob=self.dropout_prob if self.dropout else 0.0,
             rng=rng, is_training=is_training)
+        if self.pack_output:
+            if batch_offset is None or packed_batch == 0:
+                # the reference's exact contract (transducer.py:60-61)
+                raise ValueError(
+                    "Please specify batch_offset and packed_batch when "
+                    "packing is enabled")
+            if f_len is None or g_len is None:
+                raise ValueError("pack_output requires f_len and g_len")
+            return pack_joint_output(out, f_len, g_len, batch_offset,
+                                     int(packed_batch))
+        return out
 
 
 class TransducerLoss:
-    """Module wrapper (ref: transducer.py:68-126)."""
+    """Module wrapper (ref: transducer.py:68-126).  ``packed_input=True``
+    accepts the reference's packed (N, V) logits + ``batch_offset`` +
+    ``max_f_len`` and unpacks to the padded wavefront layout via
+    :func:`unpack_loss_input`."""
 
     def __init__(self, fuse_softmax_backward: bool = True, opt: int = 1,
                  packed_input: bool = False):
-        if packed_input:
-            raise NotImplementedError(
-                "packed_input requires dynamic shapes; use the padded "
-                "layout with f_len/y_len (capability-equivalent)")
         del fuse_softmax_backward, opt  # XLA fuses; level n/a
+        self.packed_input = packed_input
 
     def __call__(self, x, label, f_len, y_len, blank_idx: int = 0,
                  batch_offset=None, max_f_len=None, debug_list=None):
-        del batch_offset, max_f_len
+        if self.packed_input:
+            if batch_offset is None or max_f_len is None:
+                # the reference's exact contract (transducer.py:114-116)
+                raise ValueError(
+                    "Please specify batch_offset and max_f_len when "
+                    "packing is enabled")
+            U = label.shape[1] + 1
+            x = unpack_loss_input(x, f_len, y_len + 1, batch_offset,
+                                  int(max_f_len), U)
         if debug_list is not None:
             # parity hook: expose the alpha lattice for debugging
             debug_list.append(_alphas_for_debug(x, label, f_len, y_len,

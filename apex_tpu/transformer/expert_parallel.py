@@ -53,7 +53,8 @@ class Top2RouterOutput(NamedTuple):
 
 
 def top2_router(logits: jnp.ndarray,
-                second_policy: str = "all") -> Top2RouterOutput:
+                second_policy: str = "all",
+                rng: Optional[jax.Array] = None) -> Top2RouterOutput:
     """Top-2 gating with the GShard algebra the module docstring cites
     (Lepikhin et al. 2020, eq. for Algorithm 1): each token routes to
     its two highest-probability experts, gates renormalized over the
@@ -61,14 +62,22 @@ def top2_router(logits: jnp.ndarray,
     (the differentiable load estimator, GShard l_aux).
 
     ``second_policy``: ``"all"`` always keeps the second expert;
-    ``"random"`` keeps it with probability ``2 * gate2`` (the GShard
-    dispatch-saving trick) — deterministic policy "all" is the default
-    (no RNG threading; capacity still bounds overflow).
+    ``"random"`` keeps it with probability ``min(1, 2 * gate2)`` (the
+    GShard Algorithm-1 dispatch-saving trick: confident-second tokens
+    always dispatch, marginal ones dispatch proportionally, and E[kept
+    dispatches] halves at the uniform-gate worst case).  ``rng`` is
+    required for "random" — the draw is a pure function of the key, so
+    the policy stays deterministic per key.  A dropped second choice
+    carries gate 0, which :func:`moe_dispatch_combine` treats as
+    "do not dispatch": it claims NO capacity slot (the saving) and
+    contributes nothing to the combine.
     """
-    if second_policy not in ("all",):
-        raise NotImplementedError(
-            "second_policy='random' needs an rng; the deterministic "
-            "'all' policy ships (capacity still bounds load)")
+    if second_policy not in ("all", "random"):
+        raise ValueError(
+            f"second_policy must be 'all'|'random', got "
+            f"{second_policy!r}")
+    if second_policy == "random" and rng is None:
+        raise ValueError("second_policy='random' requires rng")
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     num_experts = logits.shape[-1]
     idx1 = jnp.argmax(probs, axis=-1)
@@ -84,26 +93,39 @@ def top2_router(logits: jnp.ndarray,
         jax.nn.one_hot(idx1, num_experts, dtype=jnp.float32), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = num_experts * jnp.sum(frac * mean_prob)
+    g1n, g2n = gate1 / denom, gate2 / denom
+    if second_policy == "random":
+        u = jax.random.uniform(rng, g2n.shape)
+        # stop_gradient on the threshold: the Bernoulli draw is not a
+        # differentiable path (GShard treats it as a dispatch decision,
+        # not a gate transformation)
+        keep2 = u < jax.lax.stop_gradient(2.0 * g2n)
+        g2n = jnp.where(keep2, g2n, 0.0)
     return Top2RouterOutput(
         jnp.stack([idx1, idx2]).astype(jnp.int32),
-        jnp.stack([gate1 / denom, gate2 / denom]), aux)
+        jnp.stack([g1n, g2n]), aux)
 
 
 def _dispatch_indices(expert_index: jnp.ndarray, num_experts: int,
-                      capacity: int):
+                      capacity: int, valid=None):
     """Position of each token within its expert's capacity slots.
 
     Returns ``(slot, keep)``: slot in [0, capacity) and a keep mask
-    (False = dropped by overflow).  Pure cumsum arithmetic — no sorting,
-    no dynamic shapes.
+    (False = dropped by overflow or invalid).  Pure cumsum arithmetic —
+    no sorting, no dynamic shapes.  ``valid`` (bool (T,)) marks entries
+    that should not dispatch at all (e.g. second choices dropped by the
+    GShard "random" policy): they claim NO slot — later entries slide
+    into the freed capacity — and come back keep=False.
     """
     one_hot = jax.nn.one_hot(expert_index, num_experts, dtype=jnp.int32)
+    if valid is not None:
+        one_hot = one_hot * valid.astype(jnp.int32)[:, None]
     position_in_expert = jnp.cumsum(one_hot, axis=0) * one_hot  # 1-based
-    # every token's own one-hot contributes 1 to its cumsum, so slot is
-    # always >= 0; the only droppable state is capacity overflow
+    # a dispatching entry's own one-hot contributes 1 to its cumsum, so
+    # its slot is >= 0; invalid entries have an all-zero row -> slot -1
     slot = jnp.sum(position_in_expert, axis=1) - 1               # (T,)
-    keep = slot < capacity
-    return jnp.minimum(slot, capacity - 1), keep
+    keep = (slot >= 0) & (slot < capacity)
+    return jnp.clip(slot, 0, capacity - 1), keep
 
 
 def moe_dispatch_combine(x: jnp.ndarray,
@@ -133,8 +155,12 @@ def moe_dispatch_combine(x: jnp.ndarray,
     gates = jnp.atleast_2d(router.gate)
     k = idx.shape[0]
     capacity = max(1, int(capacity_factor * k * T / num_experts))
+    # gate == 0 marks a choice the router decided not to dispatch
+    # (GShard second_policy="random"): it claims no capacity slot
+    valid = gates.reshape(-1) > 0.0
     slot, keep = _dispatch_indices(idx.reshape(-1), num_experts,
-                                   capacity)           # choice-major
+                                   capacity,           # choice-major
+                                   valid=valid)
 
     # scatter tokens into (num_experts, capacity, H); each of a token's
     # k choices occupies its own slot
@@ -181,15 +207,19 @@ class ExpertParallelMLP:
     def __init__(self, hidden_size: int, ffn_hidden_size: int,
                  num_experts: int, capacity_factor: float = 1.25,
                  axis_name: Optional[str] = EXPERT_AXIS,
-                 router: str = "top1"):
+                 router: str = "top1", second_policy: str = "all"):
         if router not in ("top1", "top2"):
             raise ValueError(f"router must be top1|top2, got {router!r}")
+        if second_policy not in ("all", "random"):
+            raise ValueError(f"second_policy must be 'all'|'random', "
+                             f"got {second_policy!r}")
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.axis_name = axis_name
         self.router = router
+        self.second_policy = second_policy
 
     def init(self, key: jax.Array) -> dict:
         kr, k1, k2 = jax.random.split(key, 3)
@@ -202,14 +232,17 @@ class ExpertParallelMLP:
             * (2.0 / f) ** 0.5,
         }
 
-    def apply(self, params: dict, x: jnp.ndarray):
+    def apply(self, params: dict, x: jnp.ndarray, rng=None):
         """(T, H) -> ((T, H), aux_loss).  Inside shard_map, pass expert
         weights sharded ``P(EXPERT_AXIS)`` on their leading axis and the
         router replicated; tokens may be data-sharded on any other
-        axis."""
+        axis.  ``rng``: required when ``second_policy='random'`` (the
+        GShard dispatch-saving Bernoulli draw)."""
         logits = x.astype(jnp.float32) @ params["router"]
-        router = (top2_router(logits) if self.router == "top2"
-                  else top1_router(logits))
+        router = (top2_router(logits,
+                              second_policy=self.second_policy,
+                              rng=rng)
+                  if self.router == "top2" else top1_router(logits))
 
         def expert_fn(buf):  # (local_e, rows, H)
             h = jnp.einsum("erh,ehf->erf", buf.astype(jnp.float32),

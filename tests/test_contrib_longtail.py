@@ -183,11 +183,86 @@ class TestTransducer:
         assert np.asarray(out[1, 3:]).max() == 0.0  # t >= f_len zeroed
         assert np.asarray(out[1, :, 2:]).max() == 0.0  # u > g_len zeroed
 
-    def test_joint_pack_output_raises(self):
+    def test_joint_pack_output_matches_reference_layout(self):
+        """pack_output=True emits the reference's packed rows
+        (ref: transducer.py:51-63 — batch b's f_len[b]*g_len[b] valid
+        (t, u) pairs, t-major, at batch_offset[b-1])."""
         from apex_tpu.contrib.transducer import TransducerJoint
 
-        with pytest.raises(NotImplementedError):
-            TransducerJoint(pack_output=True)
+        f = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 8))
+        g = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))
+        f_len = jnp.array([5, 3])
+        g_len = jnp.array([4, 2])
+        batch_offset = jnp.cumsum(f_len * g_len)
+        packed_batch = int(batch_offset[-1])
+
+        packed = TransducerJoint(pack_output=True)(
+            f, g, f_len=f_len, g_len=g_len,
+            batch_offset=batch_offset, packed_batch=packed_batch)
+        padded = TransducerJoint()(f, g, f_len=f_len, g_len=g_len)
+        assert packed.shape == (packed_batch, 8)
+        want = []
+        for b in range(2):
+            for t in range(int(f_len[b])):
+                for u in range(int(g_len[b])):
+                    want.append(np.asarray(padded[b, t, u]))
+        np.testing.assert_allclose(np.asarray(packed), np.stack(want),
+                                   rtol=1e-6)
+
+    def test_joint_pack_output_requires_offsets(self):
+        from apex_tpu.contrib.transducer import TransducerJoint
+
+        f = jnp.zeros((1, 2, 4))
+        g = jnp.zeros((1, 2, 4))
+        with pytest.raises(ValueError, match="batch_offset"):
+            TransducerJoint(pack_output=True)(
+                f, g, f_len=jnp.array([2]), g_len=jnp.array([2]))
+
+    def test_loss_packed_input_matches_padded(self):
+        """packed_input=True (the one reference capability previously
+        waived): pack the padded logits per the reference layout
+        (batch_offset = cumsum(f_len*(y_len+1)), ref transducer.py:101),
+        feed the packed buffer, and the loss AND its gradients must
+        equal the padded path."""
+        from apex_tpu.contrib.transducer import (TransducerLoss,
+                                                 pack_joint_output)
+
+        B, T, U, V = 2, 4, 3, 5
+        x = jax.random.normal(jax.random.PRNGKey(0), (B, T, U, V)) * 0.5
+        labels = jnp.array([[1, 2], [3, 4]])
+        f_len = jnp.array([4, 3])
+        y_len = jnp.array([2, 1])
+        g_len = y_len + 1
+        batch_offset = jnp.cumsum(f_len * g_len)
+        N = int(batch_offset[-1])
+        x_packed = pack_joint_output(x, f_len, g_len, batch_offset, N)
+
+        want = TransducerLoss()(x, labels, f_len, y_len, 0)
+        got = TransducerLoss(packed_input=True)(
+            x_packed, labels, f_len, y_len, 0,
+            batch_offset=batch_offset, max_f_len=T)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
+
+        gp = jax.grad(lambda xp: jnp.sum(TransducerLoss(
+            packed_input=True)(xp, labels, f_len, y_len, 0,
+                               batch_offset=batch_offset,
+                               max_f_len=T)))(x_packed)
+        gd = jax.grad(lambda xx: jnp.sum(TransducerLoss()(
+            xx, labels, f_len, y_len, 0)))(x)
+        # padded grads at valid positions == packed grads, repacked
+        gd_packed = pack_joint_output(gd, f_len, g_len, batch_offset, N)
+        np.testing.assert_allclose(np.asarray(gp),
+                                   np.asarray(gd_packed), rtol=1e-5,
+                                   atol=1e-7)
+
+    def test_loss_packed_input_requires_offsets(self):
+        from apex_tpu.contrib.transducer import TransducerLoss
+
+        with pytest.raises(ValueError, match="batch_offset"):
+            TransducerLoss(packed_input=True)(
+                jnp.zeros((4, 5)), jnp.array([[1]]), jnp.array([2]),
+                jnp.array([1]), 0)
 
     def test_loss_matches_brute_force(self):
         from apex_tpu.contrib.transducer import transducer_loss
