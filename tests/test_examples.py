@@ -43,6 +43,7 @@ def _make_npz(path, n=256, size=32, classes=4, seed=0,
 
 
 class TestImagenetDriverNpz:
+    @pytest.mark.slow
     def test_npz_convergence_tiny_resnet(self, tmp_path):
         """Real-data loss curve: the driver must learn a separable
         4-class set well below chance level (-ln(1/4) = 1.386)."""
@@ -58,6 +59,8 @@ class TestImagenetDriverNpz:
         ])
         assert final_loss < 0.9, f"no convergence on npz data: {final_loss}"
 
+
+    @pytest.mark.slow
     def test_native_loader_convergence_and_determinism(self, tmp_path):
         """The DataLoader path (C++ prefetch workers when available)
         must also learn, and be run-to-run deterministic despite
@@ -99,6 +102,8 @@ class TestImagenetDriverNpz:
         assert final_loss < 0.9, f"no convergence on uint8 data: " \
                                  f"{final_loss}"
 
+
+    @pytest.mark.slow
     def test_npz_deterministic_across_runs(self, tmp_path):
         """Same seed + deterministic flag => bitwise-equal loss curves
         (the L1 compare.py exact-equality oracle,
@@ -120,6 +125,8 @@ class TestImagenetDriverNpz:
                 logs.append(f.read())
         assert logs[0] == logs[1], "nondeterministic loss curve"
 
+
+    @pytest.mark.slow
     def test_resume_continues_from_checkpoint(self, tmp_path):
         npz = _make_npz(str(tmp_path / "tiny3.npz"))
         ck = str(tmp_path / "resume.msgpack")

@@ -33,6 +33,7 @@ class TestCheckpointPolicy:
 
     @pytest.mark.parametrize("policy", ["full", "dots",
                                         "dots_with_no_batch_dims"])
+    @pytest.mark.slow
     def test_remat_policy_grads_match_no_remat(self, policy):
         kw = dict(vocab_size=VOCAB, hidden_size=HID, num_layers=2,
                   num_attention_heads=HEADS, max_sequence_length=SEQ,
@@ -70,6 +71,7 @@ class TestGPTTensorParallel:
         return dense, manual
 
     @pytest.mark.parametrize("use_flash", [False, True])
+    @pytest.mark.slow
     def test_tp4_logits_match_dense(self, use_flash):
         mesh = parallel_state.initialize_model_parallel(
             tensor_model_parallel_size=4)
@@ -89,6 +91,8 @@ class TestGPTTensorParallel:
                                    np.asarray(ref_logits),
                                    rtol=2e-4, atol=2e-4)
 
+
+    @pytest.mark.slow
     def test_tp4_loss_and_grads_match_dense(self):
         mesh = parallel_state.initialize_model_parallel(
             tensor_model_parallel_size=4)
@@ -147,6 +151,8 @@ class TestGPTPipelined:
                 unbox(hv), boxed_specs(ev), boxed_specs(svs, 1),
                 boxed_specs(hv), tokens, key)
 
+
+    @pytest.mark.slow
     def test_pipelined_loss_matches_sequential(self):
         (mesh, embed, stage, head, ep, sp, hp, espec, sspec, hspec,
          tokens, key) = self._build(pp=2, tpsize=2)
@@ -176,6 +182,8 @@ class TestGPTPipelined:
         ref = gpt_loss(logits, labels)
         np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
 
+
+    @pytest.mark.slow
     def test_pipelined_training_learns(self):
         (mesh, embed, stage, head, ep, sp, hp, espec, sspec, hspec,
          tokens, key) = self._build(pp=2, tpsize=2)
@@ -211,6 +219,8 @@ class TestGPTPipelined:
         assert losses[-1] < 0.7 * losses[0], f"too slow: {losses}"
         assert np.isfinite(losses).all()
 
+
+    @pytest.mark.slow
     def test_3d_convergence_minimal(self):
         """Reference-tier minimal convergence run
         (ref: tests/L0/run_transformer/run_megatron_gpt_pipeline.py — a

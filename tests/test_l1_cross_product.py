@@ -14,6 +14,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # full-driver integration matrix: slow tier
+
 _spec = importlib.util.spec_from_file_location(
     "apex_tpu_example_main_amp_l1",
     os.path.join(os.path.dirname(__file__), "..", "examples", "imagenet",

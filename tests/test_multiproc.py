@@ -24,6 +24,10 @@ def _free_port():
         return s.getsockname()[1]
 
 
+
+
+
+@pytest.mark.slow
 def test_two_process_ddp_grad_sync(tmp_path):
     # bounded by communicate(timeout=540) below — no pytest-timeout dep
     port = _free_port()

@@ -117,6 +117,8 @@ class TestSchedules:
         ys = jax.random.normal(jax.random.fold_in(key, 2), (m, mbsz, width))
         return mesh, params, xs, ys
 
+
+    @pytest.mark.slow
     def test_1f1b_loss_and_grads_match_sequential(self):
         mesh, params, xs, ys = self._setup(4)
 
@@ -201,6 +203,8 @@ class TestSchedules:
                                        np.asarray(rgrads[k]),
                                        rtol=1e-4, atol=1e-6)
 
+
+    @pytest.mark.slow
     def test_interleaved_fallback_warns_and_strict_raises(self):
         """M %% P != 0 degrades to sequential sweeps — must WARN (the
         bubble the caller asked to remove is back) and raise under

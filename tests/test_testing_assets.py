@@ -191,6 +191,8 @@ class TestStandaloneBert:
         np.testing.assert_allclose(np.asarray(bin_f), np.asarray(bin_r),
                                    rtol=2e-4, atol=2e-4)
 
+
+    @pytest.mark.slow
     def test_bert_minimal_convergence(self):
         """ref: run_bert_minimal_test.py — a short MLM optimization."""
         from apex_tpu.testing.standalone_bert import BertModel
@@ -261,6 +263,7 @@ class TestOptimWrapper:
 
 
 class TestDCGANDriver:
+    @pytest.mark.slow
     def test_multi_model_multi_loss_amp(self):
         spec = importlib.util.spec_from_file_location(
             "apex_tpu_example_dcgan",
