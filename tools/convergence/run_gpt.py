@@ -96,11 +96,16 @@ def _clear_scratch_ckpts(ckpt_dir: str, default_dir: str) -> None:
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--steps", type=int, default=1000)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--layers", type=int, default=24)
     p.add_argument("--hidden", type=int, default=1024)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=None,
+                   help="linear warmup length (default: steps//10)")
+    p.add_argument("--eval-every", type=int, default=50,
+                   help="held-out eval cadence in steps (0 = off)")
     p.add_argument("--vocab-mode", choices=("word50k", "byte"),
                    default="word50k")
     p.add_argument("--out", default=None)
@@ -140,15 +145,36 @@ def main(argv=None):
     n_params = sum(x.size for x in
                    jax.tree_util.tree_leaves(variables["params"]))
     print(f"params: {n_params/1e6:.1f}M")
+    # linear warmup + cosine decay to lr/10 (round-4 VERDICT weak #6:
+    # the fixed-lr 300-step run proved the path trains, not that it
+    # trains WELL; this is the standard GPT pretrain schedule shape)
+    import optax
+
+    warmup = args.warmup_steps
+    if warmup is None:
+        warmup = max(1, args.steps // 10)
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=args.lr, warmup_steps=warmup,
+        decay_steps=args.steps, end_value=args.lr / 10)
     params, opt, state = amp.initialize(
-        variables["params"], fused_adam(3e-4), opt_level="O5")
+        variables["params"], fused_adam(schedule), opt_level="O5")
     del variables
     params, state = jax.tree_util.tree_map(jnp.array, (params, state))
 
-    # deterministic epoch-shuffled window sampler (host side)
+    # deterministic epoch-shuffled window sampler (host side); the TAIL
+    # of the shuffled order is held out for eval perplexity
     rng = np.random.RandomState(0)
     n_windows = (corpus.size - 1) // args.seq
     order = rng.permutation(n_windows)
+    # clamp: the held-out tail must leave at least one training window
+    # (tiny corpora / large --seq would otherwise empty the sampler)
+    n_eval = min(max(args.batch, n_windows // 20), n_windows - 1)
+    if n_eval < 1:
+        raise SystemExit(f"corpus too small: {n_windows} windows of "
+                         f"seq {args.seq}")
+    eval_order = order[n_windows - n_eval:]
+    n_train = n_windows - n_eval
+    order = order[:n_train]
 
     CHUNK = 10  # steps per dispatch: one tunnel RPC per 10 steps
 
@@ -156,9 +182,19 @@ def main(argv=None):
         toks = np.stack([np.stack([
             corpus[i * args.seq:(i + 1) * args.seq + 1]
             for i in (order[((c0 * CHUNK + s) * args.batch + j)
-                            % n_windows] for j in range(args.batch))])
+                            % n_train] for j in range(args.batch))])
             for s in range(CHUNK)])
         return jnp.asarray(toks[:, :, :-1]), jnp.asarray(toks[:, :, 1:])
+
+    # fixed held-out batches (never sampled by chunk_batches)
+    n_eval_batches = min(4, n_eval // args.batch)
+    eval_batches = []
+    for bi in range(n_eval_batches):
+        w = np.stack([corpus[i * args.seq:(i + 1) * args.seq + 1]
+                      for i in eval_order[bi * args.batch:
+                                          (bi + 1) * args.batch]])
+        eval_batches.append((jnp.asarray(w[:, :-1]),
+                             jnp.asarray(w[:, 1:])))
 
     def one_step(carry, batch):
         params, state = carry
@@ -180,12 +216,29 @@ def main(argv=None):
     def train_chunk(carry, tokens, labels):
         return jax.lax.scan(one_step, carry, (tokens, labels))
 
+    @jax.jit
+    def eval_loss_one(params, tokens, labels):
+        logits = model.apply({"params": params}, tokens,
+                             deterministic=True)
+        return jnp.mean(softmax_cross_entropy_loss(
+            logits.reshape(-1, vocab), labels.reshape(-1),
+            half_to_float=True))
+
+    def eval_ppl(params):
+        ls = [float(eval_loss_one(params, t, l))
+              for t, l in eval_batches]
+        mean = float(np.mean(ls))
+        return mean, float(np.exp(min(mean, 30.0)))
+
     from apex_tpu.utils import checkpoint as ckpt
 
     assert args.steps % (2 * CHUNK) == 0, "steps must be multiple of 20"
     n_chunks = args.steps // CHUNK
     half_chunk = n_chunks // 2
+    eval_every_chunks = (max(1, args.eval_every // CHUNK)
+                         if args.eval_every else 0)
     losses = []
+    evals = []
     carry = (params, state)
     for c in range(n_chunks):
         toks, labs = chunk_batches(c)
@@ -197,6 +250,14 @@ def main(argv=None):
         lv = float(ls[-1])
         losses.append({"step": (c + 1) * CHUNK - 1, "loss": lv})
         print(f"step {(c + 1) * CHUNK - 1}: loss {lv:.4f}", flush=True)
+        if eval_every_chunks and ((c + 1) % eval_every_chunks == 0
+                                  or c + 1 == n_chunks):
+            el, ep = eval_ppl(carry[0])
+            evals.append({"step": (c + 1) * CHUNK - 1,
+                          "eval_loss": round(el, 4),
+                          "eval_ppl": round(ep, 2)})
+            print(f"  eval @ step {(c + 1) * CHUNK - 1}: "
+                  f"loss {el:.4f} ppl {ep:.2f}", flush=True)
         if c + 1 == half_chunk:
             params, state = carry
             # mid-run checkpoint (Orbax sharded writer): masters +
@@ -255,7 +316,12 @@ def main(argv=None):
                  else "repo source bytes (real text)"),
         "steps": args.steps,
         "batch": args.batch, "seq": args.seq,
+        "lr_schedule": {"kind": "linear_warmup_cosine",
+                        "peak": args.lr, "warmup_steps": warmup,
+                        "end": args.lr / 10},
+        "heldout_windows": int(n_eval),
         "losses": losses,
+        "eval": evals,
         "first_loss": first, "final_loss": last,
         "resume_bitwise_ok": resume_ok,
         "device": str(jax.devices()[0].device_kind),

