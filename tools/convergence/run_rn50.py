@@ -42,6 +42,10 @@ def main(argv=None):
     p.add_argument("--no-augment", action="store_true",
                    help="disable the random-shift train augmentation")
     p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--label-smoothing", type=float, default=0.1,
+                   help="CE label smoothing (the standard ResNet "
+                        "recipe value; also puts the contrib xentropy "
+                        "smoothing path on the trained path)")
     p.add_argument("--out", default=os.path.join(
         REPO, "docs", "convergence", "rn50_loss.json"))
     p.add_argument("--ckpt-dir", default="/tmp/apex_tpu_rn50_conv_ckpt")
@@ -104,17 +108,22 @@ def main(argv=None):
                for j in range(args.batch)]
         xb = images[idx]
         if not args.no_augment:
-            # random shift up to +-6 px via pad-and-crop (background is
-            # -1.0 after normalization); the standard small-image
-            # translation augmentation
+            # random +-1 source-pixel shift via pad-and-crop (background
+            # is -1.0 after normalization).  The images are 8x nearest-
+            # neighbor upsamples, so every training image sits on an
+            # 8-px block grid; shifting by a multiple of the upsample
+            # factor teaches translation invariance WITHIN the training
+            # distribution.  (Arbitrary-pixel shifts put every training
+            # image off-grid — a domain the centered eval set never
+            # shows — and stalled held-out accuracy at ~0.70.)
             r = np.random.RandomState(1000 + step)
-            pad = 6
+            reps = args.image_size // 8
             size = args.image_size
-            xp = np.pad(xb, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
-                        constant_values=-1.0)
+            xp = np.pad(xb, ((0, 0), (reps, reps), (reps, reps),
+                             (0, 0)), constant_values=-1.0)
             out = np.empty_like(xb)
             for j in range(xb.shape[0]):
-                dx, dy = r.randint(0, 2 * pad + 1, size=2)
+                dx, dy = r.randint(0, 3, size=2) * reps
                 out[j] = xp[j, dx:dx + size, dy:dy + size]
             xb = out
         return (jnp.asarray(xb, policy.compute_dtype),
@@ -127,7 +136,8 @@ def main(argv=None):
                 {"params": pr, "batch_stats": batch_stats}, x,
                 train=True, mutable=["batch_stats"])
             l = jnp.mean(softmax_cross_entropy_loss(
-                logits, y, half_to_float=True))
+                logits, y, smoothing=args.label_smoothing,
+                half_to_float=True))
             return opt.scale_loss(l, state), (l, mutated)
 
         grads, (loss, mutated) = jax.grad(loss_fn, has_aux=True)(params)
@@ -188,11 +198,17 @@ def main(argv=None):
 
     first, last = losses[0]["loss"], losses[-1]["loss"]
     final_acc = accs[-1]["top1"]
+    # a single eval draw at 297 held-out images moves +-1.5 images
+    # (+-0.005) between adjacent evals; the tail mean is the stable
+    # statement of where the run converged
+    tail = [a["top1"] for a in accs[len(accs) // 2:][-5:]]
+    tail_mean = round(float(np.mean(tail)), 4)
     out = {
         "model": "resnet50_o5", "params_m": round(n_params / 1e6, 1),
         "data": ("sklearn digits (real scans), 64x64 RGB, "
                  f"{n} train / {n_eval} held out"),
         "augment": not args.no_augment,
+        "label_smoothing": args.label_smoothing,
         "lr_schedule": {"kind": "cosine", "peak": args.lr,
                         "alpha": 0.05},
         "steps": args.steps, "batch": args.batch,
@@ -200,6 +216,7 @@ def main(argv=None):
         "eval_top1": accs,
         "first_loss": first, "final_loss": last,
         "final_eval_top1": final_acc,
+        "tail_eval_top1_mean": tail_mean,
         "resume_bitwise_ok": resume_ok,
         "device": str(jax.devices()[0].device_kind),
     }
@@ -207,9 +224,9 @@ def main(argv=None):
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {args.out}: loss {first:.4f} -> {last:.4f}, "
-          f"held-out top-1 {final_acc:.3f}")
+          f"held-out top-1 {final_acc:.3f} (tail mean {tail_mean:.3f})")
     assert last < first * 0.5, "insufficient convergence"
-    assert final_acc > 0.8, f"held-out top-1 {final_acc} too low"
+    assert tail_mean > 0.8, f"held-out top-1 tail {tail_mean} too low"
     assert resume_ok, "resume not bitwise identical"
 
 
