@@ -58,10 +58,14 @@ SLOW_NODEID_PATTERNS = (
     "test_forward_and_grad_parity[False-2048",
     "test_backward_parity_masked[2048-2048]",
     "test_packed_matches_per_tensor[2048",
-    # E layout: padded-s and hg=2 grouping large variants
-    "test_forward_and_grad_parity[shape1-True]",
-    "test_forward_and_grad_parity[shape2-True]",
-    "test_forward_and_grad_parity[shape3-True]",
+    # E layout: padded-s / d=32-grouping / hg=2 shapes keep their
+    # causal twin in the default tier and send the NON-causal one here
+    # (non-causal computes every tile — measured ~2x the interpret-mode
+    # cost of the causal walk); shape0 keeps both modes as the
+    # non-causal representative
+    "test_forward_and_grad_parity[shape1-False]",
+    "test_forward_and_grad_parity[shape2-False]",
+    "test_forward_and_grad_parity[shape3-False]",
     # blocked E walk: one causal+one non-causal stay (shape0)
     "test_blocked_long_sequence[shape1",
     "test_blocked_long_sequence[shape2",
