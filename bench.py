@@ -1107,6 +1107,30 @@ def _compact_summary(full):
     return c
 
 
+def _fit_compact_line(compact, limit=1800):
+    """Serialize the compact summary, guaranteed under ``limit`` chars.
+
+    The driver captures ~2000 chars of the final stdout line; never let
+    the artifact of record outgrow it again (round-4 failure: the
+    verbose line outgrew the capture and the RN50/optimizer rows
+    survived only in the README).  Drop whole keys least-important-
+    first — truncating the string would emit invalid JSON, losing
+    every number on the line.  Operates on a copy: the caller's dict
+    keeps every key it had."""
+    compact = dict(compact, extras=dict(compact.get("extras", {})))
+    line = json.dumps(compact, separators=(",", ":"))
+    for drop in ("pack", "psum_gbps", "hbm_gbps_dev", "longctx_tfs",
+                 "opt"):
+        if len(line) <= limit:
+            break
+        print(f"[bench] WARNING: compact line {len(line)} chars; "
+              f"dropping '{drop}' to fit (full report in "
+              "BENCH_FULL.json)", file=sys.stderr)
+        compact["extras"].pop(drop, None)
+        line = json.dumps(compact, separators=(",", ":"))
+    return line
+
+
 def main():
     if not parallel_state.model_parallel_is_initialized():
         parallel_state.initialize_model_parallel()
@@ -1164,22 +1188,7 @@ def main():
                                           with_profile=False))
             section("bert_large", bench_bert_large)
             section("zero_sharded_adam", bench_zero_adam)
-    compact = _compact_summary(full)
-    line = json.dumps(compact, separators=(",", ":"))
-    # the driver captures ~2000 chars of the final line; never let the
-    # artifact of record outgrow it again (round-4 failure).  Drop whole
-    # keys least-important-first — truncating the string would emit
-    # invalid JSON, losing every number on the line.
-    for drop in ("pack", "psum_gbps", "hbm_gbps_dev", "longctx_tfs",
-                 "opt"):
-        if len(line) <= 1800:
-            break
-        print(f"[bench] WARNING: compact line {len(line)} chars; "
-              f"dropping '{drop}' to fit (full report in "
-              "BENCH_FULL.json)", file=sys.stderr)
-        compact["extras"].pop(drop, None)
-        line = json.dumps(compact, separators=(",", ":"))
-    print(line)
+    print(_fit_compact_line(_compact_summary(full)))
 
 
 if __name__ == "__main__":
