@@ -3,8 +3,11 @@ shield): the final stdout line must always be parseable JSON under the
 driver's capture size and must carry every number the judge checks;
 physically impossible bandwidths must never be published.
 
-These are pure-function tests over bench.py's summary helpers — no TPU,
-no measurement.  (ref test idiom: the reference pins its report formats
+Mostly pure-function tests over bench.py's summary helpers — no TPU,
+no measurement; TestReadmeDriftGuard is the one integration-level
+check, shelling out to tools/readme_numbers.py --check against the
+checked-in README.md + BENCH_FULL.json.  (ref test idiom: the
+reference pins its report formats
 with fixture-driven parses, apex/pyprof tests; here the artifact format
 IS the product surface the driver consumes.)
 """
@@ -134,3 +137,23 @@ class TestSlopeFloor:
     def test_guard(self, t1, t2, expect):
         got = bench._slope_dt(t1, t2, 1, 2, "test", floor=0.02)
         assert got == pytest.approx(expect)
+
+
+class TestReadmeDriftGuard:
+    def test_readme_matches_checked_in_artifact(self):
+        """README's closing-numbers block must byte-match what
+        tools/readme_numbers.py renders from the checked-in
+        BENCH_FULL.json (round-4 VERDICT weak #3: hand-transcribed
+        numbers drifted from the artifact of record).  Runs the real
+        --check entry so a hand-edit of either file fails the suite."""
+        import subprocess
+        import sys
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "readme_numbers.py"),
+             "--check"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stdout + r.stderr
