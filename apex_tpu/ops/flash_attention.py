@@ -10,7 +10,11 @@ the shape caps: any sq/sk (padded to block multiples), head dim 64-256,
 causal or full attention.
 
 Layout: q (b, h, sq, d), k/v (b, h, sk, d).  Matmuls hit the MXU in the
-input dtype with fp32 accumulation; softmax math is fp32.
+input dtype with fp32 accumulation; softmax math is fp32.  At d=64 with
+even h the per-tensor drivers pack head PAIRS onto one 128-lane tile
+and run every matmul full-width via a sign rotation — see the
+head-packing note above ``set_head_packing`` (escape hatch:
+``APEX_TPU_FLASH_PACK_D64=0``).
 
 Kernel-economy notes (v5e profile at GPT-345M shapes, b=8 h=16 s=1024
 d=64; structural matmul minimum fwd 262 us / bwd 611 us per call):
@@ -81,6 +85,128 @@ def _env_block(var: str, default: int, lo: int = 8,
 
 DEFAULT_BLOCK_Q = _env_block("APEX_TPU_FLASH_BLOCK_Q", 1024)
 DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 1024)
+
+# --- d=64 head packing ------------------------------------------------------
+#
+# A d=64 head fills only HALF the 128-wide MXU lane tile: q k^T contracts
+# 64 of 128 lanes and p v emits 64 of 128 output lanes, so the unpacked
+# kernels cap near half the d=128 rate (round-5 BENCH_FULL.json:
+# 52.6/52.8 TF/s device at s=8192/16384 vs 97.3-98.2 at d=128) — at the
+# reference FMHA's ONLY supported head dim (ref: setup.py:408-424).
+#
+# Fix: when d == 64 and h is even, the (b, h, s, d) drivers pack adjacent
+# head pairs into one 128-lane tile, (b, h, s, 64) -> (b, h/2, s, 128),
+# and every per-head matmul pair is recovered from two FULL-WIDTH
+# matmuls via a sign rotation.  With sigma = [+1]*64 ++ [-1]*64 on the
+# packed lane axis and packed operands X = [X0|X1], W = [W0|W1]:
+#
+#   X W^T         = X0 W0^T + X1 W1^T        (contraction: all 128 lanes)
+#   X (W*sigma)^T = X0 W0^T - X1 W1^T
+#
+# so S0/S1 fall out of a half-sum/half-difference instead of two
+# half-width d=64 contractions; the mirrored combine
+# ((A0+A1) W + (A0-A1) (W*sigma)) / 2 = [A0 W0 | A1 W1] does the same
+# for the products whose OUTPUT axis is the packed lane axis (p v, ds k,
+# and the dim-0-contracting dk/dv forms).  Cross-head terms cancel in
+# the rotation algebra — no block-diagonal masking pass exists anywhere.
+# Per k-block a packed program runs 2 matmuls per score-side product for
+# BOTH heads where the unpacked kernel ran 2 half-width ones PER head:
+# ~2x useful MXU throughput.  Softmax, causal/segment masking, the
+# dropout coordinate hash (per GLOBAL head) and the lse/delta sidebands
+# stay per-head, so the packed path is numerically the same computation
+# up to fp reassociation in the rotation.  One rounding caveat beyond
+# pure reassociation: in the low-precision combines the SUM/DIFFERENCE
+# of the pair's score-shaped arrays is what gets rounded to the input
+# dtype, so each head's products carry absolute error ~ulp of the
+# PAIR's combined magnitude — in bf16, a head whose ds/p run orders of
+# magnitude below its partner's absorbs noise at the partner's ulp
+# scale (the unpacked path rounds each head alone).  Harmless at
+# training tolerances; flip the escape hatch if a workload needs
+# per-head-exact bf16 rounding.
+#
+# Escape hatch: APEX_TPU_FLASH_PACK_D64=0 (read at import) or
+# set_head_packing(False) forces the old half-width path.  Packing is an
+# implementation detail with no semantic contract — even a packed-fwd /
+# unpacked-bwd mix is exact, because the backward recomputes p from the
+# per-head lse and the dropout mask is coordinate-hashed, never
+# tiling-derived.
+_PACK_D64 = {"enabled": _os.environ.get(
+    "APEX_TPU_FLASH_PACK_D64", "1") != "0"}
+
+
+def set_head_packing(enabled: bool) -> None:
+    """Toggle the d=64 head-pair packing (see the module note above).
+    Flip OUTSIDE jit traces: a cached trace keeps whatever layout it was
+    traced with (the results agree either way)."""
+    _PACK_D64["enabled"] = bool(enabled)
+
+
+def head_packing_enabled() -> bool:
+    return _PACK_D64["enabled"]
+
+
+def _use_head_packing(h: int, d: int) -> bool:
+    return d == 64 and h % 2 == 0 and _PACK_D64["enabled"]
+
+
+def _pack_head_pairs(x):
+    """(b, h, s, d) -> (b, h/2, s, 2d): head 2j in lanes [0, d), head
+    2j+1 in lanes [d, 2d) of pair j."""
+    b, h, s, d = x.shape
+    return x.reshape(b, h // 2, 2, s, d).transpose(0, 1, 3, 2, 4) \
+        .reshape(b, h // 2, s, 2 * d)
+
+
+def _unpack_head_pairs(x):
+    """Inverse of :func:`_pack_head_pairs`."""
+    b, hp, s, d2 = x.shape
+    return x.reshape(b, hp, s, 2, d2 // 2).transpose(0, 1, 3, 2, 4) \
+        .reshape(b, 2 * hp, s, d2 // 2)
+
+
+def _lane_sign(dtype, width):
+    """sigma row of the packing rotation: +1 on the first lane half,
+    -1 on the second."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
+    return jnp.where(lane < width // 2, 1.0, -1.0).astype(dtype)
+
+
+def _packed_scores(x, w):
+    """Both heads' (m, n) score-shaped products from lane-packed
+    x = [X0|X1], w = [W0|W1]: returns (X0 W0^T, X1 W1^T) via the sum
+    and sigma-rotated difference — two matmuls whose contraction spans
+    all 128 lanes.  Serves q k^T and the backward's do (v*scale)^T."""
+    sig = _lane_sign(w.dtype, w.shape[-1])
+    ssum = _dot(x, w, trans_b=True)
+    sdif = _dot(x, w * sig, trans_b=True)
+    return 0.5 * (ssum + sdif), 0.5 * (ssum - sdif)
+
+
+def _packed_out(a0, a1, w):
+    """[A0 W0 | A1 W1] from per-head score-shaped A and lane-packed
+    w = [W0|W1] — the mirrored combine keeps the OUTPUT lane axis
+    full-width.  Serves p v (forward acc) and ds k (dq)."""
+    sig = _lane_sign(w.dtype, w.shape[-1])
+    asum = (a0 + a1).astype(w.dtype)
+    adif = (a0 - a1).astype(w.dtype)
+    return 0.5 * (_dot(asum, w) + _dot(adif, w * sig))
+
+
+def _packed_out_t0(a0, a1, w):
+    """[A0^T W0 | A1^T W1] — the dim-0-contracting (dk/dv) form of
+    :func:`_packed_out`."""
+    sig = _lane_sign(w.dtype, w.shape[-1])
+    asum = (a0 + a1).astype(w.dtype)
+    adif = (a0 - a1).astype(w.dtype)
+    return 0.5 * (_dot_t0(asum, w) + _dot_t0(adif, w * sig))
+
+
+def _pack_lane_cols(c0, c1, width):
+    """Per-head (rows, 1) columns -> a (rows, width) lane-selected
+    array: head 0's value on the first lane half, head 1's on the
+    second (the packed accumulator's corr / 1/l multiplier)."""
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
+    return jnp.where(lane < width // 2, c0, c1)
 
 
 def _clamp_blocks(block_q: int, block_k: int, d: int):
@@ -190,7 +316,7 @@ def rand_keep_global(shape, seed, rate, batch_offset=0, head_offset=0,
 # --- forward ---------------------------------------------------------------
 
 def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
-                       *refs, drop=0.0, h=1):
+                       *refs, drop=0.0, h=1, pack=False):
     """Whole-(padded)-sequence-in-one-block forward: plain softmax, no
     online-correction carries (the default 1024 blocks put GPT s=1024
     and BERT s=512 here).  ``has_off``: a leading SMEM ref carries
@@ -200,7 +326,12 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
     after the (optional) off ref an SMEM [seed, head_offset, q_offset,
     k_offset] ref salts the coordinate-hash keep mask (the SP dropout
     route; dropout's own offsets are separate from ``has_off`` because
-    non-causal ring blocks drop the causal offsets entirely)."""
+    non-causal ring blocks drop the causal offsets entirely).
+    ``pack``: q/k/v blocks carry a d=64 head PAIR on 128 lanes and
+    ``h`` counts head PAIRS; per-head scores come from the sigma
+    rotation (see the module head-packing note) and softmax/masking/
+    dropout/lse run per head; lse_ref carries 16 sublanes (head 2j on
+    rows 0-7, 2j+1 on 8-15)."""
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
@@ -216,41 +347,74 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
         o_ref, lse_ref = rest
     q = q_ref[0]
     k = k_ref[0]
-    s = _dot(q, k, trans_b=True)                      # raw logits, fp32
+    # raw logits, fp32; packed: both heads via two full-width matmuls
+    heads = _packed_scores(q, k) if pack \
+        else (_dot(q, k, trans_b=True),)
     mask = None
     if causal:
-        mask = _tri_mask(s.shape, qoff, koff)
+        mask = _tri_mask(heads[0].shape, qoff, koff)
     if kpad and not has_kvm:
         # _kvm8 zero-pads, so kv_mask already masks pad columns
-        km = _kcol_mask(s.shape, 0, sk)
+        km = _kcol_mask(heads[0].shape, 0, sk)
         mask = km if mask is None else (mask & km)
     if has_kvm:
         vm = kvm_ref[0, 0, 0, :][None, :] > 0
         mask = vm if mask is None else (mask & vm)
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG)
-    m = jnp.max(s, axis=1, keepdims=True)             # raw units
-    p = jnp.exp2((s - m) * a)
-    l = jnp.sum(p, axis=1, keepdims=True)
     guard_dead = has_kvm or (has_off and causal)
-    if guard_dead:
-        # fully-masked rows (all keys masked, or an offset block whose
-        # keys are all in the causal future): m stayed at _NEG so
-        # (s - m) = 0 and p = 1 spuriously; zero them via the row max
-        # instead of a score-shaped select.
-        dead = m <= _NEG * 0.5
-        l = jnp.where(dead, 0.0, l)
-    pa = p
     if drop > 0.0:
-        # l stays undropped (normalization by the true denominator);
-        # only the accumulated values drop — the lse-merge across ring
-        # blocks then reproduces dense in-kernel dropout exactly.
         bh_i = pl.program_id(0)
-        keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
-                                 dsalt_ref[1] + bh_i % h,
-                                 dsalt_ref[2], dsalt_ref[3], drop)
-        pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
-    acc = _dot(pa.astype(v_ref.dtype), v_ref[0])
+    stats = []
+    pas = []
+    for hh, s in enumerate(heads):
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG)
+        m = jnp.max(s, axis=1, keepdims=True)         # raw units
+        p = jnp.exp2((s - m) * a)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        if guard_dead:
+            # fully-masked rows (all keys masked, or an offset block
+            # whose keys are all in the causal future): m stayed at
+            # _NEG so (s - m) = 0 and p = 1 spuriously; zero them via
+            # the row max instead of a score-shaped select.
+            dead = m <= _NEG * 0.5
+            l = jnp.where(dead, 0.0, l)
+        else:
+            dead = None
+        pa = p
+        if drop > 0.0:
+            # l stays undropped (normalization by the true denominator);
+            # only the accumulated values drop — the lse-merge across
+            # ring blocks then reproduces dense in-kernel dropout
+            # exactly.  Packed: the GLOBAL head index salts each half.
+            head_ix = dsalt_ref[1] + (2 * (bh_i % h) + hh if pack
+                                      else bh_i % h)
+            keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
+                                     head_ix, dsalt_ref[2],
+                                     dsalt_ref[3], drop)
+            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+        stats.append((m, l, dead))
+        pas.append(pa)
+    if pack:
+        acc = _packed_out(pas[0], pas[1], v_ref[0])
+        (m0, l0, dead0), (m1, l1, dead1) = stats
+        sl0 = jnp.where(l0 == 0.0, 1.0, l0)
+        sl1 = jnp.where(l1 == 0.0, 1.0, l1)
+        o = acc * _pack_lane_cols(1.0 / sl0, 1.0 / sl1, acc.shape[1])
+        if guard_dead:
+            o = jnp.where(_pack_lane_cols(dead0, dead1, acc.shape[1]),
+                          0.0, o)
+        o_ref[0] = o.astype(o_ref.dtype)
+        half = lse_ref.shape[2] // 2
+        tail = lse_ref.shape[3:]
+        lse0 = m0 * scale + jnp.log(sl0)
+        lse1 = m1 * scale + jnp.log(sl1)
+        lse_ref[0, 0] = jnp.concatenate(
+            [jnp.broadcast_to(lse0[:, 0][None, :], (half,) + tail),
+             jnp.broadcast_to(lse1[:, 0][None, :], (half,) + tail)],
+            axis=0)
+        return
+    acc = _dot(pas[0].astype(v_ref.dtype), v_ref[0])
+    m, l, dead = stats[0]
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o = acc / safe_l
     if guard_dead:
@@ -262,7 +426,7 @@ def _fwd_single_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk,
 
 
 def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
-                *refs, drop=0.0, h=1):
+                *refs, drop=0.0, h=1, pack=False):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
@@ -279,6 +443,9 @@ def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    # program ids read OUTSIDE the pl.when bodies: inside them the
+    # primitive sits in a cond branch that interpret mode cannot lower
+    bh_i = pl.program_id(0) if drop > 0.0 else 0
 
     @pl.when(j == 0)
     def _init():
@@ -293,47 +460,85 @@ def _fwd_kernel(scale, a, causal, has_kvm, has_off, kpad, sq, sk, bq, bk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True)                  # raw logits, fp32
+        # raw logits, fp32; packed: two heads per program, m/l carries
+        # in scratch column hh (the E blocked kernel's idiom)
+        heads = _packed_scores(q, k) if pack \
+            else (_dot(q, k, trans_b=True),)
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, i * bq + qoff, j * bk + koff)
+            mask = _tri_mask(heads[0].shape, i * bq + qoff,
+                             j * bk + koff)
         if kpad and not has_kvm:
             # _kvm8 zero-pads, so kv_mask already masks pad columns
-            km = _kcol_mask(s.shape, j * bk, sk)
+            km = _kcol_mask(heads[0].shape, j * bk, sk)
             mask = km if mask is None else (mask & km)
         if has_kvm:
             vm = kvm_ref[0, 0, 0, :][None, :] > 0
             mask = vm if mask is None else (mask & vm)
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG)
-        m_prev = m_sc[:, :1]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        corr = jnp.exp2((m_prev - m_cur) * a)
-        p = jnp.exp2((s - m_cur) * a)
-        if has_kvm or (has_off and causal):
-            # rows with every key masked so far keep m_cur = _NEG and
-            # (s - m_cur) = 0 at masked entries — zero p explicitly so
-            # such rows sum to l = 0 and emit exactly 0 (matching the
-            # backward, where masked entries recompute p = 0).  The
-            # has_off case: a q-block straddling the k_offset boundary
-            # runs with some rows entirely in the causal future.
-            p = jnp.where(mask, p, 0.0)
-        l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        pa = p
-        if drop > 0.0:
-            # see _fwd_single_kernel: values drop, l does not
-            bh_i = pl.program_id(0)
-            keep = _rand_keep_coords(
-                p.shape, dsalt_ref[0], bh_i // h,
-                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + i * bq,
-                dsalt_ref[3] + j * bk, drop)
-            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
-        acc[:] = acc[:] * corr + _dot(pa.astype(v_ref.dtype), v_ref[0])
-        m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
-        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+        pas, corrs = [], []
+        for hh, s in enumerate(heads):
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG)
+            m_prev = m_sc[:, hh:hh + 1]
+            m_cur = jnp.maximum(m_prev,
+                                jnp.max(s, axis=1, keepdims=True))
+            corr = jnp.exp2((m_prev - m_cur) * a)
+            p = jnp.exp2((s - m_cur) * a)
+            if has_kvm or (has_off and causal):
+                # rows with every key masked so far keep m_cur = _NEG
+                # and (s - m_cur) = 0 at masked entries — zero p
+                # explicitly so such rows sum to l = 0 and emit exactly
+                # 0 (matching the backward, where masked entries
+                # recompute p = 0).  The has_off case: a q-block
+                # straddling the k_offset boundary runs with some rows
+                # entirely in the causal future.
+                p = jnp.where(mask, p, 0.0)
+            l_new = l_sc[:, hh:hh + 1] * corr \
+                + jnp.sum(p, axis=1, keepdims=True)
+            pa = p
+            if drop > 0.0:
+                # see _fwd_single_kernel: values drop, l does not;
+                # packed salts by the GLOBAL head index of each half
+                head_ix = dsalt_ref[1] + (2 * (bh_i % h) + hh if pack
+                                          else bh_i % h)
+                keep = _rand_keep_coords(
+                    p.shape, dsalt_ref[0], bh_i // h, head_ix,
+                    dsalt_ref[2] + i * bq, dsalt_ref[3] + j * bk, drop)
+                pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+            pas.append(pa)
+            corrs.append(corr)
+            if pack:
+                m_sc[:, hh:hh + 1] = m_cur
+                l_sc[:, hh:hh + 1] = l_new
+            else:
+                m_sc[:] = jnp.broadcast_to(m_cur, m_sc.shape)
+                l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+        if pack:
+            corr_w = _pack_lane_cols(corrs[0], corrs[1], acc.shape[1])
+            acc[:] = acc[:] * corr_w \
+                + _packed_out(pas[0], pas[1], v_ref[0])
+        else:
+            acc[:] = acc[:] * corrs[0] \
+                + _dot(pas[0].astype(v_ref.dtype), v_ref[0])
 
     @pl.when(j == nk - 1)
     def _finish():
+        if pack:
+            l0 = l_sc[:, :1]
+            l1 = l_sc[:, 1:2]
+            sl0 = jnp.where(l0 == 0.0, 1.0, l0)   # fully-masked rows
+            sl1 = jnp.where(l1 == 0.0, 1.0, l1)   # -> zeros
+            inv = _pack_lane_cols(1.0 / sl0, 1.0 / sl1, acc.shape[1])
+            o_ref[0] = (acc[:] * inv).astype(o_ref.dtype)
+            half = lse_ref.shape[2] // 2
+            tail = lse_ref.shape[3:]
+            lse0 = m_sc[:, :1] * scale + jnp.log(sl0)
+            lse1 = m_sc[:, 1:2] * scale + jnp.log(sl1)
+            lse_ref[0, 0] = jnp.concatenate(
+                [jnp.broadcast_to(lse0[:, 0][None, :], (half,) + tail),
+                 jnp.broadcast_to(lse1[:, 0][None, :], (half,) + tail)],
+                axis=0)
+            return
         l = l_sc[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
@@ -368,6 +573,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
                offsets=None, drop=0.0, dsalt=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    pack = _use_head_packing(h, d)
+    if pack:
+        # d=64 head-pair packing (module note): adjacent heads share a
+        # 128-lane tile; h counts PAIRS below, lse carries 2 sublane
+        # groups per q-block and unpacks to per-head order at the end.
+        q, k, v = (_pack_head_pairs(x) for x in (q, k, v))
+        h, d = h // 2, 2 * d
+    g = 2 if pack else 1
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
     bq = min(block_q, max(8, sq))
     bk = min(block_k, max(128, sk))
@@ -380,6 +593,17 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
     a = scale * _LOG2E
     kpad = psk != sk
 
+    def _unpack(o, lse8):
+        lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
+        if not pack:
+            return o[:, :sq].reshape(b, h, sq, d), lse
+        o4 = _unpack_head_pairs(o[:, :sq].reshape(b, h, sq, d))
+        lse1 = lse8[:, :, 8, :].reshape(bh, psq)[:, :sq]
+        # (bh_pairs, 2, sq) flattens straight to global head order:
+        # pair j holds heads 2j / 2j+1
+        lse = jnp.stack([lse, lse1], axis=1).reshape(bh * 2, sq)
+        return o4, lse
+
     has_kvm = kv_mask is not None
     has_off = offsets is not None and causal
     if nq == 1 and nk == 1:
@@ -387,7 +611,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
                                memory_space=pltpu.VMEM)
         kb_spec = pl.BlockSpec((1, psk, d), lambda b_: (b_, 0, 0),
                                memory_space=pltpu.VMEM)
-        lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+        lse_spec = pl.BlockSpec((1, 1, 8 * g, bq),
+                                lambda b_: (b_, 0, 0, 0),
                                 memory_space=pltpu.VMEM)
         in_specs = [qb_spec, kb_spec, kb_spec]
         operands = [q3, k3, v3]
@@ -405,24 +630,24 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
         o, lse8 = pl.pallas_call(
             functools.partial(_fwd_single_kernel, scale, a, causal,
                               has_kvm, has_off, kpad, sq, sk,
-                              drop=drop, h=h),
+                              drop=drop, h=h, pack=pack),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, lse_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, 1, 8, bq), jnp.float32),
+                jax.ShapeDtypeStruct((bh, 1, 8 * g, bq), jnp.float32),
             ],
             interpret=_interpret(),
         )(*operands)
-        lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
-        return o[:, :sq].reshape(b, h, sq, d), lse
+        return _unpack(o, lse8)
 
     q_spec = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0),
                           memory_space=pltpu.VMEM)
-    lse_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+    lse_spec = pl.BlockSpec((1, 1, 8 * g, bq),
+                            lambda b_, i, j: (b_, i, 0, 0),
                             memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     operands = [q3, k3, v3]
@@ -441,13 +666,13 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
     o, lse8 = pl.pallas_call(
         functools.partial(_fwd_kernel, scale, a, causal, has_kvm,
                           has_off, kpad, sq, sk, bq, bk,
-                          drop=drop, h=h),
+                          drop=drop, h=h, pack=pack),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[q_spec, lse_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, psq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, nq, 8, bq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, 8 * g, bq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -456,8 +681,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, kv_mask=None,
         ],
         interpret=_interpret(),
     )(*operands)
-    lse = lse8[:, :, 0, :].reshape(bh, psq)[:, :sq]
-    return o[:, :sq].reshape(b, h, sq, d), lse
+    return _unpack(o, lse8)
 
 
 def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
@@ -567,7 +791,7 @@ def _flash_fwd_packed(qkv, b, h, scale, causal, block_q, block_k,
 # no kpad mask — _kvm8 zero-pads, masking pad columns for free.
 
 def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                   bq, bk, *refs, drop=0.0, h=1):
+                   bq, bk, *refs, drop=0.0, h=1, pack=False):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
@@ -584,6 +808,7 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
+    bh_i = pl.program_id(0) if drop > 0.0 else 0   # see _fwd_kernel
 
     @pl.when(j == 0)
     def _init():
@@ -596,35 +821,43 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True)
-        lse2 = lse2_ref[0, 0, 0, :][:, None]
-        arg = s * a - lse2
+        heads = _packed_scores(q, k) if pack \
+            else (_dot(q, k, trans_b=True),)
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, i * bq + qoff, j * bk + koff)
+            mask = _tri_mask(heads[0].shape, i * bq + qoff,
+                             j * bk + koff)
         if kpad and not has_kvm:
-            km = _kcol_mask(s.shape, j * bk, sk)
+            km = _kcol_mask(heads[0].shape, j * bk, sk)
             mask = km if mask is None else (mask & km)
         if has_kvm:
             vm = kvm_ref[0, 0, 0, :][None, :] > 0
             mask = vm if mask is None else (mask & vm)
-        if mask is not None:
-            arg = jnp.where(mask, arg, _NEG)
-        p = jnp.exp2(arg)
         vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
-        dp = _dot(do_ref[0], vs, trans_b=True)
-        if drop > 0.0:
-            # regenerate the forward's keep mask from the same global
-            # coordinates; ds = p*(keep*dp/(1-r) - delta)
-            bh_i = pl.program_id(0)
-            keep = _rand_keep_coords(
-                p.shape, dsalt_ref[0], bh_i // h,
-                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + i * bq,
-                dsalt_ref[3] + j * bk, drop)
-            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
-        delta = delta_ref[0, 0, 0, :][:, None]
-        ds = p * (dp - delta)
-        dq_acc[:] += _dot(ds.astype(k.dtype), k)
+        dps = _packed_scores(do_ref[0], vs) if pack \
+            else (_dot(do_ref[0], vs, trans_b=True),)
+        dss = []
+        for hh, (s, dp) in enumerate(zip(heads, dps)):
+            lse2 = lse2_ref[0, 0, 8 * hh, :][:, None]
+            arg = s * a - lse2
+            if mask is not None:
+                arg = jnp.where(mask, arg, _NEG)
+            p = jnp.exp2(arg)
+            if drop > 0.0:
+                # regenerate the forward's keep mask from the same
+                # global coordinates; ds = p*(keep*dp/(1-r) - delta)
+                head_ix = dsalt_ref[1] + (2 * (bh_i % h) + hh if pack
+                                          else bh_i % h)
+                keep = _rand_keep_coords(
+                    p.shape, dsalt_ref[0], bh_i // h, head_ix,
+                    dsalt_ref[2] + i * bq, dsalt_ref[3] + j * bk, drop)
+                dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
+            delta = delta_ref[0, 0, 8 * hh, :][:, None]
+            dss.append(p * (dp - delta))
+        if pack:
+            dq_acc[:] += _packed_out(dss[0], dss[1], k)
+        else:
+            dq_acc[:] += _dot(dss[0].astype(k.dtype), k)
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -632,7 +865,7 @@ def _bwd_dq_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
 
 
 def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                    bq, bk, *refs, drop=0.0, h=1):
+                    bq, bk, *refs, drop=0.0, h=1, pack=False):
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
@@ -649,6 +882,7 @@ def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     i = pl.program_id(1)   # k block
     j = pl.program_id(2)   # q block
     nq = pl.num_programs(2)
+    bh_i = pl.program_id(0) if drop > 0.0 else 0   # see _fwd_kernel
 
     @pl.when(j == 0)
     def _init():
@@ -662,40 +896,49 @@ def _bwd_dkv_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     def _block():
         q = q_ref[0]
         k = k_ref[0]
-        s = _dot(q, k, trans_b=True)                  # (bq, bk)
-        lse2 = lse2_ref[0, 0, 0, :][:, None]
-        arg = s * a - lse2
+        do = do_ref[0]
+        heads = _packed_scores(q, k) if pack \
+            else (_dot(q, k, trans_b=True),)          # (bq, bk)
         mask = None
         if causal:
-            mask = _tri_mask(s.shape, j * bq + qoff, i * bk + koff)
+            mask = _tri_mask(heads[0].shape, j * bq + qoff,
+                             i * bk + koff)
         if kpad and not has_kvm:
-            km = _kcol_mask(s.shape, i * bk, sk)
+            km = _kcol_mask(heads[0].shape, i * bk, sk)
             mask = km if mask is None else (mask & km)
         if has_kvm:
             vm = kvm_ref[0, 0, 0, :][None, :] > 0
             mask = vm if mask is None else (mask & vm)
-        if mask is not None:
-            arg = jnp.where(mask, arg, _NEG)
-        p = jnp.exp2(arg)
-        do = do_ref[0]
-        pa = p
-        if drop > 0.0:
-            # rows are q-block j, cols k-block i on this side — the
-            # coordinate hash makes the orientation swap free
-            bh_i = pl.program_id(0)
-            keep = _rand_keep_coords(
-                p.shape, dsalt_ref[0], bh_i // h,
-                dsalt_ref[1] + bh_i % h, dsalt_ref[2] + j * bq,
-                dsalt_ref[3] + i * bk, drop)
-            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
-        dv_acc[:] += _dot_t0(pa.astype(do.dtype), do)
         vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
-        dp = _dot(do, vs, trans_b=True)
-        if drop > 0.0:
-            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
-        delta = delta_ref[0, 0, 0, :][:, None]
-        ds = p * (dp - delta)                         # (bq, bk)
-        dk_acc[:] += _dot_t0(ds.astype(q.dtype), q)
+        dps = _packed_scores(do, vs) if pack \
+            else (_dot(do, vs, trans_b=True),)
+        pas, dss = [], []
+        for hh, (s, dp) in enumerate(zip(heads, dps)):
+            lse2 = lse2_ref[0, 0, 8 * hh, :][:, None]
+            arg = s * a - lse2
+            if mask is not None:
+                arg = jnp.where(mask, arg, _NEG)
+            p = jnp.exp2(arg)
+            pa = p
+            if drop > 0.0:
+                # rows are q-block j, cols k-block i on this side — the
+                # coordinate hash makes the orientation swap free
+                head_ix = dsalt_ref[1] + (2 * (bh_i % h) + hh if pack
+                                          else bh_i % h)
+                keep = _rand_keep_coords(
+                    p.shape, dsalt_ref[0], bh_i // h, head_ix,
+                    dsalt_ref[2] + j * bq, dsalt_ref[3] + i * bk, drop)
+                pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+                dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
+            delta = delta_ref[0, 0, 8 * hh, :][:, None]
+            pas.append(pa)
+            dss.append(p * (dp - delta))              # (bq, bk)
+        if pack:
+            dv_acc[:] += _packed_out_t0(pas[0], pas[1], do)
+            dk_acc[:] += _packed_out_t0(dss[0], dss[1], q)
+        else:
+            dv_acc[:] += _dot_t0(pas[0].astype(do.dtype), do)
+            dk_acc[:] += _dot_t0(dss[0].astype(q.dtype), q)
 
     @pl.when(j == nq - 1)
     def _finish():
@@ -710,14 +953,28 @@ def _rows8(x2d, bq):
         x2d.reshape(bh, rows // bq, 1, bq), (bh, rows // bq, 8, bq))
 
 
+def _rows16(x2d, bq):
+    """Per-head (b*h, rows) sidebands -> the packed kernels' paired
+    (b*h/2, rows/bq, 16, bq) layout: head 2j broadcast over sublanes
+    0-7 of pair j, head 2j+1 over 8-15 (matching the packed forward's
+    lse emission and the ``8 * hh`` row reads in the backwards)."""
+    bh2, rows = x2d.shape
+    x = x2d.reshape(bh2 // 2, 2, rows // bq, 1, bq)
+    x = jnp.broadcast_to(x, (bh2 // 2, 2, rows // bq, 8, bq))
+    return x.transpose(0, 2, 1, 3, 4) \
+        .reshape(bh2 // 2, rows // bq, 16, bq)
+
+
 def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
-                      *refs, drop=0.0, h=1):
+                      *refs, drop=0.0, h=1, pack=False):
     """Single-block backward: when the whole (padded) sequence fits one
     q-block and one k-block, dq/dk/dv come from ONE pass — the scores
     ``s`` and ``dp`` are computed once instead of once per kernel (the
     two-kernel flash backward recomputes both), removing 2 of the 7
     matmuls; the two it removes are the d-contracted (half-MXU-lane)
-    ones, so the saving exceeds their FLOP share."""
+    ones, so the saving exceeds their FLOP share.  ``pack``: d=64 head
+    pairs on 128 lanes (module note) — all five products run full-width
+    via the sigma rotation, lse/delta ride 16-sublane blocks."""
     if has_off:
         off_ref, *refs = refs
         qoff, koff = off_ref[0], off_ref[1]
@@ -734,38 +991,53 @@ def _bwd_fused_kernel(a, vscale, causal, has_kvm, has_off, kpad, sq, sk,
     q = q_ref[0]
     k = k_ref[0]
     do = do_ref[0]
-    s = _dot(q, k, trans_b=True)                      # (sq, sk) fp32
+    heads = _packed_scores(q, k) if pack \
+        else (_dot(q, k, trans_b=True),)              # (sq, sk) fp32
     # dp next: it does not depend on the softmax, so the VPU's
     # exp2/select work on p overlaps this MXU pass.
     vs = v_ref[0] * jnp.asarray(vscale, v_ref.dtype)
-    dp = _dot(do, vs, trans_b=True)
-    lse2 = lse2_ref[0, 0, 0, :][:, None]
-    arg = s * a - lse2
+    dps = _packed_scores(do, vs) if pack \
+        else (_dot(do, vs, trans_b=True),)
     mask = None
     if causal:
-        mask = _tri_mask(s.shape, qoff, koff)
+        mask = _tri_mask(heads[0].shape, qoff, koff)
     if kpad and not has_kvm:
-        km = _kcol_mask(s.shape, 0, sk)
+        km = _kcol_mask(heads[0].shape, 0, sk)
         mask = km if mask is None else (mask & km)
     if has_kvm:
         vm = kvm_ref[0, 0, 0, :][None, :] > 0
         mask = vm if mask is None else (mask & vm)
-    if mask is not None:
-        arg = jnp.where(mask, arg, _NEG)
-    p = jnp.exp2(arg)
-    pa = p
     if drop > 0.0:
         bh_i = pl.program_id(0)
-        keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
-                                 dsalt_ref[1] + bh_i % h,
-                                 dsalt_ref[2], dsalt_ref[3], drop)
-        pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
-        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
-    dv_ref[0] = _dot_t0(pa.astype(do.dtype), do).astype(dv_ref.dtype)
-    delta = delta_ref[0, 0, 0, :][:, None]
-    ds = p * (dp - delta)
-    dq_ref[0] = _dot(ds.astype(k.dtype), k).astype(dq_ref.dtype)
-    dk_ref[0] = _dot_t0(ds.astype(q.dtype), q).astype(dk_ref.dtype)
+    pas, dss = [], []
+    for hh, (s, dp) in enumerate(zip(heads, dps)):
+        lse2 = lse2_ref[0, 0, 8 * hh, :][:, None]
+        arg = s * a - lse2
+        if mask is not None:
+            arg = jnp.where(mask, arg, _NEG)
+        p = jnp.exp2(arg)
+        pa = p
+        if drop > 0.0:
+            head_ix = dsalt_ref[1] + (2 * (bh_i % h) + hh if pack
+                                      else bh_i % h)
+            keep = _rand_keep_coords(p.shape, dsalt_ref[0], bh_i // h,
+                                     head_ix, dsalt_ref[2],
+                                     dsalt_ref[3], drop)
+            pa = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - drop))
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - drop))
+        delta = delta_ref[0, 0, 8 * hh, :][:, None]
+        pas.append(pa)
+        dss.append(p * (dp - delta))
+    if pack:
+        dv_ref[0] = _packed_out_t0(pas[0], pas[1], do) \
+            .astype(dv_ref.dtype)
+        dq_ref[0] = _packed_out(dss[0], dss[1], k).astype(dq_ref.dtype)
+        dk_ref[0] = _packed_out_t0(dss[0], dss[1], q) \
+            .astype(dk_ref.dtype)
+        return
+    dv_ref[0] = _dot_t0(pas[0].astype(do.dtype), do).astype(dv_ref.dtype)
+    dq_ref[0] = _dot(dss[0].astype(k.dtype), k).astype(dq_ref.dtype)
+    dk_ref[0] = _dot_t0(dss[0].astype(q.dtype), q).astype(dk_ref.dtype)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
@@ -773,6 +1045,27 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     q, k, v, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    pack = _use_head_packing(h, d)
+    # delta scales by the SAME v.dtype-rounded constant the kernels
+    # fold into v: a non-power-of-two scale (e.g. d=96) rounds in bf16,
+    # and mixing rounded dp' with exact-scaled delta' would bias
+    # ds = p*(dp'-delta') wherever dp ~ delta.  Computed BEFORE any
+    # head packing: lse/delta sidebands stay per-head either way.
+    scale_v = float(np.asarray(scale).astype(v.dtype))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(b * h, sq)
+    if dlse is not None:
+        # lse cotangent (the partial entry): dlse/ds_raw = scale*p, so
+        # it folds into delta — ds = p*(dp' - (delta - dlse)*scale_v)
+        delta = delta - dlse.reshape(b * h, sq)
+    delta = delta * scale_v
+    lse2 = lse * _LOG2E
+    if pack:
+        # d=64 head-pair packing (module note): operands to the packed
+        # lane layout, sidebands to paired 16-sublane blocks
+        q, k, v, do = (_pack_head_pairs(x) for x in (q, k, v, do))
+        h, d = h // 2, 2 * d
+    g = 2 if pack else 1
     block_q, block_k = _clamp_blocks(block_q, block_k, d)
     bq = min(block_q, max(8, sq))
     bk = min(block_k, max(128, sk))
@@ -790,38 +1083,38 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     nq, nk = psq // bq, psk // bk
     kpad = psk != sk
 
-    # delta scales by the SAME v.dtype-rounded constant the kernels
-    # fold into v: a non-power-of-two scale (e.g. d=96) rounds in bf16,
-    # and mixing rounded dp' with exact-scaled delta' would bias
-    # ds = p*(dp'-delta') wherever dp ~ delta.
-    scale_v = float(np.asarray(scale).astype(v.dtype))
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1).reshape(bh, sq)
-    if dlse is not None:
-        # lse cotangent (the partial entry): dlse/ds_raw = scale*p, so
-        # it folds into delta — ds = p*(dp' - (delta - dlse)*scale_v)
-        delta = delta - dlse.reshape(bh, sq)
-    delta = delta * scale_v
     delta = _pad_to(delta, 1, bq)
     # +BIG pad: q-padded rows recompute p = exp2(s*a - BIG) = 0, so
     # they contribute nothing to dk/dv and need no position masks.
-    lse2_p = _pad_to(lse * _LOG2E, 1, bq, value=_BIG)
-    lse8 = _rows8(lse2_p, bq)
-    delta8 = _rows8(delta, bq)
+    lse2_p = _pad_to(lse2, 1, bq, value=_BIG)
+    rows = _rows16 if pack else _rows8
+    lse8 = rows(lse2_p, bq)
+    delta8 = rows(delta, bq)
     has_kvm = kv_mask is not None
     has_off = offsets is not None and causal
     kvm = _kvm8(kv_mask, b, psk, bk) if has_kvm else None
 
-    if nq == 1 and nk == 1 and d <= 64:
+    def _unpack_grads(dq, dk, dv):
+        dq = dq[:, :sq].reshape(b, h, sq, d)
+        dk = dk[:, :sk].reshape(b, h, sk, d)
+        dv = dv[:, :sk].reshape(b, h, sk, d)
+        if pack:
+            dq, dk, dv = (_unpack_head_pairs(x) for x in (dq, dk, dv))
+        return dq, dk, dv
+
+    if nq == 1 and nk == 1 and (d <= 64 or pack):
         # Single-block fast path (e.g. GPT-345M s=1024 at the default
         # 1024-blocks; ring-attention shards): one fused kernel, 5
         # matmuls instead of 7.  d <= 64 keeps VMEM ~10 MB
-        # (2 score-shaped fp32 temps + 7 thin operands).
+        # (2 score-shaped fp32 temps + 7 thin operands); the packed
+        # path qualifies too — its _clamp_blocks-halved bq caps the
+        # per-head temps at (512, 1024) while the operand lanes double.
         qb_spec = pl.BlockSpec((1, psq, d), lambda b_: (b_, 0, 0),
                                memory_space=pltpu.VMEM)
         kb_spec = pl.BlockSpec((1, psk, d), lambda b_: (b_, 0, 0),
                                memory_space=pltpu.VMEM)
-        rb_spec = pl.BlockSpec((1, 1, 8, bq), lambda b_: (b_, 0, 0, 0),
+        rb_spec = pl.BlockSpec((1, 1, 8 * g, bq),
+                               lambda b_: (b_, 0, 0, 0),
                                memory_space=pltpu.VMEM)
         in_specs = [qb_spec, kb_spec, kb_spec, qb_spec, rb_spec,
                     rb_spec]
@@ -840,7 +1133,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, a, scale, causal,
                               has_kvm, has_off, kpad, sq, sk,
-                              drop=drop, h=h),
+                              drop=drop, h=h, pack=pack),
             grid=(bh,),
             in_specs=in_specs,
             out_specs=[qb_spec, kb_spec, kb_spec],
@@ -849,15 +1142,14 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
                        jax.ShapeDtypeStruct((bh, psk, d), v.dtype)],
             interpret=_interpret(),
         )(*operands)
-        return (dq[:, :sq].reshape(b, h, sq, d),
-                dk[:, :sk].reshape(b, h, sk, d),
-                dv[:, :sk].reshape(b, h, sk, d))
+        return _unpack_grads(dq, dk, dv)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda b_, i, j: (b_, i, 0),
                             memory_space=pltpu.VMEM)
     k_spec_j = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, j, 0),
                             memory_space=pltpu.VMEM)
-    r_spec_i = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, i, 0, 0),
+    r_spec_i = pl.BlockSpec((1, 1, 8 * g, bq),
+                            lambda b_, i, j: (b_, i, 0, 0),
                             memory_space=pltpu.VMEM)
 
     in_specs = [q_spec_i, k_spec_j, k_spec_j, q_spec_i, r_spec_i,
@@ -878,7 +1170,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, a, scale, causal, has_kvm,
                           has_off, kpad, sq, sk, bq, bk,
-                          drop=drop, h=h),
+                          drop=drop, h=h, pack=pack),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec_i,
@@ -891,7 +1183,8 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
                             memory_space=pltpu.VMEM)
     k_spec_i = pl.BlockSpec((1, bk, d), lambda b_, i, j: (b_, i, 0),
                             memory_space=pltpu.VMEM)
-    r_spec_j = pl.BlockSpec((1, 1, 8, bq), lambda b_, i, j: (b_, j, 0, 0),
+    r_spec_j = pl.BlockSpec((1, 1, 8 * g, bq),
+                            lambda b_, i, j: (b_, j, 0, 0),
                             memory_space=pltpu.VMEM)
     in_specs = [q_spec_j, k_spec_i, k_spec_i, q_spec_j, r_spec_j,
                 r_spec_j]
@@ -911,7 +1204,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, a, scale, causal, has_kvm,
                           has_off, kpad, sq, sk, bq, bk,
-                          drop=drop, h=h),
+                          drop=drop, h=h, pack=pack),
         grid=(bh, nk, nq),
         in_specs=in_specs,
         out_specs=[k_spec_i, k_spec_i],
@@ -922,9 +1215,7 @@ def _flash_bwd(scale, causal, block_q, block_k, res, do, kv_mask=None,
         interpret=_interpret(),
     )(*operands)
 
-    return (dq[:, :sq].reshape(b, h, sq, d),
-            dk[:, :sk].reshape(b, h, sk, d),
-            dv[:, :sk].reshape(b, h, sk, d))
+    return _unpack_grads(dq, dk, dv)
 
 
 def _flash_bwd_packed(scale, causal, block_q, block_k, res, do,
@@ -1088,6 +1379,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     ref: setup.py:408-424); composes with ``causal``.  Inside
     shard_map manual axes the XLA reference path runs (Pallas calls
     cannot yet carry VMA types).
+
+    d=64 with even ``h`` (the reference FMHA's native head size) runs
+    the head-packed full-width kernels — two heads per 128-lane MXU
+    tile, ~2x the half-width rate; ``APEX_TPU_FLASH_PACK_D64=0`` or
+    :func:`set_head_packing` force the old path (module note).
     """
     from ._context import in_manual_axis_context
     from .._autocast_ctx import autocast_compute_dtype

@@ -14,6 +14,16 @@ attention combine).
 Call inside ``shard_map`` with q, k, v sequence-sharded on
 ``axis_name``; the result is the bit-for-tolerance equivalent of dense
 softmax attention over the full sequence.
+
+Head dim 64 (the reference FMHA's native size): the flash mode's
+per-shard partials automatically ride the head-packed d=64 kernels —
+two heads per 128-lane MXU tile via the sigma rotation (see the
+head-packing note in :mod:`.flash_attention`) — whenever ``h`` is even,
+roughly doubling per-shard MXU throughput over the old half-width path
+(escape hatch: ``APEX_TPU_FLASH_PACK_D64=0`` /
+``flash_attention.set_head_packing(False)``).  The dropout keep masks
+are coordinate-hashed in GLOBAL positions, so packed and unpacked
+shards draw identical masks and the ring merge is unaffected.
 """
 from __future__ import annotations
 
@@ -109,9 +119,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (o, lse) pairs — per-step attention memory drops from the
     materialized O(s_local^2) fp32 scores to the kernel's blockwise
     working set, and the MXU kernel replaces the unfused einsum
-    softmax.  Same math either way; causal blocks wholly in the future
-    still run their (masked) matmuls in both modes — the merge
-    annihilates them.
+    softmax.  At d=64 with even ``h`` the partial runs the head-packed
+    full-width kernels (module note above).  Same math either way;
+    causal blocks wholly in the future still run their (masked)
+    matmuls in both modes — the merge annihilates them.
 
     ``dropout_rate`` applies attention dropout with GLOBAL-position
     keep masks (the round-4 in-kernel dropout, threaded through SP):

@@ -123,6 +123,127 @@ class TestFitCompactLine:
         assert set(json.loads(line)["extras"]) == keys_before
 
 
+class TestHeadlineOnlyFallback:
+    def test_nondroppable_bloat_falls_back_to_headline_only(self):
+        """The drop loop only covers the droppable keys; if the
+        non-droppable residue itself outgrows the limit the function
+        must fall back to a minimal headline-only object — a valid,
+        under-limit JSON line — instead of silently returning an
+        oversized one (the round-4 failure mode it exists to kill)."""
+        c = bench._compact_summary(_full_report())
+        c["extras"]["bogus_nondroppable"] = "y" * 3000
+        line = bench._fit_compact_line(c)
+        assert len(line) <= 1800
+        rt = json.loads(line)
+        assert rt["metric"] == c["metric"]
+        assert rt["value"] == c["value"]
+        assert rt["full_report"] == "BENCH_FULL.json"
+        # the caller's dict is untouched either way
+        assert "bogus_nondroppable" in c["extras"]
+
+
+class TestWallVoiding:
+    """_void_noisy_wall: a wall dt below the xprof device self-time is
+    physically impossible (slope noise) — the wall rate is voided, the
+    device rate stays the artifact of record (round-5 committed a
+    116.1 TF/s wall row against a 97.3 device rate)."""
+
+    def test_impossible_wall_is_voided(self):
+        row = {"tflops_per_sec": 116.1, "device_tflops_per_sec": 97.3}
+        bench._void_noisy_wall(row, wall_s=0.03316, dev_s=0.03954,
+                               label="t")
+        assert row["tflops_per_sec"] is None
+        assert "wall_voided" in row
+        assert row["device_tflops_per_sec"] == 97.3
+
+    def test_sane_wall_is_kept(self):
+        row = {"tflops_per_sec": 95.0, "device_tflops_per_sec": 97.3}
+        bench._void_noisy_wall(row, wall_s=0.041, dev_s=0.0395,
+                               label="t")
+        assert row["tflops_per_sec"] == 95.0 and "wall_voided" not in row
+
+    def test_no_device_measurement_is_a_noop(self):
+        row = {"tflops_per_sec": 95.0}
+        bench._void_noisy_wall(row, wall_s=0.01, dev_s=None, label="t")
+        assert row["tflops_per_sec"] == 95.0
+
+    def test_compact_summary_survives_a_voided_wall(self):
+        full = _full_report()
+        full["extras"]["long_context"]["s8192"] = {
+            "tflops_per_sec": None, "device_tflops_per_sec": 95.8,
+            "wall_voided": "wall dt < device self-time (slope noise)"}
+        ce = bench._compact_summary(full)["extras"]
+        assert ce["longctx_tfs"]["s8192"] == 95.8
+
+
+class TestInterruptedRunArtifactSurvival:
+    """The round-6 capture contract: per-section checkpoints land in
+    ``<path>.partial``, the compact line prints after EVERY section
+    (last-line-wins), and the committed BENCH_FULL.json changes ONLY
+    via finalize()'s atomic rename on full completion — a simulated
+    driver timeout must leave the committed artifact byte-identical."""
+
+    @staticmethod
+    def _writer(tmp_path, committed_text='{"metric": "seed-state"}'):
+        path = tmp_path / "BENCH_FULL.json"
+        path.write_text(committed_text)
+        full = {"metric": "resnet50_o5_train_images_per_sec_1chip",
+                "value": 2743.0, "unit": "images/sec",
+                "vs_baseline": 1.097, "extras": {}}
+        return path, full, bench._ArtifactWriter(full, str(path))
+
+    def test_interrupt_preserves_committed_artifact(self, tmp_path,
+                                                    capsys):
+        path, full, w = self._writer(tmp_path)
+        committed = path.read_text()
+        w.checkpoint()
+        bench._run_section(
+            full["extras"], "long_context",
+            lambda: {"s8192": {"device_tflops_per_sec": 95.8}}, w)
+
+        def timed_out():
+            # the driver's kill arrives as a signal, not an Exception —
+            # _run_section must not swallow it into an {"error"} row
+            raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            bench._run_section(full["extras"], "ring_flash", timed_out,
+                               w)
+        # the committed artifact is byte-identical
+        assert path.read_text() == committed
+        # the scratch checkpoint carries every completed section
+        scratch = json.loads(
+            (tmp_path / "BENCH_FULL.json.partial").read_text())
+        assert scratch["extras"]["long_context"]["s8192"][
+            "device_tflops_per_sec"] == 95.8
+        # last stdout line is parseable JSON with the completed rows
+        out_lines = [ln for ln in
+                     capsys.readouterr().out.strip().splitlines() if ln]
+        last = json.loads(out_lines[-1])
+        assert last["extras"]["longctx_tfs"]["s8192"] == 95.8
+        assert last["value"] == 2743.0
+
+    def test_errored_section_still_emits_a_line(self, tmp_path, capsys):
+        path, full, w = self._writer(tmp_path)
+        bench._run_section(full["extras"], "boom",
+                           lambda: 1 / 0, w)
+        assert "error" in full["extras"]["boom"]
+        last = capsys.readouterr().out.strip().splitlines()[-1]
+        assert json.loads(last)["value"] == 2743.0
+
+    def test_finalize_commits_atomically(self, tmp_path):
+        path, full, w = self._writer(tmp_path)
+        bench._run_section(
+            full["extras"], "ring_flash",
+            lambda: {"device_tflops_per_sec": 112.8}, w)
+        w.finalize()
+        committed = json.loads(path.read_text())
+        assert committed["extras"]["ring_flash"][
+            "device_tflops_per_sec"] == 112.8
+        # scratch is consumed by the rename
+        assert not (tmp_path / "BENCH_FULL.json.partial").exists()
+
+
 class TestSlopeFloor:
     """_slope_dt is the round-4 'impossible bandwidth' fix: a slope
     below the physical-peak floor (or inverted by noise) falls back to

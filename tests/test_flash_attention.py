@@ -245,6 +245,219 @@ def test_fully_masked_rows_zero_output_and_grads():
     assert np.isfinite(np.asarray(dv)).all()
 
 
+class TestHeadPackedD64:
+    """d=64 head-pair packing (the round-6 full-width MXU path): two
+    heads share one 128-lane tile and the kernels recover per-head
+    scores via the sigma rotation.  Parity vs the jnp reference AND vs
+    the forced-unpacked kernels at the SAME tolerances as the d=128
+    path, across the fused single-block backward and both two-pass
+    backward kernels, causal and non-causal, with and without the
+    kv_mask segment masking, plus the partial (ring) entry and
+    in-kernel dropout."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_packing(self):
+        from apex_tpu.ops import flash_attention as fa
+        assert fa.head_packing_enabled()   # default ON
+        yield
+        fa.set_head_packing(True)
+
+    @staticmethod
+    def _unpacked(fn, *args, **kw):
+        from apex_tpu.ops import flash_attention as fa
+        fa.set_head_packing(False)
+        try:
+            return fn(*args, **kw)
+        finally:
+            fa.set_head_packing(True)
+
+    def test_dispatch_predicate(self):
+        from apex_tpu.ops.flash_attention import _use_head_packing
+        assert _use_head_packing(2, 64) and _use_head_packing(16, 64)
+        assert not _use_head_packing(3, 64)    # odd h
+        assert not _use_head_packing(16, 128)  # already full-width
+        assert not _use_head_packing(16, 32)
+
+    def test_escape_hatch(self):
+        from apex_tpu.ops import flash_attention as fa
+        fa.set_head_packing(False)
+        assert not fa.head_packing_enabled()
+        assert not fa._use_head_packing(16, 64)
+        fa.set_head_packing(True)
+        assert fa._use_head_packing(16, 64)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_forward_parity_fused(self, causal, dtype):
+        # h even + d=64 -> packed; single-block forward kernel
+        q, k, v = make_qkv(b=2, h=4, sq=128, sk=128, dtype=dtype, seed=1)
+        got = flash_attention(q, k, v, causal=causal)
+        want = mha_reference(q, k, v, causal=causal)
+        tol = 2e-5 if dtype == jnp.float32 else 2e-2
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=tol, atol=tol)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_parity_grid(self, causal):
+        # multi-block online-softmax kernel, unaligned sq + cross attn
+        q, k, v = make_qkv(b=1, h=2, sq=200, sk=384, seed=2)
+        got = flash_attention(q, k, v, causal=causal, block_q=128,
+                              block_k=128)
+        want = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_backward_parity_fused_kernel(self, causal, masked):
+        # s=128 at default blocks -> the packed _bwd_fused_kernel
+        q, k, v = make_qkv(b=2, h=2, sq=128, sk=128, seed=3)
+        m = TestKeyPaddingMask._mask(2, 128) if masked else None
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           kv_mask=m) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal,
+                                         kv_mask=m) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_backward_parity_two_pass_kernels(self, causal, masked):
+        # 128-blocks over s=320 -> the packed _bwd_dq + _bwd_dkv pair
+        q, k, v = make_qkv(b=1, h=2, sq=320, sk=320, seed=4)
+        m = TestKeyPaddingMask._mask(1, 320) if masked else None
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           kv_mask=m, block_q=128,
+                                           block_k=128) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, causal=causal,
+                                         kv_mask=m) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-3, atol=1e-3,
+                                       err_msg=f"d{name}")
+
+    def test_packed_matches_forced_unpacked(self):
+        """The escape hatch selects a different kernel layout, not a
+        different computation: outputs and gradients agree to fp
+        reassociation noise."""
+        q, k, v = make_qkv(b=1, h=4, sq=256, sk=256, seed=5)
+
+        def run(q, k, v):
+            return flash_attention(q, k, v, causal=True, block_q=128,
+                                   block_k=128)
+
+        def loss(q, k, v):
+            return jnp.sum(run(q, k, v) ** 2)
+
+        got = run(q, k, v)
+        want = self._unpacked(run, q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gu = self._unpacked(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+        for a, b_, name in zip(gp, gu, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name}")
+
+    def test_partial_entry_offsets_and_lse(self):
+        """The ring building block: packed partial (o, lse) at traced
+        GLOBAL offsets — o, lse AND the lse-cotangent gradients match
+        the forced-unpacked kernels."""
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        s = 128
+        q, k, v = make_qkv(b=1, h=2, sq=s, sk=s, seed=6)
+
+        def partial(q, k, v):
+            return flash_attention_partial(
+                q, k, v, causal=True, q_offset=jnp.int32(s),
+                k_offset=jnp.int32(0))
+
+        def loss(q, k, v):
+            o, lse = partial(q, k, v)
+            return jnp.sum(o ** 2) + jnp.sum(jnp.sin(lse))
+
+        (op, lp) = partial(q, k, v)
+        (ou, lu) = self._unpacked(partial, q, k, v)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(ou),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(lu),
+                                   rtol=2e-5, atol=2e-5)
+        gp = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        gu = self._unpacked(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+        for a, b_, name in zip(gp, gu, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name}")
+
+    def test_fully_future_block_is_dead(self):
+        """A packed ring block entirely in the causal future emits
+        exactly 0 with an annihilating lse (the merge contract)."""
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        s = 128
+        q, k, v = make_qkv(b=1, h=2, sq=s, sk=s, seed=7)
+        o, lse = flash_attention_partial(
+            q, k, v, causal=True, q_offset=jnp.int32(0),
+            k_offset=jnp.int32(s))
+        np.testing.assert_array_equal(np.asarray(o), 0.0)
+        assert float(np.asarray(lse).max()) < -1e28
+
+    def test_in_kernel_dropout_mask_is_layout_invariant(self):
+        """The coordinate-hash keep mask is a function of GLOBAL
+        (seed, head, row, col) — packed and unpacked kernels must drop
+        the SAME entries, so outputs and gradients agree."""
+        from apex_tpu.ops.flash_attention import flash_attention_partial
+        s, rate, seed = 128, 0.3, 1234
+        q, k, v = make_qkv(b=1, h=2, sq=s, sk=s, seed=8)
+
+        def drop(q, k, v):
+            return flash_attention_partial(
+                q, k, v, causal=True, q_offset=jnp.int32(s),
+                k_offset=jnp.int32(0), dropout_rate=rate,
+                dropout_seed=seed, head_offset=4)[0]
+
+        got = drop(q, k, v)
+        want = self._unpacked(drop, q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        gp = jax.grad(lambda q: jnp.sum(drop(q, k, v) ** 2))(q)
+        gu = self._unpacked(
+            jax.grad(lambda q: jnp.sum(drop(q, k, v) ** 2)), q)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gu),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bf16_backward(self):
+        q, k, v = make_qkv(b=1, h=2, sq=128, sk=128,
+                           dtype=jnp.bfloat16, seed=9)
+        g = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, causal=True)
+            .astype(jnp.float32)))(q)
+        assert g.dtype == jnp.bfloat16
+        gr = jax.grad(lambda q: jnp.sum(
+            mha_reference(q, k, v, causal=True)
+            .astype(jnp.float32)))(q)
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(gr, np.float32),
+                                   rtol=1e-1, atol=1e-1)
+
+
 class TestELayout:
     """flash_attention_e: the projection-native (b, s, h, 3d) entry —
     no relayout copies at the attention boundary."""

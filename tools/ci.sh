@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# One-command CI entrypoint — the repo's counterpart of the reference's
+# build/test matrix (ref: tests/docker_extension_builds/run.sh,
+# .jenkins/build.sh).  A fresh clone proves itself green with:
+#
+#     tools/ci.sh
+#
+# Steps, failing fast on the first red one:
+#   1. default test tier   — CPU backend, 8 virtual devices, slow tier
+#                            skipped (APEX_TPU_FULL=1 upgrades to the
+#                            full tier, the builder's verify flow)
+#   2. README drift guard  — the closing-numbers block must byte-match
+#                            what tools/readme_numbers.py renders from
+#                            the committed BENCH_FULL.json
+#   3. 8-device dryrun     — the multichip legs (GPT 3D DP x TP x PP,
+#                            ResNet DP, SP/MoE/ZeRO) on a virtual mesh
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "[ci] 1/3 default test tier"
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+
+echo "[ci] 2/3 README drift guard"
+python tools/readme_numbers.py --check
+
+echo "[ci] 3/3 8-device multichip dryrun"
+python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "[ci] all green"
