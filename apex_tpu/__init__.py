@@ -20,30 +20,19 @@ NVIDIA Apex (reference: guolinke/apex):
 No CUDA, no torch: compute lowers to XLA/Pallas; collectives ride the
 ICI/DCN mesh.
 """
-import logging as _logging
-
 from . import parallel_state  # noqa: F401
+# ONE rank-stamped handler on the "apex_tpu" root, installed by the one
+# configurator (ref: apex/__init__.py:29-42's logger setup).  The
+# formatter is re-exported here for parity; utils.log_util.get_logger is
+# how library modules obtain loggers.
+from .utils.log_util import (  # noqa: F401
+    RankInfoFormatter,
+    _configure_library_root_logger,
+)
 
 __version__ = "0.1.0"
 
-
-class RankInfoFormatter(_logging.Formatter):
-    """Stamp topology info on every record
-    (ref: apex/__init__.py:29-42 RankInfoFormatter)."""
-
-    def format(self, record):
-        record.rank_info = parallel_state.get_rank_info() \
-            if parallel_state.model_parallel_is_initialized() else "-"
-        return super().format(record)
-
-
-_logger = _logging.getLogger("apex_tpu")
-if not _logger.handlers:
-    _handler = _logging.StreamHandler()
-    _handler.setFormatter(RankInfoFormatter(
-        "%(asctime)s [%(levelname)s|%(rank_info)s] %(name)s: %(message)s"))
-    _logger.addHandler(_handler)
-    _logger.setLevel(_logging.WARNING)
+_configure_library_root_logger()
 
 
 def __getattr__(name):
@@ -52,6 +41,6 @@ def __getattr__(name):
     if name in ("amp", "optimizers", "ops", "normalization", "parallel",
                 "transformer", "models", "utils", "contrib", "fp16_utils",
                 "mlp", "fused_dense", "reparameterization", "testing",
-                "pyprof", "data"):
+                "pyprof", "data", "monitor"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")

@@ -168,6 +168,46 @@ def update(state: ScalerState, grads_finite: jnp.ndarray) -> ScalerState:
     )
 
 
+def snapshot(state: ScalerState) -> dict:
+    """Host-side scalar view of scaler state for telemetry (forces a
+    device sync — call once per step at most, outside jit)."""
+    return {"loss_scale": float(state.loss_scale),
+            "growth_tracker": int(state.growth_tracker),
+            "steps_skipped": int(state.steps_skipped)}
+
+
+def update_telemetry(prev: Optional[dict], cur) -> dict:
+    """Describe the latest :func:`update` transition for run telemetry.
+
+    The reference surfaces overflow skips only as a printed
+    "Gradient overflow.  Skipping step" line (ref: apex/amp/scaler.py
+    update_scale); here the transition is structured so
+    :class:`apex_tpu.monitor.StepMonitor` can log the scale and feed the
+    overflow-streak watchdog.  ``cur`` is either a :class:`ScalerState`
+    or an :class:`~apex_tpu.amp.mixed_precision.StepInfo`; ``prev`` is
+    the previous step's :func:`snapshot` (``None`` on the first step,
+    when a skip cannot be distinguished without the measured flag).
+    """
+    if hasattr(cur, "grads_checked"):  # amp StepInfo: the measured flag
+        checked = bool(cur.grads_checked)
+        scale = float(cur.loss_scale)
+        skipped = int(cur.steps_skipped)
+        overflow = checked and not bool(cur.grads_finite)
+        if not checked and prev is not None:
+            overflow = skipped > prev["steps_skipped"]
+    else:  # bare ScalerState: infer the skip from the counter delta
+        checked = False
+        scale = float(cur.loss_scale)
+        skipped = int(cur.steps_skipped)
+        overflow = prev is not None and skipped > prev["steps_skipped"]
+    return {"loss_scale": scale,
+            "steps_skipped": skipped,
+            "overflow": bool(overflow),
+            "scale_changed": (prev is not None
+                              and scale != prev["loss_scale"]),
+            "checked": checked}
+
+
 def state_dict(state: ScalerState) -> dict:
     """Serializable view (ref: amp.state_dict, apex/amp/frontend.py:428-437)."""
     return {

@@ -1,0 +1,55 @@
+"""apex_tpu.monitor — structured run telemetry.
+
+The run-health spine the reference never had: its observability ships as
+three disconnected pieces (pyprof's nvtx->parse->prof device-time
+pipeline, Megatron-style ``Timers``, ad-hoc ``print_rank_last`` loss
+lines).  This package gives drivers, amp, the pipeline schedules, and
+bench.py ONE structured emission path, in three layers:
+
+1. **Events + sinks** (:mod:`.events`) — a frozen :class:`Event` record
+   (``time``, ``step``, ``kind``, ``name``, ``value``, ``attrs``) with
+   pluggable sinks: :class:`JsonlSink` (append-only, one valid JSON line
+   per event, crash-safe by construction), :class:`MemorySink` (tests),
+   :class:`TeeSink`, plus adapters bridging the ``add_scalar`` world in
+   both directions (:class:`ScalarWriter` lets ``Timers.write`` target a
+   sink unchanged; :class:`WriterSink` forwards events to any
+   TensorBoard-like writer).
+
+2. **StepMonitor** (:mod:`.step_monitor`) — per-step recorder computing
+   run-health metrics host-side (loss, grad-norm, lr, amp loss-scale /
+   overflow via :func:`apex_tpu.amp.scaler.update_telemetry`, tokens/s,
+   step wall ms, MFU against :func:`apex_tpu.pyprof.prof.device_spec`)
+   with a :class:`Watchdog` (:mod:`.watchdog`) raising once-per-episode
+   alarms on non-finite loss, overflow streaks, and wall-clock stalls
+   (heartbeat thread; optional ``jax.profiler`` dump of a wedged step).
+
+3. **Summary** (:mod:`.summary`) — parse a JSONL run back into a
+   throughput / overflow / phase-time / alarm digest
+   (``tools/monitor_summary.py`` is the CLI).
+
+When to reach for what: ``monitor`` = run health over time; ``pyprof`` =
+where device time went; ``Timers`` = phase wall times (and they export
+into the monitor log via ``Timers.events``).  Full story with the JSONL
+schema: docs/api/observability.md.
+"""
+from .events import (
+    KINDS,
+    SCHEMA_VERSION,
+    Event,
+    JsonlSink,
+    MemorySink,
+    ScalarWriter,
+    Sink,
+    TeeSink,
+    WriterSink,
+)
+from .step_monitor import StepMonitor
+from .summary import load_events, render, summarize
+from .watchdog import Watchdog
+
+__all__ = [
+    "Event", "Sink", "JsonlSink", "MemorySink", "TeeSink",
+    "WriterSink", "ScalarWriter", "KINDS", "SCHEMA_VERSION",
+    "StepMonitor", "Watchdog",
+    "load_events", "summarize", "render",
+]

@@ -1,0 +1,226 @@
+"""Telemetry events and sinks — the base layer of :mod:`apex_tpu.monitor`.
+
+One frozen :class:`Event` record and pluggable :class:`Sink` targets.
+The reference ships run observability as disconnected fragments (pyprof's
+nvtx->parse->prof pipeline, Megatron ``Timers``, ad-hoc
+``print_rank_last`` loss lines); every emitter here — step metrics, amp
+scale transitions, watchdog alarms, pipeline phase timers, bench
+sections — flows through the same record type into the same sink, so a
+killed or stalled run leaves one inspectable log instead of scattered
+prints.
+
+:class:`JsonlSink` is crash-safe *by construction*: append-only, one
+event per line, flushed per event — every committed line is valid JSON
+on its own and there is no end-of-run rewrite to lose (the failure mode
+that twice clobbered bench artifacts; see bench.py ``_ArtifactWriter``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+SCHEMA_VERSION = 1
+
+#: Canonical ``Event.kind`` values (open set — consumers must tolerate
+#: unknown kinds):
+#:   ``run``     run lifecycle (``run_start`` / ``run_end``)
+#:   ``metric``  per-step scalars (loss, grad_norm, lr, step_ms,
+#:               tokens_per_sec, mfu, ...)
+#:   ``scale``   amp loss-scale state (``loss_scale``, ``overflow``)
+#:   ``alarm``   watchdog alarms (``stall``, ``nonfinite_loss``,
+#:               ``overflow_streak``) and their ``*_recovered`` pairs
+#:   ``timer``   phase times exported from ``Timers.events`` (seconds)
+#:   ``section`` bench/driver section lifecycle (``section_start`` /
+#:               ``section_done`` / ``section_error``)
+KINDS = ("run", "metric", "scale", "alarm", "timer", "section")
+
+
+def _jsonable(v: Any) -> Any:
+    """Coerce device scalars / numpy types to plain JSON values."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        # bare NaN/Infinity is not valid JSON; encode as a string so
+        # every committed line parses everywhere
+        return v if math.isfinite(v) else str(v)
+    try:
+        f = float(v)
+        return f if math.isfinite(f) else str(f)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One telemetry record.
+
+    ``value`` carries the single scalar most consumers want; anything
+    richer rides ``attrs``.  ``time`` is host wall-clock (epoch
+    seconds); ``step`` is the training step, ``None`` for run-level
+    events.
+    """
+
+    time: float
+    step: Optional[int]
+    kind: str
+    name: str
+    value: Optional[float] = None
+    attrs: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> str:
+        d: Dict[str, Any] = {
+            "time": round(float(self.time), 6),
+            "step": None if self.step is None else int(self.step),
+            "kind": self.kind,
+            "name": self.name,
+            "value": _jsonable(self.value),
+        }
+        if self.attrs:
+            d["attrs"] = {str(k): _jsonable(v)
+                          for k, v in self.attrs.items()}
+        return json.dumps(d, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(line: str) -> "Event":
+        d = json.loads(line)
+        return Event(time=float(d["time"]),
+                     step=d.get("step"),
+                     kind=d["kind"],
+                     name=d["name"],
+                     value=d.get("value"),
+                     attrs=d.get("attrs") or {})
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+
+class Sink:
+    """Where events go.  Implementations must be cheap per event and
+    must never raise out of ``emit`` into the training loop."""
+
+    def emit(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MemorySink(Sink):
+    """In-process event list — the test double."""
+
+    def __init__(self):
+        self.events: List[Event] = []
+
+    def emit(self, event: Event) -> None:
+        self.events.append(event)
+
+    def by_kind(self, kind: str) -> List[Event]:
+        return [e for e in self.events if e.kind == kind]
+
+    def by_name(self, name: str) -> List[Event]:
+        return [e for e in self.events if e.name == name]
+
+
+class JsonlSink(Sink):
+    """Append-only JSONL file, one event per line, flushed per line.
+
+    Crash-safe by construction: a kill at any instant leaves a file
+    whose every complete line is independently valid JSON (at worst one
+    truncated trailing line, which :func:`~apex_tpu.monitor.summary.
+    load_events` tolerates).  There is deliberately no buffering and no
+    end-of-run rewrite.
+    """
+
+    def __init__(self, path: str, append: bool = True):
+        self.path = path
+        self._f = open(path, "a" if append else "w")
+        self._lock = threading.Lock()
+
+    def emit(self, event: Event) -> None:
+        line = event.to_json()
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class TeeSink(Sink):
+    """Fan one event stream out to several sinks."""
+
+    def __init__(self, *sinks: Sink):
+        self.sinks = list(sinks)
+
+    def emit(self, event: Event) -> None:
+        for s in self.sinks:
+            s.emit(event)
+
+    def flush(self) -> None:
+        for s in self.sinks:
+            s.flush()
+
+    def close(self) -> None:
+        for s in self.sinks:
+            s.close()
+
+
+class WriterSink(Sink):
+    """Adapter: forward scalar-valued events to any TensorBoard-like
+    object exposing ``add_scalar(tag, value, global_step)`` — an
+    existing summary writer plugs into the monitor unchanged."""
+
+    def __init__(self, writer: Any):
+        self.writer = writer
+
+    def emit(self, event: Event) -> None:
+        if event.value is None or isinstance(event.value, str):
+            return
+        self.writer.add_scalar(f"{event.kind}/{event.name}",
+                               float(event.value),
+                               0 if event.step is None else event.step)
+
+
+class ScalarWriter:
+    """The inverse adapter: an ``add_scalar``-style facade over a sink,
+    so ``Timers.write(names, writer, iteration)``
+    (apex_tpu/transformer/pipeline_parallel/utils.py) and any other
+    add_scalar caller emits :class:`Event` s without modification."""
+
+    def __init__(self, sink: Sink, kind: str = "timer",
+                 clock=time.time):
+        self.sink = sink
+        self.kind = kind
+        self._clock = clock
+
+    def add_scalar(self, name: str, value: float,
+                   global_step: Optional[int] = None) -> None:
+        self.sink.emit(Event(time=self._clock(),
+                             step=None if global_step is None
+                             else int(global_step),
+                             kind=self.kind, name=str(name),
+                             value=float(value)))

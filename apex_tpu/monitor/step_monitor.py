@@ -1,0 +1,208 @@
+"""Per-step run-health recorder — the middle layer of
+:mod:`apex_tpu.monitor`.
+
+:class:`StepMonitor` turns a train loop's per-step aux outputs into
+structured events: loss, grad-norm, learning rate, amp loss-scale and
+overflow state (via :func:`apex_tpu.amp.scaler.update_telemetry`),
+tokens/s, step wall ms, and MFU against the attached device's peak
+(:func:`apex_tpu.pyprof.prof.device_spec`) — plus a
+:class:`~apex_tpu.monitor.watchdog.Watchdog` raising alarms on
+non-finite loss, overflow streaks, and wall-clock stalls.
+
+Division of labor (see docs/api/observability.md):
+``pyprof`` answers *where did device time go* (per-op attribution),
+``Timers`` answers *how long did each phase take* (host phase timing),
+``monitor`` answers *is the run healthy over time* — and the other two
+feed into it (``Timers.events`` exports phase times as ``timer``
+events; MFU reads the pyprof device spec).
+"""
+from __future__ import annotations
+
+import math
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from .events import Event, Sink
+from .watchdog import Watchdog
+
+
+def _host_float(x: Any) -> Optional[float]:
+    """Fetch a (device) scalar as a host float; None stays None."""
+    if x is None:
+        return None
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return None
+
+
+class StepMonitor:
+    """Records one event stream for a training/serving run.
+
+    Construction emits ``run_start``; :meth:`close` emits ``run_end``
+    with totals.  Per step, call :meth:`start_step` before the work and
+    :meth:`end_step` after it with whatever aux outputs the step
+    produced — every argument is optional, so partial instrumentation
+    still yields a useful log.
+
+    ``StepMonitor`` also quacks like a :class:`~apex_tpu.monitor.events.
+    Sink` (:meth:`emit`), so ``Timers.events(monitor, iteration)`` and
+    any other sink consumer can write through it directly.
+    """
+
+    def __init__(self, sink: Sink, *,
+                 tokens_per_step: Optional[float] = None,
+                 flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None,
+                 watchdog: Optional[Watchdog] = None,
+                 clock=time.perf_counter,
+                 wall_clock=time.time,
+                 run_attrs: Optional[Dict[str, Any]] = None,
+                 close_sink: bool = True):
+        self._sink = sink
+        # close_sink=False when the sink is shared (another monitor, a
+        # later Timers export): close() then leaves it open — a closed
+        # JsonlSink silently drops every subsequent event.
+        self._close_sink = bool(close_sink)
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self._peak_flops = peak_flops  # resolved lazily off pyprof
+        self.watchdog = watchdog
+        self._clock = clock
+        self._wall = wall_clock
+        self._step_t0: Optional[float] = None
+        self._run_t0 = clock()
+        self._steps_seen = 0
+        self._last_step: Optional[int] = None
+        self._scaler_prev: Optional[dict] = None
+        attrs = dict(run_attrs or {})
+        attrs.setdefault("schema", 1)
+        self.event("run", "run_start", **attrs)
+        if self.watchdog is not None:
+            self.watchdog.start()
+
+    # -- sink facade ---------------------------------------------------------
+
+    def emit(self, event: Event) -> None:
+        self._sink.emit(event)
+
+    def event(self, kind: str, name: str, value=None,
+              step: Optional[int] = None, **attrs) -> None:
+        self._sink.emit(Event(time=self._wall(), step=step, kind=kind,
+                              name=name, value=value, attrs=attrs))
+
+    # -- per-step recording --------------------------------------------------
+
+    def start_step(self, step: Optional[int] = None) -> None:
+        self._step_t0 = self._clock()
+        self._last_step = step
+
+    def peak_flops(self) -> Optional[float]:
+        """Device peak FLOP/s for the MFU denominator, resolved once
+        from the pyprof device spec when not given explicitly."""
+        if self._peak_flops is None:
+            try:
+                from ..pyprof.prof import device_spec
+
+                self._peak_flops = device_spec().peak_bf16_tflops * 1e12
+            except Exception:  # no device spec -> no MFU, never crash
+                self._peak_flops = 0.0
+        return self._peak_flops or None
+
+    def end_step(self, step: Optional[int] = None, *,
+                 loss=None, grad_norm=None, lr=None,
+                 scaler=None, tokens: Optional[float] = None,
+                 **extra_metrics) -> None:
+        """Record one completed step.
+
+        ``loss`` / ``grad_norm`` / ``lr`` may be device scalars (one
+        host sync each).  ``scaler`` accepts an
+        :class:`~apex_tpu.amp.mixed_precision.StepInfo`, an
+        :class:`~apex_tpu.amp.scaler.ScalerState`, or an ``AmpState``
+        (its first scaler is read).  ``tokens`` overrides the
+        constructor's ``tokens_per_step`` for this step.  Extra keyword
+        scalars become additional ``metric`` events.
+        """
+        if step is None:
+            step = self._last_step
+        self._steps_seen += 1
+        now = self._clock()
+        dt = (now - self._step_t0) if self._step_t0 is not None else None
+        self._step_t0 = None
+
+        loss_f = _host_float(loss)
+        metrics: Dict[str, Optional[float]] = {
+            "loss": loss_f,
+            "grad_norm": _host_float(grad_norm),
+            "lr": _host_float(lr),
+        }
+        if dt is not None and dt > 0.0:
+            metrics["step_ms"] = dt * 1e3
+            n_tok = tokens if tokens is not None else self.tokens_per_step
+            if n_tok:
+                metrics["tokens_per_sec"] = float(n_tok) / dt
+            peak = self.peak_flops()
+            if self.flops_per_step and peak:
+                metrics["mfu"] = self.flops_per_step / dt / peak
+        for k, v in extra_metrics.items():
+            metrics[k] = _host_float(v)
+
+        for name, v in metrics.items():
+            if v is None:
+                continue
+            if not math.isfinite(v):
+                # bare NaN is not valid JSON; keep the record parseable
+                self.event("metric", name, value=None, step=step,
+                           nonfinite=str(v))
+            else:
+                self.event("metric", name, value=v, step=step)
+
+        overflow = self._record_scaler(scaler, step)
+        if self.watchdog is not None:
+            self.watchdog.observe_step(step, loss=loss_f,
+                                       overflow=overflow)
+
+    def _record_scaler(self, scaler, step) -> Optional[bool]:
+        """Emit amp ``scale`` events; returns this step's overflow flag
+        (None when no scaler is being tracked)."""
+        if scaler is None:
+            return None
+        try:
+            from ..amp import scaler as _scaler
+
+            if hasattr(scaler, "scalers"):  # AmpState
+                scaler = scaler.scaler
+            tel = _scaler.update_telemetry(self._scaler_prev, scaler)
+        except Exception as e:  # telemetry must never kill the step
+            print(f"[monitor] scaler telemetry failed: {str(e)[:160]}",
+                  file=sys.stderr)
+            return None
+        self.event("scale", "loss_scale", value=tel["loss_scale"],
+                   step=step, steps_skipped=tel["steps_skipped"],
+                   checked=tel["checked"])
+        if tel["overflow"]:
+            streak = (self.watchdog.overflow_count + 1
+                      if self.watchdog is not None else None)
+            self.event("scale", "overflow", value=1.0, step=step,
+                       streak=streak)
+        self._scaler_prev = {"loss_scale": tel["loss_scale"],
+                             "steps_skipped": tel["steps_skipped"]}
+        return tel["overflow"]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        self.event("run", "run_end",
+                   steps=self._steps_seen,
+                   wall_s=round(self._clock() - self._run_t0, 3))
+        if self._close_sink:
+            self._sink.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

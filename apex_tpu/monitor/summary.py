@@ -1,0 +1,242 @@
+"""Render a monitor JSONL run log into a human summary.
+
+The read side of the telemetry spine: :func:`load_events` parses an
+append-only event log back into :class:`~apex_tpu.monitor.events.Event`
+records (tolerating the one truncated trailing line a kill mid-write can
+leave), :func:`summarize` folds them into a run-health digest —
+throughput, loss trajectory, amp overflow history, watchdog alarms,
+phase-timer totals, bench section outcomes — and :func:`render` prints
+it as tables.  ``tools/monitor_summary.py`` is the CLI wrapper.
+"""
+from __future__ import annotations
+
+import statistics
+import sys
+from typing import Dict, List, Optional
+
+from .events import Event
+
+
+def load_events(path: str) -> tuple:
+    """Parse a JSONL event log.  Returns ``(events, malformed)`` where
+    ``malformed`` counts undecodable lines (a crash-truncated tail is
+    expected and must not sink the post-mortem — the whole point of the
+    line-per-event format)."""
+    events: List[Event] = []
+    malformed = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(Event.from_json(line))
+            except Exception:
+                malformed += 1
+    return events, malformed
+
+
+def _series(events: List[Event], kind: str, name: str) -> List[float]:
+    return [float(e.value) for e in events
+            if e.kind == kind and e.name == name
+            and isinstance(e.value, (int, float))]
+
+
+def summarize(events: List[Event], malformed: int = 0) -> dict:
+    """Fold an event stream into the run-health digest dict."""
+    out: Dict[str, object] = {"n_events": len(events),
+                              "malformed_lines": malformed}
+    for e in events:
+        if e.kind == "run" and e.name == "run_start":
+            out["run"] = dict(e.attrs)
+            break
+    for e in reversed(events):
+        if e.kind == "run" and e.name == "run_end":
+            out["run_end"] = dict(e.attrs)
+            break
+
+    # step metrics --------------------------------------------------------
+    losses = _series(events, "metric", "loss")
+    step_ms = _series(events, "metric", "step_ms")
+    tps = _series(events, "metric", "tokens_per_sec")
+    mfu = _series(events, "metric", "mfu")
+    steps = sorted({e.step for e in events
+                    if e.kind == "metric" and e.step is not None})
+    stats: Dict[str, object] = {"count": len(steps)}
+    if steps:
+        stats["first"], stats["last"] = steps[0], steps[-1]
+    if losses:
+        stats["loss_first"] = losses[0]
+        stats["loss_last"] = losses[-1]
+        stats["loss_min"] = min(losses)
+    nonfinite = [e for e in events if e.kind == "metric"
+                 and e.name == "loss" and "nonfinite" in e.attrs]
+    if nonfinite:
+        stats["nonfinite_losses"] = len(nonfinite)
+    if step_ms:
+        stats["step_ms_mean"] = statistics.fmean(step_ms)
+        stats["step_ms_min"] = min(step_ms)
+    if tps:
+        stats["tokens_per_sec_mean"] = statistics.fmean(tps)
+    if mfu:
+        stats["mfu_mean"] = statistics.fmean(mfu)
+    out["steps"] = stats
+
+    # amp scale -----------------------------------------------------------
+    scales = _series(events, "scale", "loss_scale")
+    if scales:
+        skipped = [e.attrs.get("steps_skipped") for e in events
+                   if e.kind == "scale" and e.name == "loss_scale"]
+        overflow_events = [e for e in events
+                           if e.kind == "scale" and e.name == "overflow"]
+        out["scale"] = {
+            "first": scales[0], "last": scales[-1],
+            "min": min(scales), "max": max(scales),
+            "overflow_steps": len(overflow_events),
+            "steps_skipped_total": next(
+                (s for s in reversed(skipped) if s is not None), 0),
+        }
+
+    # alarms --------------------------------------------------------------
+    alarms = [e for e in events if e.kind == "alarm"]
+    if alarms:
+        out["alarms"] = [
+            {"name": e.name, "step": e.step, "value": e.value,
+             **dict(e.attrs)} for e in alarms]
+
+    # phase timers --------------------------------------------------------
+    timers: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.kind != "timer" or not isinstance(e.value, (int, float)):
+            continue
+        t = timers.setdefault(e.name, {"count": 0, "total_s": 0.0})
+        t["count"] += 1
+        t["total_s"] += float(e.value)
+    if timers:
+        for t in timers.values():
+            t["mean_ms"] = t["total_s"] * 1e3 / t["count"]
+        out["timers"] = timers
+
+    # bench/driver sections ----------------------------------------------
+    sections: Dict[str, Dict[str, object]] = {}
+    for e in events:
+        if e.kind != "section":
+            continue
+        s = sections.setdefault(e.attrs.get("section", e.name), {})
+        if e.name == "section_start":
+            s.setdefault("status", "started")
+        elif e.name == "section_done":
+            s["status"] = "done"
+            if isinstance(e.value, (int, float)):
+                s["seconds"] = float(e.value)
+        elif e.name == "section_error":
+            s["status"] = "error"
+            s["error"] = e.attrs.get("error", "")
+            if isinstance(e.value, (int, float)):
+                s["seconds"] = float(e.value)
+    if sections:
+        out["sections"] = sections
+    return out
+
+
+def _fmt(v, nd=3) -> str:
+    if isinstance(v, float):
+        return f"{v:.{nd}f}" if abs(v) < 1e5 else f"{v:.3e}"
+    return str(v)
+
+
+def render(summary: dict) -> str:
+    """Text tables for a terminal / CI log."""
+    lines: List[str] = []
+    run = summary.get("run", {})
+    head = " ".join(f"{k}={v}" for k, v in run.items() if k != "schema")
+    lines.append(f"run: {head or '(no run_start event)'}")
+    if summary.get("malformed_lines"):
+        lines.append(f"  ({summary['malformed_lines']} malformed line(s) "
+                     "skipped — truncated tail from a killed run?)")
+
+    st = summary.get("steps", {})
+    if st.get("count"):
+        lines.append("")
+        lines.append(f"steps: {st['count']} "
+                     f"({st.get('first')}..{st.get('last')})")
+        row = []
+        if "loss_first" in st:
+            row.append(f"loss {_fmt(st['loss_first'], 4)} -> "
+                       f"{_fmt(st['loss_last'], 4)} "
+                       f"(min {_fmt(st['loss_min'], 4)})")
+        if "nonfinite_losses" in st:
+            row.append(f"NONFINITE x{st['nonfinite_losses']}")
+        if "step_ms_mean" in st:
+            row.append(f"step {_fmt(st['step_ms_mean'], 1)} ms mean "
+                       f"/ {_fmt(st['step_ms_min'], 1)} ms best")
+        if "tokens_per_sec_mean" in st:
+            row.append(f"{_fmt(st['tokens_per_sec_mean'], 0)} tok/s")
+        if "mfu_mean" in st:
+            row.append(f"MFU {100.0 * st['mfu_mean']:.2f}%")
+        for r in row:
+            lines.append(f"  {r}")
+
+    sc = summary.get("scale")
+    if sc:
+        lines.append("")
+        lines.append(f"amp scale: {_fmt(sc['first'], 1)} -> "
+                     f"{_fmt(sc['last'], 1)} "
+                     f"[{_fmt(sc['min'], 1)}, {_fmt(sc['max'], 1)}], "
+                     f"overflow steps {sc['overflow_steps']}, "
+                     f"total skipped {sc['steps_skipped_total']}")
+
+    alarms = summary.get("alarms")
+    lines.append("")
+    if alarms:
+        lines.append(f"ALARMS ({len(alarms)}):")
+        for a in alarms:
+            extra = {k: v for k, v in a.items()
+                     if k not in ("name", "step", "value")}
+            lines.append(f"  {a['name']} @ step {a.get('step')} "
+                         f"value={a.get('value')} {extra or ''}".rstrip())
+    else:
+        lines.append("alarms: none")
+
+    timers = summary.get("timers")
+    if timers:
+        lines.append("")
+        lines.append(f"{'phase':<24} {'count':>6} {'total s':>10} "
+                     f"{'mean ms':>10}")
+        for name in sorted(timers):
+            t = timers[name]
+            lines.append(f"{name:<24} {t['count']:>6} "
+                         f"{t['total_s']:>10.3f} {t['mean_ms']:>10.2f}")
+
+    sections = summary.get("sections")
+    if sections:
+        lines.append("")
+        lines.append(f"{'section':<24} {'status':<8} {'seconds':>10}")
+        for name, s in sections.items():
+            sec = s.get("seconds")
+            lines.append(
+                f"{name:<24} {s.get('status', '?'):<8} "
+                f"{'' if sec is None else f'{sec:>10.2f}'}"
+                + (f"  {s['error']}" if s.get("error") else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI: ``monitor_summary.py RUN.jsonl`` — exit 0 on a parseable
+    log (alarms are reported, not fatal), 1 on missing/empty input,
+    2 on usage error."""
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print("usage: monitor_summary.py RUN.jsonl", file=sys.stderr)
+        return 2
+    try:
+        events, malformed = load_events(argv[0])
+    except OSError as e:
+        print(f"monitor_summary: {e}", file=sys.stderr)
+        return 1
+    if not events:
+        print(f"monitor_summary: no events in {argv[0]}",
+              file=sys.stderr)
+        return 1
+    print(render(summarize(events, malformed)))
+    return 0

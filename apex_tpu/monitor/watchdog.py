@@ -1,0 +1,214 @@
+"""Run-health watchdog: structured alarms for wedged or diverging runs.
+
+Three alarm classes, each firing **exactly once per episode** (an
+episode ends when the triggering condition clears, re-arming the
+alarm):
+
+- ``nonfinite_loss`` — the host-visible loss went NaN/Inf.
+- ``overflow_streak`` — >= K *consecutive* amp loss-scale overflow
+  skips (a healthy dynamic scaler skips occasionally; a streak means
+  the scale is collapsing or the model diverged in fp16).
+- ``stall`` — no step completed for ``stall_timeout`` seconds.  The
+  optional heartbeat thread (:meth:`Watchdog.start`) notices this even
+  while the main thread is wedged inside a device call — the situation
+  the alarm exists for — and can dump a ``jax.profiler`` trace of the
+  wedged step (``trace_dir``) so the hang is attributable post-mortem.
+
+Every check is driven through an injectable ``clock`` so tests prove
+the episode semantics deterministically on CPU with a fake clock
+(tests/test_monitor.py).
+"""
+from __future__ import annotations
+
+import math
+import sys
+import threading
+import time
+from typing import Any, Optional
+
+from .events import Event, Sink
+
+DEFAULT_OVERFLOW_STREAK = 8
+DEFAULT_STALL_TIMEOUT_S = 300.0
+
+
+def _finite(x: Optional[float]) -> bool:
+    return x is not None and math.isfinite(x)
+
+
+class Watchdog:
+    """Observes step completions, raises ``alarm`` events into a sink.
+
+    Drive it from a :class:`~apex_tpu.monitor.step_monitor.StepMonitor`
+    (which calls :meth:`observe_step` for you) or directly.  The stall
+    check runs either from the heartbeat thread (:meth:`start`) or by
+    calling :meth:`check_stall` manually (the deterministic test path).
+    """
+
+    def __init__(self, sink: Sink, *,
+                 overflow_streak: int = DEFAULT_OVERFLOW_STREAK,
+                 stall_timeout: float = DEFAULT_STALL_TIMEOUT_S,
+                 clock=time.monotonic,
+                 wall_clock=time.time,
+                 trace_dir: Optional[str] = None,
+                 heartbeat_interval: Optional[float] = None):
+        self._sink = sink
+        self.overflow_streak = int(overflow_streak)
+        self.stall_timeout = float(stall_timeout)
+        self._clock = clock
+        self._wall = wall_clock
+        self.trace_dir = trace_dir
+        self.heartbeat_interval = (heartbeat_interval
+                                   if heartbeat_interval is not None
+                                   else max(0.05,
+                                            min(self.stall_timeout / 4.0,
+                                                10.0)))
+        # episode state
+        self._last_progress = clock()
+        self._last_step: Optional[int] = None
+        self._stall_fired = False
+        self._nonfinite_fired = False
+        self._overflow_count = 0
+        self._overflow_fired = False
+        self._max_overflow_streak = 0
+        self._tracing = False
+        # heartbeat thread
+        self._stop_evt: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- alarm emission ------------------------------------------------------
+
+    def _alarm(self, name: str, value=None, step=None, **attrs) -> None:
+        self._sink.emit(Event(time=self._wall(), step=step, kind="alarm",
+                              name=name, value=value, attrs=attrs))
+
+    # -- observations (call on every completed step) -------------------------
+
+    def observe_step(self, step: Optional[int] = None,
+                     loss: Optional[float] = None,
+                     overflow: Optional[bool] = None,
+                     now: Optional[float] = None) -> None:
+        """Record one completed step: feeds the stall heartbeat and the
+        loss / overflow episode trackers.
+
+        ``loss`` must already be a host float (``None`` = not tracked
+        this step); ``overflow`` is this step's amp skip flag (``None``
+        = no scaler in play).
+        """
+        with self._lock:
+            now = self._clock() if now is None else now
+            self._last_progress = now
+            self._last_step = step
+            if self._stall_fired:
+                # episode over: progress resumed
+                self._stall_fired = False
+                self._alarm("stall_recovered", step=step)
+                self._stop_trace()
+            if loss is not None:
+                if not _finite(loss):
+                    if not self._nonfinite_fired:
+                        self._nonfinite_fired = True
+                        self._alarm("nonfinite_loss", step=step,
+                                    loss=str(loss))
+                else:
+                    self._nonfinite_fired = False
+            if overflow is not None:
+                if overflow:
+                    self._overflow_count += 1
+                    self._max_overflow_streak = max(
+                        self._max_overflow_streak, self._overflow_count)
+                    if (self._overflow_count >= self.overflow_streak
+                            and not self._overflow_fired):
+                        self._overflow_fired = True
+                        self._alarm("overflow_streak", step=step,
+                                    value=self._overflow_count,
+                                    threshold=self.overflow_streak)
+                else:
+                    self._overflow_count = 0
+                    self._overflow_fired = False
+
+    @property
+    def overflow_count(self) -> int:
+        """Current consecutive-overflow streak length."""
+        return self._overflow_count
+
+    # -- stall check ---------------------------------------------------------
+
+    def check_stall(self, now: Optional[float] = None) -> bool:
+        """Fire the ``stall`` alarm if no step completed for
+        ``stall_timeout`` seconds.  Returns True iff an alarm was
+        emitted by *this* call (once per episode)."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            stalled = (now - self._last_progress) >= self.stall_timeout
+            if not stalled or self._stall_fired:
+                return False
+            self._stall_fired = True
+            self._alarm("stall", value=now - self._last_progress,
+                        step=self._last_step,
+                        timeout_s=self.stall_timeout,
+                        last_step=self._last_step)
+            self._start_trace()
+            return True
+
+    # -- optional jax.profiler dump of the wedged step -----------------------
+
+    def _start_trace(self) -> None:
+        if not self.trace_dir or self._tracing:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+            self._alarm("stall_trace_started", trace_dir=self.trace_dir)
+        except Exception as e:  # telemetry must never kill the run
+            print(f"[monitor] stall trace failed to start: "
+                  f"{str(e)[:160]}", file=sys.stderr)
+
+    def _stop_trace(self) -> None:
+        if not self._tracing:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._alarm("stall_trace_stopped", trace_dir=self.trace_dir)
+        except Exception as e:
+            print(f"[monitor] stall trace failed to stop: "
+                  f"{str(e)[:160]}", file=sys.stderr)
+        self._tracing = False
+
+    # -- heartbeat thread ----------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        """Start the daemon heartbeat thread (idempotent).  It wakes
+        every ``heartbeat_interval`` seconds and runs
+        :meth:`check_stall` — the only piece that must live off the
+        main thread, which is by definition wedged during a stall."""
+        if self._thread is not None:
+            return self
+        self._stop_evt = threading.Event()
+
+        def beat():
+            while not self._stop_evt.wait(self.heartbeat_interval):
+                try:
+                    self.check_stall()
+                except Exception as e:
+                    print(f"[monitor] heartbeat check failed: "
+                          f"{str(e)[:160]}", file=sys.stderr)
+
+        self._thread = threading.Thread(
+            target=beat, name="apex_tpu-monitor-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._stop_evt is not None:
+            self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+            self._stop_evt = None
+        self._stop_trace()

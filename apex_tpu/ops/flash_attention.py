@@ -2706,9 +2706,9 @@ def _log_e_fallback(reason: str, b: int, s: int, h: int, d: int):
     if key in _E_FALLBACK_SEEN:
         return
     _E_FALLBACK_SEEN.add(key)
-    import logging
+    from ..utils.log_util import get_logger
 
-    logging.getLogger("apex_tpu.ops.flash_attention").info(
+    get_logger(__name__).info(
         "flash_attention_e fallback to transposing path for "
         "(b=%d, s=%d, h=%d, d=%d): %s", b, s, h, d, reason)
 

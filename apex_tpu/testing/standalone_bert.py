@@ -186,6 +186,74 @@ class BertModel(nn.Module):
         return lm_loss, binary_logits
 
 
+def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
+                sink=None, vocab: int = 64, hidden: int = 32,
+                num_heads: int = 4, num_layers: int = 2, batch: int = 4,
+                seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
+                stall_timeout: float = 300.0, seed: int = 0) -> float:
+    """Tiny single-device BERT train loop wired through
+    :mod:`apex_tpu.monitor` — the BERT sibling of
+    :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
+    stream: step metrics, amp scale, phase timers, watchdog), proving
+    the telemetry path is driver-agnostic.  Returns the final loss."""
+    from .. import amp
+    from ..optimizers import fused_adam
+    from ..transformer.pipeline_parallel.utils import (Timers,
+                                                       param_l2_norm)
+    from .standalone_gpt import make_smoke_monitor, run_monitored_steps
+
+    model = BertModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    mask = jnp.ones((batch, seq), jnp.int32)
+    labels = jnp.roll(tokens, -1, -1)
+    nsp = jax.random.randint(jax.random.fold_in(key, 2), (batch,), 0, 2)
+    variables = jax.jit(model.init)(key, tokens, mask)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_adam(lr), opt_level=opt_level)
+
+    @jax.jit
+    def step(params, amp_state):
+        def loss_fn(p):
+            from ..contrib.xentropy import softmax_cross_entropy_loss
+
+            lm_loss, bin_logits = model.apply(
+                {"params": p}, tokens, mask, lm_labels=labels)
+            nsp_loss = jnp.mean(softmax_cross_entropy_loss(
+                bin_logits, nsp, half_to_float=True))
+            loss = jnp.mean(lm_loss) + nsp_loss
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        gnorm = param_l2_norm(grads) / amp_state.scaler.loss_scale
+        new_params, new_state, info = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, new_state, loss, gnorm, info
+
+    monitor = make_smoke_monitor(
+        jsonl, sink, tokens_per_step=batch * seq,
+        flops_per_step=6.0 * n_params * batch * seq,
+        stall_timeout=stall_timeout,
+        run_attrs={"driver": "standalone_bert.train_smoke",
+                   "params": int(n_params), "opt_level": opt_level,
+                   "batch": batch, "seq": seq})
+    timers = Timers()
+    try:
+        _, _, loss_f = run_monitored_steps(step, params, amp_state,
+                                           steps, monitor, timers,
+                                           lr=lr)
+    finally:
+        monitor.close()
+    return loss_f
+
+
 def bert_model_provider(args, pre_process=True, post_process=True,
                         **overrides):
     """ref: standalone_bert.py:215-223 — build from Megatron args."""

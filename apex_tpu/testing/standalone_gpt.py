@@ -244,3 +244,140 @@ def gpt_forward_pipelined(embed_mod, stage_mod, head_mod,
     if data_axis is not None:
         loss = jax.lax.pmean(loss, data_axis)
     return loss
+
+
+# ---------------------------------------------------------------------------
+# Monitored smoke train loop — the run-telemetry acceptance path
+# ---------------------------------------------------------------------------
+
+def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
+                       stall_timeout, run_attrs):
+    """Monitor bootstrap shared by the GPT/BERT smoke drivers: default
+    sink selection (JSONL file if a path was given, else in-memory),
+    watchdog wiring, and close-ownership — the monitor closes the sink
+    only when it created it, so a caller-provided sink stays usable
+    after the run."""
+    from ..monitor import JsonlSink, MemorySink, StepMonitor, Watchdog
+
+    own_sink = sink is None
+    if sink is None:
+        sink = JsonlSink(jsonl) if jsonl else MemorySink()
+    return StepMonitor(
+        sink, tokens_per_step=tokens_per_step,
+        flops_per_step=flops_per_step,
+        watchdog=Watchdog(sink, stall_timeout=stall_timeout),
+        run_attrs=run_attrs, close_sink=own_sink)
+
+
+def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
+                        timers, lr=None):
+    """Drive ``step_fn(params, amp_state) -> (params, amp_state, loss,
+    grad_norm, step_info)`` for ``steps`` iterations, recording each
+    through an :class:`apex_tpu.monitor.StepMonitor` and exporting the
+    per-step phase ``timers`` into the same event log.  Shared by the
+    GPT and BERT smoke drivers."""
+    loss_f = None
+    for i in range(steps):
+        monitor.start_step(i)
+        timers("step").start()
+        params, amp_state, loss, gnorm, info = step_fn(params, amp_state)
+        timers("step").stop(wait_on=loss)
+        loss_f = float(loss)
+        monitor.end_step(i, loss=loss_f, grad_norm=gnorm, lr=lr,
+                         scaler=info)
+        timers.events(monitor, i, reset=True)
+    return params, amp_state, loss_f
+
+
+def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
+                sink=None, vocab: int = 64, hidden: int = 32,
+                num_heads: int = 4, num_layers: int = 2, batch: int = 4,
+                seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
+                stall_timeout: float = 300.0, seed: int = 0) -> float:
+    """Tiny single-device GPT train loop wired end-to-end through
+    :mod:`apex_tpu.monitor` — the CPU telemetry smoke (exercised by
+    tools/ci.sh on every run): step metrics (loss, grad-norm, lr,
+    tokens/s, step ms, MFU), amp loss-scale/overflow events (the O2
+    dynamic scaler genuinely backs off in fp16 at init scale 2^16),
+    phase-timer events, and a live stall watchdog — all into one JSONL
+    that ``tools/monitor_summary.py`` renders.
+
+    Pass ``jsonl`` for a file log, or ``sink`` (e.g. a ``MemorySink``)
+    to capture events in-process; with neither, events go to a
+    throwaway ``MemorySink``.  Returns the final loss (host float).
+    The monitor is closed on exit; it closes the sink too unless the
+    caller provided one.
+    """
+    from .. import amp
+    from ..optimizers import fused_adam
+    from ..transformer.pipeline_parallel.utils import (Timers,
+                                                       param_l2_norm)
+
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, -1)
+    variables = jax.jit(model.init)(key, tokens)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_adam(lr), opt_level=opt_level)
+
+    @jax.jit
+    def step(params, amp_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            loss = gpt_loss(logits, labels)
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        # grads carry the loss scale; report the unscaled norm
+        gnorm = param_l2_norm(grads) / amp_state.scaler.loss_scale
+        new_params, new_state, info = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, new_state, loss, gnorm, info
+
+    flops = 6.0 * n_params * batch * seq \
+        + 12.0 * num_layers * hidden * batch * seq * seq
+    monitor = make_smoke_monitor(
+        jsonl, sink, tokens_per_step=batch * seq, flops_per_step=flops,
+        stall_timeout=stall_timeout,
+        run_attrs={"driver": "standalone_gpt.train_smoke",
+                   "params": int(n_params), "opt_level": opt_level,
+                   "batch": batch, "seq": seq})
+    timers = Timers()
+    try:
+        _, _, loss_f = run_monitored_steps(step, params, amp_state,
+                                           steps, monitor, timers,
+                                           lr=lr)
+    finally:
+        monitor.close()
+    return loss_f
+
+
+def _main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Monitored GPT smoke train loop (CPU-friendly); "
+                    "writes an apex_tpu.monitor JSONL event log.")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--jsonl", default=None,
+                   help="event-log path (default: in-memory only)")
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--stall-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+    loss = train_smoke(steps=args.steps, jsonl=args.jsonl,
+                       opt_level=args.opt_level,
+                       stall_timeout=args.stall_timeout)
+    print(f"SMOKE_DONE loss={loss:.4f}"
+          + (f" jsonl={args.jsonl}" if args.jsonl else ""))
+
+
+if __name__ == "__main__":
+    _main()

@@ -195,21 +195,60 @@ class Timers:
 
     def write(self, names: Sequence[str], writer, iteration: int,
               normalizer: float = 1.0, reset: bool = False):
-        """ref: _timers.py:55-62 — writer is any object with add_scalar."""
+        """ref: _timers.py:55-62 — writer is any object with add_scalar
+        (``apex_tpu.monitor.ScalarWriter`` adapts a telemetry sink).
+
+        Names that were never started are skipped: a logging call must
+        not crash the run over a phase that happened not to execute
+        this interval (e.g. no exchange on a 1-stage pipeline).
+        """
         for name in names:
+            if name not in self.timers:
+                continue
             value = self.timers[name].elapsed(reset=reset) / normalizer
             writer.add_scalar(f"{name}-time", value, iteration)
 
     def log(self, names: Sequence[str], normalizer: float = 1.0,
             reset: bool = True):
-        """ref: _timers.py:63-70."""
+        """ref: _timers.py:63-70.  Never-started names are skipped, not
+        a KeyError (see :meth:`write`)."""
         assert normalizer > 0.0
         string = "time (ms)"
         for name in names:
+            if name not in self.timers:
+                continue
             elapsed_time = (self.timers[name].elapsed(reset=reset) * 1000.0
                             / normalizer)
             string += f" | {name}: {elapsed_time:.2f}"
         print_rank_last(string)
+
+    def events(self, sink, iteration: Optional[int] = None,
+               names: Optional[Sequence[str]] = None,
+               normalizer: float = 1.0, reset: bool = True):
+        """Export phase times as ``timer`` events (seconds) into a
+        telemetry sink — phase timings land in the same structured log
+        as step metrics and watchdog alarms (docs/api/observability.md).
+
+        ``sink`` is anything with ``emit(Event)``: a
+        :class:`apex_tpu.monitor.Sink` or a ``StepMonitor``.  ``names``
+        defaults to every timer ever started; missing names are skipped
+        (same contract as :meth:`write`).
+        """
+        import time as _time
+
+        from ...monitor.events import Event
+
+        assert normalizer > 0.0
+        if names is None:
+            names = list(self.timers)
+        for name in names:
+            if name not in self.timers:
+                continue
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            sink.emit(Event(time=_time.time(),
+                            step=None if iteration is None
+                            else int(iteration),
+                            kind="timer", name=name, value=value))
 
 
 def _set_timers():

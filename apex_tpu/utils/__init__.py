@@ -5,8 +5,11 @@ the root-logger ``RankInfoFormatter`` (ref: apex/__init__.py:29-42) and
 ``apex/transformer/log_util.py`` — plus the Orbax-backed sharded/async
 checkpoint layer (:mod:`apex_tpu.utils.checkpoint`), the TPU-native
 upgrade of the reference's state-dict save/resume flow.
+
+Checkpoint symbols resolve lazily: ``apex_tpu/__init__`` configures the
+library logger through :mod:`.log_util` at import time, and pulling the
+Orbax stack along with it would undo the package's lazy-import design.
 """
-from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint
 from .log_util import (
     RankInfoFormatter,
     get_logger,
@@ -23,3 +26,15 @@ __all__ = [
     "get_transformer_logger",
     "set_logging_level",
 ]
+
+_CHECKPOINT_SYMBOLS = ("CheckpointManager", "load_checkpoint",
+                       "save_checkpoint")
+
+
+def __getattr__(name):
+    if name in _CHECKPOINT_SYMBOLS:
+        from . import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(
+        f"module 'apex_tpu.utils' has no attribute {name!r}")

@@ -45,6 +45,11 @@ def _configure_library_root_logger() -> None:
         "%(message)s"))
     _library_root_logger.addHandler(handler)
     _library_root_logger.propagate = False
+    # Pin the library default explicitly: with NOTSET the effective
+    # level would track the ROOT logger, so an app turning on its own
+    # DEBUG logging would suddenly surface apex_tpu INFO chatter.
+    # set_logging_level remains the knob to change it.
+    _library_root_logger.setLevel(logging.WARNING)
     _configured = True
 
 
@@ -57,8 +62,23 @@ def get_transformer_logger(name: str) -> logging.Logger:
         f"{_LIBRARY_ROOT_LOGGER_NAME}.{name_wo_ext}")
 
 
-# General-purpose alias: the library logger for any subsystem.
-get_logger = get_transformer_logger
+def get_logger(name: str) -> logging.Logger:
+    """Library logger for any subsystem — the ONE way apex_tpu modules
+    obtain a logger, so exactly one rank-stamped handler ever exists on
+    the ``apex_tpu`` root (the duplicate-handler bug this replaces:
+    ``apex_tpu/__init__`` and this module each installed one).
+
+    Accepts a dotted module ``__name__`` (used as-is, rooted under
+    ``apex_tpu``) or a file path (the :func:`get_transformer_logger`
+    idiom: basename without extension).
+    """
+    _configure_library_root_logger()
+    if os.sep in name or name.endswith(".py"):
+        name = os.path.splitext(os.path.basename(name))[0]
+    if name != _LIBRARY_ROOT_LOGGER_NAME and \
+            not name.startswith(_LIBRARY_ROOT_LOGGER_NAME + "."):
+        name = f"{_LIBRARY_ROOT_LOGGER_NAME}.{name}"
+    return logging.getLogger(name)
 
 
 def set_logging_level(verbosity) -> None:

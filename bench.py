@@ -21,6 +21,7 @@ other BASELINE metrics:
 Iterations are chained through params; completion forced with a value
 fetch (async dispatch under-reports otherwise).
 """
+import contextlib
 import functools
 import json
 import os
@@ -1194,17 +1195,74 @@ class _ArtifactWriter:
         os.replace(self.scratch, self.path)
 
 
-def _run_section(extras, name, fn, writer):
+def _make_event_sink(out_dir):
+    """Monitor sink for section lifecycle events (BENCH_EVENTS.jsonl,
+    fresh each run).  The same emission path the train drivers use
+    (apex_tpu.monitor) — a timeout kill leaves a precise, line-per-event
+    record of which sections ran, completed, or died, alongside the
+    ``.partial`` artifact checkpoints.  None (and a warning) if the
+    monitor can't come up — events must never sink the bench."""
+    try:
+        from apex_tpu.monitor import JsonlSink
+
+        return JsonlSink(os.path.join(out_dir, "BENCH_EVENTS.jsonl"),
+                         append=False)
+    except Exception as e:
+        print(f"[bench] event sink unavailable: {str(e)[:120]}",
+              file=sys.stderr)
+        return None
+
+
+def _emit_event(sink, kind, name, seconds=None, **attrs):
+    """One monitor event; failures warn and are swallowed (telemetry
+    must never sink a bench row)."""
+    if sink is None:
+        return
+    try:
+        from apex_tpu.monitor.events import Event
+
+        sink.emit(Event(time=time.time(), step=None, kind=kind,
+                        name=name, value=seconds, attrs=attrs))
+    except Exception as e:
+        print(f"[bench] event emit failed: {str(e)[:120]}",
+              file=sys.stderr)
+
+
+@contextlib.contextmanager
+def _section_events(sink, name):
+    """Section lifecycle events around a bench block:
+    ``section_start`` on entry, ``section_done`` on clean exit,
+    ``section_error`` (then re-raise) on any exception — including a
+    driver kill (KeyboardInterrupt/SystemExit), so the event log
+    records exactly where the run died."""
+    _emit_event(sink, "section", "section_start", section=name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as e:
+        _emit_event(sink, "section", "section_error",
+                    seconds=time.perf_counter() - t0, section=name,
+                    error=str(e)[:200] if isinstance(e, Exception)
+                    else type(e).__name__)
+        raise
+    _emit_event(sink, "section", "section_done",
+                seconds=time.perf_counter() - t0, section=name)
+
+
+def _run_section(extras, name, fn, writer, sink=None):
     """One bench section: record the row (or the error — never sink the
     headline), checkpoint the scratch artifact, and print the compact
     summary line IMMEDIATELY.  Last-line-wins: a driver timeout later
     in the run still finds a parseable final stdout line carrying every
     section completed so far (round-5's ``rc: 124 / parsed: null`` was
     the single end-of-run print getting killed with ~8 sections of
-    measurements already in hand)."""
+    measurements already in hand).  Section lifecycle also flows as
+    ``section_start``/``section_done``/``section_error`` events through
+    ``sink`` (see _make_event_sink)."""
     print(f"[bench] {name}...", file=sys.stderr)
     try:
-        extras[name] = fn()
+        with _section_events(sink, name):
+            extras[name] = fn()
     except Exception as e:   # never sink the headline metric
         extras[name] = {"error": str(e)[:200]}
     writer.checkpoint()
@@ -1219,9 +1277,16 @@ def main():
     out_dir = os.path.dirname(os.path.abspath(__file__))
     full_path = os.path.join(out_dir, "BENCH_FULL.json")
 
+    sink = _make_event_sink(out_dir)
+    _emit_event(sink, "run", "run_start", driver="bench.py",
+                devices=n_dev, backend=jax.default_backend())
+
     with mesh:
         print("[bench] resnet50...", file=sys.stderr)
-        ips, rn50_dev_ips = bench_resnet50()
+        # the headline section has no {"error"} fallback row — a death
+        # propagates, but the event log still records it
+        with _section_events(sink, "resnet50"):
+            ips, rn50_dev_ips = bench_resnet50()
         print(f"[bench] resnet50 done: {ips:.1f} img/s", file=sys.stderr)
         extras = {}
         full = {
@@ -1242,28 +1307,35 @@ def main():
 
         if not SKIP_EXTRAS:
             _run_section(extras, "optimizer_step", bench_optimizers,
-                         writer)
-            _run_section(extras, "collective", bench_collective, writer)
+                         writer, sink)
+            _run_section(extras, "collective", bench_collective, writer,
+                         sink)
             _run_section(extras, "long_context", bench_long_context,
-                         writer)
-            _run_section(extras, "ring_flash", bench_ring_flash, writer)
-            _run_section(extras, "gpt2_345m", bench_gpt345m, writer)
+                         writer, sink)
+            _run_section(extras, "ring_flash", bench_ring_flash, writer,
+                         sink)
+            _run_section(extras, "gpt2_345m", bench_gpt345m, writer,
+                         sink)
             # model-level long-sequence row (blocked E-layout kernels
             # end-to-end) and the training config with attention
             # dropout (in-kernel E-route — round 4's eligibility work)
             _run_section(extras, "gpt2_345m_s2048",
                          lambda: bench_gpt345m(seq=2048, batch=4,
                                                with_profile=False),
-                         writer)
+                         writer, sink)
             _run_section(extras, "gpt2_345m_dropout",
                          lambda: bench_gpt345m(dropout=0.1,
                                                with_profile=False),
-                         writer)
-            _run_section(extras, "bert_large", bench_bert_large, writer)
+                         writer, sink)
+            _run_section(extras, "bert_large", bench_bert_large, writer,
+                         sink)
             _run_section(extras, "zero_sharded_adam", bench_zero_adam,
-                         writer)
+                         writer, sink)
         # every section ran: commit the artifact atomically
         writer.finalize()
+    _emit_event(sink, "run", "run_end")
+    if sink is not None:
+        sink.close()
     print(_fit_compact_line(_compact_summary(full)))
 
 

@@ -89,18 +89,43 @@ def main(steps: int = 60) -> None:
         params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
+    # Opt-in run telemetry: APEX_TPU_MONITOR_JSONL=<path> streams every
+    # step's loss (plus step ms / tokens/s and watchdog alarms) through
+    # apex_tpu.monitor — a killed CI run then leaves an inspectable
+    # event log instead of just a missing CONVERGED line.  Off by
+    # default: the per-step host fetch it needs serializes dispatch.
+    monitor = None
+    jsonl = os.environ.get("APEX_TPU_MONITOR_JSONL")
+    if jsonl:
+        from apex_tpu.monitor import JsonlSink, StepMonitor, Watchdog
+
+        sink = JsonlSink(jsonl)
+        monitor = StepMonitor(
+            sink, tokens_per_step=4 * SEQ,
+            watchdog=Watchdog(sink, stall_timeout=float(
+                os.environ.get("APEX_TPU_MONITOR_STALL_S", "300"))),
+            run_attrs={"driver": "_gpt_convergence_runner",
+                       "tp": 2, "pp": 2, "steps": steps})
+
     l0 = None
     for i in range(steps):
+        if monitor is not None:
+            monitor.start_step(i)
         params, opt_state, loss = step(params, opt_state)
-        if l0 is None:
-            l0 = float(loss)
-        elif i % 10 == 0:
+        if monitor is not None:
+            # the monitor's host fetch bounds the dispatch queue too
+            monitor.end_step(i, loss=float(loss))
+        elif l0 is None or i % 10 == 0:
             # bound the async dispatch queue: on a single-core host an
             # unbounded queue of in-flight multi-device executions
             # starves executor threads past the 40 s collective
             # rendezvous abort
             float(loss)
+        if l0 is None:
+            l0 = float(loss)
     lf = float(loss)
+    if monitor is not None:
+        monitor.close()
     assert np.isfinite(lf), f"non-finite loss {lf}"
     assert l0 > 2.5, f"initial loss implausibly low: {l0}"
     assert lf < 0.5, f"3D GPT did not converge: {l0} -> {lf}"

@@ -14,18 +14,30 @@
 #                            the committed BENCH_FULL.json
 #   3. 8-device dryrun     — the multichip legs (GPT 3D DP x TP x PP,
 #                            ResNet DP, SP/MoE/ZeRO) on a virtual mesh
+#   4. monitor smoke       — a tiny standalone_gpt train run writes a
+#                            JSONL event log through apex_tpu.monitor
+#                            and tools/monitor_summary.py renders it,
+#                            so the telemetry path is exercised on
+#                            every CI run, not only under a TPU bench
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/3 default test tier"
+echo "[ci] 1/4 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/3 README drift guard"
+echo "[ci] 2/4 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/3 8-device multichip dryrun"
+echo "[ci] 3/4 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+echo "[ci] 4/4 monitor smoke"
+MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
+python -m apex_tpu.testing.standalone_gpt --steps 3 \
+    --jsonl "$MONITOR_SMOKE_JSONL"
+python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
+rm -f "$MONITOR_SMOKE_JSONL"
 
 echo "[ci] all green"
