@@ -42,6 +42,7 @@ from .events import (
     Sink,
     TeeSink,
     WriterSink,
+    emit_resilience,
 )
 from .step_monitor import StepMonitor
 from .summary import load_events, render, summarize
@@ -49,7 +50,8 @@ from .watchdog import Watchdog
 
 __all__ = [
     "Event", "Sink", "JsonlSink", "MemorySink", "TeeSink",
-    "WriterSink", "ScalarWriter", "KINDS", "SCHEMA_VERSION",
+    "WriterSink", "ScalarWriter", "emit_resilience",
+    "KINDS", "SCHEMA_VERSION",
     "StepMonitor", "Watchdog",
     "load_events", "summarize", "render",
 ]

@@ -36,7 +36,14 @@ SCHEMA_VERSION = 1
 #:   ``timer``   phase times exported from ``Timers.events`` (seconds)
 #:   ``section`` bench/driver section lifecycle (``section_start`` /
 #:               ``section_done`` / ``section_error``)
-KINDS = ("run", "metric", "scale", "alarm", "timer", "section")
+#:   ``resilience`` preemption / restart / checkpoint-integrity
+#:               lifecycle (``termination_requested``, ``clean_exit``,
+#:               ``run_resumed``, ``preempt_exit``, ``attempt_start`` /
+#:               ``attempt_error`` / ``attempt_backoff`` /
+#:               ``attempt_done`` / ``run_giveup``,
+#:               ``escalation_abort``, ``ckpt_skipped`` / ``ckpt_gc``)
+KINDS = ("run", "metric", "scale", "alarm", "timer", "section",
+         "resilience")
 
 
 def _jsonable(v: Any) -> Any:
@@ -93,6 +100,19 @@ class Event:
                      name=d["name"],
                      value=d.get("value"),
                      attrs=d.get("attrs") or {})
+
+
+def emit_resilience(sink, name: str, *, value=None,
+                    step: Optional[int] = None, clock=time.time,
+                    **attrs) -> None:
+    """Emit one ``resilience``-kind event into ``sink`` (no-op when
+    ``sink`` is None) — the single construction point shared by
+    :mod:`apex_tpu.resilience` and the checkpoint-integrity layer, so
+    the record shape cannot drift between emitters."""
+    if sink is None:
+        return
+    sink.emit(Event(time=clock(), step=step, kind="resilience",
+                    name=name, value=value, attrs=attrs))
 
 
 # ---------------------------------------------------------------------------

@@ -5,8 +5,10 @@ append-only event log back into :class:`~apex_tpu.monitor.events.Event`
 records (tolerating the one truncated trailing line a kill mid-write can
 leave), :func:`summarize` folds them into a run-health digest —
 throughput, loss trajectory, amp overflow history, watchdog alarms,
-phase-timer totals, bench section outcomes — and :func:`render` prints
-it as tables.  ``tools/monitor_summary.py`` is the CLI wrapper.
+resilience lifecycle (preempts / resumes / restart attempts /
+checkpoint-integrity skips), phase-timer totals, bench section outcomes
+— and :func:`render` prints it as tables.  ``tools/monitor_summary.py``
+is the CLI wrapper.
 """
 from __future__ import annotations
 
@@ -117,6 +119,31 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
             t["mean_ms"] = t["total_s"] * 1e3 / t["count"]
         out["timers"] = timers
 
+    # resilience lifecycle ------------------------------------------------
+    res = [e for e in events if e.kind == "resilience"]
+    if res:
+        counts: Dict[str, int] = {}
+        for e in res:
+            counts[e.name] = counts.get(e.name, 0) + 1
+        digest: Dict[str, object] = {"counts": counts}
+        resumed = [e for e in res if e.name == "run_resumed"]
+        if resumed:
+            digest["resumed_from"] = [int(e.value) for e in resumed
+                                      if e.value is not None]
+        preempt = [e for e in res if e.name == "preempt_exit"]
+        if preempt:
+            digest["preempted_at"] = [int(e.value) for e in preempt
+                                      if e.value is not None]
+        skipped = [e for e in res if e.name == "ckpt_skipped"]
+        if skipped:
+            digest["ckpt_skipped"] = [
+                {"step": e.step, "reason": e.attrs.get("reason", "")}
+                for e in skipped]
+        giveup = [e for e in res if e.name == "run_giveup"]
+        if giveup:
+            digest["gave_up"] = dict(giveup[-1].attrs)
+        out["resilience"] = digest
+
     # bench/driver sections ----------------------------------------------
     sections: Dict[str, Dict[str, object]] = {}
     for e in events:
@@ -197,6 +224,24 @@ def render(summary: dict) -> str:
                          f"value={a.get('value')} {extra or ''}".rstrip())
     else:
         lines.append("alarms: none")
+
+    res = summary.get("resilience")
+    if res:
+        lines.append("")
+        counts = res.get("counts", {})
+        lines.append("resilience: "
+                     + " ".join(f"{k}={v}"
+                                for k, v in sorted(counts.items())))
+        if res.get("preempted_at"):
+            lines.append(f"  preempted at step(s) {res['preempted_at']} "
+                         "(clean exit)")
+        if res.get("resumed_from"):
+            lines.append(f"  resumed from step(s) {res['resumed_from']}")
+        for s in res.get("ckpt_skipped", []):
+            lines.append(f"  CKPT SKIPPED step {s['step']}: "
+                         f"{s['reason']}")
+        if res.get("gave_up"):
+            lines.append(f"  GAVE UP: {res['gave_up']}")
 
     timers = summary.get("timers")
     if timers:

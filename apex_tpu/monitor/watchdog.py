@@ -51,8 +51,16 @@ class Watchdog:
                  clock=time.monotonic,
                  wall_clock=time.time,
                  trace_dir: Optional[str] = None,
-                 heartbeat_interval: Optional[float] = None):
+                 heartbeat_interval: Optional[float] = None,
+                 on_alarm=None):
         self._sink = sink
+        # Escalation hook: called with every emitted alarm Event (e.g.
+        # apex_tpu.resilience.EscalationPolicy.notify turns alarms into
+        # checkpoint-then-abort restarts).  May run on the heartbeat
+        # thread and under the watchdog lock — it must be cheap, must
+        # not call back into the watchdog, and must never raise (a
+        # raise is swallowed: telemetry cannot kill the run).
+        self._on_alarm = on_alarm
         self.overflow_streak = int(overflow_streak)
         self.stall_timeout = float(stall_timeout)
         self._clock = clock
@@ -80,8 +88,15 @@ class Watchdog:
     # -- alarm emission ------------------------------------------------------
 
     def _alarm(self, name: str, value=None, step=None, **attrs) -> None:
-        self._sink.emit(Event(time=self._wall(), step=step, kind="alarm",
-                              name=name, value=value, attrs=attrs))
+        event = Event(time=self._wall(), step=step, kind="alarm",
+                      name=name, value=value, attrs=attrs)
+        self._sink.emit(event)
+        if self._on_alarm is not None:
+            try:
+                self._on_alarm(event)
+            except Exception as e:
+                print(f"[monitor] on_alarm hook failed: {str(e)[:160]}",
+                      file=sys.stderr)
 
     # -- observations (call on every completed step) -------------------------
 

@@ -1,0 +1,122 @@
+"""Retrying run driver: bounded restarts with backoff around a train fn.
+
+``run_resumable`` is the outermost loop of a fault-tolerant run: each
+attempt is expected to *resume itself* from the latest valid checkpoint
+(``CheckpointManager.restore`` falls back past corrupt steps on its
+own), so the driver's only jobs are bounded retry, exponential backoff
+with deterministic jitter, and a structured ``resilience`` event trail
+(``attempt_start`` / ``attempt_error`` / ``attempt_backoff`` /
+``attempt_done`` / ``run_giveup``) so a post-mortem can reconstruct the
+restart history from the same JSONL as everything else.
+
+Deliberate non-goals: no in-driver checkpointing (the loop owns state),
+no retry of ``KeyboardInterrupt``/``SystemExit`` (BaseException never
+matches the default ``retry_on=(Exception,)``), and no retry once an
+:class:`~apex_tpu.resilience.autoresume.AutoResume` says the scheduler
+wants the slot back — preemption is not a failure.
+"""
+from __future__ import annotations
+
+import random
+import time
+import traceback
+from typing import Callable, Optional, Tuple, Type
+
+DEFAULT_MAX_RESTARTS = 3
+DEFAULT_BACKOFF_BASE_S = 1.0
+DEFAULT_BACKOFF_MAX_S = 60.0
+DEFAULT_JITTER = 0.25
+
+
+class GiveUp(RuntimeError):
+    """All restart budget spent; ``__cause__`` is the last failure."""
+
+    def __init__(self, attempts: int, last_error: BaseException):
+        super().__init__(
+            f"giving up after {attempts} attempt(s); last error: "
+            f"{type(last_error).__name__}: {str(last_error)[:200]}")
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+def backoff_delay(attempt: int, *, base: float = DEFAULT_BACKOFF_BASE_S,
+                  maximum: float = DEFAULT_BACKOFF_MAX_S,
+                  jitter: float = DEFAULT_JITTER,
+                  rng: Optional[random.Random] = None) -> float:
+    """Capped exponential backoff with multiplicative jitter in
+    ``[0, jitter]`` — jitter decorrelates a fleet of preempted workers
+    restarting in lockstep.  Deterministic given ``rng``."""
+    delay = min(float(maximum), float(base) * (2.0 ** attempt))
+    if jitter and rng is not None:
+        delay *= 1.0 + float(jitter) * rng.random()
+    return min(delay, float(maximum))
+
+
+def run_resumable(train_fn: Callable[[int], object], *,
+                  max_restarts: int = DEFAULT_MAX_RESTARTS,
+                  backoff_base: float = DEFAULT_BACKOFF_BASE_S,
+                  backoff_max: float = DEFAULT_BACKOFF_MAX_S,
+                  jitter: float = DEFAULT_JITTER,
+                  retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+                  no_retry_on: Tuple[Type[BaseException], ...] = (),
+                  autoresume=None, sink=None,
+                  sleep: Callable[[float], None] = time.sleep,
+                  rng: Optional[random.Random] = None,
+                  wall_clock=time.time):
+    """Run ``train_fn(attempt)`` with bounded restarts; return its result.
+
+    ``train_fn`` receives the 0-based attempt index and must itself
+    resume from the latest valid checkpoint (pass the same checkpoint
+    directory in via closure).  On a ``retry_on`` failure the driver
+    backs off (``backoff_delay``) and retries, up to ``max_restarts``
+    *re*-starts (i.e. at most ``max_restarts + 1`` attempts), then
+    raises :class:`GiveUp` from the last error.  ``no_retry_on`` wins
+    over ``retry_on``.  With ``autoresume``, a failure that races a
+    termination request is not retried (the scheduler is taking the
+    slot; exit now, resume on the next incarnation).
+
+    ``sleep`` and ``rng`` are injectable for deterministic tests.  The
+    default rng is seeded per process (urandom) — a shared fixed seed
+    would give every worker in a preempted fleet the *same* jitter,
+    defeating the decorrelation the jitter exists for.
+    """
+    rng = random.Random() if rng is None else rng
+
+    def emit(name, value=None, **attrs):
+        from ..monitor.events import emit_resilience
+
+        emit_resilience(sink, name, value=value, clock=wall_clock,
+                        **attrs)
+
+    attempt = 0
+    while True:
+        emit("attempt_start", value=attempt,
+             max_restarts=int(max_restarts))
+        try:
+            result = train_fn(attempt)
+        except no_retry_on:
+            emit("run_giveup", value=attempt, reason="no_retry")
+            raise
+        except retry_on as e:
+            tb = traceback.format_exc(limit=8)
+            emit("attempt_error", value=attempt,
+                 error=type(e).__name__, message=str(e)[:300],
+                 traceback=tb[-1200:])
+            if autoresume is not None and \
+                    autoresume.termination_requested():
+                emit("run_giveup", value=attempt, reason="preempted")
+                raise
+            if attempt >= max_restarts:
+                emit("run_giveup", value=attempt,
+                     reason="budget_exhausted",
+                     attempts=attempt + 1)
+                raise GiveUp(attempt + 1, e) from e
+            delay = backoff_delay(attempt, base=backoff_base,
+                                  maximum=backoff_max, jitter=jitter,
+                                  rng=rng)
+            emit("attempt_backoff", value=delay, attempt=attempt)
+            sleep(delay)
+            attempt += 1
+        else:
+            emit("attempt_done", value=attempt, attempts=attempt + 1)
+            return result

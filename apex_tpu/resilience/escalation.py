@@ -1,0 +1,111 @@
+"""Watchdog-alarm escalation: turn alarms into restartable aborts.
+
+PR 2's :class:`apex_tpu.monitor.Watchdog` raises once-per-episode
+``alarm`` events (``nonfinite_loss``, ``overflow_streak``, ``stall``)
+— eyes only.  :class:`EscalationPolicy` is the hands: plugged into the
+watchdog's ``on_alarm`` callback, it latches the first alarm whose
+configured action is not ``ignore``; the training loop polls
+:meth:`pending` at step boundaries and raises :class:`EscalationAbort`
+(after an optional synchronous checkpoint) so
+:func:`apex_tpu.resilience.run_resumable` can restart the attempt from
+the last valid checkpoint.
+
+Default policy (rationale in docs/api/resilience.md):
+
+=================  =====================  =================================
+alarm              action                 why
+=================  =====================  =================================
+nonfinite_loss     abort                  params may already be poisoned —
+                                          restart from the last *good*
+                                          checkpoint, don't save this one
+overflow_streak    checkpoint_then_abort  a collapsing scaler skipped the
+                                          updates, params are sound — keep
+                                          recency, then restart
+stall              ignore                 fires on the heartbeat thread
+                                          while the main thread is wedged
+                                          in a device call; an abort flag
+                                          would never be polled
+=================  =====================  =================================
+
+Alarms not named in the policy (``*_recovered``, trace markers) are
+ignored.  ``notify`` may run on the watchdog heartbeat thread, so it
+only latches state — the loop emits the ``resilience`` events and does
+the checkpointing from the main thread.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, NamedTuple, Optional
+
+IGNORE = "ignore"
+ABORT = "abort"
+CHECKPOINT_THEN_ABORT = "checkpoint_then_abort"
+
+ACTIONS = (IGNORE, ABORT, CHECKPOINT_THEN_ABORT)
+
+DEFAULT_POLICY: Dict[str, str] = {
+    "nonfinite_loss": ABORT,
+    "overflow_streak": CHECKPOINT_THEN_ABORT,
+    "stall": IGNORE,
+}
+
+
+class EscalationAbort(RuntimeError):
+    """Raised by the training loop when an escalated alarm demands a
+    restart; :func:`~apex_tpu.resilience.run_resumable` treats it like
+    any other retryable failure."""
+
+    def __init__(self, alarm: str, action: str,
+                 step: Optional[int] = None):
+        super().__init__(f"watchdog alarm {alarm!r} escalated to "
+                         f"{action} at step {step}")
+        self.alarm = alarm
+        self.action = action
+        self.step = step
+
+
+class Escalation(NamedTuple):
+    alarm: str
+    action: str
+    step: Optional[int]
+
+
+class EscalationPolicy:
+    """Maps watchdog alarm names to actions; latches the first hit.
+
+    Use as ``Watchdog(..., on_alarm=policy.notify)``.  Overrides merge
+    over :data:`DEFAULT_POLICY`; an explicit ``ignore`` disables a
+    default escalation.
+    """
+
+    def __init__(self, policy: Optional[Dict[str, str]] = None):
+        self.policy = dict(DEFAULT_POLICY)
+        if policy:
+            for name, action in policy.items():
+                if action not in ACTIONS:
+                    raise ValueError(
+                        f"unknown escalation action {action!r} for "
+                        f"{name!r}; expected one of {ACTIONS}")
+                self.policy[name] = action
+        self._lock = threading.Lock()
+        self._pending: Optional[Escalation] = None
+
+    def notify(self, event) -> None:
+        """Watchdog ``on_alarm`` callback (any thread, never raises):
+        latch the first non-ignored alarm of the episode."""
+        action = self.policy.get(event.name, IGNORE)
+        if action == IGNORE:
+            return
+        with self._lock:
+            if self._pending is None:
+                self._pending = Escalation(event.name, action, event.step)
+
+    def pending(self) -> Optional[Escalation]:
+        """The latched escalation, if any — poll at step boundaries."""
+        with self._lock:
+            return self._pending
+
+    def reset(self) -> None:
+        """Re-arm (e.g. at the start of a fresh attempt)."""
+        with self._lock:
+            self._pending = None

@@ -1,0 +1,186 @@
+"""Deterministic fault injection: the proof harness for resilience.
+
+Every recovery claim in this package is tested by *making* the failure
+happen, at an exact step, reproducibly:
+
+* :func:`parse_fault` / :class:`FaultInjector` — step-triggered faults
+  for a training loop (``--fault`` on the standalone GPT/BERT smoke
+  drivers): ``crash@K`` (raise :class:`InjectedCrash`), ``kill@K``
+  (SIGKILL — the hard-preemption case, nothing runs after), ``sigterm@K``
+  / ``sigint@K`` (graceful preemption through
+  :class:`~apex_tpu.resilience.autoresume.AutoResume`), ``nan@K`` /
+  ``inf@K`` (non-finite observed loss — drives the watchdog
+  ``nonfinite_loss`` alarm and its escalation), ``stall@K:SECS``
+  (sleep, for stall-watchdog drills).  Specs compose with commas
+  (``"nan@3,crash@5"``); each fires **once** — an injector shared
+  across ``run_resumable`` attempts does not re-fail the recovered run.
+
+* checkpoint corruption (:func:`corrupt_checkpoint`) — damage an
+  on-disk Orbax step the ways a real preemption does: ``truncate``
+  (partial TensorStore flush: every payload file cut in half, structure
+  intact — caught only by the restore attempt), ``unfinalize`` (killed
+  before the commit marker: ``_CHECKPOINT_METADATA`` removed — caught
+  by the structural scan), ``delete`` (a required item payload gone).
+
+All injectors are plain host-side Python: no device, no randomness, no
+wall-clock dependence — a fault fires at step K or it does not.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+#: Step-triggered fault kinds understood by :func:`parse_fault`.
+KINDS = ("crash", "kill", "sigterm", "sigint", "nan", "inf", "stall")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for failures raised by the harness itself."""
+
+
+class InjectedCrash(InjectedFault):
+    """The ``crash@K`` fault: an ordinary retryable exception."""
+
+
+class _Spec:
+    __slots__ = ("kind", "step", "arg", "fired")
+
+    def __init__(self, kind: str, step: int, arg: Optional[float] = None):
+        self.kind = kind
+        self.step = int(step)
+        self.arg = arg
+        self.fired = False
+
+    def __repr__(self):
+        suffix = "" if self.arg is None else f":{self.arg}"
+        return f"{self.kind}@{self.step}{suffix}"
+
+
+class FaultInjector:
+    """Holds parsed fault specs; the loop calls the two hooks below.
+
+    ``before_step(k)`` fires process-level faults (crash/kill/signal/
+    stall) at the start of step ``k``; ``observed_loss(k, loss)``
+    rewrites the host-visible loss for value faults (nan/inf).  Fired
+    specs disarm, so a resumed attempt sails past the step that killed
+    its predecessor.
+    """
+
+    def __init__(self, specs: List[_Spec]):
+        self.specs = list(specs)
+
+    def __repr__(self):
+        return f"FaultInjector({','.join(map(repr, self.specs))})"
+
+    def fired(self) -> List[str]:
+        return [repr(s) for s in self.specs if s.fired]
+
+    def before_step(self, step: int) -> None:
+        for s in self.specs:
+            if s.fired or s.step != step:
+                continue
+            if s.kind == "crash":
+                s.fired = True
+                raise InjectedCrash(f"injected crash at step {step}")
+            if s.kind == "kill":
+                s.fired = True
+                os.kill(os.getpid(), signal.SIGKILL)  # no return
+            if s.kind in ("sigterm", "sigint"):
+                s.fired = True
+                os.kill(os.getpid(),
+                        signal.SIGTERM if s.kind == "sigterm"
+                        else signal.SIGINT)
+            if s.kind == "stall":
+                s.fired = True
+                time.sleep(float(s.arg or 1.0))
+
+    def observed_loss(self, step: int, loss: float) -> float:
+        for s in self.specs:
+            if s.fired or s.step != step:
+                continue
+            if s.kind == "nan":
+                s.fired = True
+                return float("nan")
+            if s.kind == "inf":
+                s.fired = True
+                return float("inf")
+        return loss
+
+
+def parse_fault(spec: Optional[str]) -> Optional[FaultInjector]:
+    """Parse ``"kind@step[:arg][,kind@step...]"`` into an injector
+    (None for empty/None input — the no-fault fast path)."""
+    if not spec:
+        return None
+    out: List[_Spec] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            kind, _, rest = part.partition("@")
+            stepstr, _, argstr = rest.partition(":")
+            kind = kind.strip().lower()
+            if kind not in KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}")
+            out.append(_Spec(kind, int(stepstr),
+                             float(argstr) if argstr else None))
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec {part!r} (expected kind@step[:arg] "
+                f"with kind in {KINDS}): {e}") from None
+    return FaultInjector(out) if out else None
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption
+# ---------------------------------------------------------------------------
+
+CORRUPTION_MODES = ("truncate", "unfinalize", "delete")
+
+
+def corrupt_checkpoint(directory: str, step: Optional[int] = None,
+                       mode: str = "truncate") -> int:
+    """Deterministically damage one Orbax step dir (default: the newest
+    on disk).  Returns the corrupted step number.  See module docstring
+    for what each mode simulates."""
+    # Share the step-dir scan and commit-marker name with the integrity
+    # layer — the corruption this injects must track exactly what that
+    # layer checks (lazy import: checkpoint pulls the jax/amp stack).
+    from ..utils.checkpoint import _FINALIZE_MARKER, _fs_steps
+
+    if mode not in CORRUPTION_MODES:
+        raise ValueError(f"mode {mode!r} not in {CORRUPTION_MODES}")
+    steps = _fs_steps(directory)
+    if step is None:
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint steps in {directory}")
+        step = steps[-1]
+    elif step not in steps:
+        raise FileNotFoundError(
+            f"checkpoint step {step} not found in {directory}; "
+            f"available: {steps}")
+    step_dir = os.path.join(directory, str(step))
+
+    if mode == "unfinalize":
+        os.remove(os.path.join(step_dir, _FINALIZE_MARKER))
+        return step
+
+    payloads = []
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            if name == _FINALIZE_MARKER:
+                continue
+            payloads.append(os.path.join(root, name))
+    if not payloads:
+        raise FileNotFoundError(f"no payload files under {step_dir}")
+    if mode == "delete":
+        for p in payloads:
+            os.remove(p)
+    else:  # truncate: halve every payload, as a torn flush would
+        for p in payloads:
+            with open(p, "r+b") as f:
+                f.truncate(os.path.getsize(p) // 2)
+    return step

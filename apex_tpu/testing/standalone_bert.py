@@ -190,17 +190,25 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 sink=None, vocab: int = 64, hidden: int = 32,
                 num_heads: int = 4, num_layers: int = 2, batch: int = 4,
                 seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
-                stall_timeout: float = 300.0, seed: int = 0) -> float:
+                stall_timeout: float = 300.0, seed: int = 0,
+                ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
+                ckpt_keep: int = 3, resume: bool = True,
+                fault=None, autoresume="auto", escalation=None,
+                return_state: bool = False):
     """Tiny single-device BERT train loop wired through
     :mod:`apex_tpu.monitor` — the BERT sibling of
     :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
-    stream: step metrics, amp scale, phase timers, watchdog), proving
-    the telemetry path is driver-agnostic.  Returns the final loss."""
+    stream: step metrics, amp scale, phase timers, watchdog — and the
+    same resilience wiring: periodic checkpoints + auto-resume under
+    ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe exit),
+    proving both paths are driver-agnostic.  Returns the final loss, or
+    ``(loss, params, amp_state, steps_done)`` with
+    ``return_state=True``."""
     from .. import amp
     from ..optimizers import fused_adam
     from ..transformer.pipeline_parallel.utils import (Timers,
                                                        param_l2_norm)
-    from .standalone_gpt import make_smoke_monitor, run_monitored_steps
+    from .standalone_gpt import _run_smoke_loop, make_smoke_monitor
 
     model = BertModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
@@ -240,18 +248,46 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=batch * seq,
         flops_per_step=6.0 * n_params * batch * seq,
-        stall_timeout=stall_timeout,
+        stall_timeout=stall_timeout, escalation=escalation,
         run_attrs={"driver": "standalone_bert.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
                    "batch": batch, "seq": seq})
     timers = Timers()
-    try:
-        _, _, loss_f = run_monitored_steps(step, params, amp_state,
-                                           steps, monitor, timers,
-                                           lr=lr)
-    finally:
-        monitor.close()
-    return loss_f
+    return _run_smoke_loop(
+        step, params, amp_opt, amp_state, steps, monitor, timers, lr=lr,
+        ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
+        resume=resume, fault=fault, autoresume=autoresume,
+        escalation=escalation, return_state=return_state)
+
+
+def _main(argv=None):
+    import argparse
+
+    from .standalone_gpt import add_resilience_cli
+
+    p = argparse.ArgumentParser(
+        description="Monitored BERT smoke train loop (CPU-friendly); "
+                    "writes an apex_tpu.monitor JSONL event log; "
+                    "preemption-safe with --ckpt-dir.")
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--jsonl", default=None,
+                   help="event-log path (default: in-memory only)")
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--stall-timeout", type=float, default=300.0)
+    add_resilience_cli(p)
+    args = p.parse_args(argv)
+    loss, _, _, done = train_smoke(
+        steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
+        stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every, resume=not args.no_resume,
+        fault=args.fault, return_state=True)
+    print(f"SMOKE_DONE steps_done={done}"
+          + (f" loss={loss:.4f}" if loss is not None else "")
+          + (f" jsonl={args.jsonl}" if args.jsonl else ""))
+
+
+if __name__ == "__main__":
+    _main()
 
 
 def bert_model_provider(args, pre_process=True, post_process=True,

@@ -251,12 +251,13 @@ def gpt_forward_pipelined(embed_mod, stage_mod, head_mod,
 # ---------------------------------------------------------------------------
 
 def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
-                       stall_timeout, run_attrs):
+                       stall_timeout, run_attrs, escalation=None):
     """Monitor bootstrap shared by the GPT/BERT smoke drivers: default
     sink selection (JSONL file if a path was given, else in-memory),
-    watchdog wiring, and close-ownership — the monitor closes the sink
-    only when it created it, so a caller-provided sink stays usable
-    after the run."""
+    watchdog wiring (optionally escalated through an
+    ``apex_tpu.resilience.EscalationPolicy``), and close-ownership —
+    the monitor closes the sink only when it created it, so a
+    caller-provided sink stays usable after the run."""
     from ..monitor import JsonlSink, MemorySink, StepMonitor, Watchdog
 
     own_sink = sink is None
@@ -265,35 +266,99 @@ def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
     return StepMonitor(
         sink, tokens_per_step=tokens_per_step,
         flops_per_step=flops_per_step,
-        watchdog=Watchdog(sink, stall_timeout=stall_timeout),
+        watchdog=Watchdog(sink, stall_timeout=stall_timeout,
+                          on_alarm=None if escalation is None
+                          else escalation.notify),
         run_attrs=run_attrs, close_sink=own_sink)
 
 
 def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
-                        timers, lr=None):
+                        timers, lr=None, *, start_step: int = 0,
+                        ckpt=None, ckpt_every: int = 1, amp_opt=None,
+                        autoresume=None, escalation=None, fault=None):
     """Drive ``step_fn(params, amp_state) -> (params, amp_state, loss,
-    grad_norm, step_info)`` for ``steps`` iterations, recording each
-    through an :class:`apex_tpu.monitor.StepMonitor` and exporting the
-    per-step phase ``timers`` into the same event log.  Shared by the
-    GPT and BERT smoke drivers."""
+    grad_norm, step_info)`` for steps ``[start_step, steps)``,
+    recording each through an :class:`apex_tpu.monitor.StepMonitor` and
+    exporting the per-step phase ``timers`` into the same event log.
+    Shared by the GPT and BERT smoke drivers.
+
+    The resilience wiring is all optional (None = PR-2 behavior):
+
+    * ``ckpt`` — an ``apex_tpu.utils.CheckpointManager``; after step
+      ``i`` completes, step ``i+1`` ("steps done") is saved every
+      ``ckpt_every`` steps (async — the loop keeps running).
+    * ``autoresume`` — polled at each step boundary; on a termination
+      request the loop cuts a final *synchronous* checkpoint, writes
+      the clean-exit marker, emits ``preempt_exit``, and returns early.
+    * ``escalation`` — polled at each step boundary; a latched alarm
+      raises :class:`~apex_tpu.resilience.EscalationAbort` (after a
+      synchronous checkpoint iff the action says so) for
+      ``run_resumable`` to catch and restart.
+    * ``fault`` — an ``apex_tpu.resilience.FaultInjector`` driving
+      deterministic failures (``before_step`` / ``observed_loss``).
+
+    Returns ``(params, amp_state, last_loss, steps_done)``.
+    """
     loss_f = None
-    for i in range(steps):
+    done = start_step
+
+    def save(step, sync=False):
+        ckpt.save(step, params, amp_opt, amp_state)
+        if sync:
+            ckpt.wait()
+
+    for i in range(start_step, steps):
+        if fault is not None:
+            fault.before_step(i)
         monitor.start_step(i)
         timers("step").start()
         params, amp_state, loss, gnorm, info = step_fn(params, amp_state)
         timers("step").stop(wait_on=loss)
         loss_f = float(loss)
+        if fault is not None:
+            loss_f = fault.observed_loss(i, loss_f)
         monitor.end_step(i, loss=loss_f, grad_norm=gnorm, lr=lr,
                          scaler=info)
         timers.events(monitor, i, reset=True)
-    return params, amp_state, loss_f
+        done = i + 1
+        esc = escalation.pending() if escalation is not None else None
+        if esc is not None:
+            from ..resilience import (CHECKPOINT_THEN_ABORT,
+                                      EscalationAbort)
+
+            if esc.action == CHECKPOINT_THEN_ABORT and ckpt is not None:
+                save(done, sync=True)
+            monitor.event("resilience", "escalation_abort", step=i,
+                          alarm=esc.alarm, action=esc.action,
+                          checkpointed=esc.action == CHECKPOINT_THEN_ABORT
+                          and ckpt is not None)
+            raise EscalationAbort(esc.alarm, esc.action, step=i)
+        saved = False
+        if ckpt is not None and done % max(1, ckpt_every) == 0:
+            save(done)
+            saved = True
+        if autoresume is not None and autoresume.termination_requested():
+            if ckpt is not None:
+                if not saved:
+                    save(done)
+                ckpt.wait()  # final checkpoint must be durable
+            if autoresume.marker_dir is not None:
+                autoresume.mark_clean_exit(done)
+            monitor.event("resilience", "preempt_exit", step=i,
+                          value=done, source=autoresume.source)
+            break
+    return params, amp_state, loss_f, done
 
 
 def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 sink=None, vocab: int = 64, hidden: int = 32,
                 num_heads: int = 4, num_layers: int = 2, batch: int = 4,
                 seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
-                stall_timeout: float = 300.0, seed: int = 0) -> float:
+                stall_timeout: float = 300.0, seed: int = 0,
+                ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
+                ckpt_keep: int = 3, resume: bool = True,
+                fault=None, autoresume="auto", escalation=None,
+                return_state: bool = False):
     """Tiny single-device GPT train loop wired end-to-end through
     :mod:`apex_tpu.monitor` — the CPU telemetry smoke (exercised by
     tools/ci.sh on every run): step metrics (loss, grad-norm, lr,
@@ -304,9 +369,26 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
 
     Pass ``jsonl`` for a file log, or ``sink`` (e.g. a ``MemorySink``)
     to capture events in-process; with neither, events go to a
-    throwaway ``MemorySink``.  Returns the final loss (host float).
-    The monitor is closed on exit; it closes the sink too unless the
-    caller provided one.
+    throwaway ``MemorySink``.  Returns the final loss (host float), or
+    ``(loss, params, amp_state, steps_done)`` with ``return_state=True``
+    (how the kill-and-resume tests compare runs bitwise).  The monitor
+    is closed on exit; it closes the sink too unless the caller
+    provided one.
+
+    With ``ckpt_dir`` the loop is **preemption-safe** (the tier-1
+    resilience acceptance path, see docs/api/resilience.md): every
+    ``ckpt_every`` steps an async checkpoint is cut; at start the run
+    auto-resumes from the latest *valid* step (corrupt ones skipped +
+    GC'd); ``autoresume="auto"`` installs a SIGTERM/SIGINT
+    :class:`~apex_tpu.resilience.AutoResume` whose termination request
+    produces a final synchronous checkpoint plus the ``CLEAN_EXIT.json``
+    marker (pass an instance to share one, or None to disable).
+    ``fault`` is a fault spec string or
+    :class:`~apex_tpu.resilience.FaultInjector` (``"sigterm@4"``,
+    ``"nan@3,crash@5"``, ...); ``escalation`` an
+    :class:`~apex_tpu.resilience.EscalationPolicy` latched into the
+    watchdog.  A crashing step emits a terminal ``run_error`` event
+    before the exception propagates.
     """
     from .. import amp
     from ..optimizers import fused_adam
@@ -346,18 +428,101 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         + 12.0 * num_layers * hidden * batch * seq * seq
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=batch * seq, flops_per_step=flops,
-        stall_timeout=stall_timeout,
+        stall_timeout=stall_timeout, escalation=escalation,
         run_attrs={"driver": "standalone_gpt.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
                    "batch": batch, "seq": seq})
     timers = Timers()
+    return _run_smoke_loop(
+        step, params, amp_opt, amp_state, steps, monitor, timers, lr=lr,
+        ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
+        resume=resume, fault=fault, autoresume=autoresume,
+        escalation=escalation, return_state=return_state)
+
+
+def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
+                    timers, *, lr, ckpt_dir, ckpt_every, ckpt_keep,
+                    resume, fault, autoresume, escalation, return_state):
+    """Resilience-wired driver shell shared by the GPT and BERT smokes:
+    checkpoint manager + auto-resume bootstrap around
+    :func:`run_monitored_steps`, ``run_error`` emission on a crashing
+    step, and guaranteed teardown (watchdog heartbeat, JSONL sink,
+    pending async saves) via ``try/finally``."""
+    from ..resilience import AutoResume, parse_fault
+    from ..utils import CheckpointManager
+
+    if isinstance(fault, str):
+        fault = parse_fault(fault)
+    mgr = None
+    own_autoresume = False
+    loss_f = None
+    done = 0
     try:
-        _, _, loss_f = run_monitored_steps(step, params, amp_state,
-                                           steps, monitor, timers,
-                                           lr=lr)
+        if escalation is not None:
+            escalation.reset()  # a fresh attempt re-arms the policy —
+            # a stale latch from the previous attempt would otherwise
+            # abort every retry at its first step boundary
+        start_step = 0
+        if ckpt_dir is not None:
+            mgr = CheckpointManager(ckpt_dir, keep=ckpt_keep,
+                                    sink=monitor)
+            if autoresume == "auto":
+                autoresume = AutoResume(marker_dir=mgr.directory,
+                                        sink=monitor).install()
+                own_autoresume = True
+            if resume and mgr.latest_valid_step() is not None:
+                params, amp_state, _, start_step = mgr.restore(
+                    params, amp_opt, amp_state)
+                monitor.event("resilience", "run_resumed",
+                              value=start_step, directory=mgr.directory)
+        if autoresume == "auto":  # no ckpt_dir to anchor a marker
+            autoresume = None
+        if autoresume is not None and autoresume.marker_dir:
+            autoresume.clear_clean_exit()  # marker = THIS run's exit
+        done = start_step
+        params, amp_state, loss_f, done = run_monitored_steps(
+            step_fn, params, amp_state, steps, monitor, timers, lr=lr,
+            start_step=start_step, ckpt=mgr, ckpt_every=ckpt_every,
+            amp_opt=amp_opt, autoresume=autoresume,
+            escalation=escalation, fault=fault)
+    except BaseException as e:
+        # terminal record first — the re-raise may end the process
+        monitor.event("run", "run_error", step=done,
+                      error=type(e).__name__, message=str(e)[:200])
+        raise
     finally:
-        monitor.close()
+        # Nested so one teardown failure cannot skip the next: the sink
+        # close must not strand a pending async save, and a stranded
+        # signal handler would swallow the process's next SIGTERM.
+        try:
+            monitor.close()
+        finally:
+            try:
+                if mgr is not None:
+                    mgr.close()  # pending async saves become durable
+            finally:
+                if own_autoresume:
+                    autoresume.uninstall()
+    if return_state:
+        return loss_f, params, amp_state, done
     return loss_f
+
+
+def add_resilience_cli(p) -> None:
+    """The shared GPT/BERT smoke-driver resilience flags."""
+    p.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint directory; enables periodic saves, "
+                        "auto-resume from the latest valid step, and "
+                        "SIGTERM-safe exit with a CLEAN_EXIT.json "
+                        "marker")
+    p.add_argument("--ckpt-every", type=int, default=1,
+                   help="save every N steps (default 1)")
+    p.add_argument("--no-resume", action="store_true",
+                   help="start from step 0 even if checkpoints exist")
+    p.add_argument("--fault", default=None,
+                   help="deterministic fault spec, e.g. 'sigterm@4', "
+                        "'crash@3', 'nan@2,crash@5', 'stall@1:0.5' "
+                        "(see apex_tpu.resilience.faults)")
 
 
 def _main(argv=None):
@@ -365,17 +530,24 @@ def _main(argv=None):
 
     p = argparse.ArgumentParser(
         description="Monitored GPT smoke train loop (CPU-friendly); "
-                    "writes an apex_tpu.monitor JSONL event log.")
+                    "writes an apex_tpu.monitor JSONL event log. "
+                    "With --ckpt-dir the loop is preemption-safe: "
+                    "kill it (--fault sigterm@K or a real SIGTERM) and "
+                    "re-run the same command to resume.")
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--jsonl", default=None,
                    help="event-log path (default: in-memory only)")
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--stall-timeout", type=float, default=300.0)
+    add_resilience_cli(p)
     args = p.parse_args(argv)
-    loss = train_smoke(steps=args.steps, jsonl=args.jsonl,
-                       opt_level=args.opt_level,
-                       stall_timeout=args.stall_timeout)
-    print(f"SMOKE_DONE loss={loss:.4f}"
+    loss, _, _, done = train_smoke(
+        steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
+        stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every, resume=not args.no_resume,
+        fault=args.fault, return_state=True)
+    print(f"SMOKE_DONE steps_done={done}"
+          + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))
 
 

@@ -118,8 +118,23 @@ def get_kth_microbatch(batch, k: int):
 
 
 def get_autoresume():
-    """Vestigial ADLR autoresume hook (ref: utils.py:131-133)."""
+    """The ADLR autoresume hook, realized (ref: utils.py:131-133, where
+    it always returned None).  Returns the installed
+    :class:`apex_tpu.resilience.AutoResume` — Megatron-parity call
+    sites poll ``get_autoresume().termination_requested()`` at step
+    boundaries to cut a final checkpoint before the scheduler's
+    SIGTERM deadline.  ``AutoResume.install()`` registers itself here;
+    None until then."""
     return _GLOBAL_AUTORESUME
+
+
+def set_autoresume(autoresume) -> None:
+    """Publish (or clear, with None) the process-wide autoresume
+    handler.  Called by ``AutoResume.install()``/``uninstall()``;
+    replacing an existing handler is allowed — latest wins, as with
+    signal handlers themselves."""
+    global _GLOBAL_AUTORESUME
+    _GLOBAL_AUTORESUME = autoresume
 
 
 # --- timers ----------------------------------------------------------------

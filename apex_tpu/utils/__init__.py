@@ -19,6 +19,7 @@ from .log_util import (
 
 __all__ = [
     "CheckpointManager",
+    "latest_valid_step",
     "load_checkpoint",
     "save_checkpoint",
     "RankInfoFormatter",
@@ -28,7 +29,7 @@ __all__ = [
 ]
 
 _CHECKPOINT_SYMBOLS = ("CheckpointManager", "load_checkpoint",
-                       "save_checkpoint")
+                       "save_checkpoint", "latest_valid_step")
 
 
 def __getattr__(name):

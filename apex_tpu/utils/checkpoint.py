@@ -20,29 +20,100 @@ Semantics preserved from the amp flow:
 * ``save`` is asynchronous: the training loop continues while shards
   flush (call ``wait()``/``close`` — or rely on the context manager —
   before exiting).
+
+New for the resilience layer (:mod:`apex_tpu.resilience`): checkpoint
+**integrity**.  A preempted or crashed run leaves garbage on disk — a
+step dir killed before its commit marker, or payload files torn
+mid-flush — and a restore that trips over it must not take the run
+down.  :meth:`CheckpointManager.latest_valid_step` spots structural
+garbage cheaply; :meth:`CheckpointManager.restore` (``step=None``)
+additionally survives deep corruption by falling back step-by-step to
+the newest checkpoint that actually restores, logging/emitting what was
+skipped (``ckpt_skipped`` / ``ckpt_gc`` ``resilience`` events into an
+optional ``sink``) and moving the garbage out of the way — structural
+trash deleted, torn-restore steps quarantined as ``<step>.corrupt`` —
+so it cannot shadow good steps forever.  An explicitly requested
+missing step raises a
+``FileNotFoundError`` naming the directory and the available steps —
+not a raw Orbax traceback.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional, Tuple
 
 import jax
 
 from .. import amp as _amp
+from .log_util import get_logger
+
+#: Orbax's atomic-commit marker: written last, so its absence means the
+#: step dir never finished (or was tampered with) — never restore it.
+_FINALIZE_MARKER = "_CHECKPOINT_METADATA"
 
 
 def _manager(directory: str, keep: int):
     import orbax.checkpoint as ocp
 
-    # Only absolutize plain filesystem paths — abspath would mangle
-    # URI-scheme destinations (gs://bucket/... -> <cwd>/gs:/bucket/...).
-    if "://" not in directory:
-        directory = os.path.abspath(directory)
     return ocp.CheckpointManager(
         directory,
         options=ocp.CheckpointManagerOptions(
             max_to_keep=keep, create=True, enable_async_checkpointing=True),
     )
+
+
+def _fs_steps(directory: str) -> List[int]:
+    """Numeric step dirs actually on disk (tmp dirs from a killed async
+    save carry an ``.orbax-checkpoint-tmp`` suffix and don't parse)."""
+    try:
+        return sorted(int(n) for n in os.listdir(directory)
+                      if n.isdigit()
+                      and os.path.isdir(os.path.join(directory, n)))
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
+def _step_integrity(step_dir: str) -> Tuple[bool, str]:
+    """Cheap structural validity of one Orbax step dir: finalize marker
+    present, every item subdir non-empty, the Standard/Json item
+    metadata files in place.  Catches kill-before-commit and gross
+    tampering; torn payload *contents* are only caught by the restore
+    attempt itself (see :meth:`CheckpointManager.restore`)."""
+    if not os.path.isfile(os.path.join(step_dir, _FINALIZE_MARKER)):
+        return False, "unfinalized (no _CHECKPOINT_METADATA)"
+    items = [n for n in os.listdir(step_dir)
+             if os.path.isdir(os.path.join(step_dir, n))]
+    if not items:
+        return False, "no checkpoint items"
+    for item in items:
+        if not os.listdir(os.path.join(step_dir, item)):
+            return False, f"empty item {item!r}"
+    state_meta = os.path.join(step_dir, "state", "_METADATA")
+    if os.path.isdir(os.path.dirname(state_meta)) \
+            and not os.path.isfile(state_meta):
+        return False, "state item missing _METADATA"
+    meta_file = os.path.join(step_dir, "meta", "metadata")
+    if os.path.isdir(os.path.dirname(meta_file)) and (
+            not os.path.isfile(meta_file)
+            or os.path.getsize(meta_file) == 0):
+        return False, "meta item missing/empty"
+    return True, ""
+
+
+def latest_valid_step(directory: str) -> Optional[int]:
+    """Newest step under ``directory`` that passes the structural
+    integrity scan (None if there is none).  Module-level twin of
+    :meth:`CheckpointManager.latest_valid_step` for callers that only
+    need to *decide whether* to resume."""
+    if "://" in directory:
+        raise ValueError("integrity scan requires a filesystem path; "
+                         "use CheckpointManager for URI destinations")
+    for step in reversed(_fs_steps(directory)):
+        ok, _ = _step_integrity(os.path.join(directory, str(step)))
+        if ok:
+            return step
+    return None
 
 
 class CheckpointManager:
@@ -52,10 +123,89 @@ class CheckpointManager:
     knows the amp layout (masters / scalers / model-dtype writeback).
     ``extra`` carries any additional pytrees (batch_stats, data-loader
     cursors, ...) — they are restored by structure.
+
+    ``sink`` (optional, any :class:`apex_tpu.monitor.Sink`) receives
+    ``resilience`` events when restore has to skip or GC a damaged
+    step, so integrity fallbacks land in the same JSONL as the rest of
+    the run's telemetry.
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3, sink=None):
+        # Only absolutize plain filesystem paths — abspath would mangle
+        # URI-scheme destinations (gs://b/... -> <cwd>/gs:/b/...).
+        if "://" not in directory:
+            directory = os.path.abspath(directory)
+        self.directory = directory
+        self._keep = int(keep)
+        self._sink = sink
+        self._log = get_logger(__name__)
+        # Sweep BEFORE Orbax opens: a structurally-invalid step dir
+        # left by a dead process would otherwise sit in Orbax's step
+        # list, where it silently vetoes any re-save of that step
+        # number (save() returns False) while never being restorable.
+        if self._fs_backed():
+            self._sweep_invalid()
         self._mgr = _manager(directory, keep)
+
+    def _sweep_invalid(self) -> None:
+        """Quarantine structurally-invalid step dirs as
+        ``<step>.corrupt`` at open (process 0 only under multihost;
+        rename keeps the payload for a post-mortem while freeing the
+        step number).  Assumes the single-writer model this module is
+        built on: no *other* manager may have an async save in flight
+        on this directory at open time (a step dir is briefly
+        marker-less mid-finalize).  Tolerant of rename races: a
+        concurrently swept dir is simply gone."""
+        if jax.process_index() != 0:
+            return
+        for s in _fs_steps(self.directory):
+            step_dir = os.path.join(self.directory, str(s))
+            ok, reason = _step_integrity(step_dir)
+            if ok:
+                continue
+            try:
+                dst = step_dir + ".corrupt"
+                shutil.rmtree(dst, ignore_errors=True)
+                os.rename(step_dir, dst)
+            except OSError:
+                continue
+            self._log.warning(
+                "checkpoint step %d in %s quarantined at open: %s",
+                s, self.directory, reason)
+            self._emit("ckpt_quarantined", step=s, reason=reason,
+                       directory=self.directory)
+
+    # -- integrity surface ---------------------------------------------------
+
+    def _fs_backed(self) -> bool:
+        return "://" not in self.directory
+
+    def available_steps(self) -> List[int]:
+        """Steps present on disk (or known to Orbax for URI backends),
+        regardless of validity."""
+        if self._fs_backed():
+            return _fs_steps(self.directory)
+        return sorted(self._mgr.all_steps())
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step passing the structural integrity scan — the step
+        ``restore(step=None)`` will try first.  Falls back to Orbax's
+        own ``latest_step`` on URI backends (no local scan possible)."""
+        if not self._fs_backed():
+            return self._mgr.latest_step()
+        return latest_valid_step(self.directory)
+
+    def _emit(self, name: str, value=None, step=None, **attrs) -> None:
+        from ..monitor.events import emit_resilience
+
+        emit_resilience(self._sink, name, value=value, step=step,
+                        **attrs)
+
+    def _reopen(self) -> None:
+        """Recreate the Orbax manager after step dirs were removed
+        behind its back (its step cache must not resurrect them)."""
+        self._mgr.close()
+        self._mgr = _manager(self.directory, self._keep)
 
     # -- save ---------------------------------------------------------------
 
@@ -83,7 +233,16 @@ class CheckpointManager:
         items["meta"] = ocp.args.JsonSave(meta)
         if extra:
             items["extra"] = ocp.args.StandardSave(extra)
-        self._mgr.save(step, args=ocp.args.Composite(**items))
+        accepted = self._mgr.save(step, args=ocp.args.Composite(**items))
+        if accepted is False:
+            # Orbax skips (returns False) instead of raising when the
+            # step number already exists on disk — a silent drop here
+            # would let a clean-exit marker claim durability the store
+            # doesn't have.
+            raise RuntimeError(
+                f"checkpoint save of step {step} under "
+                f"{self.directory} was declined by Orbax (step already "
+                f"on disk?); existing steps: {self.available_steps()}")
 
     # -- restore ------------------------------------------------------------
 
@@ -91,19 +250,114 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def restore(self, params: Any, amp_opt=None, amp_state=None,
-                extra: Optional[dict] = None, step: Optional[int] = None):
+                extra: Optional[dict] = None, step: Optional[int] = None,
+                gc_invalid: bool = True):
         """Restore into the shapes/shardings of the given templates.
 
         Returns ``(params, amp_state, extra, step)`` — params in the
         model dtype (re-cast from restored masters when amp is active),
         restored onto whatever sharding the template arrays carry (a
         different mesh than the one saved from is fine).
+
+        With ``step=None`` the restore is **integrity-checked**: steps
+        failing the structural scan are skipped outright, and a
+        structurally-sound step whose payload is torn (restore raises)
+        falls back to the next-newest candidate — each skip logged and
+        emitted as a ``ckpt_skipped`` event, and (``gc_invalid=True``)
+        the damaged dirs moved out of the way so they never shadow a
+        good step again (structural garbage deleted, restore failures
+        quarantined as ``<step>.corrupt``).  An explicit ``step`` that
+        does not exist raises a
+        ``FileNotFoundError`` naming this directory and the available
+        steps.
         """
+        if step is not None:
+            available = self.available_steps()
+            if step not in available:
+                raise FileNotFoundError(
+                    f"checkpoint step {step} not found under "
+                    f"{self.directory}; available steps: "
+                    f"{available if available else 'none'}")
+            return self._restore_step(step, params, amp_opt, amp_state,
+                                      extra)
+        if not self._fs_backed():
+            # URI backend: no local integrity scan; plain Orbax path.
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {self.directory}")
+            return self._restore_step(step, params, amp_opt, amp_state,
+                                      extra)
+
+        skipped: List[Tuple[int, str]] = []
+        candidates: List[int] = []
+        for s in sorted(_fs_steps(self.directory), reverse=True):
+            ok, reason = _step_integrity(
+                os.path.join(self.directory, str(s)))
+            if ok:
+                candidates.append(s)
+            else:
+                skipped.append((s, reason))
+        result = None
+        for s in candidates:
+            try:
+                result = self._restore_step(s, params, amp_opt,
+                                            amp_state, extra)
+                break
+            except Exception as e:  # torn payload — fall back one step
+                skipped.append(
+                    (s, f"restore failed: {type(e).__name__}: "
+                        f"{str(e)[:200]}"))
+        if result is None:
+            detail = "".join(f"\n  step {s}: {r}" for s, r in skipped)
+            raise FileNotFoundError(
+                f"no valid checkpoint found under {self.directory}"
+                + (f"; skipped:{detail}" if skipped else ""))
+        restored_step = result[3]
+        # Report/GC only what the fallback actually stepped over —
+        # steps older than the one restored are not in the way.
+        stale = sorted((s, r) for s, r in skipped if s > restored_step)
+        for s, reason in stale:
+            self._log.warning(
+                "checkpoint step %d in %s skipped: %s (restored %d)",
+                s, self.directory, reason, restored_step)
+            self._emit("ckpt_skipped", step=s, reason=reason,
+                       restored_step=restored_step,
+                       directory=self.directory)
+        if gc_invalid and stale:
+            # Structural garbage (no commit marker / empty items) is
+            # incomplete by construction — delete it.  A structurally
+            # sound step whose *restore* failed could in principle be a
+            # transient host error rather than a torn payload, so it is
+            # quarantined (renamed ``<step>.corrupt``) instead of
+            # destroyed — out of the step namespace, but recoverable
+            # for a post-mortem.
+            removed, quarantined = [], []
+            for s, reason in stale:
+                src = os.path.join(self.directory, str(s))
+                if reason.startswith("restore failed"):
+                    dst = src + ".corrupt"
+                    shutil.rmtree(dst, ignore_errors=True)
+                    os.rename(src, dst)
+                    quarantined.append(s)
+                else:
+                    shutil.rmtree(src, ignore_errors=True)
+                    removed.append(s)
+            self._log.warning(
+                "garbage-collected %d invalid checkpoint step(s): "
+                "deleted %s, quarantined as .corrupt %s",
+                len(stale), removed, quarantined)
+            self._emit("ckpt_gc", value=len(stale),
+                       steps=[s for s, _ in stale],
+                       removed=removed, quarantined=quarantined,
+                       directory=self.directory)
+            self._reopen()
+        return result
+
+    def _restore_step(self, step: int, params: Any, amp_opt=None,
+                      amp_state=None, extra: Optional[dict] = None):
         import orbax.checkpoint as ocp
 
-        step = self.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError("no checkpoint found")
         if amp_state is not None and amp_state.master_params is not None:
             tree = {"params": amp_state.master_params,
                     "inner_state": amp_state.inner_state}

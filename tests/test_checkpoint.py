@@ -98,3 +98,48 @@ class TestManager:
     def test_restore_missing_raises(self, tmp_path):
         with pytest.raises(FileNotFoundError):
             load_checkpoint(str(tmp_path / "nope"), _toy_params())
+
+    def test_restore_missing_explicit_step_lists_available(self,
+                                                           tmp_path):
+        # a clear FileNotFoundError naming dir + steps, not a raw
+        # Orbax traceback (see also tests/test_resilience.py)
+        with CheckpointManager(str(tmp_path / "mgr2")) as mgr:
+            p = _toy_params(6)
+            mgr.save(5, p)
+            mgr.wait()
+            with pytest.raises(FileNotFoundError) as ei:
+                mgr.restore(p, step=9)
+        assert "step 9" in str(ei.value) and "[5]" in str(ei.value)
+
+
+class TestIntegrityFallbackAmp:
+    def test_corrupt_latest_falls_back_with_amp_state(self, tmp_path):
+        """The integrity fallback composes with the amp layout: a torn
+        newest step is skipped and the previous step's masters + scaler
+        state restore intact."""
+        from apex_tpu.resilience import corrupt_checkpoint
+
+        params0 = _toy_params()
+        cast, opt, state = amp.initialize(params0, optax.sgd(0.1),
+                                          opt_level="O2")
+        d = str(tmp_path / "ckamp")
+        snapshots = {}
+        with CheckpointManager(d, keep=5) as mgr:
+            for s in (1, 2):
+                g = jax.tree_util.tree_map(jnp.ones_like, cast)
+                cast, state, _ = opt.apply_gradients(g, state, cast)
+                snapshots[s] = state
+                mgr.save(s, cast, opt, state)
+            mgr.wait()
+        corrupt_checkpoint(d, step=2, mode="truncate")
+
+        cast2, opt2, state2 = amp.initialize(params0, optax.sgd(0.1),
+                                             opt_level="O2")
+        cast2, state2, _, step = load_checkpoint(d, cast2, opt2, state2)
+        assert step == 1
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            snapshots[1].master_params, state2.master_params)
+        assert float(state2.scaler.loss_scale) == \
+            float(snapshots[1].scaler.loss_scale)

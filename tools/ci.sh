@@ -19,25 +19,52 @@
 #                            and tools/monitor_summary.py renders it,
 #                            so the telemetry path is exercised on
 #                            every CI run, not only under a TPU bench
+#   5. kill->resume smoke  — the resilience acceptance path end to end:
+#                            a checkpointed standalone_gpt run is
+#                            SIGTERM'd at step 4 (--fault sigterm@4),
+#                            must exit 0 with a CLEAN_EXIT.json marker,
+#                            then the same command resumes to step 8;
+#                            the shared JSONL must carry the
+#                            preempt_exit and run_resumed events
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/4 default test tier"
+echo "[ci] 1/5 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/4 README drift guard"
+echo "[ci] 2/5 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/4 8-device multichip dryrun"
+echo "[ci] 3/5 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/4 monitor smoke"
+echo "[ci] 4/5 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
+
+echo "[ci] 5/5 kill->resume smoke"
+RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
+RESIL_JSONL="$RESIL_DIR/events.jsonl"
+# leg 1: preempted at step 4 — must exit 0 via the graceful path
+python -m apex_tpu.testing.standalone_gpt --steps 8 \
+    --ckpt-dir "$RESIL_DIR/ck" --jsonl "$RESIL_JSONL" --fault sigterm@4
+test -f "$RESIL_DIR/ck/CLEAN_EXIT.json" \
+    || { echo "[ci] FAIL: no CLEAN_EXIT.json after SIGTERM"; exit 1; }
+# leg 2: same command resumes from the final checkpoint to step 8
+python -m apex_tpu.testing.standalone_gpt --steps 8 \
+    --ckpt-dir "$RESIL_DIR/ck" --jsonl "$RESIL_JSONL" \
+    | grep -q "steps_done=8" \
+    || { echo "[ci] FAIL: resume did not reach step 8"; exit 1; }
+grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
+    && grep -q '"name":"run_resumed"' "$RESIL_JSONL" \
+    || { echo "[ci] FAIL: resilience events missing from JSONL"; \
+         exit 1; }
+python tools/monitor_summary.py "$RESIL_JSONL"
+rm -rf "$RESIL_DIR"
 
 echo "[ci] all green"
