@@ -22,6 +22,8 @@ import optax
 
 from . import cast as _cast
 from . import scaler as _scaler
+from ..ops import fused_pipeline as _pipeline
+from ..ops import multi_tensor as _mt
 from .policy import Policy, get_policy
 
 
@@ -59,6 +61,12 @@ class StepInfo(NamedTuple):
     # Static (Python) flag: False when the step ran without inspecting
     # gradients, so grads_finite==True means "unchecked", not "healthy".
     grads_checked: bool = True
+    # Unscaled global gradient L2 norm, measured by the fused
+    # pipeline's norm sweep (None on the per-stage path, which never
+    # computes one).  Telemetry consumers (StepMonitor) read it from
+    # here instead of re-sweeping the gradient tree host-side; under
+    # shard_map it is the LOCAL shard's norm.
+    grad_norm: Optional[jnp.ndarray] = None
 
 
 class AmpOptimizer:
@@ -73,11 +81,36 @@ class AmpOptimizer:
 
     def __init__(self, tx: optax.GradientTransformation, policy: Policy,
                  num_losses: int = 1, axis_names=None,
-                 check_finite: Optional[bool] = None):
+                 check_finite: Optional[bool] = None,
+                 pipeline: Optional[bool] = None):
         self.tx = tx
         self.policy = policy
         self.num_losses = int(num_losses)
         self.use_masters = bool(policy.master_weights)
+        # Persistent packed pipeline (ops/fused_pipeline.py): masters +
+        # optimizer state live in packed flat fp32 buffers across
+        # steps and the whole post-backward step is two fused sweeps.
+        # None resolves via APEX_TPU_FUSED_PIPELINE (default ON; "0"
+        # is the escape hatch back to the per-stage path), read at
+        # construction.  Requires master weights and an optimizer with
+        # a pipeline form (fused_adam / fused_sgd / fused_lamb); under
+        # the auto default anything else keeps the per-stage path, but
+        # an EXPLICIT pipeline=True with missing prerequisites raises —
+        # a silent staged fallback would corrupt pipeline-vs-staged
+        # comparisons (bench) and user expectations.
+        capable = (self.use_masters
+                   and getattr(tx, "pipeline_step", None) is not None)
+        if pipeline and not capable:
+            raise ValueError(
+                "pipeline=True requires master weights (policy."
+                "master_weights) and an optimizer with a pipeline form "
+                f"(fused_adam/fused_sgd/fused_lamb); got policy "
+                f"{policy.opt_level!r} master_weights="
+                f"{bool(policy.master_weights)}, tx "
+                f"{type(tx).__name__} with pipeline_step="
+                f"{getattr(tx, 'pipeline_step', None)}")
+        self.use_pipeline = capable and _pipeline.pipeline_enabled(
+            pipeline)
         # Model-parallel axes to reduce the found-inf flag over, so every
         # shard takes the same skip-vs-step branch (ref:
         # apex/transformer/amp/grad_scaler.py:25-36).  Only meaningful
@@ -100,7 +133,16 @@ class AmpOptimizer:
         from them (the reference likewise clones masters from the fp32
         model before it is cast, ref: apex/amp/_process_optimizer.py:28-44).
         """
-        if self.use_masters:
+        if self.use_pipeline:
+            # Persistent packed mode: the master "tree" is a
+            # PackedMasters (flat fp32 buffers + static layout), the
+            # inner state packs into the same layout.  The layout is
+            # computed from the CAST model template so per-step
+            # gradient packing groups identically.
+            masters = _pipeline.pack_masters(
+                params, _cast.cast_params(params, self.policy))
+            inner = self.tx.pipeline_init(masters.metas)
+        elif self.use_masters:
             masters = _cast.master_copy(params)
             inner = self.tx.init(masters)
         else:
@@ -142,6 +184,9 @@ class AmpOptimizer:
         to explicitly disable the reduction for this call (e.g. when
         stepping the same optimizer outside shard_map).
         """
+        if self.use_pipeline:
+            return self._apply_gradients_pipeline(
+                scaled_grads, state, params, loss_id, axis_names)
         scaler = state.scalers[loss_id]
         fused_capable = getattr(self.tx, "fused_step", None) is not None
         # Single-pass optimizers upcast per-leaf inside their update
@@ -180,13 +225,7 @@ class AmpOptimizer:
                 new_model = _cast.restore_dtypes(new_stepped, model_)
             return new_stepped, new_inner, new_model
 
-        check = self.check_finite
-        if check is None:
-            check = scaler.dynamic
-        elif not check and scaler.dynamic:
-            raise ValueError("check_finite=False is invalid with a dynamic "
-                             "loss scaler: the scale schedule needs the "
-                             "finite flag")
+        check = self._resolve_check(scaler)
         if not check:
             # Static scaling never inspects gradients: the reference's
             # static LossScaler steps regardless of overflow
@@ -235,6 +274,78 @@ class AmpOptimizer:
             grads_checked=check,
         )
 
+    def _resolve_check(self, scaler) -> bool:
+        """Static decision: inspect gradients this step?  None
+        (default) = reference parity — only under dynamic scaling
+        (apex's static LossScaler never skips); True forces the check;
+        False is rejected for dynamic scalers."""
+        check = self.check_finite
+        if check is None:
+            return scaler.dynamic
+        if not check and scaler.dynamic:
+            raise ValueError("check_finite=False is invalid with a dynamic "
+                             "loss scaler: the scale schedule needs the "
+                             "finite flag")
+        return check
+
+    def _apply_gradients_pipeline(self, scaled_grads, state, params,
+                                  loss_id, axis_names):
+        """The persistent-packed post-backward step: TWO fused sweeps
+        instead of the per-stage unscale / finite-check / update /
+        master->model chain (see ops/fused_pipeline.py).
+
+        Sweep 1 reads the packed grads once, producing the unscaled
+        global norm and the finite flag (the multi_tensor_l2norm +
+        overflow-buffer roles); sweep 2 reads grads+masters+state and
+        writes masters+state+model-copy, with the unscale (and any
+        optimizer clip) folded into its combined scale and the
+        overflow skip as an in-sweep select.  Skip semantics match the
+        per-stage ``lax.cond`` bitwise: state unchanged, model re-cast
+        from the unchanged masters.
+
+        Static scaling steps unconditionally (``_resolve_check``) AND
+        elides the norm/finite sweep entirely — the per-stage path
+        deliberately skips that grad-wide pass (measured 14 ms/step at
+        GPT-345M) and the pipeline must not re-add it; StepInfo.
+        grad_norm is then None (telemetry falls back) and any
+        optimizer-level clip derives its own norm inside the update
+        path.
+        """
+        scaler = state.scalers[loss_id]
+        if axis_names is None:
+            axis_names = self.axis_names
+        masters = state.master_params
+        metas = masters.metas
+        gbufs = _pipeline.pack_grads(scaled_grads, metas)
+        inv = (1.0 / scaler.loss_scale).astype(jnp.float32)
+        check = self._resolve_check(scaler)
+        if check:
+            gnorm, finite_measured = _pipeline.grad_norm_finite(gbufs,
+                                                                inv)
+            finite = _scaler.reduce_finite(finite_measured, axis_names)
+        else:
+            gnorm, finite = None, jnp.bool_(True)
+        new_mbufs, new_inner, lowp = self.tx.pipeline_step(
+            gbufs, state.inner_state, masters.bufs, metas,
+            grad_scale=inv, grad_norm=gnorm, finite=finite)
+        model_leaves = jax.tree_util.tree_leaves(params)
+        new_params = _mt.assemble(
+            lowp, list(metas),
+            out_dtypes=[jnp.asarray(l).dtype for l in model_leaves])
+        new_masters = _pipeline.PackedMasters(tuple(new_mbufs), metas)
+        new_scaler = _scaler.update(scaler, finite)
+        new_scalers = tuple(
+            new_scaler if i == loss_id else s
+            for i, s in enumerate(state.scalers))
+        new_state = AmpState(new_inner, new_masters, new_scalers)
+        return new_params, new_state, StepInfo(
+            grads_finite=finite,
+            loss_scale=new_scaler.loss_scale,
+            steps_skipped=new_scaler.steps_skipped,
+            grads_checked=check,
+            grad_norm=gnorm,
+        )
+
     # -- checkpointing (ref: apex/amp/frontend.py:428-454) ------------------
 
     def state_dict(self, state: AmpState) -> dict:
@@ -266,6 +377,7 @@ def initialize(
     num_losses: int = 1,
     axis_names=None,
     check_finite: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
     **overrides,
 ) -> Tuple[Any, AmpOptimizer, Any]:
     """The two-line setup entry, mirroring
@@ -282,5 +394,6 @@ def initialize(
     cast = _cast.cast_params(params, policy)
     amp_opt = AmpOptimizer(optimizer, policy, num_losses=num_losses,
                            axis_names=axis_names,
-                           check_finite=check_finite)
+                           check_finite=check_finite,
+                           pipeline=pipeline)
     return cast, amp_opt, amp_opt.init(params)

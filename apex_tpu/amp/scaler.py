@@ -102,13 +102,21 @@ def all_finite(tree: Any, axis_names=None) -> jnp.ndarray:
         finite = jnp.stack(
             [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
         ).all()
-    if axis_names:
-        if isinstance(axis_names, str):
-            axis_names = (axis_names,)
-        # inf anywhere on the model-parallel axes => everyone skips.
-        bad = jax.lax.psum((~finite).astype(jnp.int32), tuple(axis_names))
-        finite = bad == 0
-    return finite
+    return reduce_finite(finite, axis_names)
+
+
+def reduce_finite(finite: jnp.ndarray, axis_names=None) -> jnp.ndarray:
+    """AND a local finite flag over model-parallel mesh axes so every
+    shard takes the same skip-vs-step branch (the MAX-allreduce of
+    found-inf, ref: apex/transformer/amp/grad_scaler.py:25-36).  Shared
+    by :func:`all_finite` and the fused pipeline's norm sweep."""
+    if not axis_names:
+        return finite
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    # inf anywhere on the model-parallel axes => everyone skips.
+    bad = jax.lax.psum((~finite).astype(jnp.int32), tuple(axis_names))
+    return bad == 0
 
 
 def unscale(tree: Any, state: ScalerState, out_dtype=jnp.float32) -> Any:

@@ -120,12 +120,18 @@ class StepMonitor:
         host sync each).  ``scaler`` accepts an
         :class:`~apex_tpu.amp.mixed_precision.StepInfo`, an
         :class:`~apex_tpu.amp.scaler.ScalerState`, or an ``AmpState``
-        (its first scaler is read).  ``tokens`` overrides the
-        constructor's ``tokens_per_step`` for this step.  Extra keyword
-        scalars become additional ``metric`` events.
+        (its first scaler is read).  When ``grad_norm`` is omitted and
+        ``scaler`` is a ``StepInfo`` carrying the fused pipeline's
+        measured global norm (``StepInfo.grad_norm``), that value is
+        recorded — no redundant host-side tree sweep needed.
+        ``tokens`` overrides the constructor's ``tokens_per_step`` for
+        this step.  Extra keyword scalars become additional ``metric``
+        events.
         """
         if step is None:
             step = self._last_step
+        if grad_norm is None and scaler is not None:
+            grad_norm = getattr(scaler, "grad_norm", None)
         self._steps_seen += 1
         now = self._clock()
         dt = (now - self._step_t0) if self._step_t0 is not None else None

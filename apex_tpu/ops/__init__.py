@@ -1,6 +1,6 @@
 """apex_tpu.ops — Pallas kernels and multi-tensor utilities."""
-from . import fused_optim, multi_tensor
+from . import fused_optim, fused_pipeline, multi_tensor
 from .multi_tensor import axpby, l2norm, l2norm_scale, scale
 
-__all__ = ["multi_tensor", "fused_optim", "scale", "axpby", "l2norm",
-           "l2norm_scale"]
+__all__ = ["multi_tensor", "fused_optim", "fused_pipeline", "scale",
+           "axpby", "l2norm", "l2norm_scale"]

@@ -91,10 +91,16 @@ DIRECT_MIN_ELEMS = _env_direct_min()
 PACK_MAX_ELEMS = 1 << 24
 
 
-def _group_leaves(leaves, split_direct: bool = False) -> dict:
+def _group_leaves(leaves, split_direct: bool = False,
+                  direct_min: Optional[int] = None) -> dict:
     """leaf indices by (dtype, bucket): bucket None/int chunk id =
     shared per-dtype pack (chunked at PACK_MAX_ELEMS), bucket
-    ("direct", i) = leaf i's own direct group (split_direct only)."""
+    ("direct", i) = leaf i's own direct group (split_direct only).
+    ``direct_min`` overrides the module-level DIRECT_MIN_ELEMS (the
+    fused pipeline passes a huge value to force every leaf into
+    chunked packs — its buffers persist across steps, so the measured
+    per-step packing loss the default guards against does not apply)."""
+    threshold = DIRECT_MIN_ELEMS if direct_min is None else direct_min
     groups: dict = {}
     if not split_direct:
         for i, leaf in enumerate(leaves):
@@ -104,7 +110,7 @@ def _group_leaves(leaves, split_direct: bool = False) -> dict:
     fill: dict = {}  # dtype -> (chunk id, elems in chunk)
     for i, leaf in enumerate(leaves):
         arr = jnp.asarray(leaf)
-        if arr.size >= DIRECT_MIN_ELEMS:
+        if arr.size >= threshold:
             groups[(arr.dtype, ("direct", i))] = [i]
             continue
         chunk, used = fill.get(arr.dtype, (0, 0))
@@ -116,7 +122,8 @@ def _group_leaves(leaves, split_direct: bool = False) -> dict:
 
 
 def compute_metas(tree: Any, align: int = 1,
-                  split_direct: bool = False) -> List[FlatMeta]:
+                  split_direct: bool = False,
+                  direct_min: Optional[int] = None) -> List[FlatMeta]:
     """Static packing metadata (shapes/dtypes only — works on tracers).
 
     ``align`` rounds each leaf's start offset up to a multiple of
@@ -129,12 +136,14 @@ def compute_metas(tree: Any, align: int = 1,
     ``split_direct`` gives leaves >= :data:`DIRECT_MIN_ELEMS` their own
     native-shape group (see :func:`group_buffers`); leave it False for
     consumers that need genuinely flat buffers (ZeRO sharding,
-    flat_master, segment reductions).
+    flat_master, segment reductions).  ``direct_min`` overrides the
+    module threshold per call (see :func:`_group_leaves`).
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     metas = []
     for (dtype, bucket), idxs in _group_leaves(
-            leaves, split_direct=split_direct).items():
+            leaves, split_direct=split_direct,
+            direct_min=direct_min).items():
         shapes = tuple(tuple(jnp.asarray(leaves[i]).shape) for i in idxs)
         sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
         offsets, off = [], 0

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops import fused_optim, multi_tensor
+from ..ops import fused_optim, fused_pipeline, multi_tensor
 
 ScalarOrSchedule = Union[float, jnp.ndarray, Callable]
 
@@ -41,14 +41,55 @@ class FusedTransformation(NamedTuple):
     applies the update AND (given ``model_params``, the low-precision
     template under amp master weights) emits the cast model copy from
     the same kernel pass, saving the delta round-trip and the separate
-    master->model convert."""
+    master->model convert.
+
+    ``pipeline_init`` / ``pipeline_step`` (None when the optimizer has
+    no pipeline form) are the persistent-packed entry points used by
+    :class:`apex_tpu.amp.AmpOptimizer` in pipeline mode (see
+    ops/fused_pipeline.py): state lives in packed flat fp32 buffers
+    across steps, and ``pipeline_step(gbufs, state, master_bufs, metas,
+    grad_scale=..., grad_norm=..., finite=...)`` performs the whole
+    clip+update+cast sweep over them, returning
+    ``(new_master_bufs, new_state, lowp_bufs)``."""
     init: Any
     update: Any
     fused_step: Any
+    pipeline_init: Any = None
+    pipeline_step: Any = None
 
 
 def _lr_at(lr: ScalarOrSchedule, count):
     return lr(count) if callable(lr) else lr
+
+
+def _clip_enabled(max_norm) -> bool:
+    """Static clip on/off: None or a non-positive Python number disables
+    (a traced max_norm is always enabled — the caller opted in)."""
+    return not (max_norm is None or (isinstance(max_norm, (int, float))
+                                     and max_norm <= 0))
+
+
+def _grad_clip_factor(gnorm, max_norm):
+    """``min(1, max_norm/gnorm)`` in the reference's guarded form
+    (ref: apex/optimizers/fused_lamb.py:163-185 clipped global norm) —
+    the single shared clip-factor expression, so the staged and
+    pipeline paths can never diverge on clip semantics."""
+    if not _clip_enabled(max_norm):
+        return jnp.float32(1.0)
+    return jnp.where(gnorm > max_norm,
+                     max_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+
+
+def _staged_clip(gbufs, max_norm):
+    """Grad-clip for the per-stage paths: global norm over the group
+    buffers (fp32), buffers pre-scaled by the clip factor.  The
+    pipeline folds the same factor into its combined kernel scale
+    instead of materializing scaled grads."""
+    if not _clip_enabled(max_norm):
+        return gbufs
+    gnorm = jnp.sqrt(sum(multi_tensor.sumsq(b) for b in gbufs))
+    clip = _grad_clip_factor(gnorm, max_norm)
+    return [b.astype(jnp.float32) * clip for b in gbufs]
 
 
 def _lowp_dtype_for(meta, pbuf, model_leaves):
@@ -73,8 +114,22 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
                weight_decay: float = 0.0,
                adam_w_mode: bool = True,
                bias_correction: bool = True,
+               max_grad_norm=None,
                use_pallas: bool = None) -> "FusedTransformation":
-    """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4)."""
+    """Build the FusedAdam transformation (ref: apex/optimizers/fused_adam.py:4).
+
+    ``max_grad_norm`` (None = off) enables global-norm gradient
+    clipping before the update, matching FusedLAMB's clipped-global-
+    grad-norm semantics; in pipeline mode the clip factor comes from
+    the fused norm sweep and folds into the update kernel's combined
+    scale (no extra pass)."""
+
+    def _bias_corrections(count):
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            return (1.0 - jnp.float32(beta1) ** cf,
+                    1.0 - jnp.float32(beta2) ** cf)
+        return jnp.float32(1.0), jnp.float32(1.0)
 
     def init(params):
         metas = multi_tensor.compute_metas(params, split_direct=True)
@@ -88,15 +143,11 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
             raise ValueError("fused_adam requires params in update()")
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
-        cf = count.astype(jnp.float32)
-        if bias_correction:
-            bc1 = 1.0 - jnp.float32(beta1) ** cf
-            bc2 = 1.0 - jnp.float32(beta2) ** cf
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+        bc1, bc2 = _bias_corrections(count)
 
         metas = multi_tensor.compute_metas(params, split_direct=True)
-        gbufs = multi_tensor.group_buffers(grads, metas)
+        gbufs = _staged_clip(multi_tensor.group_buffers(grads, metas),
+                             max_grad_norm)
         pbufs = multi_tensor.group_buffers(params, metas)
         deltas, new_m, new_v = [], [], []
         for i, meta in enumerate(metas):
@@ -128,15 +179,11 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
         the optax delta round-trip — see FusedTransformation."""
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
-        cf = count.astype(jnp.float32)
-        if bias_correction:
-            bc1 = 1.0 - jnp.float32(beta1) ** cf
-            bc2 = 1.0 - jnp.float32(beta2) ** cf
-        else:
-            bc1 = bc2 = jnp.float32(1.0)
+        bc1, bc2 = _bias_corrections(count)
 
         metas = multi_tensor.compute_metas(params, split_direct=True)
-        gbufs = multi_tensor.group_buffers(grads, metas)
+        gbufs = _staged_clip(multi_tensor.group_buffers(grads, metas),
+                             max_grad_norm)
         pbufs = multi_tensor.group_buffers(params, metas)
         model_leaves = (jax.tree_util.tree_leaves(model_params)
                         if model_params is not None else None)
@@ -174,7 +221,55 @@ def fused_adam(learning_rate: ScalarOrSchedule = 1e-3,
                                         model_leaves)
         return new_params, new_state, model_out
 
-    return FusedTransformation(init, update, fused_step)
+    def pipeline_init(metas):
+        """Optimizer state in the persistent packed layout (one fp32
+        flat buffer per pipeline group) — see FusedTransformation."""
+        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
+        return FusedAdamState(count=jnp.zeros((), jnp.int32), m=zeros,
+                              v=tuple(jnp.zeros_like(z) for z in zeros))
+
+    def pipeline_step(gbufs, state, master_bufs, metas, *,
+                      grad_scale=1.0, grad_norm=None, finite=True):
+        """The clip+Adam+cast sweep over the persistent packed buffers.
+
+        ``grad_scale`` is the amp inverse loss scale (combined with any
+        caller-side factor); ``grad_norm`` the unscaled global norm
+        from the fused norm sweep (required when ``max_grad_norm`` is
+        set); ``finite`` the overflow flag — non-finite steps return
+        state bitwise unchanged via an in-sweep select, matching the
+        staged path's ``lax.cond`` skip (count held still too)."""
+        finite = jnp.asarray(finite)
+        count = state.count + finite.astype(jnp.int32)
+        lr = _lr_at(learning_rate, state.count + 1)
+        bc1, bc2 = _bias_corrections(state.count + 1)
+        gscale = jnp.asarray(grad_scale, jnp.float32)
+        if _clip_enabled(max_grad_norm):
+            if grad_norm is None:
+                # amp elided the norm/finite sweep (static scaling):
+                # derive the unscaled norm here — one fused read, only
+                # paid when clipping is actually configured
+                grad_norm = fused_pipeline.packed_norm(gbufs, gscale)
+            gscale = gscale * _grad_clip_factor(grad_norm, max_grad_norm)
+        new_p, new_m, new_v, lowps = [], [], [], []
+        for i, meta in enumerate(metas):
+            p2, m2, v2, lp = fused_pipeline.adam_pipeline(
+                gbufs[i], master_bufs[i], state.m[i], state.v[i],
+                grad_scale=gscale, lr=lr, beta1=beta1, beta2=beta2,
+                eps=eps, weight_decay=weight_decay,
+                bias_correction1=bc1, bias_correction2=bc2,
+                adam_w_mode=adam_w_mode, finite=finite,
+                lowp_dtype=fused_pipeline.group_lowp_dtype(meta),
+                use_pallas=use_pallas)
+            new_p.append(p2)
+            new_m.append(m2)
+            new_v.append(v2)
+            lowps.append(lp if lp is not None else p2)
+        return (tuple(new_p),
+                FusedAdamState(count, tuple(new_m), tuple(new_v)),
+                lowps)
+
+    return FusedTransformation(init, update, fused_step,
+                               pipeline_init, pipeline_step)
 
 
 def _adam_jnp(g, p, m, v, lr, b1, b2, eps, wd, bc1, bc2, adam_w_mode):

@@ -28,9 +28,10 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops import fused_optim, multi_tensor
+from ..ops import fused_optim, fused_pipeline, multi_tensor
 from .fused_adam import (FusedTransformation, ScalarOrSchedule,
-                         _assemble_model, _lowp_dtype_for, _lr_at)
+                         _assemble_model, _grad_clip_factor,
+                         _lowp_dtype_for, _lr_at)
 
 
 class FusedLAMBState(NamedTuple):
@@ -142,7 +143,63 @@ def fused_lamb(learning_rate: ScalarOrSchedule = 1e-3,
                                         model_leaves)
         return new_params, new_state, model_out
 
-    return FusedTransformation(init, update, fused_step)
+    def pipeline_init(metas):
+        """Persistent packed m/v (fp32 per group); the pipeline layout
+        is LANE-aligned by construction, so the per-tensor trust-ratio
+        reductions stay row-friendly."""
+        zeros = tuple(jnp.zeros((m.padded,), jnp.float32) for m in metas)
+        return FusedLAMBState(count=jnp.zeros((), jnp.int32), m=zeros,
+                              v=tuple(jnp.zeros_like(z) for z in zeros))
+
+    def pipeline_step(gbufs, state, master_bufs, metas, *,
+                      grad_scale=1.0, grad_norm=None, finite=True):
+        """LAMB over the persistent packed buffers: the global grad
+        norm arrives pre-computed from the pipeline's fused norm sweep
+        (``grad_norm`` — the unscaled norm, so ``max_grad_norm`` keeps
+        its staged meaning); clip and amp unscale fold into phase 1's
+        ``gscale``; the trust-ratio stage reuses the exact
+        ``_lamb_group_update`` machinery of the staged path."""
+        if grad_norm is None:
+            # static-scaling amp elides the norm/finite sweep; LAMB's
+            # clip always needs the unscaled norm, so derive it here
+            # (one fused read — the same cost the staged path pays)
+            grad_norm = fused_pipeline.packed_norm(gbufs, grad_scale)
+        finite = jnp.asarray(finite)
+        count = state.count + finite.astype(jnp.int32)
+        stepped = state.count + 1
+        lr = _lr_at(learning_rate, stepped)
+        cf = stepped.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - jnp.float32(beta1) ** cf
+            bc2 = 1.0 - jnp.float32(beta2) ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = (1.0 - beta1) if grad_averaging else 1.0
+        gscale = jnp.asarray(grad_scale, jnp.float32) \
+            * _grad_clip_factor(grad_norm, max_grad_norm)
+        fused = fused_pipeline.use_pallas_pipeline(use_pallas)
+        new_p, new_m, new_v, lowps = [], [], [], []
+        for i, meta in enumerate(metas):
+            adapted_u, m2, v2 = _lamb_group_update(
+                meta, gbufs[i], master_bufs[i], state.m[i], state.v[i],
+                gscale=gscale, beta1=beta1, beta2=beta2, beta3=beta3,
+                eps=eps, weight_decay=weight_decay, bc1=bc1, bc2=bc2,
+                adam_w_mode=adam_w_mode, use_nvlamb=use_nvlamb,
+                fused=fused)
+            p2 = jnp.where(finite, master_bufs[i] - lr * adapted_u,
+                           master_bufs[i])
+            lowp_dt = fused_pipeline.group_lowp_dtype(meta)
+            new_p.append(p2)
+            new_m.append(jnp.where(finite, m2, state.m[i]))
+            new_v.append(jnp.where(finite, v2, state.v[i]))
+            lowps.append(p2.astype(lowp_dt) if lowp_dt is not None
+                         else p2)
+        return (tuple(new_p),
+                FusedLAMBState(count, tuple(new_m), tuple(new_v)),
+                lowps)
+
+    return FusedTransformation(init, update, fused_step,
+                               pipeline_init, pipeline_step)
 
 
 def _global_grad_clip(gbufs, max_norm):
@@ -164,15 +221,8 @@ def _global_grad_clip(gbufs, max_norm):
     gnorm = jnp.sqrt(gsq)
     # The enable decision must be static (max_norm may be a traced value
     # when the caller scales it by a traced loss scale — pass None to
-    # disable in that case).
-    disabled = max_norm is None or (
-        isinstance(max_norm, (int, float)) and max_norm <= 0)
-    if disabled:
-        clip = jnp.float32(1.0)
-    else:
-        clip = jnp.where(gnorm > max_norm,
-                         max_norm / jnp.maximum(gnorm, 1e-12), 1.0)
-    return gnorm, clip
+    # disable in that case); _grad_clip_factor makes it so.
+    return gnorm, _grad_clip_factor(gnorm, max_norm)
 
 
 def _lamb_group_update(meta, gbuf, pbuf, m, v, *, gscale, beta1, beta2,

@@ -16,9 +16,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..ops import fused_optim, multi_tensor
+from ..ops import fused_optim, fused_pipeline, multi_tensor
 from .fused_adam import (FusedTransformation, ScalarOrSchedule,
-                         _assemble_model, _lowp_dtype_for, _lr_at)
+                         _assemble_model, _clip_enabled,
+                         _grad_clip_factor, _lowp_dtype_for, _lr_at,
+                         _staged_clip)
 
 
 class FusedSGDState(NamedTuple):
@@ -32,6 +34,7 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
               weight_decay: float = 0.0,
               nesterov: bool = False,
               wd_after_momentum: bool = False,
+              max_grad_norm=None,
               use_pallas: bool = None) -> "FusedTransformation":
     if nesterov and (momentum <= 0 or dampening != 0):
         raise ValueError(
@@ -52,7 +55,8 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
         first = (state.count == 0).astype(jnp.float32) if momentum else \
             jnp.float32(0.0)
         metas = multi_tensor.compute_metas(params, split_direct=True)
-        gbufs = multi_tensor.group_buffers(grads, metas)
+        gbufs = _staged_clip(multi_tensor.group_buffers(grads, metas),
+                             max_grad_norm)
         pbufs = multi_tensor.group_buffers(params, metas)
         deltas, new_mom = [], []
         for i, meta in enumerate(metas):
@@ -92,7 +96,8 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
         first = (state.count == 0).astype(jnp.float32) if momentum else \
             jnp.float32(0.0)
         metas = multi_tensor.compute_metas(params, split_direct=True)
-        gbufs = multi_tensor.group_buffers(grads, metas)
+        gbufs = _staged_clip(multi_tensor.group_buffers(grads, metas),
+                             max_grad_norm)
         pbufs = multi_tensor.group_buffers(params, metas)
         model_leaves = (jax.tree_util.tree_leaves(model_params)
                         if model_params is not None else None)
@@ -140,7 +145,57 @@ def fused_sgd(learning_rate: ScalarOrSchedule,
         return new_params, FusedSGDState(count, tuple(new_mom)), \
             model_out
 
-    return FusedTransformation(init, update, fused_step)
+    def pipeline_init(metas):
+        """Persistent packed momentum buffers (fp32 per group)."""
+        return FusedSGDState(
+            count=jnp.zeros((), jnp.int32),
+            momentum=tuple(jnp.zeros((m.padded,), jnp.float32)
+                           for m in metas))
+
+    def pipeline_step(gbufs, state, master_bufs, metas, *,
+                      grad_scale=1.0, grad_norm=None, finite=True):
+        """The clip+SGD+cast sweep over the persistent packed buffers —
+        see fused_adam's pipeline_step for the skip/count semantics."""
+        finite = jnp.asarray(finite)
+        count = state.count + finite.astype(jnp.int32)
+        lr = _lr_at(learning_rate, state.count + 1)
+        first = (state.count == 0).astype(jnp.float32) if momentum else \
+            jnp.float32(0.0)
+        gscale = jnp.asarray(grad_scale, jnp.float32)
+        if _clip_enabled(max_grad_norm):
+            if grad_norm is None:
+                # see fused_adam.pipeline_step: static-scaling amp
+                # elided the norm sweep; derive it only for the clip
+                grad_norm = fused_pipeline.packed_norm(gbufs, gscale)
+            gscale = gscale * _grad_clip_factor(grad_norm, max_grad_norm)
+        new_p, new_mom, lowps = [], [], []
+        for i, meta in enumerate(metas):
+            lowp_dt = fused_pipeline.group_lowp_dtype(meta)
+            if momentum == 0.0:
+                p = master_bufs[i]
+                g32 = gbufs[i].astype(jnp.float32) * gscale
+                p2 = jnp.where(finite,
+                               p - lr * (g32 + weight_decay * p), p)
+                mom2, lp = state.momentum[i], None
+            else:
+                p2, mom2, lp = fused_pipeline.sgd_pipeline(
+                    gbufs[i], master_bufs[i], state.momentum[i],
+                    grad_scale=gscale, lr=lr, momentum=momentum,
+                    dampening=dampening, weight_decay=weight_decay,
+                    nesterov=nesterov,
+                    wd_after_momentum=wd_after_momentum,
+                    first_run=first, finite=finite,
+                    lowp_dtype=lowp_dt, use_pallas=use_pallas)
+            if lp is None:
+                lp = p2.astype(lowp_dt) if lowp_dt is not None else p2
+            new_p.append(p2)
+            new_mom.append(mom2)
+            lowps.append(lp)
+        return (tuple(new_p), FusedSGDState(count, tuple(new_mom)),
+                lowps)
+
+    return FusedTransformation(init, update, fused_step,
+                               pipeline_init, pipeline_step)
 
 
 def _sgd_jnp(g, p, mom, lr, momentum, dampening, wd, nesterov,

@@ -240,9 +240,12 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
             return amp_opt.scale_loss(loss, amp_state), loss
 
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        gnorm = param_l2_norm(grads) / amp_state.scaler.loss_scale
         new_params, new_state, info = amp_opt.apply_gradients(
             grads, amp_state, params)
+        # pipeline mode: reuse the norm sweep's measurement (see
+        # standalone_gpt.train_smoke)
+        gnorm = info.grad_norm if info.grad_norm is not None else \
+            param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
     monitor = make_smoke_monitor(

@@ -418,10 +418,12 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
             return amp_opt.scale_loss(loss, amp_state), loss
 
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        # grads carry the loss scale; report the unscaled norm
-        gnorm = param_l2_norm(grads) / amp_state.scaler.loss_scale
         new_params, new_state, info = amp_opt.apply_gradients(
             grads, amp_state, params)
+        # the fused pipeline already measured the unscaled global norm
+        # in its norm sweep; only the per-stage path re-sweeps the tree
+        gnorm = info.grad_norm if info.grad_norm is not None else \
+            param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
     flops = 6.0 * n_params * batch * seq \

@@ -18,6 +18,7 @@ from .log_util import (
 )
 
 __all__ = [
+    "CheckpointFormatMismatch",
     "CheckpointManager",
     "latest_valid_step",
     "load_checkpoint",
@@ -29,7 +30,8 @@ __all__ = [
 ]
 
 _CHECKPOINT_SYMBOLS = ("CheckpointManager", "load_checkpoint",
-                       "save_checkpoint", "latest_valid_step")
+                       "save_checkpoint", "latest_valid_step",
+                       "CheckpointFormatMismatch")
 
 
 def __getattr__(name):

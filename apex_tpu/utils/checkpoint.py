@@ -53,6 +53,17 @@ from .log_util import get_logger
 _FINALIZE_MARKER = "_CHECKPOINT_METADATA"
 
 
+class CheckpointFormatMismatch(RuntimeError):
+    """The checkpoint's master-weight layout does not match the restore
+    template: one side is the persistent packed pipeline's
+    ``PackedMasters`` flat buffers, the other the per-leaf master tree.
+    Raised INSTEAD of letting Orbax fail on an opaque tree-structure
+    mismatch (which the integrity fallback would then mistake for a
+    torn payload and quarantine a perfectly good checkpoint).  Re-run
+    with the matching mode — ``APEX_TPU_FUSED_PIPELINE=0`` /
+    ``AmpOptimizer(pipeline=...)`` — or re-save under the new one."""
+
+
 def _manager(directory: str, keep: int):
     import orbax.checkpoint as ocp
 
@@ -228,6 +239,13 @@ class CheckpointManager:
                     else amp_state.inner_state}
         items = {"state": ocp.args.StandardSave(tree)}
         meta = {"step": int(step)}
+        if amp_state is not None and amp_state.master_params is not None:
+            # Record the master layout so a mixed-mode restore fails
+            # with a clear CheckpointFormatMismatch, not an opaque
+            # Orbax structure error (absent key = pre-pipeline
+            # checkpoint = per-leaf masters).
+            meta["packed_masters"] = hasattr(
+                amp_state.master_params, "to_model")
         if amp_opt is not None and amp_state is not None:
             meta["amp"] = amp_opt.state_dict(amp_state)
         items["meta"] = ocp.args.JsonSave(meta)
@@ -304,6 +322,10 @@ class CheckpointManager:
                 result = self._restore_step(s, params, amp_opt,
                                             amp_state, extra)
                 break
+            except CheckpointFormatMismatch:
+                # a good checkpoint in the OTHER master layout is not
+                # damage — never quarantine it, surface the real error
+                raise
             except Exception as e:  # torn payload — fall back one step
                 skipped.append(
                     (s, f"restore failed: {type(e).__name__}: "
@@ -358,25 +380,53 @@ class CheckpointManager:
                       amp_state=None, extra: Optional[dict] = None):
         import orbax.checkpoint as ocp
 
+        # Meta restores first, alone: it carries the master-layout flag
+        # the format pre-check needs, and fetching it once here (reused
+        # below, not re-restored in the Composite) keeps the amp path
+        # at a single storage round-trip for the JSON item.
+        meta = self._mgr.restore(
+            step, args=ocp.args.Composite(
+                meta=ocp.args.JsonRestore()))["meta"]
         if amp_state is not None and amp_state.master_params is not None:
+            # Format pre-check before the full restore: a packed-vs-
+            # leafwise master mismatch must raise the dedicated error,
+            # not an Orbax structure failure the integrity fallback
+            # would quarantine as a torn payload.
+            want_packed = hasattr(amp_state.master_params, "to_model")
+            have_packed = bool(meta.get("packed_masters", False))
+            if want_packed != have_packed:
+                raise CheckpointFormatMismatch(
+                    f"checkpoint step {step} under {self.directory} "
+                    f"stores {'packed' if have_packed else 'per-leaf'} "
+                    f"master weights but the restore template is "
+                    f"{'packed' if want_packed else 'per-leaf'} — the "
+                    "persistent-pipeline mode changed between save and "
+                    "restore.  Re-run with the matching mode "
+                    "(APEX_TPU_FUSED_PIPELINE / AmpOptimizer("
+                    "pipeline=...)) or re-save the checkpoint.")
             tree = {"params": amp_state.master_params,
                     "inner_state": amp_state.inner_state}
         else:
             tree = {"params": params,
                     "inner_state": None if amp_state is None
                     else amp_state.inner_state}
-        items = {"state": ocp.args.StandardRestore(tree),
-                 "meta": ocp.args.JsonRestore()}
+        items = {"state": ocp.args.StandardRestore(tree)}
         if extra:
             items["extra"] = ocp.args.StandardRestore(extra)
         out = self._mgr.restore(step, args=ocp.args.Composite(**items))
         tree = out["state"]
-        meta = out["meta"]
         new_extra = out.get("extra") if extra else None
 
         if amp_state is not None and amp_state.master_params is not None:
             masters = tree["params"]
-            new_params = _amp.restore_dtypes(masters, params)
+            if hasattr(masters, "to_model"):
+                # Persistent packed pipeline mode: masters are a
+                # PackedMasters (flat fp32 buffers + static layout) —
+                # assemble the model-dtype params from the packed
+                # buffers instead of a leafwise re-cast.
+                new_params = masters.to_model(params)
+            else:
+                new_params = _amp.restore_dtypes(masters, params)
             amp_state = amp_state._replace(
                 master_params=masters, inner_state=tree["inner_state"])
         else:

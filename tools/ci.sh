@@ -26,28 +26,33 @@
 #                            then the same command resumes to step 8;
 #                            the shared JSONL must carry the
 #                            preempt_exit and run_resumed events
+#   6. pipeline kernels    — the fused-pipeline Pallas sweeps run in
+#                            interpret mode on CPU (tiny tree, 3
+#                            steps) and must match the per-stage path,
+#                            so kernel regressions are caught without
+#                            a TPU (ops/fused_pipeline.self_check)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/5 default test tier"
+echo "[ci] 1/6 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/5 README drift guard"
+echo "[ci] 2/6 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/5 8-device multichip dryrun"
+echo "[ci] 3/6 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/5 monitor smoke"
+echo "[ci] 4/6 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/5 kill->resume smoke"
+echo "[ci] 5/6 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -66,5 +71,9 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
          exit 1; }
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
+
+echo "[ci] 6/6 fused-pipeline kernel parity (Pallas interpret mode)"
+python -c "from apex_tpu.ops import fused_pipeline; \
+fused_pipeline.self_check()"
 
 echo "[ci] all green"
