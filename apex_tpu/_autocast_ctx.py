@@ -58,17 +58,44 @@ try:
     def reset_autocast_dtype(token) -> None:
         token.mgr.__exit__(None, None, None)
 
-except (ImportError, AttributeError, TypeError):  # pragma: no cover
+except (ImportError, AttributeError, TypeError):
+    # Old jax: no trace-context-keyed config states (0.4.x
+    # ``include_in_jit_key`` exists but measurably does not key the
+    # trace cache).  ``xla_metadata`` IS in ``trace_context()`` there,
+    # so a metadata context supplies the cache keying while a plain
+    # contextvar carries the value for ``autocast_compute_dtype``.
     import contextvars
 
     _AUTOCAST_DTYPE: contextvars.ContextVar[Optional[Any]] = \
         contextvars.ContextVar("apex_tpu_autocast_dtype", default=None)
 
     def autocast_compute_dtype() -> Optional[Any]:
-        return _AUTOCAST_DTYPE.get()
+        val = _AUTOCAST_DTYPE.get()
+        if val is None:
+            return None
+        import jax.numpy as jnp
+        return jnp.dtype(val)
 
-    def set_autocast_dtype(dtype):
-        return _AUTOCAST_DTYPE.set(dtype)
+    class _Token:  # noqa: F811 — fallback twin of the config-state token
+        def __init__(self, var_token, meta_mgr):
+            self.var_token = var_token
+            self.meta_mgr = meta_mgr
+
+    def set_autocast_dtype(dtype) -> Any:
+        import jax.numpy as jnp
+        name = jnp.dtype(dtype).name
+        var_token = _AUTOCAST_DTYPE.set(name)
+        meta_mgr = None
+        try:
+            from jax.experimental.xla_metadata import set_xla_metadata
+
+            meta_mgr = set_xla_metadata(apex_tpu_autocast=name)
+            meta_mgr.__enter__()
+        except (ImportError, AttributeError, TypeError):
+            meta_mgr = None  # documented cache hazard: no trace keying
+        return _Token(var_token, meta_mgr)
 
     def reset_autocast_dtype(token) -> None:
-        _AUTOCAST_DTYPE.reset(token)
+        if token.meta_mgr is not None:
+            token.meta_mgr.__exit__(None, None, None)
+        _AUTOCAST_DTYPE.reset(token.var_token)

@@ -1,0 +1,52 @@
+"""apex_tpu.analysis — static and runtime correctness tooling.
+
+The TPU-native counterpart of the reference repo's build/test matrix
+(ref: tests/docker_extension_builds): instead of linting CUDA builds,
+lint the *tracing* discipline the whole framework depends on.
+
+Four pieces:
+
+* :mod:`.flags` — the central registry of every ``APEX_TPU_*``
+  environment flag (name, type, default, doc) with typed accessors.
+  Library code reads flags ONLY through it; the linter enforces that.
+* :mod:`.linter` — AST trace-safety linter: host syncs on traced
+  values, Python truthiness on tracers, env reads inside traced code,
+  bare/broad excepts, direct ``jax.shard_map`` use (rule table in
+  docs/api/analysis.md).
+* :mod:`.parity` — kernel-parity audit: every ``pallas_call`` site in
+  ``ops/`` must name a registered jnp twin and a test referencing both.
+* :mod:`.sanitizer` — runtime ``sanitize()`` context: JAX transfer
+  guard plus a per-step recompile budget driven by ``jax_log_compiles``.
+
+CLI: ``python -m apex_tpu.analysis --check`` (self-hosted in
+tools/ci.sh step 7; see ``--help`` for the rest).
+"""
+# flags is the one submodule production code imports at module scope
+# (ops/amp/monitor read the registry on import); keep this package
+# __init__ from dragging the linter/parity/sanitizer machinery into
+# every library import path — tooling symbols resolve lazily (PEP 562).
+from .flags import (FLAGS, Flag, flag_bool, flag_float, flag_int,
+                    flag_str, render_flag_table)
+
+_LAZY = {
+    "Finding": "linter", "lint_paths": "linter",
+    "load_baseline": "linter", "run_check": "linter",
+    "audit_kernel_parity": "parity",
+    "RecompileBudgetExceeded": "sanitizer", "Sanitizer": "sanitizer",
+    "sanitize": "sanitizer", "sanitize_smoke": "sanitizer",
+}
+
+__all__ = [
+    "FLAGS", "Flag", "flag_bool", "flag_float", "flag_int", "flag_str",
+    "render_flag_table", *_LAZY,
+]
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

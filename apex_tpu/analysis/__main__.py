@@ -1,0 +1,133 @@
+"""CLI for apex_tpu.analysis — the repo's self-hosted static pass.
+
+    python -m apex_tpu.analysis --check          # lint + parity vs baseline
+    python -m apex_tpu.analysis --update-baseline
+    python -m apex_tpu.analysis --flag-table     # print the env-flag table
+    python -m apex_tpu.analysis --check-docs     # docs flag-table drift guard
+    python -m apex_tpu.analysis --write-docs     # regenerate the docs table
+    python -m apex_tpu.analysis --smoke          # sanitizer smoke (GPT step)
+
+Exit status: 0 = clean, 1 = findings / drift / recompiles.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+from .flags import render_flag_table
+from .linter import DEFAULT_BASELINE, run_check, write_baseline, lint_paths
+
+_TABLE_BEGIN = "<!-- apex-flag-table:begin (generated: python -m apex_tpu.analysis --write-docs) -->"
+_TABLE_END = "<!-- apex-flag-table:end -->"
+DOCS_WITH_TABLE = "docs/api/ops.md"
+
+
+def _docs_block(repo_root: str) -> tuple[Path, str, int, int]:
+    p = Path(repo_root) / DOCS_WITH_TABLE
+    text = p.read_text()
+    try:
+        a = text.index(_TABLE_BEGIN) + len(_TABLE_BEGIN)
+        b = text.index(_TABLE_END)
+    except ValueError:
+        raise SystemExit(
+            f"{DOCS_WITH_TABLE} is missing the flag-table markers "
+            f"({_TABLE_BEGIN!r} ... {_TABLE_END!r})")
+    return p, text, a, b
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--check", action="store_true",
+                    help="lint apex_tpu + kernel-parity audit against "
+                         "the baseline (default action)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to accept all current "
+                         "findings")
+    ap.add_argument("--flag-table", action="store_true",
+                    help="print the generated env-flag markdown table")
+    ap.add_argument("--check-docs", action="store_true",
+                    help="fail if the docs flag table drifted from the "
+                         "registry")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the docs flag table in place")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the sanitizer smoke: the standalone-GPT "
+                         "step must compile exactly once after warmup")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"baseline path (default {DEFAULT_BASELINE})")
+    ap.add_argument("--root", default=".",
+                    help="repo root to lint from (default .)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines")
+    args = ap.parse_args(argv)
+
+    if args.flag_table:
+        print(render_flag_table())
+        return 0
+
+    if args.check_docs or args.write_docs:
+        p, text, a, b = _docs_block(args.root)
+        want = "\n" + render_flag_table() + "\n"
+        have = text[a:b]
+        if args.write_docs:
+            if have != want:
+                p.write_text(text[:a] + want + text[b:])
+                print(f"[analysis] {DOCS_WITH_TABLE} flag table updated")
+            else:
+                print(f"[analysis] {DOCS_WITH_TABLE} flag table already "
+                      f"current")
+            return 0
+        if have != want:
+            print(f"[analysis] FAIL: {DOCS_WITH_TABLE} flag table "
+                  f"drifted from the registry — run "
+                  f"'python -m apex_tpu.analysis --write-docs'",
+                  file=sys.stderr)
+            return 1
+        print(f"[analysis] {DOCS_WITH_TABLE} flag table matches the "
+              f"registry")
+        return 0
+
+    if args.smoke:
+        from .sanitizer import sanitize_smoke
+
+        n = sanitize_smoke()
+        return 0 if n == 0 else 1
+
+    if args.update_baseline:
+        findings = lint_paths(repo_root=args.root)
+        from .parity import audit_kernel_parity
+
+        findings.extend(audit_kernel_parity(repo_root=args.root))
+        write_baseline(findings, args.baseline, repo_root=args.root)
+        print(f"[analysis] baseline rewritten with "
+              f"{len(set(f.key for f in findings))} entries")
+        return 0
+
+    # default: --check
+    unsuppressed, stale = run_check(baseline=args.baseline,
+                                    repo_root=args.root)
+    for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
+        if args.json:
+            print(json.dumps(dataclasses.asdict(f)))
+        else:
+            print(f.render())
+    for k in sorted(stale):
+        print(f"[analysis] stale baseline entry (finding no longer "
+              f"fires — delete the line): {k}", file=sys.stderr)
+    if unsuppressed or stale:
+        print(f"[analysis] FAIL: {len(unsuppressed)} unsuppressed "
+              f"finding(s), {len(stale)} stale baseline entr(ies)",
+              file=sys.stderr)
+        return 1
+    print("[analysis] clean: 0 unsuppressed findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Central registry of every ``APEX_TPU_*`` environment flag.
+
+One declaration per flag — name, type, default, constraints, doc — and
+typed accessors that read the environment **per call** (setting a flag
+after import still takes effect wherever the consuming module reads
+per call) with hard errors on malformed values
+(``APEX_TPU_STEP_PALLAS_MIN=abc`` names the flag, the raw value, and
+what was expected).
+
+Library code must not touch ``os.environ``/``os.getenv`` directly: the
+trace-safety linter (rule APX301) fails on any env read outside this
+module, and the flag table in docs/api/ops.md is generated from this
+registry (``python -m apex_tpu.analysis --flag-table``), so docs cannot
+drift from code.
+
+This module is import-light on purpose (stdlib only): ops/amp/monitor
+modules import it at module scope.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["Flag", "FLAGS", "register_flag", "flag_bool", "flag_int",
+           "flag_float", "flag_str", "flag_value", "render_flag_table"]
+
+_TRUE = ("1", "true", "on", "yes")
+_FALSE = ("0", "false", "off", "no")
+
+
+class FlagValueError(ValueError):
+    """A set environment flag failed to parse/validate."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    """One environment flag: the registry row and its parser."""
+
+    name: str
+    kind: str                    # 'bool' | 'int' | 'float' | 'str'
+    default: Any
+    doc: str
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    multiple_of: Optional[int] = None
+
+    def parse(self, raw: str) -> Any:
+        val = self._convert(raw)
+        if self.lo is not None and val < self.lo:
+            raise FlagValueError(
+                f"{self.name}={raw!r}: {val} below minimum {self.lo}")
+        if self.hi is not None and val > self.hi:
+            raise FlagValueError(
+                f"{self.name}={raw!r}: {val} above maximum {self.hi}")
+        if self.multiple_of is not None and val % self.multiple_of:
+            raise FlagValueError(
+                f"{self.name}={raw!r}: {val} must be a multiple of "
+                f"{self.multiple_of}")
+        return val
+
+    def _convert(self, raw: str) -> Any:
+        raw = raw.strip()
+        if self.kind == "bool":
+            low = raw.lower()
+            if low in _TRUE:
+                return True
+            if low in _FALSE:
+                return False
+            raise FlagValueError(
+                f"{self.name}={raw!r} is not a boolean "
+                f"(use one of {_TRUE + _FALSE})")
+        if self.kind == "int":
+            try:
+                return int(raw)
+            except ValueError:
+                raise FlagValueError(
+                    f"{self.name}={raw!r} is not an integer") from None
+        if self.kind == "float":
+            try:
+                val = float(raw)
+            except ValueError:
+                raise FlagValueError(
+                    f"{self.name}={raw!r} is not a number") from None
+            if not math.isfinite(val):
+                # NaN slips every range check (nan < lo is False) and
+                # poisons downstream comparisons silently
+                raise FlagValueError(
+                    f"{self.name}={raw!r} must be finite")
+            return val
+        return raw                                    # 'str'
+
+    @property
+    def default_str(self) -> str:
+        if self.default is None:
+            return "unset"
+        if self.kind == "bool":
+            return "1" if self.default else "0"
+        return str(self.default)
+
+
+FLAGS: Dict[str, Flag] = {}
+
+
+def register_flag(name: str, kind: str, default: Any, doc: str,
+                  **constraints) -> Flag:
+    if kind not in ("bool", "int", "float", "str"):
+        raise ValueError(f"unknown flag kind {kind!r}")
+    if name in FLAGS:
+        raise ValueError(f"duplicate flag registration: {name}")
+    flag = Flag(name=name, kind=kind, default=default, doc=doc,
+                **constraints)
+    FLAGS[name] = flag
+    return flag
+
+
+def flag_value(name: str) -> Any:
+    """Parsed value of a registered flag: the environment if set (with
+    validation), else the registered default."""
+    flag = FLAGS.get(name)
+    if flag is None:
+        raise KeyError(
+            f"{name} is not a registered apex_tpu flag; declare it in "
+            f"apex_tpu/analysis/flags.py (the registry is the single "
+            f"source of truth for the docs table and the linter)")
+    raw = os.environ.get(name)
+    if raw is None:
+        return flag.default
+    return flag.parse(raw)
+
+
+def _typed(name: str, kind: str) -> Any:
+    flag = FLAGS.get(name)
+    if flag is not None and flag.kind != kind:
+        raise TypeError(f"{name} is a {flag.kind} flag, not {kind}")
+    return flag_value(name)
+
+
+def flag_bool(name: str) -> bool:
+    return _typed(name, "bool")
+
+
+def flag_int(name: str) -> int:
+    return _typed(name, "int")
+
+
+def flag_float(name: str) -> float:
+    return _typed(name, "float")
+
+
+def flag_str(name: str) -> Optional[str]:
+    return _typed(name, "str")
+
+
+def render_flag_table() -> str:
+    """Markdown table of the registry, stable ordering — embedded in
+    docs/api/ops.md between the flag-table markers and drift-guarded by
+    ci.sh step 7."""
+    lines = ["| Flag | Type | Default | Constraints | Meaning |",
+             "|---|---|---|---|---|"]
+    for name in sorted(FLAGS):
+        f = FLAGS[name]
+        cons = []
+        if f.lo is not None:
+            cons.append(f">= {f.lo:g}")
+        if f.hi is not None:
+            cons.append(f"<= {f.hi:g}")
+        if f.multiple_of is not None:
+            cons.append(f"multiple of {f.multiple_of}")
+        lines.append(
+            f"| `{name}` | {f.kind} | `{f.default_str}` | "
+            f"{', '.join(cons) or '—'} | {f.doc} |")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Every APEX_TPU_* flag the repo reads, in one place.
+# ---------------------------------------------------------------------------
+
+register_flag(
+    "APEX_TPU_FUSED_PIPELINE", "bool", True,
+    "Persistent packed optimizer pipeline under amp master weights "
+    "(docs/api/optimizers.md#persistent-packed-pipeline). `0` is the "
+    "escape hatch back to the per-stage unscale/check/step path.")
+register_flag(
+    "APEX_TPU_PIPELINE_PALLAS", "bool", False,
+    "Route both fused-pipeline sweeps through the Pallas kernels "
+    "instead of the jnp twins (auto stays jnp per the measured "
+    "880-vs-190 GB/s elementwise-stream gap).")
+register_flag(
+    "APEX_TPU_STEP_PALLAS_MIN", "int", 0,
+    "Element-count floor above which single-pass STEP optimizer work "
+    "(adam_step/sgd_step) dispatches the Pallas kernels; 0 keeps the "
+    "measured-faster XLA fusion path.", lo=0)
+register_flag(
+    "APEX_TPU_DIRECT_MIN_ELEMS", "int", 0,
+    "Element-count threshold below which multi-tensor ops pack leaves "
+    "into flat buffers (legacy per-step packed path); 0 keeps every "
+    "leaf on the native per-leaf path.", lo=0)
+register_flag(
+    "APEX_TPU_FLASH_BLOCK_Q", "int", 1024,
+    "Flash-attention query block rows (read at import; bench-driven "
+    "re-tuning knob).", lo=8, hi=4096)
+register_flag(
+    "APEX_TPU_FLASH_BLOCK_K", "int", 1024,
+    "Flash-attention key block columns (read at import).", lo=8, hi=4096)
+register_flag(
+    "APEX_TPU_FLASH_PACK_D64", "bool", True,
+    "d=64 head-pair packing into full 128-lane MXU tiles "
+    "(docs/api/ops.md head-packing note). `0` forces the half-width "
+    "per-head kernels.")
+register_flag(
+    "APEX_TPU_FLASH_E_MAX_SEQ", "int", 32768,
+    "Longest padded sequence the blocked E-layout flash walk streams "
+    "before falling back to the transposing path (bounds the "
+    "lse/delta sideband HBM).", lo=128, hi=1 << 20)
+register_flag(
+    "APEX_TPU_FLASH_E_BLOCK", "int", 512,
+    "E-layout flash walk block size (TPU lane grain).", lo=128, hi=4096, multiple_of=128)
+register_flag(
+    "APEX_TPU_FLASH_E_LANES", "int", 768,
+    "Lane budget per head-group block in the E-layout kernels (VMEM "
+    "sizing for the bwd score temporaries).", lo=8, hi=4096)
+register_flag(
+    "APEX_TPU_MONITOR_JSONL", "str", None,
+    "Path for an apex_tpu.monitor JSONL event log in drivers that "
+    "support ambient wiring (e.g. the 3D-parallel convergence runner).")
+register_flag(
+    "APEX_TPU_MONITOR_STALL_S", "float", 300.0,
+    "Watchdog stall timeout (seconds) for ambient monitor wiring.", lo=0.0)
+register_flag(
+    "APEX_TPU_FULL", "bool", False,
+    "CI switch: run the full (slow-inclusive) test tier in "
+    "tools/ci.sh.")
+register_flag(
+    "APEX_TPU_L1_FULL", "bool", False,
+    "Run the full L1 amp x optimizer cross-product grid instead of "
+    "the CI slice.")

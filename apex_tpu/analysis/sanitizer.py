@@ -1,0 +1,222 @@
+"""Runtime sanitizer: transfer guard + per-step recompile budget.
+
+The two classic silent performance killers in a JAX train loop are
+host<->device transfers inside the step (a sync per step) and
+recompilation after warmup (a shape or flag leaking into the trace —
+minutes lost per occurrence at scale).  Both are invisible to tests
+that only check numerics.  ``sanitize()`` makes a smoke run FAIL on
+either:
+
+    with sanitize(recompile_budget=0, warmup_steps=1) as san:
+        for i in range(steps):
+            out = step(...)
+            san.step()          # step boundary: budget enforced here
+
+* transfers — wires ``jax.transfer_guard(level)`` for the body
+  (default ``"disallow"``): JAX itself raises on implicit transfers.
+* recompiles — flips ``jax_log_compiles`` and captures the
+  "Finished XLA compilation of <name>" records from the
+  ``jax._src.dispatch`` logger.  Compilations observed after
+  ``warmup_steps`` completed step boundaries count against
+  ``recompile_budget``; exceeding it raises
+  :class:`RecompileBudgetExceeded` naming every offending computation.
+
+:func:`sanitize_smoke` is the CI acceptance path (tools/ci.sh step 7):
+it drives the standalone-GPT train step under
+``sanitize(recompile_budget=0, warmup_steps=1)`` and proves the step
+function compiles exactly once after warmup.
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import re
+from typing import List, Optional
+
+__all__ = ["RecompileBudgetExceeded", "Sanitizer", "sanitize",
+           "sanitize_smoke"]
+
+_COMPILE_RE = re.compile(r"Finished XLA compilation of (.+?) in")
+_DISPATCH_LOGGER = "jax._src.dispatch"
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """A traced computation recompiled after warmup."""
+
+    def __init__(self, names: List[str], budget: int, step: int):
+        self.names = list(names)
+        self.budget = budget
+        self.step = step
+        super().__init__(
+            f"{len(names)} compilation(s) after warmup exceeded the "
+            f"per-run recompile budget of {budget} at step boundary "
+            f"{step}: {names} — a shape, python scalar, or env flag is "
+            f"leaking into the trace")
+
+
+class _CompileCapture(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.names: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        m = _COMPILE_RE.search(record.getMessage())
+        if m:
+            self.names.append(m.group(1))
+
+
+class Sanitizer:
+    """Collects compile events between :meth:`step` boundaries; see
+    module docstring.  Not a context manager itself — use
+    :func:`sanitize`."""
+
+    def __init__(self, *, recompile_budget: int = 0,
+                 warmup_steps: int = 1) -> None:
+        self.recompile_budget = int(recompile_budget)
+        self.warmup_steps = int(warmup_steps)
+        self.steps_done = 0
+        self.warmup_compiles: List[str] = []
+        self.post_warmup_compiles: List[str] = []
+        self._capture = _CompileCapture()
+
+    # wired by sanitize()
+    def _drain(self) -> List[str]:
+        names, self._capture.names = self._capture.names, []
+        return names
+
+    def step(self) -> None:
+        """Mark a completed train step.  After ``warmup_steps`` of
+        these, any captured compilation is charged against the budget
+        and the step that overflows it raises."""
+        names = self._drain()
+        if self.steps_done < self.warmup_steps:
+            self.warmup_compiles.extend(names)
+        else:
+            self.post_warmup_compiles.extend(names)
+        self.steps_done += 1
+        if len(self.post_warmup_compiles) > self.recompile_budget:
+            raise RecompileBudgetExceeded(
+                self.post_warmup_compiles, self.recompile_budget,
+                self.steps_done)
+
+    def finish(self) -> None:
+        """Final boundary check (for loops that end right after the
+        offending step) — called automatically on context exit.
+        Events drained here belong to step ``steps_done + 1``, which is
+        post-warmup whenever ``steps_done >= warmup_steps``."""
+        names = self._drain()
+        if self.steps_done < self.warmup_steps:
+            self.warmup_compiles.extend(names)
+            return
+        self.post_warmup_compiles.extend(names)
+        if len(self.post_warmup_compiles) > self.recompile_budget:
+            raise RecompileBudgetExceeded(
+                self.post_warmup_compiles, self.recompile_budget,
+                self.steps_done)
+
+
+@contextlib.contextmanager
+def sanitize(*, transfer_guard: Optional[str] = "disallow",
+             recompile_budget: int = 0, warmup_steps: int = 1):
+    """Context manager yielding a :class:`Sanitizer`.
+
+    ``transfer_guard``: a ``jax.transfer_guard`` level ("allow",
+    "log", "disallow", ...) or None to leave transfers unguarded.
+    ``recompile_budget``/``warmup_steps``: see :class:`Sanitizer`.
+    """
+    import jax
+
+    san = Sanitizer(recompile_budget=recompile_budget,
+                    warmup_steps=warmup_steps)
+    logger = logging.getLogger(_DISPATCH_LOGGER)
+    prior_level = logger.level
+    prior_propagate = logger.propagate
+    logger.addHandler(san._capture)
+    # log_compiles emits at WARNING via this logger; make sure the
+    # records reach handlers even if the app raised the level, and
+    # keep them out of the user's console while we capture
+    if logger.level > logging.WARNING:
+        logger.setLevel(logging.WARNING)
+    logger.propagate = False
+    # pxla chats "Compiling <name> with global shapes" on the same
+    # flag; silence it for the duration too
+    pxla_logger = logging.getLogger("jax._src.interpreters.pxla")
+    prior_pxla_propagate = pxla_logger.propagate
+    pxla_logger.propagate = False
+    pxla_null = logging.NullHandler()  # else logging.lastResort prints
+    pxla_logger.addHandler(pxla_null)
+    prior_flag = jax.config.jax_log_compiles
+    jax.config.update("jax_log_compiles", True)
+    try:
+        if transfer_guard is not None:
+            with jax.transfer_guard(transfer_guard):
+                yield san
+        else:
+            yield san
+        san.finish()
+    finally:
+        jax.config.update("jax_log_compiles", prior_flag)
+        logger.removeHandler(san._capture)
+        logger.setLevel(prior_level)
+        logger.propagate = prior_propagate
+        pxla_logger.removeHandler(pxla_null)
+        pxla_logger.propagate = prior_pxla_propagate
+
+
+def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
+    """Drive the standalone-GPT smoke step under the sanitizer; the CI
+    proof that the train step compiles exactly once after warmup.
+
+    Returns the number of post-warmup recompiles (0 on success);
+    raises :class:`RecompileBudgetExceeded` on any.  Mirrors
+    ``testing.standalone_gpt.train_smoke``'s model/step construction
+    but owns the loop so the step boundary is explicit.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .. import amp
+    from ..optimizers import fused_adam
+    from ..testing.standalone_gpt import GPTModel, gpt_loss
+
+    vocab, hidden, heads, layers, batch, seq = 64, 32, 4, 2, 4, 16
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, -1)
+    variables = jax.jit(model.init)(key, tokens)
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_adam(1e-3), opt_level="O2")
+
+    @jax.jit
+    def step(params, amp_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            loss = gpt_loss(logits, labels)
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_state, _ = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        return new_params, new_state, loss
+
+    # the init/initialize compiles above happen OUTSIDE the sanitizer;
+    # transfer_guard stays off for the smoke (loss readout is an
+    # explicit, expected device->host transfer)
+    with sanitize(transfer_guard=None, recompile_budget=0,
+                  warmup_steps=1) as san:
+        for _ in range(steps):
+            params, amp_state, loss = step(params, amp_state)
+            loss.block_until_ready()
+            san.step()
+    if verbose:
+        print(f"[sanitize-smoke] steps={steps} "
+              f"warmup_compiles={len(san.warmup_compiles)} "
+              f"post_warmup_compiles={len(san.post_warmup_compiles)} "
+              f"loss={float(loss):.4f}")
+    return len(san.post_warmup_compiles)

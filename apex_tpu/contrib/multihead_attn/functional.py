@@ -47,8 +47,9 @@ def _is_causal_mask(mask, sq: int, sk: int) -> bool:
         import numpy as np
 
         m = np.asarray(mask).astype(bool)
-    except Exception:
-        return False  # traced: no memo (tracer ids recycle fast)
+    except (TypeError, ValueError):
+        return False  # traced: no memo (tracer ids recycle fast);
+        # TracerArrayConversionError is a TypeError subclass
     if m.shape[-2:] != (sq, sk):
         result = False
     else:

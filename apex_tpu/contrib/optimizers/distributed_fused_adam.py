@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import NamedTuple, Tuple
 
 import jax
+from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 import optax
 
@@ -55,7 +56,7 @@ def distributed_fused_adam(
     (unreduced) gradients — the reduce is fused into the scatter."""
 
     def init(params):
-        world = jax.lax.axis_size(axis_name)
+        world = axis_size(axis_name)
         metas = multi_tensor.compute_metas(params)
         shards = tuple(
             jnp.zeros((_shard_padded(m, world) // world,), jnp.float32)
@@ -69,8 +70,8 @@ def distributed_fused_adam(
             else jax.default_backend() == "tpu"
         if params is None:
             raise ValueError("distributed_fused_adam requires params")
-        world = jax.lax.axis_size(axis_name)
-        rank = jax.lax.axis_index(axis_name)
+        world = axis_size(axis_name)
+        rank = axis_index(axis_name)
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import NamedTuple, Tuple
 
 import jax
+from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 import optax
 
@@ -44,7 +45,7 @@ def distributed_fused_lamb(
         grad_average: bool = True) -> optax.GradientTransformation:
 
     def init(params):
-        world = jax.lax.axis_size(axis_name)
+        world = axis_size(axis_name)
         metas = multi_tensor.compute_metas(params)
         shards = tuple(
             jnp.zeros((_shard_padded(m, world) // world,), jnp.float32)
@@ -56,8 +57,8 @@ def distributed_fused_lamb(
     def update(grads, state, params=None):
         if params is None:
             raise ValueError("distributed_fused_lamb requires params")
-        world = jax.lax.axis_size(axis_name)
-        rank = jax.lax.axis_index(axis_name)
+        world = axis_size(axis_name)
+        rank = axis_index(axis_name)
         count = state.count + 1
         lr = _lr_at(learning_rate, count)
         cf = count.astype(jnp.float32)

@@ -31,8 +31,8 @@ def native_library_path(rebuild: bool = False) -> str:
         if (not rebuild and os.path.exists(_OUT)
                 and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
             return _OUT
-        cxx = os.environ.get("CXX") or shutil.which("g++") \
-            or shutil.which("c++")
+        cxx = (os.environ.get("CXX")  # apex-lint: disable=APX301 -- CXX is the standard build-toolchain contract var, not an apex flag
+               or shutil.which("g++") or shutil.which("c++"))
         if cxx is None:
             raise NativeBuildError("no C++ compiler on PATH")
         os.makedirs(os.path.dirname(_OUT), exist_ok=True)

@@ -227,7 +227,7 @@ class DataLoader:
     def __del__(self):  # pragma: no cover - GC timing
         try:
             self.close()
-        except Exception:
+        except Exception:  # apex-lint: disable=APX202 -- GC-time close: the interpreter (or the native lib) may already be torn down; nothing to log to
             pass
 
 

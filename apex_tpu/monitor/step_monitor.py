@@ -19,11 +19,13 @@ events; MFU reads the pyprof device spec).
 from __future__ import annotations
 
 import math
-import sys
 import time
 from typing import Any, Dict, Optional
 
+from ..utils.log_util import get_logger
 from .events import Event, Sink
+
+logger = get_logger(__name__)
 from .watchdog import Watchdog
 
 
@@ -106,7 +108,8 @@ class StepMonitor:
                 from ..pyprof.prof import device_spec
 
                 self._peak_flops = device_spec().peak_bf16_tflops * 1e12
-            except Exception:  # no device spec -> no MFU, never crash
+            except (ImportError, AttributeError, KeyError,
+                    RuntimeError):  # no device spec -> no MFU
                 self._peak_flops = 0.0
         return self._peak_flops or None
 
@@ -181,8 +184,7 @@ class StepMonitor:
                 scaler = scaler.scaler
             tel = _scaler.update_telemetry(self._scaler_prev, scaler)
         except Exception as e:  # telemetry must never kill the step
-            print(f"[monitor] scaler telemetry failed: {str(e)[:160]}",
-                  file=sys.stderr)
+            logger.warning("scaler telemetry failed: %s", str(e)[:160])
             return None
         self.event("scale", "loss_scale", value=tel["loss_scale"],
                    step=step, steps_skipped=tel["steps_skipped"],

@@ -33,8 +33,8 @@ def load_events(path: str) -> tuple:
                 continue
             try:
                 events.append(Event.from_json(line))
-            except Exception:
-                malformed += 1
+            except (ValueError, KeyError, TypeError):
+                malformed += 1  # torn/garbled line: count, keep parsing
     return events, malformed
 
 

@@ -21,12 +21,14 @@ the episode semantics deterministically on CPU with a fake clock
 from __future__ import annotations
 
 import math
-import sys
 import threading
 import time
 from typing import Any, Optional
 
+from ..utils.log_util import get_logger
 from .events import Event, Sink
+
+logger = get_logger(__name__)
 
 DEFAULT_OVERFLOW_STREAK = 8
 DEFAULT_STALL_TIMEOUT_S = 300.0
@@ -95,8 +97,7 @@ class Watchdog:
             try:
                 self._on_alarm(event)
             except Exception as e:
-                print(f"[monitor] on_alarm hook failed: {str(e)[:160]}",
-                      file=sys.stderr)
+                logger.warning("on_alarm hook failed: %s", str(e)[:160])
 
     # -- observations (call on every completed step) -------------------------
 
@@ -179,8 +180,8 @@ class Watchdog:
             self._tracing = True
             self._alarm("stall_trace_started", trace_dir=self.trace_dir)
         except Exception as e:  # telemetry must never kill the run
-            print(f"[monitor] stall trace failed to start: "
-                  f"{str(e)[:160]}", file=sys.stderr)
+            logger.warning("stall trace failed to start: %s",
+                           str(e)[:160])
 
     def _stop_trace(self) -> None:
         if not self._tracing:
@@ -191,8 +192,8 @@ class Watchdog:
             jax.profiler.stop_trace()
             self._alarm("stall_trace_stopped", trace_dir=self.trace_dir)
         except Exception as e:
-            print(f"[monitor] stall trace failed to stop: "
-                  f"{str(e)[:160]}", file=sys.stderr)
+            logger.warning("stall trace failed to stop: %s",
+                           str(e)[:160])
         self._tracing = False
 
     # -- heartbeat thread ----------------------------------------------------
@@ -211,8 +212,8 @@ class Watchdog:
                 try:
                     self.check_stall()
                 except Exception as e:
-                    print(f"[monitor] heartbeat check failed: "
-                          f"{str(e)[:160]}", file=sys.stderr)
+                    logger.warning("heartbeat check failed: %s",
+                                   str(e)[:160])
 
         self._thread = threading.Thread(
             target=beat, name="apex_tpu-monitor-heartbeat", daemon=True)

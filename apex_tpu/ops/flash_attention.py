@@ -66,25 +66,10 @@ _LOG2E = math.log2(math.e)
 # d<=256: q/k/v/acc blocks + fp32 scores ~7 MB, within the 16 MB
 # budget (at d > 64 block_q is halved — see _clamp_blocks).  Env
 # overrides (read at import) for bench-driven re-tuning.
-import os as _os
+from ..analysis.flags import flag_bool, flag_int
 
-
-def _env_block(var: str, default: int, lo: int = 8,
-               hi: int = 4096) -> int:
-    raw = _os.environ.get(var)
-    if raw is None:
-        return default
-    try:
-        val = int(raw.strip())
-    except ValueError:
-        raise ValueError(f"{var}={raw!r} is not an integer") from None
-    if not lo <= val <= hi:
-        raise ValueError(f"{var}={val} out of range [{lo}, {hi}]")
-    return val
-
-
-DEFAULT_BLOCK_Q = _env_block("APEX_TPU_FLASH_BLOCK_Q", 1024)
-DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 1024)
+DEFAULT_BLOCK_Q = flag_int("APEX_TPU_FLASH_BLOCK_Q")
+DEFAULT_BLOCK_K = flag_int("APEX_TPU_FLASH_BLOCK_K")
 
 # --- d=64 head packing ------------------------------------------------------
 #
@@ -130,8 +115,7 @@ DEFAULT_BLOCK_K = _env_block("APEX_TPU_FLASH_BLOCK_K", 1024)
 # unpacked-bwd mix is exact, because the backward recomputes p from the
 # per-head lse and the dropout mask is coordinate-hashed, never
 # tiling-derived.
-_PACK_D64 = {"enabled": _os.environ.get(
-    "APEX_TPU_FLASH_PACK_D64", "1") != "0"}
+_PACK_D64 = {"enabled": flag_bool("APEX_TPU_FLASH_PACK_D64")}
 
 
 def set_head_packing(enabled: bool) -> None:
@@ -1774,16 +1758,12 @@ _E_MAX_SEQ = 1024
 # at s=32768/h=16 the (b, h, 8, ps) fp32 sidebands are 64 MB of HBM
 # per batch row, a sane ceiling; the walk itself is shape-generic
 # (hardware-verified blocked parity at s=16384 for d in {64, 128}).
-_E_MAX_SEQ_BLOCKED = _env_block("APEX_TPU_FLASH_E_MAX_SEQ", 32768,
-                                lo=128, hi=1 << 20)
-_E_BLOCK = _env_block("APEX_TPU_FLASH_E_BLOCK", 512, lo=128)
-if _E_BLOCK % 128:
-    raise ValueError(f"APEX_TPU_FLASH_E_BLOCK={_E_BLOCK} must be a "
-                     "multiple of 128 (TPU lane grain)")
+_E_MAX_SEQ_BLOCKED = flag_int("APEX_TPU_FLASH_E_MAX_SEQ")
+_E_BLOCK = flag_int("APEX_TPU_FLASH_E_BLOCK")  # registry enforces %128
 # lane budget per head-group block (3*hg*d lanes): sized so the bwd's
 # score-shaped fp32 temporaries (~10 MB at ps=1024) plus double-buffered
 # qkv/do/dqkv blocks stay inside the 16 MB VMEM window.
-_E_LANE_BUDGET = _env_block("APEX_TPU_FLASH_E_LANES", 768)
+_E_LANE_BUDGET = flag_int("APEX_TPU_FLASH_E_LANES")
 
 
 def _pick_heads_per_group(h: int, d: int, ps: int,

@@ -16,13 +16,14 @@ ref: csrc/multi_tensor_adam.cu:29).  Kernels emit the *update delta*
 from __future__ import annotations
 
 import functools
-import os
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..analysis.flags import flag_int
 
 LANE = 128
 # 1024x128 fp32 = 512 KiB per buffer per block.  Swept on v5e at
@@ -57,7 +58,7 @@ def _step_pallas_min() -> int:
     """Opt-in floor for routing STEP work to the Pallas kernels; read
     per call (NOT at import) so setting the env var after importing
     apex_tpu still takes effect."""
-    return int(os.environ.get("APEX_TPU_STEP_PALLAS_MIN", "0"))
+    return flag_int("APEX_TPU_STEP_PALLAS_MIN")
 
 
 def step_use_pallas(use_pallas, size: int) -> bool:

@@ -55,7 +55,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
@@ -63,6 +62,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis.flags import flag_bool
 from . import fused_optim, multi_tensor
 from .multi_tensor import LANE, FlatMeta
 
@@ -79,7 +79,7 @@ def pipeline_enabled(flag: Optional[bool] = None) -> bool:
     the var after import still takes effect for new optimizers."""
     if flag is not None:
         return bool(flag)
-    return os.environ.get("APEX_TPU_FUSED_PIPELINE", "1") != "0"
+    return flag_bool("APEX_TPU_FUSED_PIPELINE")
 
 
 def use_pallas_pipeline(flag: Optional[bool] = None) -> bool:
@@ -88,7 +88,7 @@ def use_pallas_pipeline(flag: Optional[bool] = None) -> bool:
     measured rationale) unless ``APEX_TPU_PIPELINE_PALLAS=1``."""
     if flag is not None:
         return bool(flag)
-    return os.environ.get("APEX_TPU_PIPELINE_PALLAS", "") == "1"
+    return flag_bool("APEX_TPU_PIPELINE_PALLAS")
 
 
 def pipeline_metas(tree: Any) -> List[FlatMeta]:
@@ -252,6 +252,13 @@ def _norm_finite_pallas(buf: jnp.ndarray, inv: jnp.ndarray,
     return jnp.sum(parts), jnp.all(fins > 0)
 
 
+def _norm_finite_jnp(buf: jnp.ndarray, inv: jnp.ndarray):
+    """jnp twin of :func:`_norm_finite_pallas` — one buffer's
+    (sum-of-squares, finite) partial for the norm/finite sweep."""
+    g = buf.astype(jnp.float32) * inv
+    return multi_tensor.sumsq(g), jnp.all(jnp.isfinite(g))
+
+
 def grad_norm_finite(gbufs: Sequence[jnp.ndarray], inv_scale=1.0,
                      use_pallas: Optional[bool] = None, interpret=None):
     """ONE read-only sweep over the packed grad buffers ->
@@ -266,9 +273,7 @@ def grad_norm_finite(gbufs: Sequence[jnp.ndarray], inv_scale=1.0,
         if use_pallas_pipeline(use_pallas):
             s, f = _norm_finite_pallas(buf, inv, interpret=interpret)
         else:
-            g = buf.astype(jnp.float32) * inv
-            s = multi_tensor.sumsq(g)
-            f = jnp.all(jnp.isfinite(g))
+            s, f = _norm_finite_jnp(buf, inv)
         sums.append(s)
         fins.append(f)
     if not sums:

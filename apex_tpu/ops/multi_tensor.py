@@ -17,12 +17,13 @@ The overflow-buffer convention becomes a returned finite flag.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..analysis.flags import flag_int
 
 # TPU lane/sublane tile for fp32; flat buffers are padded to this so Pallas
 # kernels can view them as (rows, 128) without remainder handling.
@@ -69,17 +70,7 @@ class FlatMeta:
 # (ref: csrc/multi_tensor_apply.cuh), a cost class XLA does not have;
 # the Pallas packed kernels remain available via use_pallas=True /
 # APEX_TPU_DIRECT_MIN_ELEMS for hardware where the trade-off shifts.
-def _env_direct_min() -> int:
-    raw = os.environ.get("APEX_TPU_DIRECT_MIN_ELEMS", "0")
-    try:
-        return int(raw.strip())
-    except ValueError:
-        raise ValueError(
-            f"APEX_TPU_DIRECT_MIN_ELEMS={raw!r} is not an integer "
-            "(element-count threshold, e.g. 1048576)") from None
-
-
-DIRECT_MIN_ELEMS = _env_direct_min()
+DIRECT_MIN_ELEMS = flag_int("APEX_TPU_DIRECT_MIN_ELEMS")
 
 # Upper bound on a single packed group's element count (split_direct
 # consumers only; classic one-group-per-dtype callers like ZeRO keep a

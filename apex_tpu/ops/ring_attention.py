@@ -30,6 +30,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+from .._compat import (HAS_VMA, axis_index, axis_size,
+                       rewrite_trace_free, typeof)
 import jax.numpy as jnp
 
 _NEG = -1e30
@@ -46,18 +48,17 @@ def flash_legal_here(*operands) -> bool:
     kernel automatically: probed on the CPU mesh, a ``P('sp')`` operand
     shows ``vma={'sp'}`` under ``check_vma=True`` and ``vma=set()``
     under ``check_vma=False``."""
+    if not HAS_VMA:
+        # VMA types unavailable (older JAX): there pallas_call is
+        # rejected by the check_rep=True rewrite interpreter ("no
+        # replication rule"), so legality = not being under it.
+        return rewrite_trace_free(*operands)
     for x in operands:
         try:
-            vma = getattr(jax.typeof(x), "vma", None)
+            vma = getattr(typeof(x), "vma", None)
         except (AttributeError, TypeError):
-            # jax.typeof itself absent (older JAX) or operand untypable
-            return False
-        if vma is None:
-            # VMA types unavailable (older JAX): we cannot PROVE the
-            # Pallas call is legal here, so fail safe to the einsum
-            # path — a slow correct fallback beats a hard trace error.
-            return False
-        if vma:
+            return False  # operand untypable
+        if vma is None or vma:
             return False
     return True
 
@@ -139,8 +140,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     if use_flash is None:
         use_flash = flash_legal_here(q, k, v)
-    nshards = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    nshards = axis_size(axis_name)
+    rank = axis_index(axis_name)
     s_local = q.shape[-2]
     perm = [(i, (i + 1) % nshards) for i in range(nshards)]
     drop_kw = (dict(dropout_rate=dropout_rate,
@@ -251,7 +252,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     offsets.  A fixed seed draws identical global masks in ring and
     Ulysses mode.
     """
-    nshards = jax.lax.axis_size(axis_name)
+    nshards = axis_size(axis_name)
     b, h, s_local, d = q.shape
     assert h % nshards == 0, (
         f"heads {h} not divisible by axis size {nshards}")
@@ -271,7 +272,7 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                   concat_axis=1, tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    head_off = jax.lax.axis_index(axis_name) * (h // nshards)
+    head_off = axis_index(axis_name) * (h // nshards)
     if attention_fn is None:
         if use_flash:
             # bypass flash_attention's manual-axis fallback: the Pallas

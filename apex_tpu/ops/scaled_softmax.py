@@ -61,6 +61,17 @@ def _scaled_upper_triang_masked_softmax_fused(x: jnp.ndarray,
     return _causal_fwd(x, scale)[0]
 
 
+def _causal_softmax_xla(x: jnp.ndarray, scale: float) -> jnp.ndarray:
+    """jnp twin of the causal kernel (:func:`_causal_fwd`) — the XLA
+    reference path used inside shard_map manual axes, and the parity
+    anchor the kernel audit checks against."""
+    sq, sk = x.shape[-2:]
+    s = x.astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((sq, sk), bool))
+    s = jnp.where(mask, s, jnp.float32(-10000.0))
+    return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+
+
 def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
                                        scale: float = 1.0) -> jnp.ndarray:
     """Causal softmax over (..., sq, sk) attention scores
@@ -70,11 +81,7 @@ def scaled_upper_triang_masked_softmax(x: jnp.ndarray,
     from ._context import in_manual_axis_context
 
     if in_manual_axis_context(x):
-        sq, sk = x.shape[-2:]
-        s = x.astype(jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((sq, sk), bool))
-        s = jnp.where(mask, s, jnp.float32(-10000.0))
-        return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        return _causal_softmax_xla(x, scale)
     return _scaled_upper_triang_masked_softmax_fused(x, scale)
 
 
@@ -163,10 +170,16 @@ def scaled_masked_softmax(x: jnp.ndarray, mask: jnp.ndarray,
     from ._context import in_manual_axis_context
 
     if in_manual_axis_context(x, mask):
-        s = x.astype(jnp.float32) * scale
-        s = jnp.where(mask, jnp.float32(-10000.0), s)
-        return jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        return _masked_softmax_xla(x, mask, scale)
     return _scaled_masked_softmax_fused(x, mask, scale)
+
+
+def _masked_softmax_xla(x: jnp.ndarray, mask: jnp.ndarray,
+                        scale: float) -> jnp.ndarray:
+    """jnp twin of the masked kernel (:func:`_masked_fwd`)."""
+    s = x.astype(jnp.float32) * scale
+    s = jnp.where(mask, jnp.float32(-10000.0), s)
+    return jax.nn.softmax(s, axis=-1).astype(x.dtype)
 
 
 def _masked_fwd(x, mask, scale):

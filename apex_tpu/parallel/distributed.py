@@ -41,6 +41,7 @@ import dataclasses
 from typing import Any, Optional
 
 import jax
+from .._compat import axis_size, pcast
 import jax.numpy as jnp
 
 from .. import parallel_state
@@ -60,7 +61,7 @@ def sync_gradients(grads: Any,
     ``predivide_factor`` before the reduction and by
     ``world_size / predivide_factor`` after when averaging.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
 
     def _one(g):
         orig_dtype = g.dtype
@@ -84,7 +85,7 @@ def average_presummed(grads: Any,
                       axis_name: str = parallel_state.DATA_AXIS) -> Any:
     """Turn autodiff's pre-summed gradients (grad w.r.t. replicated params
     inside shard_map) into the data-parallel average."""
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     return jax.tree_util.tree_map(lambda g: g / world, grads)
 
 
@@ -93,7 +94,7 @@ def make_varying(tree: Any, axis_name: str = parallel_state.DATA_AXIS) -> Any:
     stay local (opting out of shard_map's automatic cotangent psum)."""
     def _one(x):
         try:
-            return jax.lax.pcast(x, axis_name, to="varying")
+            return pcast(x, axis_name, to="varying")
         except ValueError:
             return x  # already varying over this axis
     return jax.tree_util.tree_map(_one, tree)
@@ -106,7 +107,7 @@ def allreduce_params(params: Any,
                      average: bool = True) -> Any:
     def _one(p):
         p = jax.lax.psum(p, axis_name)
-        return p / jax.lax.axis_size(axis_name) if average else p
+        return p / axis_size(axis_name) if average else p
     return jax.tree_util.tree_map(_one, params)
 
 

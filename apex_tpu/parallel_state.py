@@ -23,6 +23,7 @@ import dataclasses
 from typing import Optional, Sequence
 
 import jax
+from ._compat import axis_index
 import numpy as np
 from jax.sharding import Mesh
 
@@ -155,15 +156,15 @@ def get_world_size() -> int:
 
 def get_tensor_model_parallel_rank():
     """Traced TP rank of the current shard (inside shard_map only)."""
-    return jax.lax.axis_index(TENSOR_AXIS)
+    return axis_index(TENSOR_AXIS)
 
 
 def get_pipeline_model_parallel_rank():
-    return jax.lax.axis_index(PIPE_AXIS)
+    return axis_index(PIPE_AXIS)
 
 
 def get_data_parallel_rank():
-    return jax.lax.axis_index(DATA_AXIS)
+    return axis_index(DATA_AXIS)
 
 
 def is_pipeline_first_stage(ignore_virtual: bool = False):
@@ -176,7 +177,7 @@ def is_pipeline_first_stage(ignore_virtual: bool = False):
     if not ignore_virtual and _STATE.virtual_pipeline_model_parallel_size:
         if get_virtual_pipeline_model_parallel_rank() != 0:
             return False
-    return jax.lax.axis_index(PIPE_AXIS) == 0
+    return axis_index(PIPE_AXIS) == 0
 
 
 def is_pipeline_last_stage(ignore_virtual: bool = False):
@@ -185,7 +186,7 @@ def is_pipeline_last_stage(ignore_virtual: bool = False):
         if get_virtual_pipeline_model_parallel_rank() != vpp - 1:
             return False
     return (
-        jax.lax.axis_index(PIPE_AXIS)
+        axis_index(PIPE_AXIS)
         == get_pipeline_model_parallel_world_size() - 1
     )
 

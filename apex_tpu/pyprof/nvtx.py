@@ -55,7 +55,7 @@ def _describe(x: Any):
         try:
             return {"shape": tuple(int(d) for d in x.shape),
                     "dtype": str(getattr(x, "dtype", "?"))}
-        except Exception:
+        except (TypeError, ValueError, AttributeError):
             return {"type": type(x).__name__}
     if isinstance(x, (int, float, bool, str)) or x is None:
         return x

@@ -93,14 +93,14 @@ def _nbytes(aval) -> float:
     try:
         return float(np.prod(aval.shape, dtype=np.float64)
                      * jnp.dtype(aval.dtype).itemsize)
-    except Exception:
+    except (TypeError, ValueError, AttributeError, OverflowError):
         return 0.0
 
 
 def _numel(aval) -> float:
     try:
         return float(np.prod(aval.shape, dtype=np.float64))
-    except Exception:
+    except (TypeError, ValueError, AttributeError, OverflowError):
         return 0.0
 
 
@@ -108,7 +108,7 @@ def _shape_str(avals) -> str:
     def one(a):
         try:
             return "(" + ",".join(str(int(d)) for d in a.shape) + ")"
-        except Exception:
+        except (TypeError, ValueError, AttributeError):
             return "?"
     return "x".join(one(a) for a in avals)
 
@@ -269,8 +269,8 @@ def _walk(jaxpr, scope: str, mult: int,
             ns = str(eqn.source_info.name_stack)
             if ns:
                 eqn_scope = (scope + "/" + ns) if scope else ns
-        except Exception:
-            pass
+        except AttributeError:
+            pass  # jaxpr without source info (synthetic/cached)
         if subs:
             inner = f"{eqn.primitive.name}"
             for sub, trips in subs:

@@ -52,8 +52,8 @@ def _rebuild(node, out: dict):
         return out
     try:
         return type(node)(out)
-    except Exception:
-        return out
+    except TypeError:
+        return out  # mapping type without a dict-like constructor
 
 
 def _aux_base(node, k: str, sfx) -> Optional[str]:

@@ -56,8 +56,8 @@ def parse_args(extra_args_provider=None, defaults=None,
         try:
             import jax
             parsed.world_size = jax.device_count()
-        except Exception:
-            parsed.world_size = int(os.environ.get("WORLD_SIZE", "1"))
+        except (ImportError, RuntimeError):  # no backend in dry-runs
+            parsed.world_size = int(os.environ.get("WORLD_SIZE", "1"))  # apex-lint: disable=APX301 -- torchrun launcher contract var, not an apex flag
     parsed.tensor_model_parallel_size = min(
         parsed.tensor_model_parallel_size, parsed.world_size)
     model_parallel = (parsed.tensor_model_parallel_size

@@ -98,10 +98,12 @@ def _set_tensorboard_writer(args):
 
         _GLOBAL_TENSORBOARD_WRITER = SummaryWriter(
             log_dir=args.tensorboard_dir)
-    except Exception:
-        print("WARNING: TensorBoard writing requested but unavailable "
-              "(no tensorboard package), no TensorBoard logs will be "
-              "written.", flush=True)
+    except Exception as e:
+        from ..utils.log_util import get_logger
+
+        get_logger(__name__).warning(
+            "TensorBoard writing requested but unavailable (%s); no "
+            "TensorBoard logs will be written.", str(e)[:120])
 
 
 def destroy_global_vars():

@@ -19,6 +19,7 @@ re-designed for one-program SPMD:
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -275,7 +276,8 @@ def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
 def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
                         timers, lr=None, *, start_step: int = 0,
                         ckpt=None, ckpt_every: int = 1, amp_opt=None,
-                        autoresume=None, escalation=None, fault=None):
+                        autoresume=None, escalation=None, fault=None,
+                        sanitizer=None):
     """Drive ``step_fn(params, amp_state) -> (params, amp_state, loss,
     grad_norm, step_info)`` for steps ``[start_step, steps)``,
     recording each through an :class:`apex_tpu.monitor.StepMonitor` and
@@ -296,6 +298,9 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
       ``run_resumable`` to catch and restart.
     * ``fault`` — an ``apex_tpu.resilience.FaultInjector`` driving
       deterministic failures (``before_step`` / ``observed_loss``).
+    * ``sanitizer`` — an :class:`apex_tpu.analysis.Sanitizer`; its
+      ``step()`` runs at each step boundary, so a post-warmup
+      recompile fails the run (docs/api/analysis.md).
 
     Returns ``(params, amp_state, last_loss, steps_done)``.
     """
@@ -320,6 +325,8 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
         monitor.end_step(i, loss=loss_f, grad_norm=gnorm, lr=lr,
                          scaler=info)
         timers.events(monitor, i, reset=True)
+        if sanitizer is not None:
+            sanitizer.step()  # post-warmup recompile -> raise here
         done = i + 1
         esc = escalation.pending() if escalation is not None else None
         if esc is not None:
@@ -358,7 +365,7 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
                 ckpt_keep: int = 3, resume: bool = True,
                 fault=None, autoresume="auto", escalation=None,
-                return_state: bool = False):
+                return_state: bool = False, sanitize: bool = False):
     """Tiny single-device GPT train loop wired end-to-end through
     :mod:`apex_tpu.monitor` — the CPU telemetry smoke (exercised by
     tools/ci.sh on every run): step metrics (loss, grad-norm, lr,
@@ -439,12 +446,14 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         step, params, amp_opt, amp_state, steps, monitor, timers, lr=lr,
         ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
         resume=resume, fault=fault, autoresume=autoresume,
-        escalation=escalation, return_state=return_state)
+        escalation=escalation, return_state=return_state,
+        sanitize=sanitize)
 
 
 def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                     timers, *, lr, ckpt_dir, ckpt_every, ckpt_keep,
-                    resume, fault, autoresume, escalation, return_state):
+                    resume, fault, autoresume, escalation, return_state,
+                    sanitize: bool = False):
     """Resilience-wired driver shell shared by the GPT and BERT smokes:
     checkpoint manager + auto-resume bootstrap around
     :func:`run_monitored_steps`, ``run_error`` emission on a crashing
@@ -482,11 +491,24 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
         if autoresume is not None and autoresume.marker_dir:
             autoresume.clear_clean_exit()  # marker = THIS run's exit
         done = start_step
-        params, amp_state, loss_f, done = run_monitored_steps(
-            step_fn, params, amp_state, steps, monitor, timers, lr=lr,
-            start_step=start_step, ckpt=mgr, ckpt_every=ckpt_every,
-            amp_opt=amp_opt, autoresume=autoresume,
-            escalation=escalation, fault=fault)
+        with contextlib.ExitStack() as stack:
+            san = None
+            if sanitize:
+                # smoke contract: the jitted step compiles once during
+                # the first (warmup) step and never again — a
+                # post-warmup recompile raises RecompileBudgetExceeded
+                # out of the loop
+                from ..analysis import sanitize as sanitize_ctx
+
+                san = stack.enter_context(sanitize_ctx(
+                    transfer_guard=None, recompile_budget=0,
+                    warmup_steps=1))
+            params, amp_state, loss_f, done = run_monitored_steps(
+                step_fn, params, amp_state, steps, monitor, timers,
+                lr=lr, start_step=start_step, ckpt=mgr,
+                ckpt_every=ckpt_every, amp_opt=amp_opt,
+                autoresume=autoresume, escalation=escalation,
+                fault=fault, sanitizer=san)
     except BaseException as e:
         # terminal record first — the re-raise may end the process
         monitor.event("run", "run_error", step=done,
@@ -541,13 +563,16 @@ def _main(argv=None):
                    help="event-log path (default: in-memory only)")
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--stall-timeout", type=float, default=300.0)
+    p.add_argument("--sanitize", action="store_true",
+                   help="run under apex_tpu.analysis.sanitize(): fail "
+                        "if the train step recompiles after warmup")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     loss, _, _, done = train_smoke(
         steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
         stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, resume=not args.no_resume,
-        fault=args.fault, return_state=True)
+        fault=args.fault, return_state=True, sanitize=args.sanitize)
     print(f"SMOKE_DONE steps_done={done}"
           + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Callable, NamedTuple, Optional
 
 import jax
+from .._compat import axis_size
 import jax.numpy as jnp
 
 from ..parallel_state import EXPERT_AXIS  # noqa: F401
@@ -170,7 +171,7 @@ def moe_dispatch_combine(x: jnp.ndarray,
         jnp.where(keep[:, None], xk, 0))
 
     if axis_name is not None:
-        n_shards = jax.lax.axis_size(axis_name)
+        n_shards = axis_size(axis_name)
         assert num_experts % n_shards == 0
         # shard e receives every peer's slice for its local experts:
         # (E, C, H) -> (E/P, P*C, H)

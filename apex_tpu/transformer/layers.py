@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
+from .._compat import axis_size
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
@@ -50,7 +51,7 @@ Dtype = Any
 
 
 def _maybe_axis_size(axis_name: Optional[str]) -> int:
-    return 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    return 1 if axis_name is None else axis_size(axis_name)
 
 
 class ParallelMLP(nn.Module):

@@ -23,12 +23,13 @@ from batched isend/irecv falls out of the collective formulation).
 from __future__ import annotations
 
 import jax
+from ..._compat import axis_size
 
 from ...parallel_state import PIPE_AXIS
 
 
 def _shift(x, axis_name: str, forward: bool, wrap: bool = False):
-    size = jax.lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     if forward:
         perm = [(i, (i + 1) % size) for i in range(size if wrap
                                                    else size - 1)]

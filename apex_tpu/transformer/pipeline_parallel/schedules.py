@@ -39,6 +39,7 @@ import warnings
 from typing import Any, Callable, Optional
 
 import jax
+from ..._compat import axis_index, axis_size, pcast, psum_replicated, typeof
 import jax.numpy as jnp
 
 from ...parallel_state import PIPE_AXIS
@@ -70,8 +71,8 @@ def pipeline_forward(stage_fn: Callable, stage_params: Any, microbatches: Any,
     here as the scan bounds: M + P - 1 ticks, microbatch ``t - rank``
     active on stage ``rank`` at tick ``t``.
     """
-    nstages = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    nstages = axis_size(axis_name)
+    rank = axis_index(axis_name)
     leaves = jax.tree.leaves(microbatches)
     num_micro = leaves[0].shape[0]
 
@@ -87,9 +88,9 @@ def pipeline_forward(stage_fn: Callable, stage_params: Any, microbatches: Any,
         # e.g. 'data' when the batch is data-sharded); the initial zeros
         # must be marked identically for VMA type agreement
         def mark(x, ref):
-            target = set(jax.typeof(ref).vma) | {axis_name}
-            missing = tuple(a for a in target if a not in jax.typeof(x).vma)
-            return jax.lax.pcast(x, missing, to="varying") if missing else x
+            target = set(typeof(ref).vma) | {axis_name}
+            missing = tuple(a for a in target if a not in typeof(x).vma)
+            return pcast(x, missing, to="varying") if missing else x
         ref_leaves = jax.tree.leaves(jax.tree.map(lambda m: m[0],
                                                   microbatches))
         return jax.tree.map(
@@ -134,8 +135,9 @@ def pipeline_forward(stage_fn: Callable, stage_params: Any, microbatches: Any,
 
     (_, outputs), _ = jax.lax.scan(
         tick, (state0, outputs0), jnp.arange(num_micro + nstages - 1))
-    # Only the last stage wrote non-zeros; psum replicates to every stage.
-    return jax.tree.map(lambda o: jax.lax.psum(o, axis_name), outputs)
+    # Only the last stage wrote non-zeros; psum replicates to every
+    # stage (seed-once VJP semantics on old jax — see _compat).
+    return jax.tree.map(lambda o: psum_replicated(o, axis_name), outputs)
 
 
 def forward_backward_no_pipelining(loss_fn: Callable, params: Any,
@@ -215,8 +217,8 @@ def pipeline_forward_interleaved(stage_fn: Callable, chunk_params: Any,
     ``vpp*(M + P - 1)`` — the ``(vpp-1)*(P-1)`` bubble the interleaved
     schedule exists to remove is removed.
     """
-    nstages = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    nstages = axis_size(axis_name)
+    rank = axis_index(axis_name)
     vpp = jax.tree.leaves(chunk_params)[0].shape[0]
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
     K = vpp * num_micro
@@ -238,10 +240,10 @@ def pipeline_forward_interleaved(stage_fn: Callable, chunk_params: Any,
 
     def _varying(tree):
         def mark(x, ref):
-            target = set(jax.typeof(ref).vma) | {axis_name}
+            target = set(typeof(ref).vma) | {axis_name}
             missing = tuple(a for a in target
-                            if a not in jax.typeof(x).vma)
-            return jax.lax.pcast(x, missing, to="varying") if missing \
+                            if a not in typeof(x).vma)
+            return pcast(x, missing, to="varying") if missing \
                 else x
         ref_leaves = jax.tree.leaves(jax.tree.map(lambda m: m[0],
                                                   microbatches))
@@ -295,8 +297,9 @@ def pipeline_forward_interleaved(stage_fn: Callable, chunk_params: Any,
 
     (_, outputs), _ = jax.lax.scan(
         tick, (state0, outputs0), jnp.arange(K + nstages))
-    # Only stage 0 collected; psum replicates across the axis.
-    return jax.tree.map(lambda o: jax.lax.psum(o, axis_name), outputs)
+    # Only stage 0 collected; psum replicates across the axis
+    # (seed-once VJP semantics on old jax — see _compat).
+    return jax.tree.map(lambda o: psum_replicated(o, axis_name), outputs)
 
 
 def forward_backward_pipelining_with_interleaving(
@@ -322,7 +325,7 @@ def forward_backward_pipelining_with_interleaving(
     asserts ``num_microbatches %% pipeline_parallel_size == 0``).
     """
     num_micro = jax.tree.leaves(microbatches)[0].shape[0]
-    nstages = jax.lax.axis_size(axis_name)
+    nstages = axis_size(axis_name)
     vpp = jax.tree.leaves(stage_params)[0].shape[0]
     if num_micro % nstages != 0:
         msg = (f"interleaved pipeline schedule needs num_microbatches "

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+from .._compat import axis_index, axis_size
 import jax.numpy as jnp
 
 from ..ops.ring_attention import ring_attention, ulysses_attention
@@ -38,8 +39,8 @@ def scatter_to_sequence_parallel_region(x, axis_name: str = TENSOR_AXIS,
                                         seq_dim: int = 1):
     """Replicated (b, s, h) -> local sequence shard (b, s/P, h): each
     rank keeps its slice (the SP entry scatter)."""
-    rank = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    rank = axis_index(axis_name)
+    n = axis_size(axis_name)
     s = x.shape[seq_dim]
     assert s % n == 0, f"sequence {s} not divisible by axis size {n}"
     return jax.lax.dynamic_slice_in_dim(x, rank * (s // n), s // n,

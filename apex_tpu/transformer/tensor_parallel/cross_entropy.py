@@ -15,6 +15,7 @@ with the logits' last dim sharded over ``axis_name``.
 from __future__ import annotations
 
 import jax
+from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 
 from ...parallel_state import TENSOR_AXIS
@@ -37,8 +38,8 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
     unreduced loss as well, ref: cross_entropy.py:73-75).
     """
     logits = vocab_parallel_logits.astype(jnp.float32)
-    world = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    world = axis_size(axis_name)
+    rank = axis_index(axis_name)
     per_part = logits.shape[-1]
     vocab = per_part * world
 

@@ -27,6 +27,7 @@ from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
+from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -52,7 +53,7 @@ def _sliced_init(init: Initializer, axis_name: str, full_shape,
 
     def wrapped(key, shape, dtype):
         full = init(key, full_shape, dtype)
-        rank = jax.lax.axis_index(axis_name)
+        rank = axis_index(axis_name)
         chunk = shape[partition_dim]
         return jax.lax.dynamic_slice_in_dim(full, rank * chunk, chunk,
                                             axis=partition_dim)
@@ -105,7 +106,7 @@ class ColumnParallelLinear(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.axis_name is not None:
-            world = jax.lax.axis_size(self.axis_name)
+            world = axis_size(self.axis_name)
             local_out = divide(self.output_size, world)
             kernel = self.param(
                 "kernel",
@@ -164,7 +165,7 @@ class RowParallelLinear(nn.Module):
     @nn.compact
     def __call__(self, x):
         if self.axis_name is not None:
-            world = jax.lax.axis_size(self.axis_name)
+            world = axis_size(self.axis_name)
             local_in = divide(self.input_size, world)
             kernel = self.param(
                 "kernel",
@@ -217,7 +218,7 @@ class VocabParallelEmbedding(nn.Module):
 
     def setup(self):
         if self.axis_name is not None:
-            world = jax.lax.axis_size(self.axis_name)
+            world = axis_size(self.axis_name)
             per_part = divide(self.num_embeddings, world)
             self.embedding = self.param(
                 "embedding",
@@ -235,9 +236,9 @@ class VocabParallelEmbedding(nn.Module):
         if isinstance(table, nn.Partitioned):
             table = table.unbox()
         if self.axis_name is not None:
-            world = jax.lax.axis_size(self.axis_name)
+            world = axis_size(self.axis_name)
             per_part = divide(self.num_embeddings, world)
-            rank = jax.lax.axis_index(self.axis_name)
+            rank = axis_index(self.axis_name)
             first, _last = (
                 VocabUtility.vocab_range_from_per_partition_vocab_size(
                     per_part, rank, world))

@@ -29,6 +29,7 @@ all-gather-shaped collective on ICI.
 from __future__ import annotations
 
 import jax
+from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 
 from ...parallel_state import TENSOR_AXIS
@@ -52,12 +53,12 @@ def reduce_from_tensor_model_parallel_region(x,
 def scatter_to_tensor_model_parallel_region(x,
                                             axis_name: str = TENSOR_AXIS):
     """Keep this shard's chunk of the last dim (ref: mappings.py:109-122)."""
-    size = jax.lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     if x.shape[-1] % size != 0:
         raise ValueError(
             f"last dim {x.shape[-1]} not divisible by axis size {size}")
     chunk = x.shape[-1] // size
-    rank = jax.lax.axis_index(axis_name)
+    rank = axis_index(axis_name)
     return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk,
                                         axis=x.ndim - 1)
 
@@ -66,8 +67,8 @@ def gather_from_tensor_model_parallel_region(x,
                                              axis_name: str = TENSOR_AXIS):
     """All-gather along the last dim; every shard receives the full tensor
     (ref: mappings.py:125-138)."""
-    size = jax.lax.axis_size(axis_name)
-    rank = jax.lax.axis_index(axis_name)
+    size = axis_size(axis_name)
+    rank = axis_index(axis_name)
     chunk = x.shape[-1]
     full_shape = x.shape[:-1] + (chunk * size,)
     start = (0,) * (x.ndim - 1) + (rank * chunk,)

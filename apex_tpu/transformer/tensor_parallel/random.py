@@ -20,6 +20,7 @@ import contextlib
 from typing import Dict, Optional
 
 import jax
+from ..._compat import axis_index
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_policies as _policies
 
@@ -37,7 +38,7 @@ def model_parallel_rng_key(key: jax.Array,
     (the contract documented at ref: random.py:193-204)."""
     return jax.random.fold_in(
         jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET),
-        jax.lax.axis_index(axis_name))
+        axis_index(axis_name))
 
 
 class RNGStatesTracker:

@@ -326,7 +326,7 @@ class CheckpointManager:
                 # a good checkpoint in the OTHER master layout is not
                 # damage — never quarantine it, surface the real error
                 raise
-            except Exception as e:  # torn payload — fall back one step
+            except Exception as e:  # apex-lint: disable=APX202 -- deep-restore fallback: ANY torn-payload error must become a skip entry (recorded + ckpt_skipped event upstream), never a crash
                 skipped.append(
                     (s, f"restore failed: {type(e).__name__}: "
                         f"{str(e)[:200]}"))

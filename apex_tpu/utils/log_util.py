@@ -22,7 +22,7 @@ class RankInfoFormatter(logging.Formatter):
         from .. import parallel_state
         try:
             record.rank_info = parallel_state.get_rank_info()
-        except Exception:
+        except Exception:  # apex-lint: disable=APX202 -- a log formatter must never raise: it would turn every log call into the crash it reports
             record.rank_info = "(tp=?, pp=?, dp=?)"
         return super().format(record)
 

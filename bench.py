@@ -44,6 +44,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 
 from apex_tpu import amp, parallel_state
@@ -681,7 +682,7 @@ def bench_collective():
             x = jnp.ones((n_dev, n // n_dev), jnp.float32)
 
             def ar(x):
-                return jax.shard_map(
+                return shard_map(
                     lambda v: jax.lax.psum(v, "data"), mesh=mesh,
                     in_specs=P("data"), out_specs=P())(x)
 
@@ -815,7 +816,7 @@ def _zero_adam_at(count):
         p = _synthetic_params(count, jax.random.PRNGKey(5))
         g = jax.tree_util.tree_map(lambda x: x * 1e-3 + 1e-3, p)
         if sharded:
-            s = jax.shard_map(tx.init, mesh=mesh, in_specs=P(),
+            s = shard_map(tx.init, mesh=mesh, in_specs=P(),
                               out_specs=P(), check_vma=False)(p)
         else:
             s = tx.init(p)
@@ -836,7 +837,7 @@ def _zero_adam_at(count):
                 return (optax.apply_updates(p, u), s2), ()
             return jax.lax.scan(body, (p, s), None, length=K)[0]
 
-        inner = jax.shard_map(kbody, mesh=mesh,
+        inner = shard_map(kbody, mesh=mesh,
                               in_specs=(P(), P(), P()),
                               out_specs=P(), check_vma=False) \
             if sharded else kbody

@@ -48,6 +48,7 @@ def main(argv=None):
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu import amp
+    from apex_tpu._compat import shard_map
     from apex_tpu.optimizers import fused_sgd
     from apex_tpu.parallel import sync_gradients
 
@@ -107,7 +108,7 @@ def main(argv=None):
         return params, losses
 
     sharded = jax.jit(
-        jax.shard_map(run, mesh=mesh,
+        shard_map(run, mesh=mesh,
                       in_specs=(P(), P(), P("data"), P("data")),
                       out_specs=(P(), P())))
     params, losses = sharded(params, amp_state, x, y)

@@ -22,6 +22,7 @@ _flags.append("--xla_force_host_platform_device_count=4")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax
+from apex_tpu._compat import shard_map
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
@@ -72,7 +73,7 @@ def main(steps: int = 60) -> None:
                 embed_m, stage_m, head, ep, sp, hp, t, l,
                 num_microbatches=2, tensor_axis=TENSOR)
 
-        return jax.shard_map(f, mesh=mesh,
+        return shard_map(f, mesh=mesh,
                              in_specs=(espec, sspec, hspec, P(DATA),
                                        P(DATA)),
                              out_specs=P())(ep, sp, hp, t, l)
@@ -95,15 +96,17 @@ def main(steps: int = 60) -> None:
     # event log instead of just a missing CONVERGED line.  Off by
     # default: the per-step host fetch it needs serializes dispatch.
     monitor = None
-    jsonl = os.environ.get("APEX_TPU_MONITOR_JSONL")
+    from apex_tpu.analysis.flags import flag_float, flag_str
+
+    jsonl = flag_str("APEX_TPU_MONITOR_JSONL")
     if jsonl:
         from apex_tpu.monitor import JsonlSink, StepMonitor, Watchdog
 
         sink = JsonlSink(jsonl)
         monitor = StepMonitor(
             sink, tokens_per_step=4 * SEQ,
-            watchdog=Watchdog(sink, stall_timeout=float(
-                os.environ.get("APEX_TPU_MONITOR_STALL_S", "300"))),
+            watchdog=Watchdog(sink, stall_timeout=flag_float(
+                "APEX_TPU_MONITOR_STALL_S")),
             run_attrs={"driver": "_gpt_convergence_runner",
                        "tp": 2, "pp": 2, "steps": steps})
 

@@ -78,7 +78,9 @@ SLOW_NODEID_PATTERNS = (
 
 
 def pytest_collection_modifyitems(config, items):
-    if os.environ.get("APEX_TPU_FULL") == "1":
+    from apex_tpu.analysis.flags import flag_bool
+
+    if flag_bool("APEX_TPU_FULL"):
         return
     skip = pytest.mark.skip(
         reason="slow tier (set APEX_TPU_FULL=1 to run)")

@@ -7,6 +7,7 @@ Models the reference's L0 run_amp suite: opt-level property table
 (ref: tests/L0/run_amp/test_checkpointing.py).
 """
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -113,14 +114,14 @@ def test_all_finite_model_parallel_reduction():
         synced = amp.all_finite(gs, axis_names="tensor")
         return local[None], synced[None]
 
-    local, synced = jax.jit(jax.shard_map(
+    local, synced = jax.jit(shard_map(
         check, mesh=mesh, in_specs=P("tensor", None),
         out_specs=(P("tensor"), P("tensor"))))(jnp.asarray(g))
     # local flags diverge across shards; synced flags agree == False
     assert bool(np.asarray(local)[0]) and not bool(np.asarray(local)[1])
     assert not np.asarray(synced).any()
 
-    fin, syn = jax.jit(jax.shard_map(
+    fin, syn = jax.jit(shard_map(
         check, mesh=mesh, in_specs=P("tensor", None),
         out_specs=(P("tensor"), P("tensor"))))(jnp.zeros((8, 4)))
     assert np.asarray(fin).all() and np.asarray(syn).all()
@@ -145,7 +146,7 @@ def test_mp_scaler_every_rank_skips_and_backs_off_identically():
         return (new_p["w"], info.grads_finite[None],
                 new_st.scaler.loss_scale[None])
 
-    new_w, finite, scale = jax.jit(jax.shard_map(
+    new_w, finite, scale = jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P("tensor", None), P(), P("tensor", None)),
         out_specs=(P("tensor", None), P("tensor"), P("tensor")),

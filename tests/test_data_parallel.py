@@ -10,10 +10,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import parallel_state as ps
+from apex_tpu._compat import shard_map
 from apex_tpu.contrib.optimizers import (distributed_fused_adam,
                                          distributed_fused_lamb)
 from apex_tpu.optimizers import fused_adam, fused_lamb

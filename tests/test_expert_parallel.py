@@ -4,6 +4,7 @@ capacity contract must hold."""
 import functools
 
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -66,7 +67,7 @@ class TestExpertParallelMLP:
         mesh = expert_mesh()
         layer_ep = ExpertParallelMLP(H, F, E, capacity_factor=8.0)
 
-        y_ep = jax.jit(jax.shard_map(
+        y_ep = jax.jit(shard_map(
             lambda p, x: layer_ep.apply(p, x)[0], mesh=mesh,
             in_specs=({"router": P(), "wi": P("expert"),
                        "wo": P("expert")}, P("expert")),
@@ -87,7 +88,7 @@ class TestExpertParallelMLP:
                 return jax.lax.psum(jnp.sum(y ** 2) + 0.01 * aux,
                                     "expert")
 
-            return jax.shard_map(
+            return shard_map(
                 f, mesh=mesh,
                 in_specs=({"router": P(), "wi": P("expert"),
                            "wo": P("expert")}, P("expert")),
@@ -141,7 +142,7 @@ class TestDispatchCombineMultiExpertPerShard:
 
         mesh = expert_mesh()
         layer_ep = ExpertParallelMLP(H, F, e8, capacity_factor=16.0)
-        y_ep = jax.jit(jax.shard_map(
+        y_ep = jax.jit(shard_map(
             lambda p, x: layer_ep.apply(p, x)[0], mesh=mesh,
             in_specs=({"router": P(), "wi": P("expert"),
                        "wo": P("expert")}, P("expert")),
@@ -331,7 +332,7 @@ class TestTop2Router:
 
         # production topology (same as the top-1 test): tokens
         # data-sharded over the expert axis, experts weight-sharded
-        y_shard = jax.jit(jax.shard_map(
+        y_shard = jax.jit(shard_map(
             lambda p, x: layer_s.apply(p, x)[0], mesh=mesh,
             in_specs=({"router": P(), "wi": P("expert"),
                        "wo": P("expert")}, P("expert")),
@@ -516,7 +517,7 @@ class TestSecondPolicyRandom:
         # replication of the output through the dispatch/return
         # all_to_all pair is real but not statically inferable ->
         # check_vma=False
-        y_ep = jax.jit(jax.shard_map(
+        y_ep = jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=({"router": P(), "wi": P("expert"),
                        "wo": P("expert")}, P()),

@@ -211,3 +211,33 @@ def test_fused_dense_gelu_dense():
         + d2["bias"]
     np.testing.assert_allclose(np.asarray(y, np.float32), want,
                                rtol=5e-2, atol=5e-2)
+
+
+# --- kernel-parity anchors (apex_tpu.analysis.parity) -----------------------
+
+def test_causal_softmax_kernel_matches_registered_twin():
+    from apex_tpu.ops.scaled_softmax import _causal_softmax_xla
+
+    x = jax.random.normal(jax.random.PRNGKey(11), (2, 3, 48, 48))
+    got = scaled_upper_triang_masked_softmax(x, 1.7)
+    want = _causal_softmax_xla(x, 1.7)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    gk = jax.grad(lambda x: jnp.sum(
+        scaled_upper_triang_masked_softmax(x, 1.7) ** 2))(x)
+    gt = jax.grad(lambda x: jnp.sum(_causal_softmax_xla(x, 1.7) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gt),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_masked_softmax_kernel_matches_registered_twin():
+    from apex_tpu.ops.scaled_softmax import _masked_softmax_xla
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(12))
+    x = jax.random.normal(k1, (2, 3, 32, 40))
+    mask = jax.random.bernoulli(k2, 0.3, (2, 1, 32, 40))
+    got = scaled_masked_softmax(x, mask, 0.9)
+    want = _masked_softmax_xla(x, mask, 0.9)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)

@@ -712,3 +712,26 @@ class TestPackedCheckpoint:
         tree_bitwise(ref_state.master_params, state2.master_params)
         assert float(ref_state.scaler.loss_scale) == \
             float(state2.scaler.loss_scale)
+
+
+def test_norm_finite_pallas_matches_registered_twin():
+    """Kernel-parity anchor: grad_norm_finite's Pallas sweep against
+    the registered jnp twin _norm_finite_jnp, per buffer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops.fused_pipeline import (_norm_finite_jnp,
+                                             _norm_finite_pallas)
+
+    buf = jax.random.normal(jax.random.PRNGKey(3), (640,)) * 7
+    inv = jnp.float32(0.125)
+    s_j, f_j = _norm_finite_jnp(buf, inv)
+    s_p, f_p = _norm_finite_pallas(buf, inv, interpret=True)
+    np.testing.assert_allclose(float(s_p), float(s_j), rtol=1e-6)
+    assert bool(f_p) == bool(f_j) is True
+
+    bad = buf.at[17].set(jnp.inf)
+    s_j, f_j = _norm_finite_jnp(bad, inv)
+    s_p, f_p = _norm_finite_pallas(bad, inv, interpret=True)
+    assert bool(f_p) == bool(f_j) is False

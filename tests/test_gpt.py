@@ -8,6 +8,7 @@ train step must learn.
 """
 import os
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -83,7 +84,7 @@ class TestGPTTensorParallel:
         ref_logits = dense.apply(params, tokens)
 
         specs = boxed_specs(variables)
-        out = jax.shard_map(
+        out = shard_map(
             lambda p, t: manual.apply(p, t), mesh=mesh,
             in_specs=(specs, P()),
             out_specs=P(None, None, TENSOR))(params, tokens)
@@ -109,7 +110,7 @@ class TestGPTTensorParallel:
             def f(p, t, l):
                 logits = manual.apply(p, t)
                 return gpt_loss(logits, l, axis_name=TENSOR)
-            return jax.shard_map(f, mesh=mesh,
+            return shard_map(f, mesh=mesh,
                                  in_specs=(specs, P(), P()),
                                  out_specs=P())(params, tokens, labels)
 
@@ -167,7 +168,7 @@ class TestGPTPipelined:
                 embed_m, stage_m, head, ep, sp, hp, t, l,
                 num_microbatches=2, tensor_axis=TENSOR)
 
-        loss = jax.shard_map(
+        loss = shard_map(
             f, mesh=mesh,
             in_specs=(espec, sspec, hspec, P(DATA), P(DATA)),
             out_specs=P())(ep, sp, hp, tokens, labels)
@@ -198,7 +199,7 @@ class TestGPTPipelined:
                 return gpt_forward_pipelined(
                     embed_m, stage_m, head, ep, sp, hp, t, l,
                     num_microbatches=2, tensor_axis=TENSOR)
-            return jax.shard_map(
+            return shard_map(
                 f, mesh=mesh,
                 in_specs=(espec, sspec, hspec, P(DATA), P(DATA)),
                 out_specs=P())(ep, sp, hp, t, l)

@@ -60,7 +60,9 @@ def _npz(tmp_path):
 # axis value at least once while keeping suite time bounded;
 # APEX_TPU_L1_FULL=1 runs the reference's full matrix (skipping only
 # combinations amp.initialize itself rejects).
-if os.environ.get("APEX_TPU_L1_FULL") == "1":
+from apex_tpu.analysis.flags import flag_bool
+
+if flag_bool("APEX_TPU_L1_FULL"):
     COMBOS = [
         (o, s, b)
         for o in ("O0", "O1", "O2", "O3")

@@ -91,3 +91,32 @@ def test_module_multidim_normalized_shape():
     # rows normalized over the flattened (8,16) trailing dims
     np.testing.assert_allclose(
         np.asarray(jnp.mean(y.reshape(3, 4, -1), -1)), 0.0, atol=1e-5)
+
+
+def test_kernel_matches_registered_twin():
+    """Kernel-parity anchor (apex_tpu.analysis.parity): the Pallas
+    layer_norm against its registered jnp twin _layer_norm_reference,
+    forward and gradients."""
+    from apex_tpu.ops.layer_norm import _layer_norm_reference
+
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    x = jax.random.normal(k1, (4, 9, 256)) * 2 + 0.5
+    g = jax.random.normal(k2, (256,))
+    b = jax.random.normal(k3, (256,))
+
+    got = layer_norm(x, g, b)
+    want = _layer_norm_reference(x, g, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_k(x, g, b):
+        return jnp.sum(layer_norm(x, g, b) ** 2)
+
+    def loss_t(x, g, b):
+        return jnp.sum(_layer_norm_reference(x, g, b, 1e-5) ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, g, b)
+    gt = jax.grad(loss_t, argnums=(0, 1, 2))(x, g, b)
+    for a, w in zip(gk, gt):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)

@@ -617,3 +617,39 @@ def optax_apply(p, u):
 
     return optax.apply_updates(p, u)
 
+
+
+def test_adam_kernel_matches_registered_twin():
+    """Kernel-parity anchor: the Pallas adam_update (interpret mode)
+    against the registered per-leaf jnp twin _adam_jnp."""
+    import numpy as np
+
+    from apex_tpu.optimizers.fused_adam import _adam_jnp
+    from apex_tpu.ops import fused_optim
+
+    k = jax.random.PRNGKey(5)
+    kg, kp, km, kv = jax.random.split(k, 4)
+    g = jax.random.normal(kg, (384,))
+    p = jax.random.normal(kp, (384,))
+    m = jax.random.normal(km, (384,)) * 0.1
+    v = jax.random.uniform(kv, (384,)) * 0.01
+    hp = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              weight_decay=0.01, bias_correction1=0.9,
+              bias_correction2=0.999)
+
+    (gb, pb, mb, vb), restore = fused_optim.flatten_for_kernel(g, p, m, v)
+    d_k, m_k, v_k = fused_optim.adam_update(
+        gb, pb, mb, vb, adam_w_mode=True, interpret=True, **hp)
+    d_k, m_k, v_k = restore(d_k), restore(m_k), restore(v_k)
+
+    d_j, m_j, v_j = _adam_jnp(g, p, m, v, hp["lr"], hp["beta1"],
+                              hp["beta2"], hp["eps"],
+                              hp["weight_decay"],
+                              hp["bias_correction1"],
+                              hp["bias_correction2"], True)
+    np.testing.assert_allclose(np.asarray(d_k), np.asarray(d_j),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_j),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(v_k), np.asarray(v_j),
+                               rtol=1e-6, atol=1e-7)

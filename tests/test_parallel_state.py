@@ -5,10 +5,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu import parallel_state as ps
+from apex_tpu._compat import shard_map
 
 
 def test_initialize_factorization():

@@ -7,6 +7,7 @@ sequential single-device execution of the same stacked layers, forward
 and backward.
 """
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -62,7 +63,7 @@ class TestPipelineForward:
         def run(params, xs):
             return pp.pipeline_forward(stage_fn, params, xs)
 
-        out = jax.shard_map(run, mesh=mesh,
+        out = shard_map(run, mesh=mesh,
                             in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
                             out_specs=P())(params, xs)
         ref = jax.vmap(lambda x: sequential_ref(params, x, 4))(xs)
@@ -83,7 +84,7 @@ class TestPipelineForward:
         def run(params, xs):
             return pp.pipeline_forward(tree_stage, params, xs)
 
-        out = jax.shard_map(run, mesh=mesh,
+        out = shard_map(run, mesh=mesh,
                             in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
                             out_specs=P())(params, xs)
         ref = jax.vmap(lambda x: sequential_ref(params, x, 2))(xs["h"])
@@ -102,7 +103,7 @@ class TestPipelineForward:
             return pp.pipeline_forward(bad_stage, params, xs)
 
         with pytest.raises(ValueError, match="preserve activation shape"):
-            jax.shard_map(run, mesh=mesh,
+            shard_map(run, mesh=mesh,
                           in_specs=({"w": P(PIPE), "b": P(PIPE)}, P()),
                           out_specs=P())(params, xs)
 
@@ -129,7 +130,7 @@ class TestSchedules:
             return pp.forward_backward_pipelining_without_interleaving(
                 stage_fn, loss_fn, params, xs)
 
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             run, mesh=mesh,
             in_specs=({"w": P(PIPE), "b": P(PIPE)}, P(), P()),
             out_specs=(P(), {"w": P(PIPE), "b": P(PIPE)}))(params, xs, ys)
@@ -158,7 +159,7 @@ class TestSchedules:
             assert grads is None
             return loss
 
-        loss = jax.shard_map(
+        loss = shard_map(
             run, mesh=mesh,
             in_specs=({"w": P(PIPE), "b": P(PIPE)}, P(), P()),
             out_specs=P())(params, xs, ys)
@@ -183,7 +184,7 @@ class TestSchedules:
             return pp.forward_backward_pipelining_with_interleaving(
                 stage_fn, loss_fn, vparams, xs)
 
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             run, mesh=mesh,
             in_specs=({"w": P(None, PIPE), "b": P(None, PIPE)}, P(), P()),
             out_specs=(P(), {"w": P(None, PIPE), "b": P(None, PIPE)}))(
@@ -221,7 +222,7 @@ class TestSchedules:
                     return jnp.mean((out_mb - y) ** 2)
                 return pp.forward_backward_pipelining_with_interleaving(
                     stage_fn, loss_fn, vparams, xs, strict=strict)
-            return jax.shard_map(
+            return shard_map(
                 go, mesh=mesh,
                 in_specs=({"w": P(None, PIPE), "b": P(None, PIPE)},
                           P(), P()),
@@ -290,7 +291,7 @@ class TestP2P:
                 jnp.full((2,), r + 1.0))
             return got[None]
 
-        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+        out = shard_map(f, mesh=mesh, in_specs=P(),
                             out_specs=P(PIPE))(jnp.zeros((4,)))
         # stage 0 receives zeros; stage k receives k (value k-1+1)
         np.testing.assert_allclose(np.asarray(out)[:, 0], [0., 1., 2., 3.])
@@ -304,7 +305,7 @@ class TestP2P:
                 jnp.full((2,), r + 1.0))
             return got[None]
 
-        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+        out = shard_map(f, mesh=mesh, in_specs=P(),
                             out_specs=P(PIPE))(jnp.zeros((4,)))
         # last stage receives zeros; stage k receives k+2
         np.testing.assert_allclose(np.asarray(out)[:, 0], [2., 3., 4., 0.])
@@ -318,7 +319,7 @@ class TestP2P:
                 jnp.full((1,), r + 1.0), jnp.full((1,), r + 10.0))
             return jnp.stack([fwd, bwd])[None]
 
-        out = jax.shard_map(f, mesh=mesh, in_specs=P(),
+        out = shard_map(f, mesh=mesh, in_specs=P(),
                             out_specs=P(PIPE))(jnp.zeros((2,)))
         arr = np.asarray(out)
         np.testing.assert_allclose(arr[0, :, 0], [0., 11.])  # stage 0

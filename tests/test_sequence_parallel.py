@@ -6,6 +6,7 @@ allreduce.
 import functools
 
 import jax
+from apex_tpu._compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -44,7 +45,7 @@ def _dense(q, k, v, causal):
 
 def _run_sharded(fn, q, k, v, mesh):
     spec = P(None, None, "sequence", None)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec))(q, k, v)
 
@@ -129,7 +130,7 @@ class TestSPRegionMappings:
             # the out_specs reconstruct the global tensor
             return scatter_to_sequence_parallel_region(full, "sequence")
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh, in_specs=P(),
             out_specs=P(None, "sequence", None)))(x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(x),
@@ -147,7 +148,7 @@ class TestSPRegionMappings:
             full = gather_from_sequence_parallel_region(part, "sequence")
             return full - jax.lax.psum(xl, "sequence")
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f, mesh=mesh, in_specs=P("sequence"),
             out_specs=P("sequence")))(x)
         np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-5)
@@ -195,7 +196,7 @@ class TestSequenceParallelSelfAttention:
 
         y_ref = dense.apply(params, x)
         spec = P(None, "sequence", None)
-        y = jax.jit(jax.shard_map(
+        y = jax.jit(shard_map(
             lambda p, x: attn.apply(p, x), mesh=mesh,
             in_specs=(P(), spec), out_specs=spec))(params, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
@@ -218,7 +219,7 @@ class TestSequenceParallelSelfAttention:
             def f(p, x, t):
                 y = attn.apply(p, x)
                 return jax.lax.psum(jnp.sum((y - t) ** 2), "sequence")
-            return jax.shard_map(f, mesh=mesh,
+            return shard_map(f, mesh=mesh,
                                  in_specs=(P(), spec, spec),
                                  out_specs=P())(p, x, target) / x.size
 
@@ -302,7 +303,7 @@ class TestSequenceParallelGPTEndToEnd:
                 return jax.lax.pmean(
                     jnp.mean(self._token_losses(logits, l)), "sequence")
             spec = P(None, "sequence")
-            return jax.shard_map(f, mesh=mesh,
+            return shard_map(f, mesh=mesh,
                                  in_specs=(P(), spec, spec),
                                  out_specs=P())(p, tokens, labels)
 
@@ -347,7 +348,7 @@ class TestSequenceParallelGPTEndToEnd:
                 return jax.lax.pmean(
                     jnp.mean(self._token_losses(logits, l)), "sequence")
             spec = P(None, "sequence")
-            return jax.shard_map(f, mesh=mesh,
+            return shard_map(f, mesh=mesh,
                                  in_specs=(P(), spec, spec),
                                  out_specs=P())(p, tokens, labels)
 
@@ -369,7 +370,7 @@ def _run_sharded_novma(fn, q, k, v, mesh):
     """check_vma=False variant: the legality condition for Pallas cores
     inside shard_map (interpret mode on the CPU mesh)."""
     spec = P(None, None, "sequence", None)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False))(q, k, v)
 
@@ -529,7 +530,7 @@ class TestAutoFlash:
         orig = fa.flash_attention_partial
         fa.flash_attention_partial = spy
         try:
-            out = jax.jit(jax.shard_map(
+            out = jax.jit(shard_map(
                 lambda q, k, v: ra.ring_attention(q, k, v, "sequence",
                                                   causal=True),
                 mesh=mesh, in_specs=(P(None, None, "sequence"),) * 3,
@@ -629,7 +630,7 @@ class TestSPDropout:
 
         mesh = seq_mesh()
         q, k, v = _qkv(10)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             lambda q, k, v: ra.ring_attention(
                 q, k, v, "sequence", causal=True,
                 dropout_rate=self.RATE, dropout_seed=self.SEED),
@@ -651,7 +652,7 @@ class TestSPDropout:
         q, k, v = _qkv(13)
 
         def ring_loss(q, k, v):
-            out = jax.jit(jax.shard_map(
+            out = jax.jit(shard_map(
                 lambda q, k, v: ra.ring_attention(
                     q, k, v, "sequence", causal=True,
                     dropout_rate=self.RATE, dropout_seed=self.SEED),

@@ -8,6 +8,7 @@ backward.
 import functools
 
 import jax
+from apex_tpu._compat import set_mesh, shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -25,7 +26,7 @@ def tp_mesh(tp_size=4):
 
 
 def smap(fn, mesh, in_specs, out_specs, **kw):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_specs, **kw)
 
 
@@ -221,7 +222,7 @@ class TestGSPMDLayers:
             h = jax.nn.relu(h)
             return row.apply({"params": rp}, h)
 
-        with jax.set_mesh(mesh):
+        with set_mesh(mesh):
             out = f(cp, rp, x)
         ref = jax.nn.relu(x @ cp["kernel"] + cp["bias"]) @ rp["kernel"] \
             + rp["bias"]
