@@ -111,6 +111,14 @@ class AmpOptimizer:
                 f"{getattr(tx, 'pipeline_step', None)}")
         self.use_pipeline = capable and _pipeline.pipeline_enabled(
             pipeline)
+        # An explicit pipeline=True is a hard routing request (bench
+        # pipeline-vs-staged comparisons depend on it); the auto
+        # decision additionally applies the packed-size cutoff at
+        # init() time, when the tree is first seen (the 0.73x
+        # small-tree residue: below APEX_TPU_PIPELINE_PACK_MIN_BYTES
+        # of packed model bytes, direct per-leaf staged updates
+        # measured faster than the persistent pack).
+        self._pipeline_explicit = pipeline is True
         # Model-parallel axes to reduce the found-inf flag over, so every
         # shard takes the same skip-vs-step branch (ref:
         # apex/transformer/amp/grad_scaler.py:25-36).  Only meaningful
@@ -133,7 +141,7 @@ class AmpOptimizer:
         from them (the reference likewise clones masters from the fp32
         model before it is cast, ref: apex/amp/_process_optimizer.py:28-44).
         """
-        if self.use_pipeline:
+        if self._route_pipeline(params):
             # Persistent packed mode: the master "tree" is a
             # PackedMasters (flat fp32 buffers + static layout), the
             # inner state packs into the same layout.  The layout is
@@ -158,6 +166,30 @@ class AmpOptimizer:
                 for _ in range(self.num_losses)
             ),
         )
+
+    def _route_pipeline(self, params: Any) -> bool:
+        """The init-time pipeline routing decision for this tree.
+        Explicit ``pipeline=True`` always packs; the auto decision
+        routes trees below ``APEX_TPU_PIPELINE_PACK_MIN_BYTES`` of
+        packed model bytes to the direct per-leaf staged path — the
+        regime where the persistent pack measured 0.73x vs direct
+        (ROADMAP item 4; the flag table in docs/api/ops.md has the
+        cutoff's provenance)."""
+        if not self.use_pipeline:
+            return False
+        if self._pipeline_explicit:
+            return True
+        from ..analysis.flags import flag_int
+
+        cutoff = flag_int("APEX_TPU_PIPELINE_PACK_MIN_BYTES")
+        if cutoff <= 0:
+            return True
+        # shapes/dtypes only: eval_shape keeps the probe off-device (a
+        # real cast here would allocate a full low-precision model
+        # copy just to read its byte total)
+        model_template = jax.eval_shape(
+            lambda p: _cast.cast_params(p, self.policy), params)
+        return _pipeline.packed_nbytes(model_template) >= cutoff
 
     # -- per-iteration hooks ------------------------------------------------
 
@@ -184,7 +216,11 @@ class AmpOptimizer:
         to explicitly disable the reduction for this call (e.g. when
         stepping the same optimizer outside shard_map).
         """
-        if self.use_pipeline:
+        # Dispatch on the STATE's layout, not the constructor flag:
+        # the auto pipeline decision is per-tree (init() applies the
+        # packed-size cutoff), and a checkpoint-restored state must
+        # step the way it was built.
+        if isinstance(state.master_params, _pipeline.PackedMasters):
             return self._apply_gradients_pipeline(
                 scaled_grads, state, params, loss_id, axis_names)
         scaler = state.scalers[loss_id]

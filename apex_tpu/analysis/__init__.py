@@ -4,7 +4,8 @@ The TPU-native counterpart of the reference repo's build/test matrix
 (ref: tests/docker_extension_builds): instead of linting CUDA builds,
 lint the *tracing* discipline the whole framework depends on.
 
-Four pieces:
+Five pieces (rules registered centrally in :mod:`.rules`, docs table
+generated from it):
 
 * :mod:`.flags` — the central registry of every ``APEX_TPU_*``
   environment flag (name, type, default, doc) with typed accessors.
@@ -15,11 +16,17 @@ Four pieces:
   docs/api/analysis.md).
 * :mod:`.parity` — kernel-parity audit: every ``pallas_call`` site in
   ``ops/`` must name a registered jnp twin and a test referencing both.
+* :mod:`.hlo` — compiled-graph auditor over the lowered jaxprs /
+  StableHLO of every registered entry point
+  (:mod:`apex_tpu.testing.entry_points`): missed donations, silent
+  dtype promotions, the collective census and a peak-live-memory
+  estimate diffed against ``tools/hlo_baseline.json``.
 * :mod:`.sanitizer` — runtime ``sanitize()`` context: JAX transfer
   guard plus a per-step recompile budget driven by ``jax_log_compiles``.
 
-CLI: ``python -m apex_tpu.analysis --check`` (self-hosted in
-tools/ci.sh step 7; see ``--help`` for the rest).
+CLI: ``python -m apex_tpu.analysis --check`` / ``--check-hlo``
+(self-hosted in tools/ci.sh steps 7 and 8; see ``--help`` for the
+rest).
 """
 # flags is the one submodule production code imports at module scope
 # (ops/amp/monitor read the registry on import); keep this package
@@ -34,6 +41,10 @@ _LAZY = {
     "audit_kernel_parity": "parity",
     "RecompileBudgetExceeded": "sanitizer", "Sanitizer": "sanitizer",
     "sanitize": "sanitizer", "sanitize_smoke": "sanitizer",
+    "RULES": "rules", "Rule": "rules", "render_rule_table": "rules",
+    "EntryAudit": "hlo", "audit_entry_points": "hlo",
+    "run_hlo_check": "hlo", "peak_live_bytes": "hlo",
+    "write_hlo_baseline": "hlo",
 }
 
 __all__ = [

@@ -1,10 +1,13 @@
 """CLI for apex_tpu.analysis — the repo's self-hosted static pass.
 
     python -m apex_tpu.analysis --check          # lint + parity vs baseline
+    python -m apex_tpu.analysis --check-hlo      # compiled-graph audit
     python -m apex_tpu.analysis --update-baseline
+    python -m apex_tpu.analysis --update-hlo-baseline
     python -m apex_tpu.analysis --flag-table     # print the env-flag table
-    python -m apex_tpu.analysis --check-docs     # docs flag-table drift guard
-    python -m apex_tpu.analysis --write-docs     # regenerate the docs table
+    python -m apex_tpu.analysis --rule-table     # print the APX rule table
+    python -m apex_tpu.analysis --check-docs     # docs table drift guard
+    python -m apex_tpu.analysis --write-docs     # regenerate the docs tables
     python -m apex_tpu.analysis --smoke          # sanitizer smoke (GPT step)
 
 Exit status: 0 = clean, 1 = findings / drift / recompiles.
@@ -19,22 +22,35 @@ from pathlib import Path
 
 from .flags import render_flag_table
 from .linter import DEFAULT_BASELINE, run_check, write_baseline, lint_paths
+from .rules import render_rule_table
 
-_TABLE_BEGIN = "<!-- apex-flag-table:begin (generated: python -m apex_tpu.analysis --write-docs) -->"
-_TABLE_END = "<!-- apex-flag-table:end -->"
-DOCS_WITH_TABLE = "docs/api/ops.md"
+# Every generated docs table: (file, begin marker, end marker, render).
+# --write-docs regenerates all of them in place; --check-docs fails on
+# any drift.
+_GEN = "(generated: python -m apex_tpu.analysis --write-docs)"
+DOCS_TABLES = (
+    ("docs/api/ops.md",
+     f"<!-- apex-flag-table:begin {_GEN} -->",
+     "<!-- apex-flag-table:end -->",
+     render_flag_table),
+    ("docs/api/analysis.md",
+     f"<!-- apex-rule-table:begin {_GEN} -->",
+     "<!-- apex-rule-table:end -->",
+     render_rule_table),
+)
 
 
-def _docs_block(repo_root: str) -> tuple[Path, str, int, int]:
-    p = Path(repo_root) / DOCS_WITH_TABLE
+def _docs_block(repo_root: str, doc: str, begin: str,
+                end: str) -> tuple[Path, str, int, int]:
+    p = Path(repo_root) / doc
     text = p.read_text()
     try:
-        a = text.index(_TABLE_BEGIN) + len(_TABLE_BEGIN)
-        b = text.index(_TABLE_END)
+        a = text.index(begin) + len(begin)
+        b = text.index(end)
     except ValueError:
         raise SystemExit(
-            f"{DOCS_WITH_TABLE} is missing the flag-table markers "
-            f"({_TABLE_BEGIN!r} ... {_TABLE_END!r})")
+            f"{doc} is missing the table markers "
+            f"({begin!r} ... {end!r})")
     return p, text, a, b
 
 
@@ -49,13 +65,29 @@ def main(argv=None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to accept all current "
                          "findings")
+    ap.add_argument("--check-hlo", action="store_true",
+                    help="compiled-graph audit: lower every registered "
+                         "entry point and check donation, dtype "
+                         "promotion, the collective census, host "
+                         "transfers, and peak live memory against "
+                         "tools/hlo_baseline.json")
+    ap.add_argument("--update-hlo-baseline", action="store_true",
+                    help="rewrite tools/hlo_baseline.json from the "
+                         "current lowerings (censuses + memory only; "
+                         "APX601/602/604 findings must still be fixed "
+                         "or suppressed)")
+    ap.add_argument("--entry", action="append", default=None,
+                    help="restrict --check-hlo/--update-hlo-baseline "
+                         "to this entry point (repeatable)")
     ap.add_argument("--flag-table", action="store_true",
                     help="print the generated env-flag markdown table")
+    ap.add_argument("--rule-table", action="store_true",
+                    help="print the generated APX rule markdown table")
     ap.add_argument("--check-docs", action="store_true",
-                    help="fail if the docs flag table drifted from the "
-                         "registry")
+                    help="fail if any generated docs table drifted "
+                         "from its registry")
     ap.add_argument("--write-docs", action="store_true",
-                    help="regenerate the docs flag table in place")
+                    help="regenerate the docs tables in place")
     ap.add_argument("--smoke", action="store_true",
                     help="run the sanitizer smoke: the standalone-GPT "
                          "step must compile exactly once after warmup")
@@ -71,26 +103,75 @@ def main(argv=None) -> int:
         print(render_flag_table())
         return 0
 
+    if args.rule_table:
+        print(render_rule_table())
+        return 0
+
     if args.check_docs or args.write_docs:
-        p, text, a, b = _docs_block(args.root)
-        want = "\n" + render_flag_table() + "\n"
-        have = text[a:b]
-        if args.write_docs:
-            if have != want:
-                p.write_text(text[:a] + want + text[b:])
-                print(f"[analysis] {DOCS_WITH_TABLE} flag table updated")
+        rc = 0
+        for doc, begin, end, render in DOCS_TABLES:
+            p, text, a, b = _docs_block(args.root, doc, begin, end)
+            want = "\n" + render() + "\n"
+            have = text[a:b]
+            if args.write_docs:
+                if have != want:
+                    p.write_text(text[:a] + want + text[b:])
+                    print(f"[analysis] {doc} table updated")
+                else:
+                    print(f"[analysis] {doc} table already current")
+            elif have != want:
+                print(f"[analysis] FAIL: {doc} table drifted from the "
+                      f"registry — run 'python -m apex_tpu.analysis "
+                      f"--write-docs'", file=sys.stderr)
+                rc = 1
             else:
-                print(f"[analysis] {DOCS_WITH_TABLE} flag table already "
-                      f"current")
+                print(f"[analysis] {doc} table matches the registry")
+        return rc
+
+    if args.check_hlo or args.update_hlo_baseline:
+        from ..testing.entry_points import ENTRY_POINTS
+        from .hlo import (audit_entry_points, run_hlo_check,
+                          write_hlo_baseline)
+
+        if args.entry:
+            # a typo'd name must not produce a do-nothing audit that
+            # exits 0 claiming "hlo clean" (same guard bench.py gives
+            # --sections)
+            unknown = sorted(set(args.entry) - set(ENTRY_POINTS))
+            if unknown:
+                ap.error(f"unknown entry point(s) {unknown}; "
+                         f"registered: {sorted(ENTRY_POINTS)}")
+        if args.update_hlo_baseline:
+            audits = audit_entry_points(args.root, names=args.entry)
+            leftover = [f for a in audits.values() for f in a.findings]
+            write_hlo_baseline(audits, repo_root=args.root)
+            print(f"[analysis] hlo baseline rewritten: "
+                  f"{len(audits)} entry point(s)")
+            for f in leftover:
+                print(f"[analysis] note: unbaselined finding remains "
+                      f"(fix or suppress): {f.render()}",
+                      file=sys.stderr)
             return 0
-        if have != want:
-            print(f"[analysis] FAIL: {DOCS_WITH_TABLE} flag table "
-                  f"drifted from the registry — run "
-                  f"'python -m apex_tpu.analysis --write-docs'",
+        unsuppressed, stale, audits = run_hlo_check(args.root,
+                                                    names=args.entry)
+        for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
+            if args.json:
+                print(json.dumps(dataclasses.asdict(f)))
+            else:
+                print(f.render())
+        for k in sorted(stale):
+            print(f"[analysis] stale hlo suppression (finding no "
+                  f"longer fires — delete the line): {k}",
+                  file=sys.stderr)
+        if unsuppressed or stale:
+            print(f"[analysis] FAIL: {len(unsuppressed)} unsuppressed "
+                  f"hlo finding(s), {len(stale)} stale suppression(s)",
                   file=sys.stderr)
             return 1
-        print(f"[analysis] {DOCS_WITH_TABLE} flag table matches the "
-              f"registry")
+        ncoll = sum(len(a.collectives) for a in audits.values())
+        print(f"[analysis] hlo clean: {len(audits)} entry point(s) "
+              f"audited, {ncoll} collective op(s) match the census, "
+              f"0 unsuppressed findings")
         return 0
 
     if args.smoke:

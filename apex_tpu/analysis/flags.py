@@ -189,6 +189,14 @@ register_flag(
     "instead of the jnp twins (auto stays jnp per the measured "
     "880-vs-190 GB/s elementwise-stream gap).")
 register_flag(
+    "APEX_TPU_PIPELINE_PACK_MIN_BYTES", "int", 1 << 27,
+    "Packed-size cutoff (bytes of the model-dtype tree) below which "
+    "the auto pipeline decision (AmpOptimizer(pipeline=None)) routes "
+    "to direct per-leaf staged updates instead of the persistent "
+    "packed pipeline — the measured 0.73x small-tree packing residue "
+    "regime.  Explicit pipeline=True bypasses the cutoff; 0 packs "
+    "every tree.", lo=0)
+register_flag(
     "APEX_TPU_STEP_PALLAS_MIN", "int", 0,
     "Element-count floor above which single-pass STEP optimizer work "
     "(adam_step/sgd_step) dispatches the Pallas kernels; 0 keeps the "
@@ -237,3 +245,8 @@ register_flag(
     "APEX_TPU_L1_FULL", "bool", False,
     "Run the full L1 amp x optimizer cross-product grid instead of "
     "the CI slice.")
+register_flag(
+    "APEX_TPU_BENCH_GATE", "bool", False,
+    "tools/ci.sh step 8: also run `bench.py --quick` and gate the "
+    "fresh artifact with tools/bench_gate.py (for bench hosts; the "
+    "gate's self-test runs in CI regardless).")

@@ -1,0 +1,141 @@
+"""Central registry of every APX analysis rule.
+
+One declaration per rule — id, analysis layer, scope, one-line
+description — mirroring the flag registry's design: the rule table in
+docs/api/analysis.md is GENERATED from this module
+(``python -m apex_tpu.analysis --write-docs``) and drift-guarded in CI,
+so the docs can never describe a rule the code doesn't implement (or
+miss one it does).
+
+Layers:
+
+* ``source`` — the AST trace-safety linter (:mod:`.linter`): sees
+  Python source only, never imports or lowers anything.
+* ``kernel`` — the pallas/jnp parity audit (:mod:`.parity`).
+* ``compiled`` — the jaxpr/StableHLO auditor (:mod:`.hlo`): sees what
+  XLA was actually handed for the registered entry points
+  (:mod:`apex_tpu.testing.entry_points`), which source-level review
+  cannot (missed donations, promotion converts the tracer inserted,
+  collectives emitted by transpositions).
+
+Import-light on purpose (stdlib only), like :mod:`.flags`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+__all__ = ["Rule", "RULES", "register_rule", "render_rule_table"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One analysis rule: the registry row the docs render."""
+
+    id: str          # 'APX601'
+    layer: str       # 'source' | 'kernel' | 'compiled'
+    scope: str       # where it applies, for the docs table
+    doc: str         # one-line description
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(id: str, layer: str, scope: str, doc: str) -> Rule:
+    if layer not in ("source", "kernel", "compiled"):
+        raise ValueError(f"unknown rule layer {layer!r}")
+    if id in RULES:
+        raise ValueError(f"duplicate rule registration: {id}")
+    rule = Rule(id=id, layer=layer, scope=scope, doc=doc)
+    RULES[id] = rule
+    return rule
+
+
+def render_rule_table() -> str:
+    """Markdown table of the registry, stable (id) ordering — embedded
+    in docs/api/analysis.md between the rule-table markers and
+    drift-guarded by ci.sh."""
+    lines = ["| rule | layer | scope | fires on |",
+             "|---|---|---|---|"]
+    for rid in sorted(RULES):
+        r = RULES[rid]
+        lines.append(f"| `{r.id}` | {r.layer} | {r.scope} | {r.doc} |")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The registry.  Every rule any apex_tpu.analysis pass can emit.
+# ---------------------------------------------------------------------------
+
+register_rule(
+    "APX000", "source", "everywhere",
+    "file fails to parse (the linter cannot vouch for code it cannot "
+    "read)")
+register_rule(
+    "APX101", "source", "traced regions",
+    "host-sync call on a traced value: `float()` / `int()` / `bool()` "
+    "/ `.item()` / `.tolist()` / `np.asarray` / `np.array` / "
+    "`jax.device_get`")
+register_rule(
+    "APX102", "source", "traced regions",
+    "Python truthiness on a traced value in `if` / `while` / `assert` "
+    "tests, including `not`/`and`/`or` within them (identity tests "
+    "`is None` are exempt — they are static)")
+register_rule(
+    "APX103", "source", "traced regions",
+    "`os.environ` / `os.getenv` read — the value is baked into the "
+    "trace (stale flag) and a new value means a silent recompile")
+register_rule(
+    "APX201", "source", "everywhere", "bare `except:`")
+register_rule(
+    "APX202", "source", "everywhere",
+    "broad `except Exception/BaseException` that neither re-raises "
+    "nor logs through a logger method")
+register_rule(
+    "APX301", "source", "everywhere",
+    "env read outside `apex_tpu/analysis/flags.py` — route "
+    "`APEX_TPU_*` flags through the registry")
+register_rule(
+    "APX401", "kernel", "`ops/`",
+    "`pallas_call` site without a registered jnp twin, or a registered "
+    "twin that does not exist")
+register_rule(
+    "APX402", "kernel", "`ops/`",
+    "kernel/twin pair with no test referencing both symbols")
+register_rule(
+    "APX501", "source", "everywhere",
+    "direct `jax.shard_map` / `from jax.experimental.shard_map import "
+    "...` — use `apex_tpu._compat.shard_map` (old jax spells it "
+    "differently; the shim also pins the grad-correct `check_rep` "
+    "mapping)")
+register_rule(
+    "APX601", "compiled", "entry points",
+    "missed donation: a jit input whose shape/dtype matches an output, "
+    "declared dead after the call by the entry registry, but carrying "
+    "no `tf.aliasing_output` in the lowered module — the buffer is "
+    "copied instead of reused (masters/optimizer state must be "
+    "donated end-to-end)")
+register_rule(
+    "APX602", "compiled", "entry points (O4/O5 policy)",
+    "silent dtype promotion: a `convert_element_type` bf16/f16 → f32 "
+    "the precision policy did not ask for (provenance outside the "
+    "entry's sanctioned-fp32 region list)")
+register_rule(
+    "APX603", "compiled", "entry points",
+    "collective census drift vs tools/hlo_baseline.json: a new "
+    "collective kind, more collective ops, or a >10% growth in bytes "
+    "moved per step (shrinks fail too — refresh the baseline so the "
+    "gate stays tight)")
+register_rule(
+    "APX604", "compiled", "entry points",
+    "host transfer compiled into the graph: callback / infeed / "
+    "outfeed ops XLA will service from the host every step — the "
+    "runtime transfer-guard can only catch these after deployment")
+register_rule(
+    "APX605", "compiled", "entry points",
+    "peak-live-memory estimate drift: buffer liveness over the "
+    "lowered jaxpr exceeds the committed baseline by >10% (shrinks "
+    "fail too — refresh the baseline)")
+register_rule(
+    "APX900", "source", "everywhere",
+    "suppression comment without a reason")

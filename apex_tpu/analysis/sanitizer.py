@@ -168,42 +168,17 @@ def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
     proof that the train step compiles exactly once after warmup.
 
     Returns the number of post-warmup recompiles (0 on success);
-    raises :class:`RecompileBudgetExceeded` on any.  Mirrors
-    ``testing.standalone_gpt.train_smoke``'s model/step construction
-    but owns the loop so the step boundary is explicit.
+    raises :class:`RecompileBudgetExceeded` on any.  The model and
+    step come from the SAME construction path the train-smoke loop and
+    the hlo auditor use (``testing.standalone_gpt.make_smoke_setup`` /
+    ``build_train_step`` — the shared entry-point list), so this smoke
+    proves the exact step CI audits.
     """
-    import jax
-    import jax.numpy as jnp
+    from ..testing.standalone_gpt import build_train_step, make_smoke_setup
 
-    from .. import amp
-    from ..optimizers import fused_adam
-    from ..testing.standalone_gpt import GPTModel, gpt_loss
-
-    vocab, hidden, heads, layers, batch, seq = 64, 32, 4, 2, 4, 16
-    model = GPTModel(
-        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
-        num_attention_heads=heads, max_sequence_length=seq,
-        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
-        dtype=jnp.float32)
-    key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(jax.random.fold_in(key, 1),
-                                (batch, seq), 0, vocab)
-    labels = jnp.roll(tokens, -1, -1)
-    variables = jax.jit(model.init)(key, tokens)
-    params, amp_opt, amp_state = amp.initialize(
-        variables["params"], fused_adam(1e-3), opt_level="O2")
-
-    @jax.jit
-    def step(params, amp_state):
-        def loss_fn(p):
-            logits = model.apply({"params": p}, tokens)
-            loss = gpt_loss(logits, labels)
-            return amp_opt.scale_loss(loss, amp_state), loss
-
-        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        new_params, new_state, _ = amp_opt.apply_gradients(
-            grads, amp_state, params)
-        return new_params, new_state, loss
+    setup = make_smoke_setup(opt_level="O2")
+    step = build_train_step(setup)
+    params, amp_state = setup.params, setup.amp_state
 
     # the init/initialize compiles above happen OUTSIDE the sanitizer;
     # transfer_guard stays off for the smoke (loss readout is an
@@ -211,7 +186,7 @@ def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
     with sanitize(transfer_guard=None, recompile_budget=0,
                   warmup_steps=1) as san:
         for _ in range(steps):
-            params, amp_state, loss = step(params, amp_state)
+            params, amp_state, loss, _, _ = step(params, amp_state)
             loss.block_until_ready()
             san.step()
     if verbose:

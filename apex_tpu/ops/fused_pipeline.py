@@ -102,6 +102,28 @@ def pipeline_metas(tree: Any) -> List[FlatMeta]:
                                       direct_min=_ALL_PACKED)
 
 
+def packed_nbytes(tree: Any) -> int:
+    """Pre-alignment byte total of ``tree`` in its own leaf dtypes
+    (shapes/dtypes only — safe on arrays, tracers, and
+    ``ShapeDtypeStruct`` templates).  The quantity the
+    ``APEX_TPU_PIPELINE_PACK_MIN_BYTES`` routing cutoff compares: the
+    persistent pipeline's win is amortizing the pack across a run, and
+    below a packed-size floor the measured 0.73x small-tree residue
+    says direct per-leaf updates are the faster regime."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            arr = jnp.asarray(leaf)
+            shape, dtype = arr.shape, arr.dtype
+        size = 1
+        for d in shape:
+            size *= int(d)
+        total += size * jnp.dtype(dtype).itemsize
+    return total
+
+
 def pack_grads(tree: Any, metas: Sequence[FlatMeta]) -> List[jnp.ndarray]:
     """Pack a gradient pytree into flat buffers by per-leaf
     ``dynamic_update_slice`` writes into a zero-initialized buffer.

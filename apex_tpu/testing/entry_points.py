@@ -1,0 +1,335 @@
+"""Registry of the framework's lowerable entry points.
+
+ONE list of real jitted steps, shared by everything that needs "the
+programs this framework actually compiles":
+
+* the compiled-graph auditor (:mod:`apex_tpu.analysis.hlo`) lowers each
+  entry and checks donation, dtype promotion, the collective census,
+  host transfers, and peak live memory against the committed baseline
+  (``python -m apex_tpu.analysis --check-hlo``, tools/ci.sh step 8);
+* the sanitizer smoke drives the GPT entry's exact step function;
+* the train-smoke drivers build their steps through the same
+  ``make_smoke_setup``/``build_train_step`` pair the entries here use.
+
+Before this registry the smoke drivers, the sanitizer, and CI each
+reconstructed their own copy of "the GPT step" — an audit of one said
+nothing about the others.  Now an entry point is data: name, builder,
+precision-policy tag, which arguments die at the call boundary
+(donation candidates, APX601), which provenance paths are sanctioned
+fp32 regions under the policy (APX602), and how many devices the build
+needs (multichip entries lower on an 8-device host-platform mesh —
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``, the tests'
+standing configuration).
+
+Builders are lazy (nothing lowers at import) and cheap: tiny shapes,
+CPU-lowerable, no compile — the auditor only needs ``.lower()``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["EntryPoint", "ENTRY_POINTS", "register_entry_point",
+           "available_entry_points"]
+
+# Provenance path substrings (repo-relative) where an fp32 upcast is
+# the precision policy's own doing, shared by every low-precision
+# entry: fp32 layer-norm statistics, fp32 softmax, fp32 loss, and the
+# amp/optimizer machinery (masters, unscale, norm sweeps) are what
+# O4/O5 *mean* — APX602 exists for upcasts outside this list.
+POLICY_FP32_REGIONS = (
+    "apex_tpu/normalization/",
+    "apex_tpu/ops/layer_norm.py",
+    "apex_tpu/ops/scaled_softmax.py",
+    "apex_tpu/ops/flash_attention.py",
+    "apex_tpu/contrib/xentropy/",
+    "apex_tpu/transformer/tensor_parallel/cross_entropy.py",
+    "apex_tpu/transformer/functional/fused_softmax.py",
+    "apex_tpu/amp/",
+    "apex_tpu/optimizers/",
+    "apex_tpu/ops/fused_pipeline.py",
+    "apex_tpu/ops/fused_optim.py",
+    "apex_tpu/ops/multi_tensor.py",
+    # the smoke drivers' own loss-side fp32 entry (gpt_loss /
+    # bert lm+nsp mean): loss math is fp32 under every policy
+    "apex_tpu/testing/standalone_gpt.py",
+    "apex_tpu/testing/standalone_bert.py",
+    # param_l2_norm / loss averaging: fp32 norm accumulation is the
+    # same sanctioned class as multi_tensor.sumsq
+    "apex_tpu/transformer/pipeline_parallel/utils.py",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One lowerable entry point: the registry row the auditor walks.
+
+    ``build()`` returns ``(fn, args)`` with ``fn`` a ``jax.jit``-wrapped
+    callable and ``args`` example arguments — the auditor calls
+    ``fn.lower(*args)`` and never executes the step.
+    """
+
+    name: str
+    build: Callable[[], Tuple[Any, tuple]]
+    # O-level tag; 'O4'/'O5' arms APX602 (silent bf16/f16->f32
+    # promotion) for this entry.
+    policy: Optional[str] = None
+    # Positional argnums whose buffers are dead after the call (the
+    # caller rebinds them) — donation candidates for APX601.
+    dead_args: Tuple[int, ...] = ()
+    # Extra sanctioned-fp32 provenance substrings on top of
+    # POLICY_FP32_REGIONS.
+    allow_upcast: Tuple[str, ...] = ()
+    min_devices: int = 1
+    doc: str = ""
+
+
+ENTRY_POINTS: Dict[str, EntryPoint] = {}
+
+
+def register_entry_point(name: str, build, **kw) -> EntryPoint:
+    if name in ENTRY_POINTS:
+        raise ValueError(f"duplicate entry point registration: {name}")
+    ep = EntryPoint(name=name, build=build, **kw)
+    ENTRY_POINTS[name] = ep
+    return ep
+
+
+def available_entry_points() -> Dict[str, EntryPoint]:
+    """Entries buildable on this host (device-count gate)."""
+    import jax
+
+    n = jax.device_count()
+    return {k: v for k, v in ENTRY_POINTS.items() if v.min_devices <= n}
+
+
+# ---------------------------------------------------------------------------
+# Single-chip entries: the smoke train steps and the fused pipeline
+# ---------------------------------------------------------------------------
+
+def _build_gpt_train_step():
+    from .standalone_gpt import build_train_step, make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O2")
+    return build_train_step(setup), (setup.params, setup.amp_state)
+
+
+def _build_gpt_train_step_o5():
+    import jax.numpy as jnp
+
+    from .standalone_gpt import build_train_step, make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
+    return build_train_step(setup), (setup.params, setup.amp_state)
+
+
+def _build_bert_train_step():
+    from .standalone_bert import build_train_step, make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O2")
+    return build_train_step(setup), (setup.params, setup.amp_state)
+
+
+def _build_fused_pipeline_step():
+    """The PR-4 persistent packed optimizer pipeline as its own entry:
+    one full amp post-backward step (pack -> norm/finite sweep ->
+    clip/update/cast sweep) with ``pipeline=True`` forced, grads/state/
+    model donated — masters and optimizer state live in the packed
+    buffers, so a missed donation here doubles the largest allocations
+    in the whole step (the APX601 end-to-end requirement)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from .. import amp
+    from ..optimizers import fused_adam
+
+    params = {
+        "w": jnp.linspace(-1.0, 1.0, 4096,
+                          dtype=jnp.float32).reshape(32, 128),
+        "b": jnp.linspace(0.1, 0.5, 128, dtype=jnp.float32),
+        "deep": {"k": jnp.full((16, 128), 0.25, jnp.float32)},
+    }
+    amp_opt = amp.AmpOptimizer(
+        fused_adam(1e-3, weight_decay=0.01, max_grad_norm=1.0),
+        amp.get_policy("O5", loss_scale=1024.0), check_finite=True,
+        pipeline=True)
+    amp_state = amp_opt.init(params)
+    model = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), params)
+    grads = jax.tree_util.tree_map(
+        lambda x: (x * 0.001 * 1024.0).astype(jnp.bfloat16), params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def post_backward_step(grads, amp_state, model):
+        new_model, new_state, info = amp_opt.apply_gradients(
+            grads, amp_state, model)
+        return new_model, new_state, info.grad_norm
+
+    return post_backward_step, (grads, amp_state, model)
+
+
+def _build_flash_attention_grad():
+    """The flash-attention call site, fwd+bwd: whatever branch is
+    legal on this backend (Pallas kernels on TPU, the dispatching
+    fallback elsewhere) is exactly what the auditor should see —
+    auditing a forced branch would certify a graph production never
+    runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.flash_attention import flash_attention
+
+    b, h, s, d = 2, 4, 128, 64
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                 (b, h, s, d), jnp.bfloat16)
+               for i in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2))), (q, k, v)
+
+
+register_entry_point(
+    "gpt_train_step", _build_gpt_train_step, policy="O2",
+    dead_args=(0, 1),
+    doc="standalone-GPT smoke train step (O2 fp16, dynamic scaling) — "
+        "the step the sanitizer smoke and CI telemetry smoke drive")
+register_entry_point(
+    "gpt_train_step_o5", _build_gpt_train_step_o5, policy="O5",
+    dead_args=(0, 1),
+    doc="standalone-GPT train step under the O5 bf16 policy — the "
+        "APX602 promotion-audit surface")
+register_entry_point(
+    "bert_train_step", _build_bert_train_step, policy="O2",
+    dead_args=(0, 1),
+    doc="standalone-BERT smoke train step (LM + NSP loss)")
+register_entry_point(
+    "fused_pipeline_step", _build_fused_pipeline_step, policy="O5",
+    dead_args=(0, 1, 2),
+    doc="persistent packed optimizer pipeline post-backward step "
+        "(pipeline=True forced), grads/state/model donated")
+register_entry_point(
+    "flash_attention_grad", _build_flash_attention_grad, policy="O5",
+    dead_args=(),
+    # the builder's own loss sums in fp32 on purpose (loss math is
+    # fp32 under every policy)
+    allow_upcast=("apex_tpu/testing/entry_points.py",),
+    doc="flash-attention fwd+bwd call site (q/k/v retained by the "
+        "caller — no donation expected)")
+
+
+# ---------------------------------------------------------------------------
+# Multichip entries (8-device host-platform mesh): the collective
+# census must cover the parallel stack, not just single-chip steps.
+# ---------------------------------------------------------------------------
+
+def _build_dp8_train_step():
+    """Pure data-parallel GPT loss step over an 8-way mesh: pmean of
+    the loss inside shard_map, gradient psum from boundary
+    transposition (replicated params sum their cotangents) — the
+    collectives every DP run emits."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..optimizers import fused_adam
+    from .standalone_gpt import GPTModel, gpt_loss
+
+    vocab, hidden, heads, layers, seq = 64, 32, 4, 2, 16
+    batch = 16  # 2 per device
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, -1)
+    params = jax.jit(model.init)(key, tokens[:2])["params"]
+    tx = fused_adam(1e-3)
+    opt_state = jax.jit(tx.init)(params)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def loss_fn(p, t, l):
+        def shard(p, t, l):
+            loss = gpt_loss(model.apply({"params": p}, t), l)
+            return jax.lax.pmean(loss, "data")
+
+        return shard_map(shard, mesh=mesh,
+                         in_specs=(P(), P("data"), P("data")),
+                         out_specs=P(), check_vma=False)(p, t, l)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
+                                                  labels)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        import optax
+
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    return train_step, (params, opt_state, tokens, labels)
+
+
+def _build_zero_dp8_update_step():
+    """ZeRO-style sharded update over 8 devices: grads psum_scatter'd
+    (each device reduces+keeps 1/8th), the shard updated locally, the
+    updated shard all_gather'd back into replicated params — the
+    reduce_scatter + all_gather pair the census must price."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from .._compat import shard_map
+
+    n = 8
+    dim = 1024  # divisible by 8
+    key = jax.random.PRNGKey(0)
+    params = jax.random.normal(key, (dim, 64), jnp.float32)
+    grads = params * 1e-3
+    mesh = Mesh(np.array(jax.devices()[:n]), ("zero",))
+
+    def update(p, g):
+        def shard(p, g):
+            # g arrives FULL (replicated, as from a DP backward);
+            # reduce+shard it, step the local shard, regather.
+            g_shard = jax.lax.psum_scatter(g, "zero",
+                                           scatter_dimension=0,
+                                           tiled=True)
+            i = jax.lax.axis_index("zero")
+            rows = p.shape[0] // n
+            p_shard = jax.lax.dynamic_slice_in_dim(p, i * rows, rows, 0)
+            p_new = p_shard - 0.1 * g_shard
+            return jax.lax.all_gather(p_new, "zero", axis=0,
+                                      tiled=True)
+
+        return shard_map(shard, mesh=mesh, in_specs=(P(), P()),
+                         out_specs=P(), check_vma=False)(p, g)
+
+    return (functools.partial(jax.jit, donate_argnums=(0,))(update),
+            (params, grads))
+
+
+register_entry_point(
+    "gpt_dp8_train_step", _build_dp8_train_step, policy="O0",
+    dead_args=(0, 1), min_devices=8,
+    doc="8-way data-parallel GPT train step (pmean loss, psum grad "
+        "sync from boundary transposition)")
+register_entry_point(
+    "zero_dp8_update_step", _build_zero_dp8_update_step, policy="O0",
+    dead_args=(0,), min_devices=8,
+    doc="ZeRO-sharded update: psum_scatter grads -> local shard "
+        "update -> all_gather params")

@@ -9,7 +9,8 @@ from the same library blocks as the GPT model
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+import functools
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -186,35 +187,36 @@ class BertModel(nn.Module):
         return lm_loss, binary_logits
 
 
-def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
-                sink=None, vocab: int = 64, hidden: int = 32,
-                num_heads: int = 4, num_layers: int = 2, batch: int = 4,
-                seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
-                stall_timeout: float = 300.0, seed: int = 0,
-                ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
-                ckpt_keep: int = 3, resume: bool = True,
-                fault=None, autoresume="auto", escalation=None,
-                return_state: bool = False):
-    """Tiny single-device BERT train loop wired through
-    :mod:`apex_tpu.monitor` — the BERT sibling of
-    :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
-    stream: step metrics, amp scale, phase timers, watchdog — and the
-    same resilience wiring: periodic checkpoints + auto-resume under
-    ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe exit),
-    proving both paths are driver-agnostic.  Returns the final loss, or
-    ``(loss, params, amp_state, steps_done)`` with
-    ``return_state=True``."""
+class BertSmokeSetup(NamedTuple):
+    """Everything the BERT smoke train step needs, built once — the
+    BERT sibling of :class:`.standalone_gpt.SmokeSetup`; shared by
+    :func:`train_smoke` and the hlo-auditor entry registry."""
+
+    model: Any
+    tokens: jnp.ndarray
+    mask: jnp.ndarray
+    labels: jnp.ndarray
+    nsp: jnp.ndarray
+    params: Any
+    amp_opt: Any
+    amp_state: Any
+    n_params: int
+
+
+def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
+                     num_heads: int = 4, num_layers: int = 2,
+                     batch: int = 4, seq: int = 16,
+                     opt_level: str = "O2", lr: float = 1e-3,
+                     seed: int = 0, dtype=jnp.float32,
+                     pipeline: Optional[bool] = None) -> BertSmokeSetup:
     from .. import amp
     from ..optimizers import fused_adam
-    from ..transformer.pipeline_parallel.utils import (Timers,
-                                                       param_l2_norm)
-    from .standalone_gpt import _run_smoke_loop, make_smoke_monitor
 
     model = BertModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
         num_attention_heads=num_heads, max_sequence_length=seq,
         attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
-        dtype=jnp.float32)
+        dtype=dtype)
     key = jax.random.PRNGKey(seed)
     tokens = jax.random.randint(jax.random.fold_in(key, 1),
                                 (batch, seq), 0, vocab)
@@ -225,9 +227,23 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     n_params = sum(x.size for x in
                    jax.tree_util.tree_leaves(variables["params"]))
     params, amp_opt, amp_state = amp.initialize(
-        variables["params"], fused_adam(lr), opt_level=opt_level)
+        variables["params"], fused_adam(lr), opt_level=opt_level,
+        pipeline=pipeline)
+    return BertSmokeSetup(model, tokens, mask, labels, nsp, params,
+                          amp_opt, amp_state, int(n_params))
 
-    @jax.jit
+
+def build_train_step(setup: BertSmokeSetup):
+    """The jitted BERT smoke train step (LM + NSP loss through amp).
+    ``params``/``amp_state`` are donated, exactly as in
+    :func:`.standalone_gpt.build_train_step` — the loop rebinds both,
+    and undonated masters/optimizer state double their HBM (APX601)."""
+    from ..transformer.pipeline_parallel.utils import param_l2_norm
+
+    model, tokens, mask = setup.model, setup.tokens, setup.mask
+    labels, nsp, amp_opt = setup.labels, setup.nsp, setup.amp_opt
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, amp_state):
         def loss_fn(p):
             from ..contrib.xentropy import softmax_cross_entropy_loss
@@ -248,6 +264,38 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
             param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
+    return step
+
+
+def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
+                sink=None, vocab: int = 64, hidden: int = 32,
+                num_heads: int = 4, num_layers: int = 2, batch: int = 4,
+                seq: int = 16, opt_level: str = "O2", lr: float = 1e-3,
+                stall_timeout: float = 300.0, seed: int = 0,
+                ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
+                ckpt_keep: int = 3, resume: bool = True,
+                fault=None, autoresume="auto", escalation=None,
+                return_state: bool = False):
+    """Tiny single-device BERT train loop wired through
+    :mod:`apex_tpu.monitor` — the BERT sibling of
+    :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
+    stream: step metrics, amp scale, phase timers, watchdog — and the
+    same resilience wiring: periodic checkpoints + auto-resume under
+    ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe exit),
+    proving both paths are driver-agnostic.  Returns the final loss, or
+    ``(loss, params, amp_state, steps_done)`` with
+    ``return_state=True``."""
+    from ..transformer.pipeline_parallel.utils import Timers
+    from .standalone_gpt import _run_smoke_loop, make_smoke_monitor
+
+    setup = make_smoke_setup(
+        vocab=vocab, hidden=hidden, num_heads=num_heads,
+        num_layers=num_layers, batch=batch, seq=seq,
+        opt_level=opt_level, lr=lr, seed=seed)
+    step = build_train_step(setup)
+    params, amp_opt, amp_state = (setup.params, setup.amp_opt,
+                                  setup.amp_state)
+    n_params = setup.n_params
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=batch * seq,
         flops_per_step=6.0 * n_params * batch * seq,

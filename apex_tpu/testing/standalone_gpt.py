@@ -20,7 +20,8 @@ re-designed for one-program SPMD:
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Optional
+import functools
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -248,6 +249,92 @@ def gpt_forward_pipelined(embed_mod, stage_mod, head_mod,
 
 
 # ---------------------------------------------------------------------------
+# Shared smoke-step construction — ONE build path for the train-smoke
+# loop, the sanitizer smoke, and the compiled-graph auditor's entry
+# registry (apex_tpu.testing.entry_points), so what CI lowers and
+# audits is byte-for-byte what the drivers run.
+# ---------------------------------------------------------------------------
+
+
+class SmokeSetup(NamedTuple):
+    """Everything a smoke train step needs, built once."""
+
+    model: Any
+    tokens: jnp.ndarray
+    labels: jnp.ndarray
+    params: Any
+    amp_opt: Any
+    amp_state: Any
+    n_params: int
+
+
+def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
+                     num_heads: int = 4, num_layers: int = 2,
+                     batch: int = 4, seq: int = 16,
+                     opt_level: str = "O2", lr: float = 1e-3,
+                     seed: int = 0, dtype=jnp.float32,
+                     pipeline: Optional[bool] = None) -> SmokeSetup:
+    """Build the tiny single-device GPT workload shared by
+    :func:`train_smoke`, the sanitizer smoke, and the hlo-auditor entry
+    registry.  ``dtype`` is the model COMPUTE dtype (the historical
+    smoke default is fp32 even under O2 — params still cast per the
+    policy); the O5 audit entry passes ``jnp.bfloat16`` so the lowered
+    graph is a real low-precision policy region."""
+    from .. import amp
+    from ..optimizers import fused_adam
+
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=dtype)
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(jax.random.fold_in(key, 1),
+                                (batch, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, -1)
+    variables = jax.jit(model.init)(key, tokens)
+    n_params = sum(x.size for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    params, amp_opt, amp_state = amp.initialize(
+        variables["params"], fused_adam(lr), opt_level=opt_level,
+        pipeline=pipeline)
+    return SmokeSetup(model, tokens, labels, params, amp_opt,
+                      amp_state, int(n_params))
+
+
+def build_train_step(setup: SmokeSetup):
+    """The jitted smoke train step: forward, scaled loss, backward,
+    amp apply.  ``params`` and ``amp_state`` are DONATED — the loop
+    rebinds both every step, and without donation XLA double-buffers
+    the masters and optimizer state (the APX601 finding this fixed:
+    fp32 masters + m/v are the largest buffers in the step).  Returns
+    ``step(params, amp_state) -> (params, amp_state, loss, gnorm,
+    info)``."""
+    from ..transformer.pipeline_parallel.utils import param_l2_norm
+
+    model, tokens, labels = setup.model, setup.tokens, setup.labels
+    amp_opt = setup.amp_opt
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, amp_state):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            loss = gpt_loss(logits, labels)
+            return amp_opt.scale_loss(loss, amp_state), loss
+
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        new_params, new_state, info = amp_opt.apply_gradients(
+            grads, amp_state, params)
+        # the fused pipeline already measured the unscaled global norm
+        # in its norm sweep; only the per-stage path re-sweeps the tree
+        gnorm = info.grad_norm if info.grad_norm is not None else \
+            param_l2_norm(grads) / amp_state.scaler.loss_scale
+        return new_params, new_state, loss, gnorm, info
+
+    return step
+
+
+# ---------------------------------------------------------------------------
 # Monitored smoke train loop — the run-telemetry acceptance path
 # ---------------------------------------------------------------------------
 
@@ -397,42 +484,16 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     watchdog.  A crashing step emits a terminal ``run_error`` event
     before the exception propagates.
     """
-    from .. import amp
-    from ..optimizers import fused_adam
-    from ..transformer.pipeline_parallel.utils import (Timers,
-                                                       param_l2_norm)
+    from ..transformer.pipeline_parallel.utils import Timers
 
-    model = GPTModel(
-        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
-        num_attention_heads=num_heads, max_sequence_length=seq,
-        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
-        dtype=jnp.float32)
-    key = jax.random.PRNGKey(seed)
-    tokens = jax.random.randint(jax.random.fold_in(key, 1),
-                                (batch, seq), 0, vocab)
-    labels = jnp.roll(tokens, -1, -1)
-    variables = jax.jit(model.init)(key, tokens)
-    n_params = sum(x.size for x in
-                   jax.tree_util.tree_leaves(variables["params"]))
-    params, amp_opt, amp_state = amp.initialize(
-        variables["params"], fused_adam(lr), opt_level=opt_level)
-
-    @jax.jit
-    def step(params, amp_state):
-        def loss_fn(p):
-            logits = model.apply({"params": p}, tokens)
-            loss = gpt_loss(logits, labels)
-            return amp_opt.scale_loss(loss, amp_state), loss
-
-        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        new_params, new_state, info = amp_opt.apply_gradients(
-            grads, amp_state, params)
-        # the fused pipeline already measured the unscaled global norm
-        # in its norm sweep; only the per-stage path re-sweeps the tree
-        gnorm = info.grad_norm if info.grad_norm is not None else \
-            param_l2_norm(grads) / amp_state.scaler.loss_scale
-        return new_params, new_state, loss, gnorm, info
-
+    setup = make_smoke_setup(
+        vocab=vocab, hidden=hidden, num_heads=num_heads,
+        num_layers=num_layers, batch=batch, seq=seq,
+        opt_level=opt_level, lr=lr, seed=seed)
+    step = build_train_step(setup)
+    params, amp_opt, amp_state = (setup.params, setup.amp_opt,
+                                  setup.amp_state)
+    n_params = setup.n_params
     flops = 6.0 * n_params * batch * seq \
         + 12.0 * num_layers * hidden * batch * seq * seq
     monitor = make_smoke_monitor(

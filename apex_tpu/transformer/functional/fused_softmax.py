@@ -62,6 +62,23 @@ class FusedScaleMaskSoftmax:
                     and self.input_in_float16
                     and sk > 1)
 
+    def _model_dtype(self):
+        """The dtype probs leave in, from the constructor flags — NOT
+        the input dtype: callers may (should) hand in fp32 scores
+        straight off the matmul's fp32 accumulate, and the downcast to
+        model dtype is this sanctioned-fp32 region's own exit cast
+        (re-deriving it from the input recreated the APX602
+        fp32->bf16->fp32 round-trip the hlo auditor flagged)."""
+        if self.input_in_fp16:
+            return jnp.float16
+        if self.input_in_bf16:
+            return jnp.bfloat16
+        return None
+
+    def _exit_cast(self, probs):
+        dtype = self._model_dtype()
+        return probs.astype(dtype) if dtype is not None else probs
+
     def __call__(self, inputs: jnp.ndarray,
                  mask: Optional[jnp.ndarray]) -> jnp.ndarray:
         b, np_, sq, sk = inputs.shape
@@ -76,16 +93,16 @@ class FusedScaleMaskSoftmax:
             assert sq == sk, "causal mask is only for self attention"
             probs = scaled_upper_triang_masked_softmax(
                 inputs.reshape(-1, sq, sk), scale)
-            return probs.reshape(b, np_, sq, sk)
+            return self._exit_cast(probs.reshape(b, np_, sq, sk))
         if mask is not None:
-            return scaled_masked_softmax(inputs, mask, scale)
-        return scaled_masked_softmax(
-            inputs, jnp.zeros((b, 1, sq, sk), jnp.int32), scale)
+            return self._exit_cast(scaled_masked_softmax(inputs, mask,
+                                                         scale))
+        return self._exit_cast(scaled_masked_softmax(
+            inputs, jnp.zeros((b, 1, sq, sk), jnp.int32), scale))
 
     def forward_jax_softmax(self, inputs, mask):
         """Unfused fallback (ref: forward_torch_softmax,
         fused_softmax.py:176-194)."""
-        orig_dtype = inputs.dtype
         if self.input_in_float16 and self.softmax_in_fp32:
             inputs = inputs.astype(jnp.float32)
         if self.scale is not None:
@@ -105,6 +122,6 @@ class FusedScaleMaskSoftmax:
                 inputs = jnp.where(mask.astype(bool), -10000.0, inputs)
         probs = jnp.exp(inputs - jnp.max(inputs, -1, keepdims=True))
         probs = probs / jnp.sum(probs, -1, keepdims=True)
-        if self.input_in_float16 and self.softmax_in_fp32:
-            probs = probs.astype(orig_dtype)
+        if self.softmax_in_fp32:
+            probs = self._exit_cast(probs)
         return probs

@@ -174,7 +174,13 @@ class ParallelSelfAttention(nn.Module):
                 attn_mask_type=softmax_mask_type,
                 scaled_masked_softmax_fusion=True,
                 mask_func=None, softmax_in_fp32=True, scale=scale)
-            probs = softmax(scores.astype(self.dtype), attention_mask)
+            # feed the fp32 scores straight in: the softmax is fp32
+            # internally anyway, and the former scores.astype(dtype)
+            # round-tripped the MXU's fp32 accumulate through bf16 —
+            # a silent re-promotion on entry (APX602) plus a backward
+            # convert pair, for strictly worse precision; probs are
+            # cast once below, where the V matmul wants model dtype
+            probs = softmax(scores, attention_mask)
             if not deterministic and self.attention_dropout > 0.0:
                 key = self.make_rng("dropout")
                 if self.axis_name is not None:

@@ -472,12 +472,34 @@ def bench_optimizers():
     # dynamic_update_slice writes); the direct side is the all-direct
     # staged path on the same tree — both full amp post-backward
     # steps.  packed_vs_direct >= 0.95 is the ISSUE-4 acceptance bar.
+    from apex_tpu.analysis.flags import flag_int
+    from apex_tpu.ops.fused_pipeline import packed_nbytes
+
+    def _auto_routing(count, leaf_elems):
+        """What the SHIPPING auto decision (AmpOptimizer(pipeline=None)
+        + APEX_TPU_PIPELINE_PACK_MIN_BYTES) would do with this tree —
+        recorded on the diagnostic row so the packed-vs-direct ratio
+        is always read next to the routing that users actually get."""
+        tree = jax.eval_shape(lambda: jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16),
+            _synthetic_params(count, jax.random.PRNGKey(3),
+                              leaf_elems=leaf_elems)))
+        nbytes = packed_nbytes(tree)
+        cutoff = flag_int("APEX_TPU_PIPELINE_PACK_MIN_BYTES")
+        routed = "packed" if (cutoff <= 0 or nbytes >= cutoff) \
+            else "direct"
+        return nbytes, cutoff, routed
+
     diag = []
     for label, count, leaf_elems in sizes:
         if not label.endswith("_packed"):
             continue
         for opt_name, make_fused, _ in opt_table:
             row = {"params": label, "optimizer": opt_name}
+            nbytes, cutoff, routed = _auto_routing(count, leaf_elems)
+            row["model_bytes"] = nbytes
+            row["pack_min_bytes"] = cutoff
+            row["auto_routing"] = routed
             row["packed_us"], pdev = measure_amp_step(
                 count, leaf_elems, make_fused, True)
             row["direct_us"], ddev = measure_amp_step(
@@ -1161,6 +1183,14 @@ def _compact_summary(full):
     is written to BENCH_FULL.json alongside."""
     ex = full.get("extras", {})
     c = {k: full[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    if full.get("tier"):
+        c["tier"] = full["tier"]
+    skipped = sorted(name for name, row in ex.items()
+                     if isinstance(row, dict) and row.get("skipped"))
+    if skipped:
+        # budget skips must be visible on the line of record — a
+        # bounded run may never read as a complete sweep
+        c["skipped"] = skipped
     ce = {}
     if full.get("rn50_device_ips") is not None:
         ce["rn50_dev_ips"] = round(full["rn50_device_ips"], 0)
@@ -1186,7 +1216,8 @@ def _compact_summary(full):
         ce["psum_gbps"] = {f"{r['mib']}mib": r["allreduce_gbps"]
                            for r in col["psum_sweep"]}
     lc = ex.get("long_context", {})
-    if isinstance(lc, dict) and lc and "error" not in lc:
+    if isinstance(lc, dict) and lc and "error" not in lc \
+            and "skipped" not in lc:
         ce["longctx_tfs"] = {
             k: r.get("device_tflops_per_sec", r.get("tflops_per_sec"))
             for k, r in lc.items()}
@@ -1331,7 +1362,52 @@ def _section_events(sink, name):
                 seconds=time.perf_counter() - t0, section=name)
 
 
-def _run_section(extras, name, fn, writer, sink=None):
+class SectionBudget:
+    """Wall-clock budgeting for the section loop (ROADMAP item 5: the
+    round-5 sweep died at rc=124 with its truncation invisible —
+    budget pressure must surface as EXPLICIT per-section decisions,
+    never as a killed process masquerading as a complete run).
+
+    ``total_s`` is the whole-run allowance; before each section the
+    driver asks :meth:`allows` with that section's cost estimate and
+    either runs it or records a ``SKIPPED (budget)`` row.  Estimates
+    deliberately err high: skipping a section that would have fit
+    costs one re-run with a bigger budget, while blowing the driver
+    timeout loses the whole sweep's tail."""
+
+    def __init__(self, total_s):
+        self.total_s = total_s
+        self._t0 = time.monotonic()
+
+    def remaining_s(self):
+        if self.total_s is None:
+            return None
+        return self.total_s - (time.monotonic() - self._t0)
+
+    def allows(self, estimate_s):
+        rem = self.remaining_s()
+        return rem is None or estimate_s <= rem
+
+
+# Per-section wall estimates (seconds), full tier: ceil-ish readings of
+# the per-section seconds in BENCH_EVENTS.jsonl from complete sweeps.
+SECTION_ESTIMATES_S = {
+    "resnet50": 600, "optimizer_step": 900, "collective": 240,
+    "long_context": 900, "ring_flash": 360, "gpt2_345m": 600,
+    "gpt2_345m_s2048": 480, "gpt2_345m_dropout": 480,
+    "bert_large": 600, "zero_sharded_adam": 480,
+}
+# Quick tier (BENCH_SMOKE shapes): an order of magnitude smaller.
+SECTION_ESTIMATES_QUICK_S = {k: 60 for k in SECTION_ESTIMATES_S}
+
+
+def _section_estimate(name, quick):
+    table = SECTION_ESTIMATES_QUICK_S if quick else SECTION_ESTIMATES_S
+    return table.get(name, 300)
+
+
+def _run_section(extras, name, fn, writer, sink=None, budget=None,
+                 quick=False):
     """One bench section: record the row (or the error — never sink the
     headline), checkpoint the scratch artifact, and print the compact
     summary line IMMEDIATELY.  Last-line-wins: a driver timeout later
@@ -1340,7 +1416,30 @@ def _run_section(extras, name, fn, writer, sink=None):
     the single end-of-run print getting killed with ~8 sections of
     measurements already in hand).  Section lifecycle also flows as
     ``section_start``/``section_done``/``section_error`` events through
-    ``sink`` (see _make_event_sink)."""
+    ``sink`` (see _make_event_sink).
+
+    With a ``budget``, a section whose estimate exceeds the remaining
+    allowance is NOT run: it records an explicit
+    ``{"skipped": "budget"}`` row (and a ``section_skipped`` event), so
+    a bounded run reads as exactly what it is.  Returns True iff the
+    section actually ran."""
+    if budget is not None:
+        est = _section_estimate(name, quick)
+        if not budget.allows(est):
+            rem = budget.remaining_s()
+            extras[name] = {"skipped": "budget",
+                            "estimated_s": est,
+                            "remaining_s": round(max(rem, 0.0), 1)}
+            print(f"[bench] {name}: SKIPPED (budget) — estimated "
+                  f"{est}s > remaining {max(rem, 0.0):.0f}s",
+                  file=sys.stderr)
+            _emit_event(sink, "section", "section_skipped",
+                        section=name, estimated_s=est,
+                        remaining_s=rem)
+            writer.checkpoint()
+            print(_fit_compact_line(_compact_summary(writer.full)),
+                  flush=True)
+            return False
     print(f"[bench] {name}...", file=sys.stderr)
     try:
         with _section_events(sink, name):
@@ -1349,6 +1448,7 @@ def _run_section(extras, name, fn, writer, sink=None):
         extras[name] = {"error": str(e)[:200]}
     writer.checkpoint()
     print(_fit_compact_line(_compact_summary(writer.full)), flush=True)
+    return True
 
 
 SECTION_NAMES = ("resnet50", "optimizer_step", "collective",
@@ -1369,6 +1469,20 @@ def _parse_args(argv=None):
              f"({', '.join(SECTION_NAMES)}).  Filtered runs write "
              "only BENCH_FULL.json.partial — the committed artifact "
              "stays a complete run.")
+    p.add_argument(
+        "--quick", action="store_true",
+        help="CI tier: smoke-sized shapes (BENCH_SMOKE=1, small "
+             "batch/iters), a default --time-budget of 900 s, and "
+             "NO finalize — quick numbers never overwrite the "
+             "committed full-run artifact.")
+    p.add_argument(
+        "--time-budget", type=float, default=None, metavar="SECONDS",
+        help="whole-run wall budget: a section whose estimate "
+             "(SECTION_ESTIMATES_S) exceeds the remaining allowance "
+             "records an explicit 'SKIPPED (budget)' row instead of "
+             "running — a timeout kill can never masquerade as a "
+             "complete sweep.  Runs with skipped sections never "
+             "finalize the committed artifact.")
     args = p.parse_args(argv)
     if args.sections:
         # a typo'd name must not produce a do-nothing run that exits 0
@@ -1378,13 +1492,27 @@ def _parse_args(argv=None):
         if unknown:
             p.error(f"unknown section(s) {unknown}; valid: "
                     f"{list(SECTION_NAMES)}")
+    if args.quick and args.time_budget is None:
+        args.time_budget = 900.0
     return args
 
 
 def main(argv=None):
+    global BATCH, ITERS
+
     args = _parse_args(argv)
     sections = (set(s.strip() for s in args.sections.split(",") if
                     s.strip()) if args.sections else None)
+    if args.quick:
+        # smoke tier: the per-section smoke shapes plus a small
+        # headline batch — CI-speed numbers, clearly tagged, never
+        # committed (see finalize gate below)
+        os.environ["BENCH_SMOKE"] = "1"
+        BATCH = min(BATCH, 16)
+        ITERS = min(ITERS, 3)
+    budget = (SectionBudget(args.time_budget)
+              if args.time_budget is not None else None)
+    skipped = []
 
     def want(name):
         return sections is None or name in sections
@@ -1413,6 +1541,8 @@ def main(argv=None):
         }
         if sections is not None:
             full["sections_filter"] = sorted(sections)
+        if args.quick:
+            full["tier"] = "quick"
         if want("resnet50"):
             print("[bench] resnet50...", file=sys.stderr)
             # the headline section has no {"error"} fallback row — a
@@ -1454,18 +1584,27 @@ def main(argv=None):
             )
             for name, fn in all_sections:
                 if want(name):
-                    _run_section(extras, name, fn, writer, sink)
-        if sections is None:
-            # every section ran: commit the artifact atomically.  A
-            # --sections run never finalizes — the committed
-            # BENCH_FULL.json must stay a COMPLETE run (the README
-            # drift guard renders from it); partial measurements live
-            # in BENCH_FULL.json.partial.
+                    ran = _run_section(extras, name, fn, writer, sink,
+                                       budget=budget, quick=args.quick)
+                    if not ran:
+                        skipped.append(name)
+        if skipped:
+            full["skipped_sections"] = skipped
+            writer.checkpoint()
+        if sections is None and not skipped and not args.quick:
+            # every section genuinely ran: commit the artifact
+            # atomically.  A --sections, --quick, or budget-skipped
+            # run never finalizes — the committed BENCH_FULL.json must
+            # stay a COMPLETE full-tier run (the README drift guard
+            # renders from it); partial measurements live in
+            # BENCH_FULL.json.partial.
             writer.finalize()
         else:
-            print(f"[bench] --sections run: results in "
-                  f"{writer.scratch} (committed artifact untouched)",
-                  file=sys.stderr)
+            why = ("--sections" if sections is not None else
+                   "--quick" if args.quick else
+                   f"budget-skipped {skipped}")
+            print(f"[bench] {why} run: results in {writer.scratch} "
+                  f"(committed artifact untouched)", file=sys.stderr)
     _emit_event(sink, "run", "run_end")
     if sink is not None:
         sink.close()

@@ -496,16 +496,18 @@ class TestSelfHosted:
                 os.path.abspath(__file__))))
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
-    def test_docs_flag_table_current(self):
-        from apex_tpu.analysis.__main__ import (_TABLE_BEGIN, _TABLE_END,
-                                                DOCS_WITH_TABLE)
+    def test_docs_generated_tables_current(self):
+        # every generated docs table (ops.md flag table, analysis.md
+        # APX rule table) must match its registry byte-for-byte
+        from apex_tpu.analysis.__main__ import DOCS_TABLES
 
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        text = open(os.path.join(root, DOCS_WITH_TABLE)).read()
-        a = text.index(_TABLE_BEGIN) + len(_TABLE_BEGIN)
-        b = text.index(_TABLE_END)
-        assert text[a:b] == "\n" + flags_mod.render_flag_table() + "\n", \
-            "run: python -m apex_tpu.analysis --write-docs"
+        for doc, begin, end, render in DOCS_TABLES:
+            text = open(os.path.join(root, doc)).read()
+            a = text.index(begin) + len(begin)
+            b = text.index(end)
+            assert text[a:b] == "\n" + render() + "\n", \
+                f"{doc}: run python -m apex_tpu.analysis --write-docs"
 
 
 # ---------------------------------------------------------------------------

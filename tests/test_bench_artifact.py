@@ -244,6 +244,144 @@ class TestInterruptedRunArtifactSurvival:
         assert not (tmp_path / "BENCH_FULL.json.partial").exists()
 
 
+class TestSectionBudget:
+    """ROADMAP item 5: budget pressure must surface as explicit
+    ``SKIPPED (budget)`` rows and block finalize — a bounded run can
+    never masquerade as a complete sweep (the round-5 rc=124 failure
+    mode)."""
+
+    @staticmethod
+    def _writer(tmp_path):
+        path = tmp_path / "BENCH_FULL.json"
+        path.write_text('{"metric": "seed-state"}')
+        full = {"metric": "m", "value": 1.0, "unit": "u",
+                "vs_baseline": 1.0, "extras": {}}
+        return path, full, bench._ArtifactWriter(full, str(path))
+
+    def test_over_budget_section_records_skip_row(self, tmp_path,
+                                                  capsys):
+        _, full, w = self._writer(tmp_path)
+        budget = bench.SectionBudget(0.0)  # everything is over budget
+        ran = bench._run_section(
+            full["extras"], "long_context",
+            lambda: pytest.fail("must not run"), w, budget=budget)
+        assert ran is False
+        row = full["extras"]["long_context"]
+        assert row["skipped"] == "budget"
+        assert row["estimated_s"] == \
+            bench.SECTION_ESTIMATES_S["long_context"]
+        out = capsys.readouterr()
+        assert "SKIPPED (budget)" in out.err
+        # the skip is on the compact line of record too
+        last = json.loads(out.out.strip().splitlines()[-1])
+        assert last["skipped"] == ["long_context"]
+
+    def test_within_budget_section_runs(self, tmp_path, capsys):
+        _, full, w = self._writer(tmp_path)
+        budget = bench.SectionBudget(10_000.0)
+        ran = bench._run_section(full["extras"], "ring_flash",
+                                 lambda: {"tflops_per_sec": 1.0}, w,
+                                 budget=budget)
+        assert ran is True
+        assert full["extras"]["ring_flash"] == {"tflops_per_sec": 1.0}
+        capsys.readouterr()
+
+    def test_no_budget_is_the_old_behavior(self, tmp_path, capsys):
+        _, full, w = self._writer(tmp_path)
+        assert bench._run_section(full["extras"], "ring_flash",
+                                  lambda: {"ok": 1}, w) is True
+        capsys.readouterr()
+
+    def test_quick_tier_defaults_and_flags(self):
+        args = bench._parse_args(["--quick"])
+        assert args.quick and args.time_budget == 900.0
+        args = bench._parse_args(["--quick", "--time-budget", "60"])
+        assert args.time_budget == 60.0
+        assert bench._parse_args([]).time_budget is None
+
+    def test_skipped_row_never_breaks_compact_summary(self):
+        full = {"metric": "m", "value": 1.0, "unit": "u",
+                "vs_baseline": 1.0, "tier": "quick",
+                "extras": {"long_context": {"skipped": "budget",
+                                            "estimated_s": 900},
+                           "gpt2_345m": {"skipped": "budget",
+                                         "estimated_s": 600}}}
+        c = bench._compact_summary(full)
+        assert c["skipped"] == ["gpt2_345m", "long_context"]
+        assert c["tier"] == "quick"
+        assert "longctx_tfs" not in c["extras"]
+        json.loads(bench._fit_compact_line(c))  # stays parseable
+
+
+class TestBenchGate:
+    """tools/bench_gate.py: >5% drops in named headline metrics (or
+    silently missing sections) fail; explicit budget skips are
+    excused; quick-tier artifacts never gate against full-tier."""
+
+    @staticmethod
+    def _gate():
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "bench_gate.py")
+        spec = importlib.util.spec_from_file_location("bench_gate",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_self_test_passes(self):
+        assert self._gate().self_test() == 0
+
+    def test_identity_compare_is_clean(self):
+        gate = self._gate()
+        full = _full_report()
+        regressions, _ = gate.compare(full, full)
+        assert regressions == []
+
+    def test_six_percent_drop_fails_five_percent_gate(self):
+        gate = self._gate()
+        committed = _full_report()
+        fresh = json.loads(json.dumps(committed))
+        fresh["extras"]["bert_large"]["model_tflops_per_sec"] = \
+            committed["extras"]["bert_large"][
+                "model_tflops_per_sec"] * 0.94
+        regressions, _ = gate.compare(fresh, committed)
+        assert len(regressions) == 1
+        assert "bert_large_tflops" in regressions[0]
+        # a looser gate passes the same artifact
+        regressions, _ = gate.compare(fresh, committed, max_drop=0.10)
+        assert regressions == []
+
+    def test_budget_skip_excused_but_silent_absence_fails(self):
+        gate = self._gate()
+        committed = _full_report()
+        fresh = json.loads(json.dumps(committed))
+        fresh["extras"]["long_context"] = {"skipped": "budget",
+                                           "estimated_s": 900}
+        regressions, notes = gate.compare(fresh, committed)
+        assert regressions == []
+        assert any("explicitly skipped" in n for n in notes)
+        del fresh["extras"]["long_context"]
+        regressions, _ = gate.compare(fresh, committed)
+        assert any("silently absent" in r for r in regressions)
+
+    def test_committed_artifact_passes_identity_gate(self):
+        # the real committed BENCH_FULL.json gates green against
+        # itself — proves the metric extraction matches the artifact
+        import os
+
+        gate = self._gate()
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        full = json.loads(open(os.path.join(
+            root, "BENCH_FULL.json")).read())
+        regressions, notes = gate.compare(full, full)
+        assert regressions == []
+        assert len(gate.headline_metrics(full)) >= 8
+
+
 class TestSlopeFloor:
     """_slope_dt is the round-4 'impossible bandwidth' fix: a slope
     below the physical-peak floor (or inverted by noise) falls back to

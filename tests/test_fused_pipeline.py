@@ -519,6 +519,57 @@ class TestWiring:
         assert amp.AmpOptimizer(fused_adam(1e-3), policy,
                                 pipeline=True).use_pipeline
 
+    def test_pack_min_bytes_small_tree_routes_direct(self, monkeypatch):
+        # the 0.73x small-tree residue fix: below the packed-size
+        # cutoff the AUTO decision builds staged (per-leaf) state;
+        # explicit pipeline=True still packs
+        policy = amp.get_policy("O5")
+        small = {"w": jnp.ones((64, 64), jnp.float32)}  # 8 KiB bf16
+        opt = amp.AmpOptimizer(fused_adam(1e-2), policy)
+        assert opt.use_pipeline  # capability/flag decision unchanged
+        # default cutoff (128 MiB) routes the tiny tree to staged
+        assert not isinstance(opt.init(small).master_params,
+                              fp.PackedMasters)
+        # cutoff 0 = pack everything (the pre-cutoff behavior)
+        monkeypatch.setenv("APEX_TPU_PIPELINE_PACK_MIN_BYTES", "0")
+        assert isinstance(opt.init(small).master_params,
+                          fp.PackedMasters)
+        # at/above the cutoff packs (8 KiB tree vs 4 KiB cutoff)
+        monkeypatch.setenv("APEX_TPU_PIPELINE_PACK_MIN_BYTES", "4096")
+        assert isinstance(opt.init(small).master_params,
+                          fp.PackedMasters)
+        # explicit pipeline=True bypasses any cutoff
+        monkeypatch.setenv("APEX_TPU_PIPELINE_PACK_MIN_BYTES",
+                           str(1 << 30))
+        forced = amp.AmpOptimizer(fused_adam(1e-2), policy,
+                                  pipeline=True)
+        assert isinstance(forced.init(small).master_params,
+                          fp.PackedMasters)
+
+    def test_pack_min_bytes_staged_state_steps(self):
+        # a cutoff-routed (staged) state must step through the staged
+        # path even though the optimizer is pipeline-capable — the
+        # dispatch is on the state's layout, and the result matches a
+        # pipeline=False optimizer bitwise
+        policy = amp.get_policy("O5", loss_scale=256.0)
+        params = {"w": jnp.linspace(-1.0, 1.0, 96,
+                                    dtype=jnp.float32).reshape(8, 12)}
+        model = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), params)
+        grads = jax.tree_util.tree_map(
+            lambda x: (x * 0.01 * 256.0).astype(jnp.bfloat16), params)
+        auto = amp.AmpOptimizer(fused_adam(1e-2), policy,
+                                check_finite=True)   # default cutoff
+        staged = amp.AmpOptimizer(fused_adam(1e-2), policy,
+                                  check_finite=True, pipeline=False)
+        s_a, s_s = auto.init(params), staged.init(params)
+        assert not isinstance(s_a.master_params, fp.PackedMasters)
+        m_a, s_a, i_a = auto.apply_gradients(grads, s_a, model)
+        m_s, s_s, i_s = staged.apply_gradients(grads, s_s, model)
+        tree_bitwise(m_a, m_s)
+        tree_bitwise(s_a.master_params, s_s.master_params)
+        assert i_a.grad_norm is None and i_s.grad_norm is None
+
     def test_non_pipeline_tx_falls_back(self):
         # plain optax has no pipeline form; no masters -> no pipeline
         assert not amp.AmpOptimizer(optax.sgd(0.1),
@@ -686,14 +737,19 @@ class TestPackedCheckpoint:
         assert step == 1
         tree_bitwise(state_r.master_params, state.master_params)
 
-    def test_kill_resume_equivalence_via_train_smoke(self, tmp_path):
+    def test_kill_resume_equivalence_via_train_smoke(self, tmp_path,
+                                                     monkeypatch):
         """The tier-1 resilience claim extended to the packed-state
-        mode (the smoke loop runs the pipeline by default): kill@3 +
-        resume == uninterrupted, bitwise on the packed masters."""
+        mode: kill@3 + resume == uninterrupted, bitwise on the packed
+        masters.  The smoke tree is tiny, so the auto routing would
+        send it to the staged path (APEX_TPU_PIPELINE_PACK_MIN_BYTES
+        small-tree cutoff) — pin the cutoff to 0 so the loop runs the
+        persistent pipeline this test exists to checkpoint."""
         from apex_tpu.monitor import MemorySink
         from apex_tpu.resilience import parse_fault, run_resumable
         from apex_tpu.testing.standalone_gpt import train_smoke
 
+        monkeypatch.setenv("APEX_TPU_PIPELINE_PACK_MIN_BYTES", "0")
         _, ref_params, ref_state, _ = train_smoke(steps=5,
                                                   return_state=True)
         assert isinstance(ref_state.master_params, fp.PackedMasters)

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff a fresh BENCH_FULL.json against the
+committed one and fail on >5% drops in named headline metrics.
+
+ROADMAP item 5: the perf trajectory this repo's roadmap steers by is
+only as good as the committed artifact's honesty — a regression that
+lands silently (because nobody re-read ten JSON rows) is worse than a
+red build.  This gate makes the comparison mechanical:
+
+    python tools/bench_gate.py                        # .partial vs committed
+    python tools/bench_gate.py --fresh run2.json --committed run1.json
+    python tools/bench_gate.py --max-drop 0.08
+    python tools/bench_gate.py --self-test            # gate-logic check
+
+Headline metrics (higher is better, all of them): the ResNet-50
+img/s headline (wall + device), the model TF/s rows (GPT-2 345M both
+configs, BERT-large), long-context and ring-flash device TF/s, and
+the pipeline/ZeRO speedup ratios.  A metric missing from the fresh
+run is only tolerated when its section carries an explicit
+``skipped``/``error`` row (bench.py's budget machinery) — silent
+absence fails, because that is exactly how the round-5 truncation
+hid.
+
+Tier guard: quick-tier numbers (``bench.py --quick``, smoke shapes)
+are not comparable to a committed full-tier run — cross-tier
+invocations verify artifact structure only and say so.  The real gate
+runs where fresh and committed tiers match (the TPU bench host;
+tools/ci.sh step 8 folds it in behind ``APEX_TPU_BENCH_GATE=1``).
+
+Exit status: 0 = no regression, 1 = regression / malformed artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+DEFAULT_MAX_DROP = 0.05
+
+
+def _get(d, *path):
+    for k in path:
+        if not isinstance(d, dict) or k not in d:
+            return None
+        d = d[k]
+    return d
+
+
+def _section_state(full, section):
+    """'ok' | 'skipped' | 'error' | 'missing' for an extras section."""
+    row = _get(full, "extras", section)
+    if row is None:
+        return "missing"
+    if isinstance(row, dict) and row.get("skipped"):
+        return "skipped"
+    if isinstance(row, dict) and "error" in row:
+        return "error"
+    return "ok"
+
+
+def headline_metrics(full):
+    """{metric name: (value or None, owning section)} for every named
+    headline metric.  Sections are bench.py SECTION_NAMES members so
+    budget skips can excuse absent metrics."""
+    out = {
+        "resnet50_wall_ips": (_get(full, "value"), "resnet50"),
+        "resnet50_device_ips": (_get(full, "rn50_device_ips"),
+                                "resnet50"),
+        "gpt2_345m_tflops": (_get(full, "extras", "gpt2_345m",
+                                  "model_tflops_per_sec"),
+                             "gpt2_345m"),
+        "gpt2_345m_s2048_tflops": (_get(full, "extras",
+                                        "gpt2_345m_s2048",
+                                        "model_tflops_per_sec"),
+                                   "gpt2_345m_s2048"),
+        "bert_large_tflops": (_get(full, "extras", "bert_large",
+                                   "model_tflops_per_sec"),
+                              "bert_large"),
+        "ring_flash_tflops": (_get(full, "extras", "ring_flash",
+                                   "device_tflops_per_sec")
+                              or _get(full, "extras", "ring_flash",
+                                      "tflops_per_sec"),
+                              "ring_flash"),
+        "zero_sharded_vs_dense": (_get(full, "extras",
+                                       "zero_sharded_adam",
+                                       "sharded_vs_dense_device"),
+                                  "zero_sharded_adam"),
+    }
+    lc = _get(full, "extras", "long_context") or {}
+    if isinstance(lc, dict):
+        for cfg, row in sorted(lc.items()):
+            if isinstance(row, dict):
+                v = row.get("device_tflops_per_sec",
+                            row.get("tflops_per_sec"))
+                if v is not None:
+                    out[f"long_context.{cfg}_tflops"] = (
+                        v, "long_context")
+    pipe = _get(full, "extras", "optimizer_step", "pipeline") or []
+    for row in pipe:
+        if isinstance(row, dict) and row.get("speedup") is not None:
+            key = f"pipeline.{row.get('params')}/{row.get('optimizer')}"
+            out[key] = (row["speedup"], "optimizer_step")
+    return out
+
+
+def compare(fresh, committed, max_drop=DEFAULT_MAX_DROP):
+    """(regressions, notes): regressions is a list of human-readable
+    failure lines; notes are informational lines."""
+    regressions, notes = [], []
+    fresh_tier = fresh.get("tier", "full")
+    committed_tier = committed.get("tier", "full")
+    if fresh_tier != committed_tier:
+        notes.append(
+            f"cross-tier comparison ({fresh_tier} vs {committed_tier}"
+            f"): structural check only — quick-tier smoke shapes are "
+            f"not comparable to full-tier numbers")
+        if not isinstance(fresh.get("extras"), dict):
+            regressions.append("fresh artifact has no extras object")
+        return regressions, notes
+    base = headline_metrics(committed)
+    new = headline_metrics(fresh)
+    for name, (old_v, section) in sorted(base.items()):
+        if old_v is None:
+            continue
+        new_v, _ = new.get(name, (None, section))
+        if new_v is None:
+            state = _section_state(fresh, section) \
+                if section != "resnet50" else (
+                    "ok" if fresh.get("value") is not None
+                    else "missing")
+            if state in ("skipped", "error"):
+                notes.append(f"{name}: absent, section '{section}' "
+                             f"explicitly {state} — not gated")
+                continue
+            regressions.append(
+                f"{name}: present in committed artifact but silently "
+                f"absent from the fresh run (section '{section}' "
+                f"state: {state}) — a truncated sweep may not pass "
+                f"the gate")
+            continue
+        floor = old_v * (1.0 - max_drop)
+        if new_v < floor:
+            regressions.append(
+                f"{name}: {old_v} -> {new_v} "
+                f"({(new_v / old_v - 1.0) * 100:+.1f}%, gate "
+                f"-{max_drop * 100:.0f}%)")
+        else:
+            notes.append(f"{name}: {old_v} -> {new_v} ok")
+    return regressions, notes
+
+
+def self_test() -> int:
+    """Exercise the gate logic on synthetic artifacts (run by CI on
+    every pass, so the gate cannot bit-rot between bench runs)."""
+    committed = {
+        "metric": "m", "value": 1000.0, "unit": "u",
+        "vs_baseline": 1.0, "rn50_device_ips": 1200.0,
+        "extras": {
+            "gpt2_345m": {"model_tflops_per_sec": 100.0},
+            "long_context": {"llama_d128_s4096":
+                             {"device_tflops_per_sec": 84.0}},
+            "optimizer_step": {"pipeline": [
+                {"params": "rn50_26m", "optimizer": "adam",
+                 "speedup": 1.2}]},
+        },
+    }
+    ok = json.loads(json.dumps(committed))
+    ok["value"] = 990.0                       # -1%: inside the gate
+    r, _ = compare(ok, committed)
+    assert r == [], r
+    bad = json.loads(json.dumps(committed))
+    bad["extras"]["gpt2_345m"]["model_tflops_per_sec"] = 80.0  # -20%
+    r, _ = compare(bad, committed)
+    assert len(r) == 1 and "gpt2_345m_tflops" in r[0], r
+    # silent absence fails; explicit budget skip is excused
+    gone = json.loads(json.dumps(committed))
+    del gone["extras"]["gpt2_345m"]
+    r, _ = compare(gone, committed)
+    assert any("silently absent" in x for x in r), r
+    skipped = json.loads(json.dumps(committed))
+    skipped["extras"]["gpt2_345m"] = {"skipped": "budget",
+                                      "estimated_s": 600}
+    r, notes = compare(skipped, committed)
+    assert r == [], r
+    assert any("explicitly skipped" in n for n in notes), notes
+    # cross-tier runs are structural-only
+    quick = json.loads(json.dumps(bad))
+    quick["tier"] = "quick"
+    r, notes = compare(quick, committed)
+    assert r == [] and any("cross-tier" in n for n in notes), (r, notes)
+    print("[bench-gate] self-test OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    repo = Path(__file__).resolve().parent.parent
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh",
+                    default=str(repo / "BENCH_FULL.json.partial"),
+                    help="fresh artifact (default: the .partial "
+                         "scratch next to the committed one)")
+    ap.add_argument("--committed",
+                    default=str(repo / "BENCH_FULL.json"))
+    ap.add_argument("--max-drop", type=float, default=DEFAULT_MAX_DROP,
+                    help="fractional drop that fails the gate "
+                         "(default 0.05)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the gate-logic self-test and exit")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    try:
+        fresh = json.loads(Path(args.fresh).read_text())
+        committed = json.loads(Path(args.committed).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[bench-gate] FAIL: cannot read artifacts: {e}",
+              file=sys.stderr)
+        return 1
+    regressions, notes = compare(fresh, committed,
+                                 max_drop=args.max_drop)
+    for n in notes:
+        print(f"[bench-gate] {n}")
+    for r in regressions:
+        print(f"[bench-gate] REGRESSION {r}", file=sys.stderr)
+    if regressions:
+        print(f"[bench-gate] FAIL: {len(regressions)} headline "
+              f"metric(s) regressed >{args.max_drop * 100:.0f}% "
+              f"(or went silently missing)", file=sys.stderr)
+        return 1
+    print(f"[bench-gate] OK: no headline metric regressed "
+          f">{args.max_drop * 100:.0f}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,32 +34,45 @@
 #   7. static analysis     — the self-hosted trace-safety lint +
 #                            kernel-parity audit must report zero
 #                            unsuppressed findings, the generated
-#                            env-flag doc table must match the
-#                            registry, and the sanitizer smoke must
-#                            prove the GPT step compiles exactly once
-#                            after warmup (docs/api/analysis.md)
+#                            doc tables (env flags, APX rules) must
+#                            match their registries, and the sanitizer
+#                            smoke must prove the GPT step compiles
+#                            exactly once after warmup
+#                            (docs/api/analysis.md)
+#   8. compiled-graph audit — python -m apex_tpu.analysis --check-hlo
+#                            lowers every registered entry point on
+#                            CPU (8 host-platform devices, so the
+#                            multichip entries' collective census is
+#                            covered) and checks donation, dtype
+#                            promotion, the collective census, host
+#                            transfers, and peak live memory against
+#                            tools/hlo_baseline.json; plus the bench
+#                            regression gate's self-test (and, with
+#                            APEX_TPU_BENCH_GATE=1 on a bench host,
+#                            a quick-tier bench run through
+#                            tools/bench_gate.py)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/7 default test tier"
+echo "[ci] 1/8 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/7 README drift guard"
+echo "[ci] 2/8 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/7 8-device multichip dryrun"
+echo "[ci] 3/8 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/7 monitor smoke"
+echo "[ci] 4/8 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/7 kill->resume smoke"
+echo "[ci] 5/8 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -79,13 +92,22 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/7 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/8 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/7 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/8 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
+
+echo "[ci] 8/8 compiled-graph audit (--check-hlo) + bench gate"
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.analysis --check-hlo
+python tools/bench_gate.py --self-test
+if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
+    python bench.py --quick
+    python tools/bench_gate.py
+fi
 
 echo "[ci] all green"
