@@ -238,6 +238,33 @@ register_flag(
     "APEX_TPU_MONITOR_STALL_S", "float", 300.0,
     "Watchdog stall timeout (seconds) for ambient monitor wiring.", lo=0.0)
 register_flag(
+    "APEX_TPU_TRACE_DIR", "str", None,
+    "Ambient wall-time tracing directory (apex_tpu.monitor.tracing): "
+    "drivers that support it (the convergence runner) record host "
+    "spans + the per-step waterfall and write trace.chrome.json "
+    "there.  The smoke drivers take --trace DIR explicitly.")
+register_flag(
+    "APEX_TPU_TRACE_CAPTURE_FILE", "str", None,
+    "On-demand capture trigger: touching this file at a step boundary "
+    "opens a pyprof.ProfileWindow for APEX_TPU_TRACE_CAPTURE_STEPS "
+    "steps (the file is consumed; one window per touch).")
+register_flag(
+    "APEX_TPU_TRACE_CAPTURE_STEPS", "int", 4,
+    "Length (steps) of an on-demand / auto capture window.", lo=1)
+register_flag(
+    "APEX_TPU_TRACE_RATIO_MIN", "float", 0.0,
+    "Auto-capture threshold: a step whose wall_device_ratio falls "
+    "below this opens one profiling window (0 disables; the "
+    "waterfall sibling of the Watchdog stall-trace hook).",
+    lo=0.0, hi=1.0)
+register_flag(
+    "APEX_TPU_TELEMETRY_DRAIN_EVERY", "int", 0,
+    "Deferred-telemetry cadence for the smoke drivers: K>=1 "
+    "accumulates per-step scalars in a device ring "
+    "(monitor.tracing.DeviceMetricsBuffer) drained every K steps — "
+    "zero per-step host transfers; 0 keeps the classic synchronous "
+    "per-step readback.", lo=0)
+register_flag(
     "APEX_TPU_FULL", "bool", False,
     "CI switch: run the full (slow-inclusive) test tier in "
     "tools/ci.sh.")

@@ -117,15 +117,25 @@ class Sanitizer:
 
 @contextlib.contextmanager
 def sanitize(*, transfer_guard: Optional[str] = "disallow",
+             transfer_scope: str = "all",
              recompile_budget: int = 0, warmup_steps: int = 1):
     """Context manager yielding a :class:`Sanitizer`.
 
     ``transfer_guard``: a ``jax.transfer_guard`` level ("allow",
     "log", "disallow", ...) or None to leave transfers unguarded.
+    ``transfer_scope``: "all" guards every direction;
+    "device_to_host" guards only d→h — the deferred-telemetry proof
+    (monitor.tracing.DeviceMetricsBuffer): under ``disallow`` the
+    ring's one explicit ``jax.device_get`` drain is permitted while
+    any implicit per-step readback (``float(loss)``, ``np.asarray``)
+    raises, so a passing run *is* the zero-per-step-transfer claim.
     ``recompile_budget``/``warmup_steps``: see :class:`Sanitizer`.
     """
     import jax
 
+    if transfer_scope not in ("all", "device_to_host"):
+        raise ValueError(f"unknown transfer_scope {transfer_scope!r} "
+                         "(use 'all' or 'device_to_host')")
     san = Sanitizer(recompile_budget=recompile_budget,
                     warmup_steps=warmup_steps)
     logger = logging.getLogger(_DISPATCH_LOGGER)
@@ -149,7 +159,10 @@ def sanitize(*, transfer_guard: Optional[str] = "disallow",
     jax.config.update("jax_log_compiles", True)
     try:
         if transfer_guard is not None:
-            with jax.transfer_guard(transfer_guard):
+            guard = (jax.transfer_guard_device_to_host
+                     if transfer_scope == "device_to_host"
+                     else jax.transfer_guard)
+            with guard(transfer_guard):
                 yield san
         else:
             yield san

@@ -27,6 +27,15 @@ bench.py ONE structured emission path, in three layers:
    throughput / overflow / phase-time / alarm digest
    (``tools/monitor_summary.py`` is the CLI).
 
+4. **Tracing** (:mod:`.tracing`) — the host side of the wall clock:
+   :class:`SpanTracer` spans (Chrome-trace/Perfetto export),
+   :class:`StepWaterfall` per-step wall attribution
+   (``wall_ms = data_load + dispatch + device_compute +
+   telemetry_drain + ckpt_io + other``, ``wall_device_ratio``),
+   :class:`DeviceMetricsBuffer`/:class:`DeferredTelemetry` sync-free
+   deferred metrics (zero per-step host transfers), and
+   :class:`CaptureTrigger` on-demand profiling windows.
+
 When to reach for what: ``monitor`` = run health over time; ``pyprof`` =
 where device time went; ``Timers`` = phase wall times (and they export
 into the monitor log via ``Timers.events``).  Full story with the JSONL
@@ -46,6 +55,19 @@ from .events import (
 )
 from .step_monitor import StepMonitor
 from .summary import load_events, render, summarize
+from .tracing import (
+    CaptureTrigger,
+    DeferredTelemetry,
+    DeviceMetricsBuffer,
+    SpanTracer,
+    StepWaterfall,
+    TraceSession,
+    chrome_trace_from_events,
+    get_tracer,
+    set_tracer,
+    span,
+    write_chrome_trace,
+)
 from .watchdog import Watchdog
 
 __all__ = [
@@ -54,4 +76,8 @@ __all__ = [
     "KINDS", "SCHEMA_VERSION",
     "StepMonitor", "Watchdog",
     "load_events", "summarize", "render",
+    "SpanTracer", "get_tracer", "set_tracer", "span",
+    "StepWaterfall", "TraceSession", "CaptureTrigger",
+    "DeviceMetricsBuffer", "DeferredTelemetry",
+    "chrome_trace_from_events", "write_chrome_trace",
 ]

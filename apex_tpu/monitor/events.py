@@ -34,6 +34,14 @@ SCHEMA_VERSION = 1
 #:   ``alarm``   watchdog alarms (``stall``, ``nonfinite_loss``,
 #:               ``overflow_streak``) and their ``*_recovered`` pairs
 #:   ``timer``   phase times exported from ``Timers.events`` (seconds)
+#:   ``span``    host spans from :mod:`apex_tpu.monitor.tracing`
+#:               (value = duration seconds; ``attrs.t0``/``tid``/
+#:               ``depth`` reconstruct the Chrome timeline)
+#:   ``attr``    per-step wall-time attribution rows
+#:               (``step_waterfall``: value = wall ms, attrs carry the
+#:               per-component ms + ``wall_device_ratio``)
+#:   ``trace``   on-demand capture lifecycle (``capture_requested`` /
+#:               ``capture_started`` / ``capture_stopped``)
 #:   ``section`` bench/driver section lifecycle (``section_start`` /
 #:               ``section_done`` / ``section_error``)
 #:   ``resilience`` preemption / restart / checkpoint-integrity
@@ -42,8 +50,8 @@ SCHEMA_VERSION = 1
 #:               ``attempt_error`` / ``attempt_backoff`` /
 #:               ``attempt_done`` / ``run_giveup``,
 #:               ``escalation_abort``, ``ckpt_skipped`` / ``ckpt_gc``)
-KINDS = ("run", "metric", "scale", "alarm", "timer", "section",
-         "resilience")
+KINDS = ("run", "metric", "scale", "alarm", "timer", "span", "attr",
+         "trace", "section", "resilience")
 
 
 def _jsonable(v: Any) -> Any:

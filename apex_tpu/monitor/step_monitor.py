@@ -12,9 +12,12 @@ non-finite loss, overflow streaks, and wall-clock stalls.
 Division of labor (see docs/api/observability.md):
 ``pyprof`` answers *where did device time go* (per-op attribution),
 ``Timers`` answers *how long did each phase take* (host phase timing),
-``monitor`` answers *is the run healthy over time* — and the other two
-feed into it (``Timers.events`` exports phase times as ``timer``
-events; MFU reads the pyprof device spec).
+``monitor.tracing`` answers *where did the wall time go* (per-step
+host/device waterfall, deferred telemetry), ``monitor`` answers *is
+the run healthy over time* — and the others feed into it
+(``Timers.events`` exports phase times as ``timer`` events; the
+waterfall emits ``attr`` rows and the span tracer ``span`` events
+through the same sinks; MFU reads the pyprof device spec).
 """
 from __future__ import annotations
 

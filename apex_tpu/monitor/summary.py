@@ -6,9 +6,13 @@ records (tolerating the one truncated trailing line a kill mid-write can
 leave), :func:`summarize` folds them into a run-health digest —
 throughput, loss trajectory, amp overflow history, watchdog alarms,
 resilience lifecycle (preempts / resumes / restart attempts /
-checkpoint-integrity skips), phase-timer totals, bench section outcomes
-— and :func:`render` prints it as tables.  ``tools/monitor_summary.py``
-is the CLI wrapper.
+checkpoint-integrity skips), phase-timer totals, wall-time attribution
+(the :mod:`~apex_tpu.monitor.tracing` waterfall: mean/p50/p99 per
+component + worst-step pointer), the captured-traces index, bench
+section outcomes — and :func:`render` prints it as tables.
+``tools/monitor_summary.py`` is the CLI wrapper (``--chrome OUT.json``
+additionally rebuilds a Perfetto-loadable Chrome trace from the log's
+span/timer events).
 """
 from __future__ import annotations
 
@@ -42,6 +46,19 @@ def _series(events: List[Event], kind: str, name: str) -> List[float]:
     return [float(e.value) for e in events
             if e.kind == kind and e.name == name
             and isinstance(e.value, (int, float))]
+
+
+def _pct(vals: List[float], q: float) -> float:
+    """Percentile by linear interpolation between closest ranks —
+    stable for the handfuls of steps a smoke run produces (p99 of 3
+    samples is the max, not an IndexError)."""
+    s = sorted(vals)
+    if len(s) == 1:
+        return s[0]
+    pos = (len(s) - 1) * q / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] + (s[hi] - s[lo]) * (pos - lo)
 
 
 def summarize(events: List[Event], malformed: int = 0) -> dict:
@@ -118,6 +135,58 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
         for t in timers.values():
             t["mean_ms"] = t["total_s"] * 1e3 / t["count"]
         out["timers"] = timers
+
+    # wall-time attribution (tracing waterfall) ---------------------------
+    wf_rows = [e for e in events
+               if e.kind == "attr" and e.name == "step_waterfall"
+               and isinstance(e.value, (int, float))]
+    if wf_rows:
+        comps: Dict[str, List[float]] = {"wall": []}
+        ratios: List[float] = []
+        worst = None
+        for e in wf_rows:
+            comps["wall"].append(float(e.value))
+            for k, v in e.attrs.items():
+                if k.endswith("_ms") and isinstance(v, (int, float)):
+                    comps.setdefault(k[:-3], []).append(float(v))
+            r = e.attrs.get("wall_device_ratio")
+            if isinstance(r, (int, float)):
+                ratios.append(float(r))
+            if worst is None or float(e.value) > worst[1]:
+                worst = (e.step, float(e.value), dict(e.attrs))
+        wall_total = sum(comps["wall"]) or 1.0
+        att: Dict[str, object] = {"steps": len(wf_rows), "components": {}}
+        for name, vals in comps.items():
+            att["components"][name] = {
+                "mean_ms": statistics.fmean(vals),
+                "p50_ms": _pct(vals, 50.0),
+                "p99_ms": _pct(vals, 99.0),
+                "share": sum(vals) / wall_total,
+            }
+        if ratios:
+            att["wall_device_ratio_mean"] = statistics.fmean(ratios)
+            att["wall_device_ratio_min"] = min(ratios)
+        if worst is not None:
+            att["worst_step"] = {"step": worst[0],
+                                 "wall_ms": worst[1], **worst[2]}
+        out["attribution"] = att
+
+    # captured traces ------------------------------------------------------
+    caps = [e for e in events if e.kind == "trace"]
+    if caps:
+        index: List[Dict[str, object]] = []
+        for e in caps:
+            if e.name == "capture_started":
+                index.append({"step": e.step,
+                              "reason": e.attrs.get("reason"),
+                              "trace_dir": e.attrs.get("trace_dir"),
+                              "stop": e.attrs.get("stop")})
+            elif e.name == "capture_stopped" and index \
+                    and "stopped_at" not in index[-1]:
+                index[-1]["stopped_at"] = e.step
+        requested = sum(1 for e in caps
+                        if e.name == "capture_requested")
+        out["captures"] = {"windows": index, "requested": requested}
 
     # resilience lifecycle ------------------------------------------------
     res = [e for e in events if e.kind == "resilience"]
@@ -243,6 +312,55 @@ def render(summary: dict) -> str:
         if res.get("gave_up"):
             lines.append(f"  GAVE UP: {res['gave_up']}")
 
+    att = summary.get("attribution")
+    if att:
+        lines.append("")
+        lines.append(f"wall-time attribution ({att['steps']} step(s)):")
+        lines.append(f"{'component':<18} {'mean ms':>9} {'p50 ms':>9} "
+                     f"{'p99 ms':>9} {'share':>7}")
+        comps = att["components"]
+        order = ["wall", "data_load", "dispatch", "device_compute",
+                 "telemetry_drain", "ckpt_io", "other"]
+        for name in order + sorted(set(comps) - set(order)):
+            c = comps.get(name)
+            if c is None:
+                continue
+            lines.append(
+                f"{name:<18} {c['mean_ms']:>9.3f} {c['p50_ms']:>9.3f} "
+                f"{c['p99_ms']:>9.3f} {100.0 * c['share']:>6.1f}%")
+        if "wall_device_ratio_mean" in att:
+            lines.append(
+                f"  wall/device ratio: mean "
+                f"{att['wall_device_ratio_mean']:.3f}, min "
+                f"{att['wall_device_ratio_min']:.3f}")
+        w = att.get("worst_step")
+        if w is not None:
+            parts = {k: v for k, v in w.items()
+                     if k.endswith("_ms") and k != "wall_ms"
+                     and isinstance(v, (int, float)) and v > 0.0}
+            top = sorted(parts.items(), key=lambda kv: -kv[1])[:3]
+            lines.append(
+                f"  worst step: {w['step']} at "
+                f"{_fmt(w['wall_ms'], 2)} ms ("
+                + ", ".join(f"{k[:-3]} {_fmt(v, 2)}" for k, v in top)
+                + ")")
+
+    caps = summary.get("captures")
+    if caps:
+        lines.append("")
+        lines.append(f"captured traces ({len(caps['windows'])} "
+                     f"window(s), {caps['requested']} request(s)):")
+        for c in caps["windows"]:
+            # stopped_at None = the close()-time stop of a window that
+            # was still open when the run tore down (its step-less
+            # capture_stopped event)
+            lines.append(
+                f"  step {c.get('step')} [{c.get('reason')}] -> "
+                f"{c.get('trace_dir')}"
+                + (f" (closed @ {c['stopped_at']})"
+                   if c.get("stopped_at") is not None
+                   else " (open at exit)"))
+
     timers = summary.get("timers")
     if timers:
         lines.append("")
@@ -267,12 +385,25 @@ def render(summary: dict) -> str:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    """CLI: ``monitor_summary.py RUN.jsonl`` — exit 0 on a parseable
-    log (alarms are reported, not fatal), 1 on missing/empty input,
-    2 on usage error."""
+    """CLI: ``monitor_summary.py RUN.jsonl [--chrome OUT.json]`` —
+    exit 0 on a parseable log (alarms are reported, not fatal), 1 on
+    missing/empty input, 2 on usage error.  ``--chrome`` additionally
+    rebuilds a Perfetto-loadable Chrome trace from the log's span and
+    timer events (:func:`apex_tpu.monitor.tracing.
+    chrome_trace_from_events`)."""
     argv = sys.argv[1:] if argv is None else argv
+    chrome = None
+    if "--chrome" in argv:
+        i = argv.index("--chrome")
+        if i + 1 >= len(argv):
+            print("monitor_summary: --chrome needs a path",
+                  file=sys.stderr)
+            return 2
+        chrome = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print("usage: monitor_summary.py RUN.jsonl", file=sys.stderr)
+        print("usage: monitor_summary.py RUN.jsonl [--chrome OUT.json]",
+              file=sys.stderr)
         return 2
     try:
         events, malformed = load_events(argv[0])
@@ -284,4 +415,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 1
     print(render(summarize(events, malformed)))
+    if chrome is not None:
+        from .tracing import chrome_trace_from_events, write_chrome_trace
+
+        write_chrome_trace(chrome, chrome_trace_from_events(events))
+        print(f"\nchrome trace -> {chrome}")
     return 0

@@ -57,7 +57,14 @@ def trace(logdir: str, timers=None,
 
 class ProfileWindow:
     """Iteration-window profiler switch (ref: main_amp.py:335-345 —
-    ``--prof`` starts at iteration A, stops at B)."""
+    ``--prof`` starts at iteration A, stops at B).
+
+    Besides the fixed CLI-configured window, this is the mechanism
+    behind on-demand mid-run capture:
+    :class:`apex_tpu.monitor.tracing.CaptureTrigger` opens one of
+    these at the triggering step boundary (file touch / SIGUSR1 /
+    ``wall_device_ratio`` auto-capture) and drives :meth:`step` until
+    the window closes — see docs/api/observability.md."""
 
     def __init__(self, logdir: str, start_iter: int, stop_iter: int,
                  timers=None):
@@ -79,6 +86,13 @@ class ProfileWindow:
         if self._ctx is not None and iteration >= self.stop_iter:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+
+    @property
+    def active(self) -> bool:
+        """True while the profiler trace is open (between the start
+        and stop iterations) — the state the capture trigger's
+        exactly-once tests pin down."""
+        return self._ctx is not None
 
     def close(self) -> None:
         if self._ctx is not None:

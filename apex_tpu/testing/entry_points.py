@@ -130,6 +130,24 @@ def _build_bert_train_step():
     return build_train_step(setup), (setup.params, setup.amp_state)
 
 
+def _build_gpt_train_step_deferred():
+    """The deferred-telemetry smoke step: the GPT train step with the
+    per-step scalars (loss / grad-norm / scale state) appended into a
+    device-resident :class:`apex_tpu.monitor.tracing.
+    DeviceMetricsBuffer` ring INSIDE the jit.  Auditing it proves
+    statically what the runtime sanitizer proves dynamically: the
+    deferred mode compiles in zero host transfers (APX604) and the
+    ring state donates cleanly alongside params/amp state (APX601) —
+    observability is no longer part of the host time it measures."""
+    from ..monitor.tracing import DeviceMetricsBuffer
+    from .standalone_gpt import build_train_step, make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O2")
+    buf = DeviceMetricsBuffer(capacity=4)
+    return (build_train_step(setup, telemetry=buf),
+            (setup.params, setup.amp_state, buf.init()))
+
+
 def _build_fused_pipeline_step():
     """The PR-4 persistent packed optimizer pipeline as its own entry:
     one full amp post-backward step (pack -> norm/finite sweep ->
@@ -208,6 +226,12 @@ register_entry_point(
     "bert_train_step", _build_bert_train_step, policy="O2",
     dead_args=(0, 1),
     doc="standalone-BERT smoke train step (LM + NSP loss)")
+register_entry_point(
+    "gpt_train_step_deferred", _build_gpt_train_step_deferred,
+    policy="O2", dead_args=(0, 1, 2),
+    doc="GPT smoke train step with the deferred-telemetry device ring "
+        "appended in-jit (monitor.tracing.DeviceMetricsBuffer) — the "
+        "static zero-host-transfer proof; params/state/ring donated")
 register_entry_point(
     "fused_pipeline_step", _build_fused_pipeline_step, policy="O5",
     dead_args=(0, 1, 2),

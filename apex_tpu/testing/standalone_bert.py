@@ -233,18 +233,19 @@ def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
                           amp_opt, amp_state, int(n_params))
 
 
-def build_train_step(setup: BertSmokeSetup):
+def build_train_step(setup: BertSmokeSetup, *, telemetry=None):
     """The jitted BERT smoke train step (LM + NSP loss through amp).
     ``params``/``amp_state`` are donated, exactly as in
     :func:`.standalone_gpt.build_train_step` — the loop rebinds both,
-    and undonated masters/optimizer state double their HBM (APX601)."""
+    and undonated masters/optimizer state double their HBM (APX601).
+    ``telemetry`` (a ``DeviceMetricsBuffer``) switches to the deferred
+    three-argument form, same as the GPT driver."""
     from ..transformer.pipeline_parallel.utils import param_l2_norm
 
     model, tokens, mask = setup.model, setup.tokens, setup.mask
     labels, nsp, amp_opt = setup.labels, setup.nsp, setup.amp_opt
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, amp_state):
+    def _step(params, amp_state):
         def loss_fn(p):
             from ..contrib.xentropy import softmax_cross_entropy_loss
 
@@ -264,7 +265,11 @@ def build_train_step(setup: BertSmokeSetup):
             param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
-    return step
+    if telemetry is None:
+        return functools.partial(jax.jit, donate_argnums=(0, 1))(_step)
+    from .standalone_gpt import wrap_deferred_step
+
+    return wrap_deferred_step(_step, telemetry)
 
 
 def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
@@ -275,16 +280,21 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
                 ckpt_keep: int = 3, resume: bool = True,
                 fault=None, autoresume="auto", escalation=None,
-                return_state: bool = False):
+                return_state: bool = False,
+                trace_dir: Optional[str] = None,
+                drain_every: Optional[int] = None):
     """Tiny single-device BERT train loop wired through
     :mod:`apex_tpu.monitor` — the BERT sibling of
     :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
     stream: step metrics, amp scale, phase timers, watchdog — and the
     same resilience wiring: periodic checkpoints + auto-resume under
-    ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe exit),
+    ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe
+    exit; same observability wiring: ``trace_dir`` wall-time
+    waterfall + Chrome export, ``drain_every`` deferred telemetry),
     proving both paths are driver-agnostic.  Returns the final loss, or
     ``(loss, params, amp_state, steps_done)`` with
     ``return_state=True``."""
+    from ..analysis.flags import flag_int
     from ..transformer.pipeline_parallel.utils import Timers
     from .standalone_gpt import _run_smoke_loop, make_smoke_monitor
 
@@ -292,7 +302,15 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         vocab=vocab, hidden=hidden, num_heads=num_heads,
         num_layers=num_layers, batch=batch, seq=seq,
         opt_level=opt_level, lr=lr, seed=seed)
-    step = build_train_step(setup)
+    if drain_every is None:
+        drain_every = flag_int("APEX_TPU_TELEMETRY_DRAIN_EVERY")
+    telemetry = None
+    if drain_every and drain_every > 0:
+        from ..monitor.tracing import DeferredTelemetry
+
+        telemetry = DeferredTelemetry(drain_every)
+    step = build_train_step(
+        setup, telemetry=telemetry.buffer if telemetry else None)
     params, amp_opt, amp_state = (setup.params, setup.amp_opt,
                                   setup.amp_state)
     n_params = setup.n_params
@@ -302,13 +320,21 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         stall_timeout=stall_timeout, escalation=escalation,
         run_attrs={"driver": "standalone_bert.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
-                   "batch": batch, "seq": seq})
+                   "batch": batch, "seq": seq,
+                   "telemetry": "deferred" if telemetry else "sync"})
     timers = Timers()
+    trace = None
+    if trace_dir is not None:
+        from ..monitor.tracing import TraceSession
+
+        trace = TraceSession.from_flags(trace_dir, sink=monitor,
+                                        timers=timers)
     return _run_smoke_loop(
         step, params, amp_opt, amp_state, steps, monitor, timers, lr=lr,
         ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
         resume=resume, fault=fault, autoresume=autoresume,
-        escalation=escalation, return_state=return_state)
+        escalation=escalation, return_state=return_state,
+        trace=trace, telemetry=telemetry)
 
 
 def _main(argv=None):
@@ -325,13 +351,19 @@ def _main(argv=None):
                    help="event-log path (default: in-memory only)")
     p.add_argument("--opt-level", default="O2")
     p.add_argument("--stall-timeout", type=float, default=300.0)
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="wall-time attribution (see standalone_gpt)")
+    p.add_argument("--telemetry-drain-every", type=int, default=None,
+                   metavar="K", help="deferred telemetry cadence "
+                                     "(see standalone_gpt)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     loss, _, _, done = train_smoke(
         steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
         stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, resume=not args.no_resume,
-        fault=args.fault, return_state=True)
+        fault=args.fault, return_state=True, trace_dir=args.trace,
+        drain_every=args.telemetry_drain_every)
     print(f"SMOKE_DONE steps_done={done}"
           + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))

@@ -302,21 +302,28 @@ def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
                       amp_state, int(n_params))
 
 
-def build_train_step(setup: SmokeSetup):
+def build_train_step(setup: SmokeSetup, *, telemetry=None):
     """The jitted smoke train step: forward, scaled loss, backward,
     amp apply.  ``params`` and ``amp_state`` are DONATED — the loop
     rebinds both every step, and without donation XLA double-buffers
     the masters and optimizer state (the APX601 finding this fixed:
     fp32 masters + m/v are the largest buffers in the step).  Returns
     ``step(params, amp_state) -> (params, amp_state, loss, gnorm,
-    info)``."""
+    info)``.
+
+    With ``telemetry`` (an :class:`apex_tpu.monitor.tracing.
+    DeviceMetricsBuffer`) the step takes and returns the buffer's ring
+    state as a third donated argument and appends this step's scalars
+    (loss, grad-norm, loss-scale, overflow, skip count) **inside the
+    jit** — the deferred-telemetry mode where the loop performs zero
+    per-step host transfers: ``step(params, amp_state, tstate) ->
+    (params, amp_state, tstate, loss, gnorm, info)``."""
     from ..transformer.pipeline_parallel.utils import param_l2_norm
 
     model, tokens, labels = setup.model, setup.tokens, setup.labels
     amp_opt = setup.amp_opt
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, amp_state):
+    def _step(params, amp_state):
         def loss_fn(p):
             logits = model.apply({"params": p}, tokens)
             loss = gpt_loss(logits, labels)
@@ -331,7 +338,31 @@ def build_train_step(setup: SmokeSetup):
             param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
-    return step
+    if telemetry is None:
+        return functools.partial(jax.jit, donate_argnums=(0, 1))(_step)
+    return wrap_deferred_step(_step, telemetry)
+
+
+def wrap_deferred_step(step_fn, telemetry):
+    """Wrap an unjitted ``step_fn(params, amp_state) -> (params,
+    amp_state, loss, gnorm, info)`` smoke step with the in-jit
+    deferred-telemetry append — ONE wrapper shared by the GPT and
+    BERT drivers so the recorded metric set cannot diverge between
+    them.  Returns the jitted three-argument deferred form (all
+    arguments donated)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step_deferred(params, amp_state, tstate):
+        new_params, new_state, loss, gnorm, info = step_fn(params,
+                                                           amp_state)
+        tstate = telemetry.append(
+            tstate, loss=loss, grad_norm=gnorm,
+            loss_scale=info.loss_scale,
+            overflow=1.0 - info.grads_finite.astype(jnp.float32),
+            steps_skipped=info.steps_skipped)
+        return new_params, new_state, tstate, loss, gnorm, info
+
+    return step_deferred
 
 
 # ---------------------------------------------------------------------------
@@ -364,12 +395,30 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
                         timers, lr=None, *, start_step: int = 0,
                         ckpt=None, ckpt_every: int = 1, amp_opt=None,
                         autoresume=None, escalation=None, fault=None,
-                        sanitizer=None):
+                        sanitizer=None, trace=None, telemetry=None):
     """Drive ``step_fn(params, amp_state) -> (params, amp_state, loss,
     grad_norm, step_info)`` for steps ``[start_step, steps)``,
     recording each through an :class:`apex_tpu.monitor.StepMonitor` and
     exporting the per-step phase ``timers`` into the same event log.
     Shared by the GPT and BERT smoke drivers.
+
+    The observability wiring (both optional):
+
+    * ``trace`` — an :class:`apex_tpu.monitor.tracing.TraceSession`:
+      every step is attributed over the canonical waterfall parts
+      (``data_load`` / ``dispatch`` / ``device_compute`` from the
+      block_until_ready boundary / ``telemetry_drain`` / ``ckpt_io`` /
+      ``other`` residual), emitted per step as an ``attr`` event plus
+      host spans, and the capture trigger is polled at each boundary.
+    * ``telemetry`` — an :class:`apex_tpu.monitor.tracing.
+      DeferredTelemetry`; ``step_fn`` must then be the deferred variant
+      from ``build_train_step(setup, telemetry=buf)``.  Per-step
+      scalars stay device-resident and drain every K steps through one
+      explicit ``jax.device_get`` — the loop performs **zero** per-step
+      host transfers (provable with ``sanitize(transfer_guard=
+      "disallow", transfer_scope="device_to_host")``).  Deferred mode
+      skips ``fault.observed_loss`` (losses are not host-visible at
+      step time).
 
     The resilience wiring is all optional (None = PR-2 behavior):
 
@@ -391,8 +440,15 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
 
     Returns ``(params, amp_state, last_loss, steps_done)``.
     """
+    import contextlib as _ctx
+
     loss_f = None
     done = start_step
+    wf = trace.waterfall if trace is not None else None
+    capture = trace.capture if trace is not None else None
+
+    def part(name):
+        return wf.part(name) if wf is not None else _ctx.nullcontext()
 
     def save(step, sync=False):
         ckpt.save(step, params, amp_opt, amp_state)
@@ -400,18 +456,45 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
             ckpt.wait()
 
     for i in range(start_step, steps):
-        if fault is not None:
-            fault.before_step(i)
+        if wf is not None:
+            wf.begin_step(i)
+        with part("data_load"):
+            # the smoke workload is synthetic (tokens fixed at build);
+            # a real driver wraps its loader fetch here.  The canonical
+            # span still closes every step so the waterfall shape is
+            # uniform across drivers.
+            if fault is not None:
+                fault.before_step(i)
         monitor.start_step(i)
         timers("step").start()
-        params, amp_state, loss, gnorm, info = step_fn(params, amp_state)
-        timers("step").stop(wait_on=loss)
-        loss_f = float(loss)
-        if fault is not None:
-            loss_f = fault.observed_loss(i, loss_f)
-        monitor.end_step(i, loss=loss_f, grad_norm=gnorm, lr=lr,
-                         scaler=info)
-        timers.events(monitor, i, reset=True)
+        with part("dispatch"):
+            # async dispatch: this returns at enqueue; the device runs on
+            if telemetry is not None:
+                params, amp_state, loss, gnorm, info = telemetry.step(
+                    step_fn, params, amp_state, step=i)
+            else:
+                params, amp_state, loss, gnorm, info = step_fn(
+                    params, amp_state)
+        with part("device_compute"):
+            # the block_until_ready boundary: host time spent waiting
+            # on the device (timers("step") syncs on the step outputs)
+            timers("step").stop(wait_on=loss)
+        with part("telemetry_drain"):
+            if telemetry is None:
+                loss_f = float(loss)
+                if fault is not None:
+                    loss_f = fault.observed_loss(i, loss_f)
+                monitor.end_step(i, loss=loss_f, grad_norm=gnorm,
+                                 lr=lr, scaler=info)
+            else:
+                # host-clock metrics only (step_ms, tokens/s, MFU) —
+                # no device value is touched at step time
+                monitor.end_step(i, lr=lr)
+                if telemetry.maybe_drain(monitor):
+                    loss_f = telemetry.last_metrics.get("loss")
+            timers.events(monitor, i, reset=True)
+            if trace is not None:
+                trace.flush(monitor, step=i)
         if sanitizer is not None:
             sanitizer.step()  # post-warmup recompile -> raise here
         done = i + 1
@@ -428,9 +511,16 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
                           and ckpt is not None)
             raise EscalationAbort(esc.alarm, esc.action, step=i)
         saved = False
-        if ckpt is not None and done % max(1, ckpt_every) == 0:
-            save(done)
-            saved = True
+        with part("ckpt_io"):
+            # always closes (zero-length when no manager/cadence hit)
+            # so the canonical waterfall shape is uniform per step
+            if ckpt is not None and done % max(1, ckpt_every) == 0:
+                save(done)
+                saved = True
+        if wf is not None:
+            wf.end_step(monitor, step=i)
+        if capture is not None:
+            capture.poll(i)
         if autoresume is not None and autoresume.termination_requested():
             if ckpt is not None:
                 if not saved:
@@ -441,6 +531,9 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
             monitor.event("resilience", "preempt_exit", step=i,
                           value=done, source=autoresume.source)
             break
+    if telemetry is not None and telemetry.maybe_drain(monitor,
+                                                       force=True):
+        loss_f = telemetry.last_metrics.get("loss")
     return params, amp_state, loss_f, done
 
 
@@ -452,7 +545,9 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 ckpt_dir: Optional[str] = None, ckpt_every: int = 1,
                 ckpt_keep: int = 3, resume: bool = True,
                 fault=None, autoresume="auto", escalation=None,
-                return_state: bool = False, sanitize: bool = False):
+                return_state: bool = False, sanitize: bool = False,
+                trace_dir: Optional[str] = None,
+                drain_every: Optional[int] = None):
     """Tiny single-device GPT train loop wired end-to-end through
     :mod:`apex_tpu.monitor` — the CPU telemetry smoke (exercised by
     tools/ci.sh on every run): step metrics (loss, grad-norm, lr,
@@ -483,14 +578,34 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     :class:`~apex_tpu.resilience.EscalationPolicy` latched into the
     watchdog.  A crashing step emits a terminal ``run_error`` event
     before the exception propagates.
+
+    ``trace_dir`` enables the wall-time attribution tracer
+    (:mod:`apex_tpu.monitor.tracing`): per-step waterfall rows + host
+    spans in the event log, a ``trace.chrome.json`` Perfetto artifact
+    in the directory, and the on-demand capture trigger per the
+    ``APEX_TPU_TRACE_*`` flags.  ``drain_every`` >= 1 switches to
+    sync-free deferred telemetry (device metrics ring drained every K
+    steps — zero per-step host transfers; with ``sanitize=True`` the
+    transfer guard proves it); None reads
+    ``APEX_TPU_TELEMETRY_DRAIN_EVERY``, 0 is the classic synchronous
+    path.
     """
+    from ..analysis.flags import flag_int
     from ..transformer.pipeline_parallel.utils import Timers
 
     setup = make_smoke_setup(
         vocab=vocab, hidden=hidden, num_heads=num_heads,
         num_layers=num_layers, batch=batch, seq=seq,
         opt_level=opt_level, lr=lr, seed=seed)
-    step = build_train_step(setup)
+    if drain_every is None:
+        drain_every = flag_int("APEX_TPU_TELEMETRY_DRAIN_EVERY")
+    telemetry = None
+    if drain_every and drain_every > 0:
+        from ..monitor.tracing import DeferredTelemetry
+
+        telemetry = DeferredTelemetry(drain_every)
+    step = build_train_step(
+        setup, telemetry=telemetry.buffer if telemetry else None)
     params, amp_opt, amp_state = (setup.params, setup.amp_opt,
                                   setup.amp_state)
     n_params = setup.n_params
@@ -501,25 +616,36 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         stall_timeout=stall_timeout, escalation=escalation,
         run_attrs={"driver": "standalone_gpt.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
-                   "batch": batch, "seq": seq})
+                   "batch": batch, "seq": seq,
+                   "telemetry": "deferred" if telemetry else "sync"})
     timers = Timers()
+    trace = None
+    if trace_dir is not None:
+        from ..monitor.tracing import TraceSession
+
+        trace = TraceSession.from_flags(trace_dir, sink=monitor,
+                                        timers=timers)
     return _run_smoke_loop(
         step, params, amp_opt, amp_state, steps, monitor, timers, lr=lr,
         ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
         resume=resume, fault=fault, autoresume=autoresume,
         escalation=escalation, return_state=return_state,
-        sanitize=sanitize)
+        sanitize=sanitize, trace=trace, telemetry=telemetry)
 
 
 def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                     timers, *, lr, ckpt_dir, ckpt_every, ckpt_keep,
                     resume, fault, autoresume, escalation, return_state,
-                    sanitize: bool = False):
+                    sanitize: bool = False, trace=None, telemetry=None):
     """Resilience-wired driver shell shared by the GPT and BERT smokes:
     checkpoint manager + auto-resume bootstrap around
     :func:`run_monitored_steps`, ``run_error`` emission on a crashing
     step, and guaranteed teardown (watchdog heartbeat, JSONL sink,
-    pending async saves) via ``try/finally``."""
+    pending async saves, trace session -> Chrome artifact) via
+    ``try/finally``.  With ``telemetry`` (deferred mode) the
+    ``sanitize`` contract tightens: the device→host transfer guard is
+    armed too, so ANY per-step implicit host readback fails the run —
+    the zero-transfer proof, not just the recompile budget."""
     from ..resilience import AutoResume, parse_fault
     from ..utils import CheckpointManager
 
@@ -558,36 +684,61 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                 # smoke contract: the jitted step compiles once during
                 # the first (warmup) step and never again — a
                 # post-warmup recompile raises RecompileBudgetExceeded
-                # out of the loop
+                # out of the loop.  Deferred telemetry additionally
+                # arms the d->h transfer guard: the ring's explicit
+                # device_get drain is the ONLY permitted readback
+                # (sync mode keeps transfers unguarded — its per-step
+                # float(loss) is an expected, explicit design choice).
                 from ..analysis import sanitize as sanitize_ctx
 
                 san = stack.enter_context(sanitize_ctx(
-                    transfer_guard=None, recompile_budget=0,
-                    warmup_steps=1))
+                    transfer_guard=("disallow" if telemetry is not None
+                                    else None),
+                    transfer_scope="device_to_host",
+                    recompile_budget=0, warmup_steps=1))
             params, amp_state, loss_f, done = run_monitored_steps(
                 step_fn, params, amp_state, steps, monitor, timers,
                 lr=lr, start_step=start_step, ckpt=mgr,
                 ckpt_every=ckpt_every, amp_opt=amp_opt,
                 autoresume=autoresume, escalation=escalation,
-                fault=fault, sanitizer=san)
+                fault=fault, sanitizer=san, trace=trace,
+                telemetry=telemetry)
     except BaseException as e:
         # terminal record first — the re-raise may end the process
         monitor.event("run", "run_error", step=done,
                       error=type(e).__name__, message=str(e)[:200])
         raise
     finally:
+        if telemetry is not None:
+            # a crash between drains must not lose the ring's pending
+            # steps — they are exactly the losses needed to diagnose
+            # it.  The guard context is closed by now, so the explicit
+            # fetch is unconditionally legal.
+            try:
+                telemetry.maybe_drain(monitor, force=True)
+            except Exception as e:
+                from ..utils.log_util import get_logger
+
+                get_logger(__name__).warning(
+                    "final telemetry drain failed: %s", str(e)[:160])
         # Nested so one teardown failure cannot skip the next: the sink
         # close must not strand a pending async save, and a stranded
         # signal handler would swallow the process's next SIGTERM.
         try:
-            monitor.close()
+            if trace is not None:
+                # flush remaining spans into the (still-open) sink and
+                # commit the Chrome artifact before the sink closes
+                trace.close(monitor)
         finally:
             try:
-                if mgr is not None:
-                    mgr.close()  # pending async saves become durable
+                monitor.close()
             finally:
-                if own_autoresume:
-                    autoresume.uninstall()
+                try:
+                    if mgr is not None:
+                        mgr.close()  # pending async saves become durable
+                finally:
+                    if own_autoresume:
+                        autoresume.uninstall()
     if return_state:
         return loss_f, params, amp_state, done
     return loss_f
@@ -626,14 +777,30 @@ def _main(argv=None):
     p.add_argument("--stall-timeout", type=float, default=300.0)
     p.add_argument("--sanitize", action="store_true",
                    help="run under apex_tpu.analysis.sanitize(): fail "
-                        "if the train step recompiles after warmup")
+                        "if the train step recompiles after warmup "
+                        "(with --telemetry-drain-every also fail on "
+                        "ANY per-step implicit device->host transfer)")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="wall-time attribution: per-step waterfall + "
+                        "host spans into the event log, "
+                        "DIR/trace.chrome.json for Perfetto, and the "
+                        "APEX_TPU_TRACE_* capture triggers")
+    p.add_argument("--telemetry-drain-every", type=int, default=None,
+                   metavar="K",
+                   help="deferred telemetry: accumulate per-step "
+                        "scalars in a device ring, drain every K "
+                        "steps (zero per-step host transfers); "
+                        "default: APEX_TPU_TELEMETRY_DRAIN_EVERY "
+                        "(0 = classic synchronous readback)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     loss, _, _, done = train_smoke(
         steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
         stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, resume=not args.no_resume,
-        fault=args.fault, return_state=True, sanitize=args.sanitize)
+        fault=args.fault, return_state=True, sanitize=args.sanitize,
+        trace_dir=args.trace,
+        drain_every=args.telemetry_drain_every)
     print(f"SMOKE_DONE steps_done={done}"
           + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))

@@ -265,6 +265,29 @@ class Timers:
                             else int(iteration),
                             kind="timer", name=name, value=value))
 
+    def chrome_events(self, tracer, iteration: Optional[int] = None,
+                      names: Optional[Sequence[str]] = None,
+                      reset: bool = True):
+        """Export accumulated phase times into a
+        :class:`apex_tpu.monitor.tracing.SpanTracer` as Chrome-trace
+        ``complete`` events — each timer becomes one bar ending *now*
+        on the tracer's timeline with its accumulated duration, so the
+        schedule phases the transformer stack already times land in
+        the same Perfetto view as the host spans (an aggregate bar,
+        not a per-invocation timeline; ``timer`` JSONL events get the
+        same treatment on the read side via
+        ``chrome_trace_from_events``)."""
+        now = tracer.now()
+        if names is None:
+            names = list(self.timers)
+        for name in names:
+            if name not in self.timers:
+                continue
+            dur = self.timers[name].elapsed(reset=reset)
+            if dur > 0.0:
+                tracer.add_complete(name, now - dur, dur,
+                                    thread="timers", step=iteration)
+
 
 def _set_timers():
     global _GLOBAL_TIMERS

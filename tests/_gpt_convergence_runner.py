@@ -110,14 +110,46 @@ def main(steps: int = 60) -> None:
             run_attrs={"driver": "_gpt_convergence_runner",
                        "tp": 2, "pp": 2, "steps": steps})
 
+    # Opt-in wall-time attribution: APEX_TPU_TRACE_DIR=<dir> (with the
+    # monitor on) records the canonical dispatch / device_compute /
+    # telemetry_drain waterfall per step plus a Perfetto-loadable
+    # trace.chrome.json — the 3D-parallel run's host-side cost becomes
+    # attributable the same way the smoke drivers' is (--trace there).
+    trace = None
+    trace_dir = flag_str("APEX_TPU_TRACE_DIR")
+    if trace_dir and monitor is not None:
+        from apex_tpu.monitor.tracing import TraceSession
+
+        trace = TraceSession.from_flags(trace_dir, sink=monitor)
+
     l0 = None
     for i in range(steps):
         if monitor is not None:
             monitor.start_step(i)
-        params, opt_state, loss = step(params, opt_state)
+        if trace is not None:
+            trace.waterfall.begin_step(i)
+            with trace.waterfall.part("data_load"):
+                pass  # synthetic batch — zero-length canonical span
+            with trace.waterfall.part("dispatch"):
+                params, opt_state, loss = step(params, opt_state)
+            with trace.waterfall.part("device_compute"):
+                jax.block_until_ready(loss)
+            with trace.waterfall.part("ckpt_io"):
+                pass  # no checkpointing in the convergence run
+        else:
+            params, opt_state, loss = step(params, opt_state)
         if monitor is not None:
-            # the monitor's host fetch bounds the dispatch queue too
-            monitor.end_step(i, loss=float(loss))
+            if trace is not None:
+                with trace.waterfall.part("telemetry_drain"):
+                    # the monitor's host fetch bounds the dispatch
+                    # queue too
+                    monitor.end_step(i, loss=float(loss))
+                    trace.flush(monitor, step=i)
+                trace.waterfall.end_step(monitor, step=i)
+                if trace.capture is not None:
+                    trace.capture.poll(i)
+            else:
+                monitor.end_step(i, loss=float(loss))
         elif l0 is None or i % 10 == 0:
             # bound the async dispatch queue: on a single-core host an
             # unbounded queue of in-flight multi-device executions
@@ -127,6 +159,8 @@ def main(steps: int = 60) -> None:
         if l0 is None:
             l0 = float(loss)
     lf = float(loss)
+    if trace is not None:
+        trace.close(monitor)
     if monitor is not None:
         monitor.close()
     assert np.isfinite(lf), f"non-finite loss {lf}"

@@ -1,0 +1,737 @@
+"""apex_tpu.monitor.tracing: the wall-time attribution tracer.
+
+Deterministic coverage of the ISSUE-7 surface:
+
+- fake-clock span semantics: durations, nesting depth, decorator form,
+  thread-safety of the per-thread buffers;
+- the per-step waterfall: parts sum to wall **exactly** (the ``other``
+  residual is defined as the remainder), canonical component set,
+  ``wall_device_ratio``, the ``attr`` event, the on_row hook;
+- Chrome trace-event export validates and round-trips through JSON,
+  both from a live tracer and rebuilt from a JSONL event log
+  (span + timer events);
+- DeviceMetricsBuffer: in-jit append / explicit drain, drain@K
+  bitwise-equal to the synchronous per-step readbacks (K=1 and K=3),
+  and the sanitizer-backed zero-per-step-transfer proof;
+- CaptureTrigger: file-touch and SIGUSR1 open exactly one window and
+  close it after N steps; ratio auto-capture fires once;
+- summary/render: the wall-time attribution table and the captured-
+  traces index.
+"""
+import json
+import os
+import signal
+import threading
+
+import pytest
+
+from apex_tpu.monitor import (Event, MemorySink, load_events, render,
+                              summarize)
+from apex_tpu.monitor.tracing import (CaptureTrigger,
+                                      DeviceMetricsBuffer, SpanTracer,
+                                      StepWaterfall, WATERFALL_PARTS,
+                                      check_trace,
+                                      chrome_trace_from_events,
+                                      set_tracer, span,
+                                      write_chrome_trace)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# SpanTracer
+# ---------------------------------------------------------------------------
+
+class TestSpanTracer:
+    def test_span_duration_and_epoch_anchor(self):
+        fc = FakeClock(100.0)
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 1000.0)
+        with tr.span("work"):
+            fc.advance(0.25)
+        (s,) = tr.drain()
+        assert s.name == "work"
+        assert s.dur == pytest.approx(0.25)
+        # epoch anchor: span started at perf=100 -> wall 1000.0
+        assert s.t0 == pytest.approx(1000.0)
+
+    def test_nesting_depth(self):
+        fc = FakeClock()
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 0.0)
+        with tr.span("outer"):
+            fc.advance(1.0)
+            with tr.span("inner"):
+                fc.advance(0.5)
+        spans = {s.name: s for s in tr.drain()}
+        assert spans["outer"].depth == 0
+        assert spans["inner"].depth == 1
+        assert spans["outer"].dur == pytest.approx(1.5)
+        assert spans["inner"].dur == pytest.approx(0.5)
+
+    def test_decorator_form(self):
+        tr = SpanTracer()
+
+        @tr.span("fn")
+        def fn(x):
+            return x + 1
+
+        assert fn(1) == 2 and fn(2) == 3
+        spans = tr.drain()
+        assert [s.name for s in spans] == ["fn", "fn"]
+
+    def test_thread_safety(self):
+        tr = SpanTracer()
+        barrier = threading.Barrier(4)  # all 4 alive concurrently, so
+        # thread idents cannot be reused across workers
+
+        def work():
+            barrier.wait()
+            for _ in range(100):
+                with tr.span("t"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = tr.drain()
+        assert len(spans) == 400
+        assert all(s.depth == 0 for s in spans)
+        assert len({s.tid for s in spans}) == 4
+
+    def test_events_into_sink(self):
+        fc = FakeClock()
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 5.0)
+        with tr.span("a", tag="x"):
+            fc.advance(0.1)
+        sink = MemorySink()
+        n = tr.events(sink, step=7)
+        assert n == 1
+        (e,) = sink.events
+        assert e.kind == "span" and e.name == "a" and e.step == 7
+        assert e.value == pytest.approx(0.1)
+        assert e.attrs["tag"] == "x" and "t0" in e.attrs
+        # the record survives the JSONL round trip
+        assert Event.from_json(e.to_json()).name == "a"
+
+    def test_module_level_span_is_noop_without_tracer(self):
+        set_tracer(None)
+        with span("nothing"):
+            pass
+        tr = SpanTracer()
+        set_tracer(tr)
+        try:
+            with span("something"):
+                pass
+            assert [s.name for s in tr.drain()] == ["something"]
+        finally:
+            set_tracer(None)
+
+    def test_max_spans_bounds_memory(self):
+        tr = SpanTracer(max_spans=2)
+        for _ in range(5):
+            with tr.span("s"):
+                pass
+        assert len(tr.drain()) == 2
+        assert tr._dropped == 3
+
+    def test_chrome_trace_shape(self, tmp_path):
+        fc = FakeClock()
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 1.0)
+        with tr.span("host_work"):
+            fc.advance(0.002)
+        tr.add_complete("phase", 1.5, 0.25, step=3)
+        path = str(tmp_path / "trace.json")
+        tr.write_chrome_trace(path)
+        with open(path) as f:
+            trace = json.load(f)
+        evs = trace["traceEvents"]
+        xs = [e for e in evs if e.get("ph") == "X"]
+        assert {e["name"] for e in xs} == {"host_work", "phase"}
+        for e in xs:
+            assert e["ts"] >= 0 and e["dur"] > 0 and "pid" in e
+        phase = next(e for e in xs if e["name"] == "phase")
+        assert phase["dur"] == pytest.approx(0.25e6)
+        assert phase["args"]["step"] == 3
+
+
+# ---------------------------------------------------------------------------
+# StepWaterfall
+# ---------------------------------------------------------------------------
+
+class TestStepWaterfall:
+    def _step(self, fc, wf, durs, extra_other=0.0):
+        wf.begin_step(0)
+        for name, d in durs.items():
+            with wf.part(name):
+                fc.advance(d)
+        if extra_other:
+            fc.advance(extra_other)
+        return wf
+
+    def test_parts_sum_to_wall(self):
+        fc = FakeClock()
+        wf = StepWaterfall(clock=fc)
+        durs = {"data_load": 0.002, "dispatch": 0.010,
+                "device_compute": 0.080, "telemetry_drain": 0.003,
+                "ckpt_io": 0.005}
+        self._step(fc, wf, durs, extra_other=0.004)
+        row = wf.end_step()
+        assert row["wall_ms"] == pytest.approx(104.0)
+        parts = sum(v for k, v in row.items() if k.endswith("_ms")
+                    and k != "wall_ms")
+        assert parts == pytest.approx(row["wall_ms"])
+        assert row["other_ms"] == pytest.approx(4.0)
+        assert row["wall_device_ratio"] == pytest.approx(80.0 / 104.0)
+
+    def test_repeated_part_accumulates(self):
+        fc = FakeClock()
+        wf = StepWaterfall(clock=fc)
+        wf.begin_step(1)
+        for _ in range(3):
+            with wf.part("ckpt_io"):
+                fc.advance(0.001)
+        row = wf.end_step()
+        assert row["ckpt_io_ms"] == pytest.approx(3.0)
+
+    def test_attr_event_and_on_row_hook(self):
+        fc = FakeClock()
+        seen = []
+        wf = StepWaterfall(clock=fc, on_row=seen.append)
+        sink = MemorySink()
+        wf.begin_step(5)
+        with wf.part("device_compute"):
+            fc.advance(0.09)
+        fc.advance(0.01)
+        row = wf.end_step(sink, step=5)
+        (e,) = sink.by_kind("attr")
+        assert e.name == "step_waterfall" and e.step == 5
+        assert e.value == pytest.approx(100.0)
+        assert e.attrs["device_compute_ms"] == pytest.approx(90.0)
+        assert e.attrs["wall_device_ratio"] == pytest.approx(0.9)
+        assert seen == [row]
+
+    def test_spans_recorded_through_tracer(self):
+        fc = FakeClock()
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 0.0)
+        wf = StepWaterfall(tr, clock=fc)
+        wf.begin_step(0)
+        with wf.part("dispatch"):
+            fc.advance(0.01)
+        wf.end_step()
+        assert [s.name for s in tr.drain()] == ["dispatch"]
+
+    def test_end_without_begin_raises(self):
+        with pytest.raises(RuntimeError):
+            StepWaterfall().end_step()
+
+
+# ---------------------------------------------------------------------------
+# Chrome rebuild from a JSONL event log + check_trace
+# ---------------------------------------------------------------------------
+
+def _waterfall_jsonl(tmp_path, *, drop_part=None, corrupt_sum=False):
+    """A synthetic traced-run event log with the canonical shape."""
+    fc = FakeClock()
+    tr = SpanTracer(clock=fc, wall_clock=lambda: 0.0)
+    wf = StepWaterfall(tr, clock=fc)
+    sink = MemorySink()
+    for i in range(3):
+        wf.begin_step(i)
+        for name in WATERFALL_PARTS:
+            if name == drop_part:
+                continue
+            with wf.part(name):
+                fc.advance(0.01)
+        fc.advance(0.001)
+        wf.end_step(sink, step=i)
+        tr.events(sink, step=i)
+    sink.emit(Event(time=fc.t, step=None, kind="timer", name="step",
+                    value=0.05))
+    path = str(tmp_path / "run.jsonl")
+    with open(path, "w") as f:
+        for e in sink.events:
+            if corrupt_sum and e.kind == "attr":
+                d = json.loads(e.to_json())
+                d["attrs"]["device_compute_ms"] += 50.0
+                f.write(json.dumps(d) + "\n")
+            else:
+                f.write(e.to_json() + "\n")
+    return path, sink.events
+
+
+class TestChromeAndCheck:
+    def test_rebuild_from_events_round_trips(self, tmp_path):
+        path, events = _waterfall_jsonl(tmp_path)
+        trace = chrome_trace_from_events(events)
+        out = str(tmp_path / "chrome.json")
+        write_chrome_trace(out, trace)
+        with open(out) as f:
+            loaded = json.load(f)
+        xs = [e for e in loaded["traceEvents"] if e.get("ph") == "X"]
+        names = {e["name"] for e in xs}
+        assert set(WATERFALL_PARTS) <= names
+        assert "step" in names  # the timer event became a bar
+        # every complete event is well-formed
+        for e in xs:
+            assert e["dur"] > 0 and isinstance(e["ts"], float)
+
+    def test_check_trace_passes_on_canonical_log(self, tmp_path):
+        path, events = _waterfall_jsonl(tmp_path)
+        chrome = str(tmp_path / "c.json")
+        write_chrome_trace(chrome, chrome_trace_from_events(events))
+        assert check_trace(path, chrome) == []
+
+    def test_check_trace_flags_missing_span(self, tmp_path):
+        path, _ = _waterfall_jsonl(tmp_path, drop_part="ckpt_io")
+        fails = check_trace(path)
+        assert any("ckpt_io" in f for f in fails)
+
+    def test_check_trace_flags_bad_sum(self, tmp_path):
+        path, _ = _waterfall_jsonl(tmp_path, corrupt_sum=True)
+        fails = check_trace(path)
+        assert any("parts sum" in f for f in fails)
+
+    def test_check_trace_flags_unreadable_chrome(self, tmp_path):
+        path, _ = _waterfall_jsonl(tmp_path)
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        fails = check_trace(path, str(bad))
+        assert any("unreadable" in f for f in fails)
+
+
+# ---------------------------------------------------------------------------
+# Deferred telemetry: the device ring
+# ---------------------------------------------------------------------------
+
+def _metric_series(events, kind, name):
+    return [(e.step, e.value) for e in events
+            if e.kind == kind and e.name == name
+            and isinstance(e.value, (int, float))]
+
+
+class TestDeviceMetricsBuffer:
+    def test_append_drain_roundtrip(self):
+        import jax
+        import jax.numpy as jnp
+
+        buf = DeviceMetricsBuffer(4, metrics=("a", "b"))
+        state = buf.init()
+        append = jax.jit(buf.append)
+        for i in range(3):
+            state = append(state, a=jnp.float32(i),
+                           b=jnp.float32(10 * i))
+        count, rows = buf.drain(state, 0)
+        assert count == 3
+        assert rows == [(0, {"a": 0.0, "b": 0.0}),
+                        (1, {"a": 1.0, "b": 10.0}),
+                        (2, {"a": 2.0, "b": 20.0})]
+        # incremental drain picks up only the new rows
+        state = append(state, a=jnp.float32(7), b=jnp.float32(8))
+        count, rows = buf.drain(state, count)
+        assert count == 4 and rows == [(3, {"a": 7.0, "b": 8.0})]
+
+    def test_unknown_metric_rejected(self):
+        buf = DeviceMetricsBuffer(2, metrics=("a",))
+        with pytest.raises(ValueError):
+            buf.append(buf.init(), a=1.0, typo=2.0)
+
+    @pytest.mark.parametrize("drain_every", [1, 3])
+    def test_deferred_bitwise_equals_per_step(self, drain_every):
+        """The acceptance bar: drained metrics at K=1 (and a batched
+        K) are bitwise-identical to the synchronous per-step mode —
+        same steps, same values, same event names."""
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        sync_sink, def_sink = MemorySink(), MemorySink()
+        loss_sync = train_smoke(steps=4, sink=sync_sink,
+                                autoresume=None)
+        loss_def = train_smoke(steps=4, sink=def_sink,
+                               autoresume=None,
+                               drain_every=drain_every)
+        assert loss_sync == loss_def
+        for kind, name in (("metric", "loss"), ("metric", "grad_norm"),
+                           ("scale", "loss_scale")):
+            a = _metric_series(sync_sink.events, kind, name)
+            b = _metric_series(def_sink.events, kind, name)
+            assert a == b, (kind, name, a, b)
+
+    def test_deferred_passes_d2h_transfer_guard(self):
+        """Zero per-step host transfers, sanitizer-proven: the
+        deferred loop runs green under sanitize(transfer_guard=
+        'disallow', transfer_scope='device_to_host'), which
+        _run_smoke_loop arms automatically for deferred + sanitize.
+        On the CPU backend the d→h guard is physically vacuous (the
+        buffers already live on the host), so the CPU-side teeth are
+        the drain-count proof below plus the static APX604 audit of
+        the ``gpt_train_step_deferred`` entry; on a device backend
+        this same leg is the runtime proof.  The guard machinery
+        itself is shown live via the h2d direction, which does fire
+        on every backend."""
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        sink = MemorySink()
+        loss = train_smoke(steps=3, sink=sink, autoresume=None,
+                           drain_every=1, sanitize=True)
+        assert loss is not None
+        assert _metric_series(sink.events, "metric", "loss")
+        # the guard machinery is real in this environment: the full
+        # transfer guard rejects an implicit transfer
+        x = jnp.float32(1.0) + jnp.float32(1.0)
+        with pytest.raises(Exception, match="[Tt]ransfer"):
+            with jax.transfer_guard("disallow"):
+                float(x + 1)
+
+    def test_deferred_host_fetch_count_is_drains_only(self, monkeypatch):
+        """The backend-independent zero-per-step-transfer proof: over
+        N steps at cadence K the ONLY device→host fetches the loop
+        performs are ceil(N/K) ring drains — no fetch scales with the
+        step count."""
+        from apex_tpu.monitor import tracing
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        calls = []
+        real_drain = tracing.DeviceMetricsBuffer.drain
+        monkeypatch.setattr(
+            tracing.DeviceMetricsBuffer, "drain",
+            lambda self, state, drained: calls.append(1)
+            or real_drain(self, state, drained))
+        sink = MemorySink()
+        train_smoke(steps=5, sink=sink, autoresume=None, drain_every=3)
+        # one drain at step 2 (3 pending) + the forced final drain
+        assert len(calls) == 2
+        assert len(_metric_series(sink.events, "metric", "loss")) == 5
+
+    def test_crash_drains_pending_ring(self):
+        """A step that raises between drains must not lose the ring's
+        pending metrics — the crashed run's JSONL still carries every
+        completed step's loss (the series needed to diagnose it)."""
+        from apex_tpu.resilience import InjectedCrash
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        sink = MemorySink()
+        with pytest.raises(InjectedCrash):
+            train_smoke(steps=6, sink=sink, autoresume=None,
+                        drain_every=8, fault="crash@4")
+        drained = _metric_series(sink.events, "metric", "loss")
+        assert [s for s, _ in drained] == [0, 1, 2, 3]
+        assert any(e.name == "run_error" for e in sink.events)
+
+    def test_deferred_run_attrs_and_step_ms_present(self):
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        sink = MemorySink()
+        train_smoke(steps=2, sink=sink, autoresume=None, drain_every=2)
+        (start,) = [e for e in sink.events
+                    if e.kind == "run" and e.name == "run_start"]
+        assert start.attrs["telemetry"] == "deferred"
+        # host-clock metrics still flow per step (no device reads)
+        assert len(_metric_series(sink.events, "metric",
+                                  "step_ms")) == 2
+
+
+# ---------------------------------------------------------------------------
+# CaptureTrigger
+# ---------------------------------------------------------------------------
+
+class FakeWindow:
+    def __init__(self, logdir, start_iter, stop_iter, timers=None):
+        self.logdir = logdir
+        self.start_iter, self.stop_iter = start_iter, stop_iter
+        self.steps = []
+        self.closed = False
+
+    def step(self, iteration):
+        self.steps.append(iteration)
+
+    def close(self):
+        self.closed = True
+
+
+class TestCaptureTrigger:
+    def test_file_touch_opens_and_closes_exactly_once(self, tmp_path):
+        trig = str(tmp_path / "touch-me")
+        windows = []
+
+        def factory(*a, **kw):
+            windows.append(FakeWindow(*a, **kw))
+            return windows[-1]
+
+        sink = MemorySink()
+        cap = CaptureTrigger(str(tmp_path / "prof"), steps=2,
+                             trigger_file=trig, window_factory=factory,
+                             sink=sink)
+        cap.poll(0)
+        assert windows == []            # no trigger yet
+        open(trig, "w").close()
+        for i in range(1, 6):
+            cap.poll(i)
+        assert not os.path.exists(trig)  # consumed
+        assert len(windows) == 1         # exactly one window
+        w = windows[0]
+        assert w.start_iter == 1 and w.stop_iter == 3
+        assert w.steps == [1, 2, 3]      # driven to its stop boundary
+        names = [e.name for e in sink.by_kind("trace")]
+        assert names.count("capture_started") == 1
+        assert names.count("capture_stopped") == 1
+        cap.close()
+
+    def test_sigusr1_opens_exactly_once(self, tmp_path):
+        windows = []
+        sink = MemorySink()
+        cap = CaptureTrigger(
+            str(tmp_path), steps=1, signum=signal.SIGUSR1,
+            window_factory=lambda *a, **kw: (
+                windows.append(FakeWindow(*a, **kw)) or windows[-1]),
+            sink=sink)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            cap.poll(3)
+            cap.poll(4)
+            cap.poll(5)
+            assert len(windows) == 1
+            assert windows[0].start_iter == 3
+        finally:
+            cap.close()
+        # handler restored: a SIGUSR1 after close must not re-arm
+        assert cap._pending is None
+        # the signal source shows up in the requested/opened accounting
+        # like the other two trigger sources (emitted at the consuming
+        # poll, never from the signal handler itself)
+        req = [e for e in sink.by_kind("trace")
+               if e.name == "capture_requested"]
+        assert len(req) == 1 and req[0].attrs["reason"] == "signal"
+
+    def test_ratio_autocapture_fires_once(self, tmp_path):
+        windows = []
+        sink = MemorySink()
+        cap = CaptureTrigger(
+            str(tmp_path), steps=1, ratio_min=0.9,
+            window_factory=lambda *a, **kw: (
+                windows.append(FakeWindow(*a, **kw)) or windows[-1]),
+            sink=sink)
+        cap.observe_ratio(0.95, step=0)     # healthy: no trigger
+        cap.poll(0)
+        assert windows == []
+        cap.observe_ratio(0.4, step=1)      # below threshold
+        cap.poll(1)
+        cap.poll(2)
+        cap.observe_ratio(0.3, step=3)      # bounded: once per run
+        cap.poll(3)
+        cap.poll(4)
+        assert len(windows) == 1
+        req = [e for e in sink.by_kind("trace")
+               if e.name == "capture_requested"]
+        assert len(req) == 1
+        assert req[0].attrs["reason"] == "wall_device_ratio"
+
+    def test_failed_window_step_is_closed_not_leaked(self, tmp_path):
+        """A window whose step() raises must be close()d (an abandoned
+        jax.profiler session breaks every later capture) and must
+        still emit capture_stopped so the index never shows it open
+        forever."""
+        class ExplodingWindow(FakeWindow):
+            def step(self, iteration):
+                raise RuntimeError("xplane write error")
+
+        windows = []
+        sink = MemorySink()
+        cap = CaptureTrigger(
+            str(tmp_path), steps=2,
+            window_factory=lambda *a, **kw: (
+                windows.append(ExplodingWindow(*a, **kw))
+                or windows[-1]),
+            sink=sink)
+        cap.request("manual")
+        cap.poll(0)
+        assert windows[0].closed
+        names = [e.name for e in sink.by_kind("trace")]
+        assert names.count("capture_stopped") == 1
+        # the trigger recovers: a later request opens a fresh window
+        cap.request("again")
+        cap.poll(5)
+        assert len(windows) == 2
+        cap.close()
+
+    def test_ratio_budget_not_spent_while_window_open(self, tmp_path):
+        """A below-threshold ratio observed while another capture is
+        open must not consume the once-per-run auto budget — the
+        request would be dropped, so a later genuine degradation
+        still gets its window."""
+        windows = []
+        cap = CaptureTrigger(
+            str(tmp_path), steps=3, ratio_min=0.9,
+            window_factory=lambda *a, **kw: (
+                windows.append(FakeWindow(*a, **kw)) or windows[-1]))
+        cap.request("manual")
+        cap.poll(0)                      # manual window opens [0, 3)
+        cap.observe_ratio(0.2, step=1)   # dropped — must not spend
+        cap.poll(1)
+        cap.poll(2)
+        cap.poll(3)                      # manual window closes
+        assert len(windows) == 1
+        cap.observe_ratio(0.2, step=4)   # genuine: budget intact
+        cap.poll(4)
+        assert len(windows) == 2
+        cap.close()
+
+    def test_retrigger_while_open_is_ignored(self, tmp_path):
+        windows = []
+        cap = CaptureTrigger(
+            str(tmp_path), steps=3,
+            window_factory=lambda *a, **kw: (
+                windows.append(FakeWindow(*a, **kw)) or windows[-1]))
+        cap.request("manual")
+        cap.poll(0)
+        cap.request("manual-again")         # window open: ignored
+        cap.poll(1)
+        cap.poll(2)
+        cap.poll(3)                         # closes here
+        cap.poll(4)
+        assert len(windows) == 1
+
+
+# ---------------------------------------------------------------------------
+# Summary rendering
+# ---------------------------------------------------------------------------
+
+class TestSummaryAttribution:
+    def test_attribution_digest_and_render(self, tmp_path):
+        path, events = _waterfall_jsonl(tmp_path)
+        s = summarize(events)
+        att = s["attribution"]
+        assert att["steps"] == 3
+        comps = att["components"]
+        assert set(WATERFALL_PARTS) <= set(comps)
+        # each canonical part ran 10 ms per step in the fixture
+        assert comps["dispatch"]["mean_ms"] == pytest.approx(10.0)
+        assert comps["dispatch"]["p99_ms"] == pytest.approx(10.0)
+        assert att["worst_step"]["step"] in (0, 1, 2)
+        assert 0.0 < att["wall_device_ratio_mean"] < 1.0
+        text = render(s)
+        assert "wall-time attribution" in text
+        assert "device_compute" in text and "worst step" in text
+
+    def test_captures_index_rendered(self):
+        events = [
+            Event(time=1.0, step=4, kind="trace",
+                  name="capture_requested", attrs={"reason": "file"}),
+            Event(time=1.1, step=5, kind="trace",
+                  name="capture_started",
+                  attrs={"reason": "file", "trace_dir": "/tmp/x",
+                         "stop": 7}),
+            Event(time=1.2, step=7, kind="trace",
+                  name="capture_stopped",
+                  attrs={"trace_dir": "/tmp/x"}),
+        ]
+        s = summarize(events)
+        caps = s["captures"]
+        assert caps["requested"] == 1
+        (w,) = caps["windows"]
+        assert w["trace_dir"] == "/tmp/x" and w["stopped_at"] == 7
+        text = render(s)
+        assert "captured traces" in text and "closed @ 7" in text
+
+    def test_open_at_exit_window_rendered(self):
+        # a window still open at teardown: CaptureTrigger.close()
+        # emits a step-less capture_stopped (stopped_at None)
+        events = [
+            Event(time=1.0, step=9, kind="trace",
+                  name="capture_started",
+                  attrs={"reason": "signal", "trace_dir": "/tmp/y"}),
+            Event(time=1.1, step=None, kind="trace",
+                  name="capture_stopped",
+                  attrs={"trace_dir": "/tmp/y", "at_close": True}),
+        ]
+        text = render(summarize(events))
+        assert "(open at exit)" in text and "closed @ None" not in text
+
+    def test_summary_cli_chrome_export(self, tmp_path):
+        from apex_tpu.monitor.summary import main
+
+        path, _ = _waterfall_jsonl(tmp_path)
+        out = str(tmp_path / "out.chrome.json")
+        assert main([path, "--chrome", out]) == 0
+        with open(out) as f:
+            trace = json.load(f)
+        assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# Timers -> Chrome complete events
+# ---------------------------------------------------------------------------
+
+class TestTimersChromeExport:
+    def test_accumulated_timer_becomes_complete_event(self):
+        from apex_tpu.transformer.pipeline_parallel.utils import Timers
+
+        fc = FakeClock()
+        tr = SpanTracer(clock=fc, wall_clock=lambda: 0.0)
+        timers = Timers()
+        t = timers("fwd")
+        # drive the timer's internal clock manually (no device work)
+        t._started = True
+        t._elapsed = 0.125
+        t._started = False
+        timers.chrome_events(tr, iteration=2)
+        (s,) = tr.drain()
+        assert s.name == "fwd" and s.step == 2
+        assert s.dur == pytest.approx(0.125)
+        ev = s.chrome_event()
+        assert ev["ph"] == "X" and ev["dur"] == pytest.approx(0.125e6)
+
+
+# ---------------------------------------------------------------------------
+# The traced smoke loop end-to-end (CPU)
+# ---------------------------------------------------------------------------
+
+class TestTraceSessionBounds:
+    def test_chrome_span_cap_jsonl_stays_complete(self, tmp_path):
+        from apex_tpu.monitor.tracing import TraceSession
+
+        ts = TraceSession(str(tmp_path), max_spans=5)
+        for _ in range(10):
+            with ts.tracer.span("s"):
+                pass
+        sink = MemorySink()
+        ts.flush(sink)
+        path = ts.close()
+        # the JSONL event stream is the complete record...
+        assert len(sink.by_kind("span")) == 10
+        # ...while the Chrome artifact keeps the capped prefix
+        with open(path) as f:
+            xs = [e for e in json.load(f)["traceEvents"]
+                  if e.get("ph") == "X"]
+        assert len(xs) == 5
+        assert ts._session_dropped == 5
+
+
+class TestTracedSmokeLoop:
+    def test_trace_dir_produces_waterfall_and_chrome(self, tmp_path):
+        from apex_tpu.testing.standalone_gpt import train_smoke
+
+        jsonl = str(tmp_path / "run.jsonl")
+        train_smoke(steps=3, jsonl=jsonl, autoresume=None,
+                    trace_dir=str(tmp_path))
+        chrome = tmp_path / "trace.chrome.json"
+        assert chrome.exists()
+        assert check_trace(jsonl, str(chrome)) == []
+        events, malformed = load_events(jsonl)
+        assert malformed == 0
+        rows = [e for e in events if e.kind == "attr"]
+        assert len(rows) == 3
+        for e in rows:
+            assert e.attrs["wall_device_ratio"] >= 0.0

@@ -51,28 +51,41 @@
 #                            APEX_TPU_BENCH_GATE=1 on a bench host,
 #                            a quick-tier bench run through
 #                            tools/bench_gate.py)
+#   9. trace smoke          — a 3-step standalone_gpt run with
+#                            --trace must emit the canonical wall-time
+#                            waterfall (data_load/dispatch/
+#                            device_compute/telemetry_drain/ckpt_io +
+#                            other residual, parts summing to wall_ms)
+#                            and a parseable Chrome trace artifact;
+#                            then the same run in deferred-telemetry
+#                            mode (--telemetry-drain-every 1) must
+#                            pass --sanitize with the device->host
+#                            transfer guard armed — zero per-step
+#                            host transfers, metrics drained through
+#                            the device ring (docs/api/
+#                            observability.md)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/8 default test tier"
+echo "[ci] 1/9 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/8 README drift guard"
+echo "[ci] 2/9 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/8 8-device multichip dryrun"
+echo "[ci] 3/9 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/8 monitor smoke"
+echo "[ci] 4/9 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/8 kill->resume smoke"
+echo "[ci] 5/9 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -92,16 +105,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/8 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/9 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/8 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/9 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/8 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/9 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -109,5 +122,26 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python bench.py --quick
     python tools/bench_gate.py
 fi
+
+echo "[ci] 9/9 trace smoke (waterfall + chrome + deferred telemetry)"
+TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
+# leg 1: traced run — canonical spans, waterfall rows summing to
+# wall_ms, and a parseable Chrome artifact
+python -m apex_tpu.testing.standalone_gpt --steps 3 \
+    --jsonl "$TRACE_DIR/run.jsonl" --trace "$TRACE_DIR"
+python tools/trace_check.py "$TRACE_DIR/run.jsonl" \
+    --chrome "$TRACE_DIR/trace.chrome.json"
+python tools/monitor_summary.py "$TRACE_DIR/run.jsonl" \
+    --chrome "$TRACE_DIR/rebuilt.chrome.json"
+# leg 2: deferred telemetry must survive the sanitizer with the
+# device->host transfer guard armed (zero per-step host transfers)
+# while still draining the full loss series into the log
+python -m apex_tpu.testing.standalone_gpt --steps 3 \
+    --jsonl "$TRACE_DIR/deferred.jsonl" --telemetry-drain-every 1 \
+    --sanitize
+grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
+    || { echo "[ci] FAIL: deferred run drained no loss metrics"; \
+         exit 1; }
+rm -rf "$TRACE_DIR"
 
 echo "[ci] all green"

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Validate a traced run's event log + Chrome artifact.
+
+    python tools/trace_check.py RUN.jsonl [--chrome TRACE.json]
+
+Asserts the canonical waterfall spans are present, every
+``step_waterfall`` row's components sum to ``wall_ms`` within
+tolerance, and the Chrome trace-event artifact parses and carries the
+canonical step parts — the CI trace smoke (tools/ci.sh).  Thin wrapper
+over :func:`apex_tpu.monitor.tracing.check_trace` (avoiding the
+``python -m`` runpy double-import warning the package import would
+cause).  See docs/api/observability.md.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from apex_tpu.monitor.tracing import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
