@@ -91,6 +91,11 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="run the sanitizer smoke: the standalone-GPT "
                          "step must compile exactly once after warmup")
+    ap.add_argument("--scan-steps", type=int, default=0, metavar="K",
+                    help="with --smoke: drive the batched-step scan "
+                         "driver (K steps per jit call) instead of "
+                         "the per-step loop — one compile for the "
+                         "whole N-step run")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help=f"baseline path (default {DEFAULT_BASELINE})")
     ap.add_argument("--root", default=".",
@@ -177,7 +182,7 @@ def main(argv=None) -> int:
     if args.smoke:
         from .sanitizer import sanitize_smoke
 
-        n = sanitize_smoke()
+        n = sanitize_smoke(scan_steps=args.scan_steps)
         return 0 if n == 0 else 1
 
     if args.update_baseline:

@@ -265,6 +265,31 @@ register_flag(
     "zero per-step host transfers; 0 keeps the classic synchronous "
     "per-step readback.", lo=0)
 register_flag(
+    "APEX_TPU_SCAN_STEPS", "int", 0,
+    "Batched-step scan driver for the smoke drivers: K>=1 runs K train "
+    "steps per jit call via lax.scan (params/amp state/telemetry ring "
+    "threaded through the carry, all donated), amortizing per-dispatch "
+    "host overhead across the window; telemetry drains and checkpoint/"
+    "watchdog/waterfall boundaries land on K-step edges.  0 keeps the "
+    "classic one-dispatch-per-step loop.  The smoke drivers' "
+    "--scan-steps overrides.", lo=0)
+register_flag(
+    "APEX_TPU_COMPILE_CACHE_DIR", "str", None,
+    "Persistent XLA compilation cache directory "
+    "(utils.compile_cache.configure_compile_cache): when set, every "
+    "driver/bench process wires jax's persistent cache here (size/"
+    "compile-time floors relaxed so even smoke-sized programs cache), "
+    "so a warmed host pays compile cost once — cold-start and retrace "
+    "stop polluting wall rows.  One `python -m "
+    "apex_tpu.testing.entry_points --aot` run pre-populates it for "
+    "every registered entry point.")
+register_flag(
+    "APEX_TPU_BENCH_GATE_RATIO", "bool", False,
+    "tools/bench_gate.py: escalate the wall_device_ratio check on the "
+    "long_context and optimizer-pipeline rows from WARN to a gating "
+    "regression (--ratio-min, default 0.9 — ROADMAP item 2's exit "
+    "bar).  Off by default so the nightly bench tier arms it first.")
+register_flag(
     "APEX_TPU_FULL", "bool", False,
     "CI switch: run the full (slow-inclusive) test tier in "
     "tools/ci.sh.")

@@ -176,7 +176,8 @@ def sanitize(*, transfer_guard: Optional[str] = "disallow",
         pxla_logger.propagate = prior_pxla_propagate
 
 
-def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
+def sanitize_smoke(steps: int = 4, *, scan_steps: int = 0,
+                   verbose: bool = True) -> int:
     """Drive the standalone-GPT smoke step under the sanitizer; the CI
     proof that the train step compiles exactly once after warmup.
 
@@ -186,11 +187,23 @@ def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
     the hlo auditor use (``testing.standalone_gpt.make_smoke_setup`` /
     ``build_train_step`` — the shared entry-point list), so this smoke
     proves the exact step CI audits.
+
+    ``scan_steps`` >= 1 drives the batched-step scan driver instead
+    (``build_train_step_scan``, the ``gpt_train_step_scan`` audit
+    entry): ``steps`` K-step windows per run, one ``san.step()``
+    boundary per window — proving an N-step run (N = steps*K) costs
+    exactly ONE compile after warmup, the scan half of ROADMAP item
+    2's dispatch-amortization claim.
     """
-    from ..testing.standalone_gpt import build_train_step, make_smoke_setup
+    from ..testing.standalone_gpt import (build_train_step,
+                                          build_train_step_scan,
+                                          make_smoke_setup)
 
     setup = make_smoke_setup(opt_level="O2")
-    step = build_train_step(setup)
+    if scan_steps and scan_steps > 0:
+        step = build_train_step_scan(setup, scan_steps)
+    else:
+        step = build_train_step(setup)
     params, amp_state = setup.params, setup.amp_state
 
     # the init/initialize compiles above happen OUTSIDE the sanitizer;
@@ -203,8 +216,11 @@ def sanitize_smoke(steps: int = 4, *, verbose: bool = True) -> int:
             loss.block_until_ready()
             san.step()
     if verbose:
-        print(f"[sanitize-smoke] steps={steps} "
-              f"warmup_compiles={len(san.warmup_compiles)} "
+        total = steps * max(1, scan_steps)
+        print(f"[sanitize-smoke] steps={total}"
+              + (f" (scan K={scan_steps}, {steps} windows)"
+                 if scan_steps else "")
+              + f" warmup_compiles={len(san.warmup_compiles)} "
               f"post_warmup_compiles={len(san.post_warmup_compiles)} "
               f"loss={float(loss):.4f}")
     return len(san.post_warmup_compiles)

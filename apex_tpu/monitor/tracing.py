@@ -430,12 +430,21 @@ class StepWaterfall:
             self._parts[name] = (self._parts.get(name, 0.0)
                                  + self._clock() - t0)
 
-    def end_step(self, sink=None, step: Optional[int] = None) -> dict:
+    def end_step(self, sink=None, step: Optional[int] = None,
+                 **extra) -> dict:
         """Close the step: compute the attribution row, emit it as one
         ``attr`` event into ``sink`` (when given), invoke the ``on_row``
-        hook (auto-capture wiring), and return it."""
+        hook (auto-capture wiring), and return it.  ``extra`` keyword
+        values are merged into the row (and the event attrs) verbatim —
+        how the scan driver stamps ``scan_k`` (steps per dispatch) on a
+        window's row; names must not end in ``_ms`` (those are reserved
+        for the parts-sum-to-wall invariant)."""
         if self._t0 is None:
             raise RuntimeError("end_step without begin_step")
+        bad = [k for k in extra if k.endswith("_ms")]
+        if bad:
+            raise ValueError(f"extra row field(s) {bad} collide with "
+                             "the *_ms attribution namespace")
         wall = self._clock() - self._t0
         if step is None:
             step = self._step
@@ -443,6 +452,7 @@ class StepWaterfall:
         other = max(0.0, wall - sum(parts.values()))
         row: Dict[str, Any] = {"step": step,
                                "wall_ms": wall * 1e3}
+        row.update(extra)
         for name in WATERFALL_PARTS:
             row[f"{name}_ms"] = parts.pop(name, 0.0) * 1e3
         for name, v in sorted(parts.items()):  # non-canonical extras
@@ -576,6 +586,7 @@ class DeferredTelemetry:
             capacity=self.every)
         self.state = self.buffer.init()
         self._drained = 0
+        self._drain_count = 0
         self._steps: List[int] = []   # step number per pending append
         self.last_metrics: Optional[Dict[str, float]] = None
 
@@ -589,13 +600,41 @@ class DeferredTelemetry:
         self._steps.append(step)
         return params, amp_state, loss, gnorm, info
 
+    def scan_window(self, step_fn, params, amp_state, *, start: int,
+                    k: int):
+        """Run one K-step scan window: ``step_fn(params, amp_state,
+        tstate) -> (params, amp_state, tstate, loss, gnorm, info)``
+        where the jitted body appended ``k`` rows to the ring (the
+        shape ``build_train_step_scan(setup, k, telemetry=buf)``
+        produces).  Records the window's step numbers
+        ``[start, start+k)`` for drain-time renumbering; no host
+        transfer.  The ring must hold a full window
+        (``buffer.capacity >= k``) or rows would be overwritten before
+        the drain."""
+        if k > self.buffer.capacity:
+            raise ValueError(
+                f"scan window of {k} steps exceeds the telemetry ring "
+                f"capacity {self.buffer.capacity}")
+        params, amp_state, self.state, loss, gnorm, info = step_fn(
+            params, amp_state, self.state)
+        self._steps.extend(range(start, start + k))
+        return params, amp_state, loss, gnorm, info
+
     @property
     def pending(self) -> int:
         return len(self._steps)
 
+    @property
+    def drains(self) -> int:
+        """Completed drains so far (the ceil(N/K) proof counter)."""
+        return self._drain_count
+
     def maybe_drain(self, monitor, force: bool = False) -> int:
         """Drain if ``every`` appends accumulated (or ``force``).
-        Returns the number of rows emitted."""
+        Returns the number of rows emitted.  Each actual drain also
+        emits one ``telemetry``/``telemetry_drain`` event (rows +
+        drain ordinal) so a log proves the drain cadence — the
+        ceil(N/K) count the scan-driver CI smoke asserts."""
         if not self._steps or (not force
                                and len(self._steps) < self.every):
             return 0
@@ -608,6 +647,11 @@ class DeferredTelemetry:
             emitted += 1
         self._steps = self._steps[count - base:]
         self._drained = count
+        self._drain_count += 1
+        ev = getattr(monitor, "event", None)
+        if ev is not None:
+            ev("telemetry", "telemetry_drain", value=float(emitted),
+               step=None, drain=self._drain_count, forced=bool(force))
         return emitted
 
     def _emit_row(self, monitor, step: int,
@@ -921,12 +965,23 @@ class TraceSession:
 # ---------------------------------------------------------------------------
 
 def check_trace(jsonl_path: str, chrome_path: Optional[str] = None, *,
-                tolerance: float = 0.02) -> List[str]:
+                tolerance: float = 0.02,
+                scan_k: Optional[int] = None,
+                steps: Optional[int] = None) -> List[str]:
     """Validate a traced run: canonical spans present, every
     ``step_waterfall`` row's parts sum to ``wall_ms`` within
     ``tolerance``, and (when given) the Chrome artifact parses and
     carries both host spans and the canonical step parts.  Returns a
-    list of failure strings (empty = pass)."""
+    list of failure strings (empty = pass).
+
+    Scan mode (``scan_k``): the run used the batched-step driver, so
+    each waterfall row covers one K-step window — every row must carry
+    ``scan_k`` (== ``scan_k`` except a trailing remainder window), and
+    with ``steps`` also given there must be exactly ``ceil(steps /
+    scan_k)`` rows whose ``scan_k`` values sum to ``steps``.  The
+    parts-sum-to-wall invariant is checked per window exactly as per
+    step — amortizing dispatch must not break the attribution
+    identity."""
     from .summary import load_events
 
     failures: List[str] = []
@@ -950,6 +1005,27 @@ def check_trace(jsonl_path: str, chrome_path: Optional[str] = None, *,
             failures.append(
                 f"step {e.step}: parts sum {parts:.4f} ms != wall "
                 f"{wall:.4f} ms (> {tolerance:.0%})")
+    if scan_k is not None:
+        ks = [e.attrs.get("scan_k") for e in rows]
+        bad = [e.step for e, k in zip(rows, ks)
+               if not isinstance(k, int)]
+        if bad:
+            failures.append(f"scan mode: waterfall row(s) at step(s) "
+                            f"{bad} carry no scan_k window size")
+        else:
+            over = [e.step for e, k in zip(rows, ks) if k > scan_k]
+            if over:
+                failures.append(
+                    f"scan mode: row(s) at step(s) {over} cover more "
+                    f"than K={scan_k} steps")
+            if steps is not None:
+                want_rows = -(-steps // scan_k)  # ceil
+                if len(rows) != want_rows or sum(ks) != steps:
+                    failures.append(
+                        f"scan mode: {len(rows)} window row(s) "
+                        f"covering {sum(ks)} step(s) != ceil({steps}/"
+                        f"{scan_k}) = {want_rows} windows / {steps} "
+                        f"steps")
     if chrome_path is not None:
         try:
             with open(chrome_path) as f:
@@ -987,16 +1063,25 @@ def main(argv=None) -> int:
                     help="(default action) run the validations")
     ap.add_argument("--tolerance", type=float, default=0.02,
                     help="parts-sum-to-wall tolerance (default 0.02)")
+    ap.add_argument("--scan-k", type=int, default=None, metavar="K",
+                    help="scan-driver run: every waterfall row must be "
+                         "a K-step window (parts still sum to wall)")
+    ap.add_argument("--steps", type=int, default=None, metavar="N",
+                    help="with --scan-k: require ceil(N/K) window "
+                         "rows covering exactly N steps")
     args = ap.parse_args(argv)
     failures = check_trace(args.jsonl, args.chrome,
-                           tolerance=args.tolerance)
+                           tolerance=args.tolerance,
+                           scan_k=args.scan_k, steps=args.steps)
     for f in failures:
         print(f"[trace-check] FAIL: {f}", file=sys.stderr)
     if failures:
         return 1
     print(f"[trace-check] OK: {args.jsonl} carries the canonical "
-          "waterfall" + (f"; {args.chrome} parses" if args.chrome
-                         else ""))
+          "waterfall"
+          + (f" ({-(-args.steps // args.scan_k)} K={args.scan_k} "
+             "window(s))" if args.scan_k and args.steps else "")
+          + (f"; {args.chrome} parses" if args.chrome else ""))
     return 0
 
 

@@ -96,6 +96,18 @@ class FaultInjector:
                 s.fired = True
                 time.sleep(float(s.arg or 1.0))
 
+    def before_window(self, start: int, k: int) -> None:
+        """Scan-driver form of :meth:`before_step`: fire every armed
+        process-level spec whose step lands anywhere in the K-step
+        window ``[start, start + k)``.  Host code only runs at window
+        edges under the scan driver, so a fault aimed mid-window fires
+        at the nearest preceding boundary — the same edge checkpoints
+        and termination polls land on (and the edge a real preemption
+        would resume from)."""
+        for s in list(self.specs):
+            if not s.fired and start <= s.step < start + k:
+                self.before_step(s.step)
+
     def observed_loss(self, step: int, loss: float) -> float:
         for s in self.specs:
             if s.fired or s.step != step:

@@ -148,6 +148,26 @@ def _build_gpt_train_step_deferred():
             (setup.params, setup.amp_state, buf.init()))
 
 
+def _build_gpt_train_step_scan():
+    """The ISSUE-8 batched-step scan driver: K=4 GPT train steps per
+    jit call (``build_train_step_scan``) with the deferred-telemetry
+    ring appended inside the scan body.  Auditing it proves the whole
+    hot path stays clean when K steps fuse into one dispatch: params,
+    amp state (masters + packed m/v + scaler), and the ring all donate
+    through the scan carry (APX601 — a missed donation here costs
+    K-fold nothing extra, but it doubles the largest buffers exactly
+    like the per-step entry), and zero host transfers compile in
+    (APX604).  The census walker multiplies scan-body ops by the trip
+    count, so any per-step collective would be priced K times."""
+    from ..monitor.tracing import DeviceMetricsBuffer
+    from .standalone_gpt import build_train_step_scan, make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O2")
+    buf = DeviceMetricsBuffer(capacity=4)
+    return (build_train_step_scan(setup, 4, telemetry=buf),
+            (setup.params, setup.amp_state, buf.init()))
+
+
 def _build_fused_pipeline_step():
     """The PR-4 persistent packed optimizer pipeline as its own entry:
     one full amp post-backward step (pack -> norm/finite sweep ->
@@ -232,6 +252,14 @@ register_entry_point(
     doc="GPT smoke train step with the deferred-telemetry device ring "
         "appended in-jit (monitor.tracing.DeviceMetricsBuffer) — the "
         "static zero-host-transfer proof; params/state/ring donated")
+register_entry_point(
+    "gpt_train_step_scan", _build_gpt_train_step_scan,
+    policy="O2", dead_args=(0, 1, 2),
+    doc="K=4 batched-step scan driver (lax.scan over the GPT smoke "
+        "train step, telemetry ring appended in-body) — params/amp "
+        "state/ring donated through the scan carry; the "
+        "dispatch-amortized hot path the smoke drivers run under "
+        "--scan-steps / APEX_TPU_SCAN_STEPS")
 register_entry_point(
     "fused_pipeline_step", _build_fused_pipeline_step, policy="O5",
     dead_args=(0, 1, 2),
@@ -357,3 +385,129 @@ register_entry_point(
     dead_args=(0,), min_devices=8,
     doc="ZeRO-sharded update: psum_scatter grads -> local shard "
         "update -> all_gather params")
+
+
+# ---------------------------------------------------------------------------
+# AOT warmup: pre-compile the registry (ISSUE-8 tentpole c)
+# ---------------------------------------------------------------------------
+
+def aot_warmup(names=None, *, configure_cache: bool = True):
+    """``jit(...).lower().compile()`` every (buildable) registry entry
+    point ahead of time — no execution, just the compile.  With the
+    persistent compilation cache configured
+    (``APEX_TPU_COMPILE_CACHE_DIR``; wired here unless
+    ``configure_cache=False``), one warmup run per host populates the
+    on-disk cache and every later process — smoke drivers, bench
+    sections, tests — warm-starts its compiles from it, so cold-start
+    and retrace cost stop polluting wall measurements.
+
+    ``names`` restricts to specific entries (unknown names raise,
+    naming the registry — a typo must not produce a do-nothing warmup
+    that claims success); entries this host cannot build (device-count
+    gate) are skipped and reported as None.  Returns
+    ``{name: compile_ms | None}``.
+    """
+    import time
+
+    from ..utils.compile_cache import configure_compile_cache
+
+    if configure_cache:
+        configure_compile_cache()
+    if names is not None:
+        unknown = sorted(set(names) - set(ENTRY_POINTS))
+        if unknown:
+            raise KeyError(
+                f"unknown entry point(s) {unknown}; registered: "
+                f"{sorted(ENTRY_POINTS)}")
+    avail = available_entry_points()
+    out = {}
+    for name in sorted(names if names is not None else avail):
+        ep = avail.get(name)
+        if ep is None:
+            out[name] = None  # device-count gated on this host
+            continue
+        fn, args = ep.build()
+        t0 = time.perf_counter()
+        fn.lower(*args).compile()
+        out[name] = round((time.perf_counter() - t0) * 1e3, 1)
+    return out
+
+
+def _main(argv=None):
+    """CLI: ``python -m apex_tpu.testing.entry_points --aot`` —
+    pre-compile the registry into the persistent cache (tools/ci.sh
+    step 10 proves the second process warm-starts from it)."""
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="python -m apex_tpu.testing.entry_points",
+        description="Registry of lowerable entry points; --aot "
+                    "pre-compiles them (persistent cache per "
+                    "APEX_TPU_COMPILE_CACHE_DIR).")
+    ap.add_argument("--aot", action="store_true",
+                    help="lower+compile every buildable entry point")
+    ap.add_argument("--entry", action="append", default=None,
+                    help="restrict to this entry (repeatable)")
+    ap.add_argument("--expect-cache-hits", action="store_true",
+                    help="fail (exit 1) unless at least one compile "
+                         "was served from the persistent cache — the "
+                         "second-process warm-start proof")
+    args = ap.parse_args(argv)
+    if not args.aot:
+        for name, ep in sorted(ENTRY_POINTS.items()):
+            print(f"{name}: {ep.doc}")
+        return 0
+    hits = []
+    if args.expect_cache_hits:
+        # jax logs "Persistent compilation cache hit for '<name>'"
+        # through jax._src.compiler when jax_log_compiles is on;
+        # capturing it is the ground truth that the compile was read
+        # from disk rather than redone.  The flag also makes the
+        # dispatch/pxla loggers chatty — keep the capture out of the
+        # console (the sanitizer's discipline): capture-only handler,
+        # propagation off, NullHandlers so logging.lastResort stays
+        # quiet.
+        import logging
+        import re
+
+        import jax
+
+        class _Hits(logging.Handler):
+            def emit(self, record):
+                m = re.search(r"Persistent compilation cache hit",
+                              record.getMessage())
+                if m:
+                    hits.append(record.getMessage())
+
+        lg = logging.getLogger("jax._src.compiler")
+        lg.addHandler(_Hits())
+        if lg.level > logging.DEBUG:
+            lg.setLevel(logging.DEBUG)
+        for name in ("jax._src.compiler", "jax._src.dispatch",
+                     "jax._src.interpreters.pxla"):
+            noisy = logging.getLogger(name)
+            noisy.addHandler(logging.NullHandler())
+            noisy.propagate = False
+        jax.config.update("jax_log_compiles", True)
+    res = aot_warmup(args.entry)
+    for name, ms in res.items():
+        state = "SKIPPED (device count)" if ms is None else f"{ms} ms"
+        print(f"[aot] {name}: {state}")
+    compiled = [ms for ms in res.values() if ms is not None]
+    print(f"[aot] {len(compiled)} entry point(s) compiled, "
+          f"{sum(compiled):.0f} ms total"
+          + (f", {len(hits)} persistent-cache hit(s)"
+             if args.expect_cache_hits else ""))
+    if args.expect_cache_hits and not hits:
+        print("[aot] FAIL: no persistent-cache hits — the warmup did "
+              "not warm-start (is APEX_TPU_COMPILE_CACHE_DIR set and "
+              "pre-populated?)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -233,13 +233,10 @@ def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
                           amp_opt, amp_state, int(n_params))
 
 
-def build_train_step(setup: BertSmokeSetup, *, telemetry=None):
-    """The jitted BERT smoke train step (LM + NSP loss through amp).
-    ``params``/``amp_state`` are donated, exactly as in
-    :func:`.standalone_gpt.build_train_step` — the loop rebinds both,
-    and undonated masters/optimizer state double their HBM (APX601).
-    ``telemetry`` (a ``DeviceMetricsBuffer``) switches to the deferred
-    three-argument form, same as the GPT driver."""
+def make_step_fn(setup: BertSmokeSetup):
+    """The raw (unjitted) BERT smoke train step — the single build
+    site the jitted wrappers close over (see
+    :func:`.standalone_gpt.make_step_fn`)."""
     from ..transformer.pipeline_parallel.utils import param_l2_norm
 
     model, tokens, mask = setup.model, setup.tokens, setup.mask
@@ -265,11 +262,32 @@ def build_train_step(setup: BertSmokeSetup, *, telemetry=None):
             param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
+    return _step
+
+
+def build_train_step(setup: BertSmokeSetup, *, telemetry=None):
+    """The jitted BERT smoke train step (LM + NSP loss through amp).
+    ``params``/``amp_state`` are donated, exactly as in
+    :func:`.standalone_gpt.build_train_step` — the loop rebinds both,
+    and undonated masters/optimizer state double their HBM (APX601).
+    ``telemetry`` (a ``DeviceMetricsBuffer``) switches to the deferred
+    three-argument form, same as the GPT driver."""
+    _step = make_step_fn(setup)
     if telemetry is None:
         return functools.partial(jax.jit, donate_argnums=(0, 1))(_step)
     from .standalone_gpt import wrap_deferred_step
 
     return wrap_deferred_step(_step, telemetry)
+
+
+def build_train_step_scan(setup: BertSmokeSetup, k: int, *,
+                          telemetry=None):
+    """K BERT train steps per jit call — the batched-step scan driver,
+    through the SAME :func:`.standalone_gpt.wrap_scan_step` the GPT
+    driver uses (carry/donation/telemetry contract documented there)."""
+    from .standalone_gpt import wrap_scan_step
+
+    return wrap_scan_step(make_step_fn(setup), k, telemetry=telemetry)
 
 
 def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
@@ -282,7 +300,8 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 fault=None, autoresume="auto", escalation=None,
                 return_state: bool = False,
                 trace_dir: Optional[str] = None,
-                drain_every: Optional[int] = None):
+                drain_every: Optional[int] = None,
+                scan_steps: Optional[int] = None):
     """Tiny single-device BERT train loop wired through
     :mod:`apex_tpu.monitor` — the BERT sibling of
     :func:`apex_tpu.testing.standalone_gpt.train_smoke` (same event
@@ -291,26 +310,25 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     ``ckpt_dir``, deterministic ``fault`` injection, SIGTERM-safe
     exit; same observability wiring: ``trace_dir`` wall-time
     waterfall + Chrome export, ``drain_every`` deferred telemetry),
-    proving both paths are driver-agnostic.  Returns the final loss, or
+    proving both paths are driver-agnostic (``scan_steps`` >= 1: the
+    batched-step scan driver, K steps per jit call — see the GPT
+    docstring).  Returns the final loss, or
     ``(loss, params, amp_state, steps_done)`` with
     ``return_state=True``."""
-    from ..analysis.flags import flag_int
     from ..transformer.pipeline_parallel.utils import Timers
-    from .standalone_gpt import _run_smoke_loop, make_smoke_monitor
+    from ..utils.compile_cache import configure_compile_cache
+    from .standalone_gpt import (_run_smoke_loop, make_smoke_monitor,
+                                 resolve_driver_mode)
 
+    configure_compile_cache()
     setup = make_smoke_setup(
         vocab=vocab, hidden=hidden, num_heads=num_heads,
         num_layers=num_layers, batch=batch, seq=seq,
         opt_level=opt_level, lr=lr, seed=seed)
-    if drain_every is None:
-        drain_every = flag_int("APEX_TPU_TELEMETRY_DRAIN_EVERY")
-    telemetry = None
-    if drain_every and drain_every > 0:
-        from ..monitor.tracing import DeferredTelemetry
-
-        telemetry = DeferredTelemetry(drain_every)
-    step = build_train_step(
-        setup, telemetry=telemetry.buffer if telemetry else None)
+    scan_steps, telemetry, step, scan_factory = resolve_driver_mode(
+        setup, scan_steps, drain_every,
+        build_step=build_train_step,
+        build_step_scan=build_train_step_scan)
     params, amp_opt, amp_state = (setup.params, setup.amp_opt,
                                   setup.amp_state)
     n_params = setup.n_params
@@ -321,6 +339,7 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         run_attrs={"driver": "standalone_bert.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
                    "batch": batch, "seq": seq,
+                   "scan_steps": scan_steps or 0,
                    "telemetry": "deferred" if telemetry else "sync"})
     timers = Timers()
     trace = None
@@ -334,7 +353,8 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
         resume=resume, fault=fault, autoresume=autoresume,
         escalation=escalation, return_state=return_state,
-        trace=trace, telemetry=telemetry)
+        trace=trace, telemetry=telemetry,
+        scan_steps=scan_steps or 0, scan_factory=scan_factory)
 
 
 def _main(argv=None):
@@ -356,6 +376,9 @@ def _main(argv=None):
     p.add_argument("--telemetry-drain-every", type=int, default=None,
                    metavar="K", help="deferred telemetry cadence "
                                      "(see standalone_gpt)")
+    p.add_argument("--scan-steps", type=int, default=None, metavar="K",
+                   help="batched-step scan driver: K steps per jit "
+                        "call (see standalone_gpt)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     loss, _, _, done = train_smoke(
@@ -363,7 +386,8 @@ def _main(argv=None):
         stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, resume=not args.no_resume,
         fault=args.fault, return_state=True, trace_dir=args.trace,
-        drain_every=args.telemetry_drain_every)
+        drain_every=args.telemetry_drain_every,
+        scan_steps=args.scan_steps)
     print(f"SMOKE_DONE steps_done={done}"
           + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))

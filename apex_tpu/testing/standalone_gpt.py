@@ -302,22 +302,13 @@ def make_smoke_setup(*, vocab: int = 64, hidden: int = 32,
                       amp_state, int(n_params))
 
 
-def build_train_step(setup: SmokeSetup, *, telemetry=None):
-    """The jitted smoke train step: forward, scaled loss, backward,
-    amp apply.  ``params`` and ``amp_state`` are DONATED — the loop
-    rebinds both every step, and without donation XLA double-buffers
-    the masters and optimizer state (the APX601 finding this fixed:
-    fp32 masters + m/v are the largest buffers in the step).  Returns
-    ``step(params, amp_state) -> (params, amp_state, loss, gnorm,
-    info)``.
-
-    With ``telemetry`` (an :class:`apex_tpu.monitor.tracing.
-    DeviceMetricsBuffer`) the step takes and returns the buffer's ring
-    state as a third donated argument and appends this step's scalars
-    (loss, grad-norm, loss-scale, overflow, skip count) **inside the
-    jit** — the deferred-telemetry mode where the loop performs zero
-    per-step host transfers: ``step(params, amp_state, tstate) ->
-    (params, amp_state, tstate, loss, gnorm, info)``."""
+def make_step_fn(setup: SmokeSetup):
+    """The raw (unjitted) smoke train step: forward, scaled loss,
+    backward, amp apply — ``step(params, amp_state) -> (params,
+    amp_state, loss, gnorm, info)``.  The single build site the jitted
+    wrappers (:func:`build_train_step`, :func:`build_train_step_scan`)
+    all close over, so the per-step, deferred, and K-batched drivers
+    cannot diverge in step semantics."""
     from ..transformer.pipeline_parallel.utils import param_l2_norm
 
     model, tokens, labels = setup.model, setup.tokens, setup.labels
@@ -338,9 +329,50 @@ def build_train_step(setup: SmokeSetup, *, telemetry=None):
             param_l2_norm(grads) / amp_state.scaler.loss_scale
         return new_params, new_state, loss, gnorm, info
 
+    return _step
+
+
+def build_train_step(setup: SmokeSetup, *, telemetry=None):
+    """The jitted smoke train step.  ``params`` and ``amp_state`` are
+    DONATED — the loop rebinds both every step, and without donation
+    XLA double-buffers the masters and optimizer state (the APX601
+    finding this fixed: fp32 masters + m/v are the largest buffers in
+    the step).  Returns ``step(params, amp_state) -> (params,
+    amp_state, loss, gnorm, info)``.
+
+    With ``telemetry`` (an :class:`apex_tpu.monitor.tracing.
+    DeviceMetricsBuffer`) the step takes and returns the buffer's ring
+    state as a third donated argument and appends this step's scalars
+    (loss, grad-norm, loss-scale, overflow, skip count) **inside the
+    jit** — the deferred-telemetry mode where the loop performs zero
+    per-step host transfers: ``step(params, amp_state, tstate) ->
+    (params, amp_state, tstate, loss, gnorm, info)``."""
+    _step = make_step_fn(setup)
     if telemetry is None:
         return functools.partial(jax.jit, donate_argnums=(0, 1))(_step)
     return wrap_deferred_step(_step, telemetry)
+
+
+def build_train_step_scan(setup: SmokeSetup, k: int, *, telemetry=None):
+    """K train steps per jit call (the ISSUE-8 batched-step driver):
+    the same smoke step as :func:`build_train_step`, iterated ``k``
+    times inside one ``lax.scan`` — one dispatch, one compile, one
+    donation round-trip per K steps, so the per-call host constant
+    (dispatch + Python + tunnel latency) is amortized K-fold.  See
+    :func:`wrap_scan_step` for the carry/signature contract."""
+    return wrap_scan_step(make_step_fn(setup), k, telemetry=telemetry)
+
+
+def _append_step_metrics(telemetry, tstate, *, loss, gnorm, finite,
+                         scale, skipped):
+    """The ONE build site for the per-step metric set recorded into
+    the device ring — shared by the deferred (per-step) wrapper and
+    the scan body, so the drained series cannot diverge between K=0
+    and K>=1 runs (add/rename a metric here and both modes get it)."""
+    return telemetry.append(
+        tstate, loss=loss, grad_norm=gnorm, loss_scale=scale,
+        overflow=1.0 - finite.astype(jnp.float32),
+        steps_skipped=skipped)
 
 
 def wrap_deferred_step(step_fn, telemetry):
@@ -355,14 +387,147 @@ def wrap_deferred_step(step_fn, telemetry):
     def step_deferred(params, amp_state, tstate):
         new_params, new_state, loss, gnorm, info = step_fn(params,
                                                            amp_state)
-        tstate = telemetry.append(
-            tstate, loss=loss, grad_norm=gnorm,
-            loss_scale=info.loss_scale,
-            overflow=1.0 - info.grads_finite.astype(jnp.float32),
-            steps_skipped=info.steps_skipped)
+        tstate = _append_step_metrics(
+            telemetry, tstate, loss=loss, gnorm=gnorm,
+            finite=info.grads_finite, scale=info.loss_scale,
+            skipped=info.steps_skipped)
         return new_params, new_state, tstate, loss, gnorm, info
 
     return step_deferred
+
+
+def wrap_scan_step(step_fn, k: int, *, telemetry=None):
+    """Wrap an unjitted ``step_fn(params, amp_state) -> (params,
+    amp_state, loss, gnorm, info)`` smoke step into a jitted K-step
+    ``lax.scan`` window — ONE wrapper shared by the GPT and BERT
+    drivers (the scan sibling of :func:`wrap_deferred_step`).
+
+    Everything the K steps mutate rides the scan carry: params (under
+    the fused pipeline that includes the PackedMasters flat buffers
+    reassembled into the model tree), the full amp state (masters +
+    packed m/v + scaler), and — when ``telemetry`` (a
+    :class:`~apex_tpu.monitor.tracing.DeviceMetricsBuffer` with
+    ``capacity >= k``) is given — the telemetry ring, appended
+    *inside* the scan body exactly as in the deferred step, so the
+    whole window performs zero host transfers and every argument
+    donates end-to-end (APX601: the scan entry is in the audited
+    registry as ``gpt_train_step_scan``).
+
+    The per-step amp semantics are unchanged — an overflow step inside
+    the window skips its update and backs the scaler off exactly as it
+    would standalone (tests prove K=1 vs K=4 bitwise-equal after N
+    steps).  Returns, without telemetry, ``scan_step(params,
+    amp_state) -> (params, amp_state, loss_last, gnorm_last,
+    info_last)``; with telemetry the ring state joins as a third
+    donated argument/result, matching the deferred signature."""
+    if k < 1:
+        raise ValueError(f"scan window must be >= 1 step, got {k}")
+    meta = {}
+
+    def _body(params, amp_state):
+        new_params, new_state, loss, gnorm, info = step_fn(params,
+                                                           amp_state)
+        # static StepInfo structure, captured at trace time (the scan
+        # body traces once): ys can only carry arrays
+        meta["grads_checked"] = info.grads_checked
+        meta["has_grad_norm"] = info.grad_norm is not None
+        ys = (loss, gnorm, info.grads_finite, info.loss_scale,
+              info.steps_skipped)
+        return new_params, new_state, ys
+
+    def _last(ys):
+        from ..amp.mixed_precision import StepInfo
+
+        loss, gnorm, finite, scale, skipped = ys
+        info = StepInfo(
+            grads_finite=finite[-1], loss_scale=scale[-1],
+            steps_skipped=skipped[-1],
+            grads_checked=meta["grads_checked"],
+            grad_norm=gnorm[-1] if meta["has_grad_norm"] else None)
+        return loss[-1], gnorm[-1], info
+
+    if telemetry is None:
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def scan_step(params, amp_state):
+            def body(carry, _):
+                p, s = carry
+                p, s, ys = _body(p, s)
+                return (p, s), ys
+
+            (params, amp_state), ys = jax.lax.scan(
+                body, (params, amp_state), None, length=k)
+            loss, gnorm, info = _last(ys)
+            return params, amp_state, loss, gnorm, info
+
+        return scan_step
+
+    if telemetry.capacity < k:
+        raise ValueError(
+            f"telemetry ring capacity {telemetry.capacity} < scan "
+            f"window {k}: a window's rows would overwrite each other "
+            f"before the drain")
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def scan_step(params, amp_state, tstate):
+        def body(carry, _):
+            p, s, t = carry
+            p, s, ys = _body(p, s)
+            loss, gnorm, finite, scale, skipped = ys
+            t = _append_step_metrics(
+                telemetry, t, loss=loss, gnorm=gnorm, finite=finite,
+                scale=scale, skipped=skipped)
+            return (p, s, t), ys
+
+        (params, amp_state, tstate), ys = jax.lax.scan(
+            body, (params, amp_state, tstate), None, length=k)
+        loss, gnorm, info = _last(ys)
+        return params, amp_state, tstate, loss, gnorm, info
+
+    return scan_step
+
+
+def resolve_driver_mode(setup, scan_steps, drain_every, *, build_step,
+                        build_step_scan):
+    """Resolve a smoke driver's execution mode from ``(scan_steps,
+    drain_every)`` — ONE copy of the scan/deferred policy shared by
+    the GPT and BERT drivers: env-flag fallback
+    (``APEX_TPU_SCAN_STEPS`` / ``APEX_TPU_TELEMETRY_DRAIN_EVERY``),
+    the drain-cadence conflict check (the scan driver fixes the drain
+    to the window size), DeferredTelemetry construction, and the
+    ``(step, scan_factory)`` pair ``_run_smoke_loop`` consumes.
+    ``build_step(setup, telemetry=)`` / ``build_step_scan(setup, n,
+    telemetry=)`` are the driver's own builders.  Returns
+    ``(scan_steps, telemetry, step, scan_factory)`` with exactly one
+    of ``step`` / ``scan_factory`` non-None."""
+    from ..analysis.flags import flag_int
+
+    if scan_steps is None:
+        scan_steps = flag_int("APEX_TPU_SCAN_STEPS")
+    if drain_every is None:
+        drain_every = flag_int("APEX_TPU_TELEMETRY_DRAIN_EVERY")
+    if scan_steps and scan_steps > 0:
+        from ..monitor.tracing import DeferredTelemetry
+
+        if drain_every and drain_every > 0 \
+                and drain_every != scan_steps:
+            raise ValueError(
+                f"scan_steps={scan_steps} fixes the telemetry drain "
+                f"cadence to the window size; drain_every="
+                f"{drain_every} conflicts (drop it, or match K)")
+        telemetry = DeferredTelemetry(scan_steps)
+
+        def scan_factory(n, _setup=setup, _buf=telemetry.buffer):
+            return build_step_scan(_setup, n, telemetry=_buf)
+
+        return scan_steps, telemetry, None, scan_factory
+    telemetry = None
+    if drain_every and drain_every > 0:
+        from ..monitor.tracing import DeferredTelemetry
+
+        telemetry = DeferredTelemetry(drain_every)
+    step = build_step(
+        setup, telemetry=telemetry.buffer if telemetry else None)
+    return scan_steps, telemetry, step, None
 
 
 # ---------------------------------------------------------------------------
@@ -389,6 +554,61 @@ def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
                           on_alarm=None if escalation is None
                           else escalation.notify),
         run_attrs=run_attrs, close_sink=own_sink)
+
+
+def _boundary_tail(done, prev_done, step_label, *, monitor, ckpt,
+                   ckpt_every, save, part, wf, capture, escalation,
+                   autoresume, wf_extras=None):
+    """The per-boundary resilience/observability tail shared by
+    :func:`run_monitored_steps` (boundary = every step) and
+    :func:`run_scan_windows` (boundary = every K-step window edge):
+    escalation poll -> checkpoint cadence -> waterfall close ->
+    capture poll -> termination poll.  ``done`` is the steps-done
+    count the checkpoint is cut at, ``prev_done`` the count at the
+    previous boundary; ``step_label`` the step number events are
+    stamped with (the window's last step under scan).  The checkpoint
+    cadence is a *crossing* check — save when ``(prev_done, done]``
+    contains a multiple of ``ckpt_every`` — so a cadence that is not a
+    multiple of the scan window K still checkpoints at the first edge
+    past each cadence point instead of aliasing to lcm(K, ckpt_every)
+    (or never).  At K=1 this is exactly ``done % ckpt_every == 0``.
+    Returns True when a termination request ended the run (the caller
+    breaks), False to continue."""
+    esc = escalation.pending() if escalation is not None else None
+    if esc is not None:
+        from ..resilience import (CHECKPOINT_THEN_ABORT,
+                                  EscalationAbort)
+
+        if esc.action == CHECKPOINT_THEN_ABORT and ckpt is not None:
+            save(done, sync=True)
+        monitor.event("resilience", "escalation_abort", step=step_label,
+                      alarm=esc.alarm, action=esc.action,
+                      checkpointed=esc.action == CHECKPOINT_THEN_ABORT
+                      and ckpt is not None)
+        raise EscalationAbort(esc.alarm, esc.action, step=step_label)
+    saved = False
+    with part("ckpt_io"):
+        # always closes (zero-length when no manager/cadence hit) so
+        # the canonical waterfall shape is uniform per boundary
+        ce = max(1, ckpt_every)
+        if ckpt is not None and done // ce > prev_done // ce:
+            save(done)
+            saved = True
+    if wf is not None:
+        wf.end_step(monitor, step=step_label, **(wf_extras or {}))
+    if capture is not None:
+        capture.poll(step_label)
+    if autoresume is not None and autoresume.termination_requested():
+        if ckpt is not None:
+            if not saved:
+                save(done)
+            ckpt.wait()  # final checkpoint must be durable
+        if autoresume.marker_dir is not None:
+            autoresume.mark_clean_exit(done)
+        monitor.event("resilience", "preempt_exit", step=step_label,
+                      value=done, source=autoresume.source)
+        return True
+    return False
 
 
 def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
@@ -498,41 +718,158 @@ def run_monitored_steps(step_fn, params, amp_state, steps, monitor,
         if sanitizer is not None:
             sanitizer.step()  # post-warmup recompile -> raise here
         done = i + 1
-        esc = escalation.pending() if escalation is not None else None
-        if esc is not None:
-            from ..resilience import (CHECKPOINT_THEN_ABORT,
-                                      EscalationAbort)
-
-            if esc.action == CHECKPOINT_THEN_ABORT and ckpt is not None:
-                save(done, sync=True)
-            monitor.event("resilience", "escalation_abort", step=i,
-                          alarm=esc.alarm, action=esc.action,
-                          checkpointed=esc.action == CHECKPOINT_THEN_ABORT
-                          and ckpt is not None)
-            raise EscalationAbort(esc.alarm, esc.action, step=i)
-        saved = False
-        with part("ckpt_io"):
-            # always closes (zero-length when no manager/cadence hit)
-            # so the canonical waterfall shape is uniform per step
-            if ckpt is not None and done % max(1, ckpt_every) == 0:
-                save(done)
-                saved = True
-        if wf is not None:
-            wf.end_step(monitor, step=i)
-        if capture is not None:
-            capture.poll(i)
-        if autoresume is not None and autoresume.termination_requested():
-            if ckpt is not None:
-                if not saved:
-                    save(done)
-                ckpt.wait()  # final checkpoint must be durable
-            if autoresume.marker_dir is not None:
-                autoresume.mark_clean_exit(done)
-            monitor.event("resilience", "preempt_exit", step=i,
-                          value=done, source=autoresume.source)
+        if _boundary_tail(done, i, i, monitor=monitor, ckpt=ckpt,
+                          ckpt_every=ckpt_every, save=save, part=part,
+                          wf=wf, capture=capture, escalation=escalation,
+                          autoresume=autoresume):
             break
     if telemetry is not None and telemetry.maybe_drain(monitor,
                                                        force=True):
+        loss_f = telemetry.last_metrics.get("loss")
+    return params, amp_state, loss_f, done
+
+
+def run_scan_windows(scan_factory, k, params, amp_state, steps, monitor,
+                     timers, telemetry, *, lr=None, start_step: int = 0,
+                     ckpt=None, ckpt_every: int = 1, amp_opt=None,
+                     autoresume=None, escalation=None, fault=None,
+                     sanitizer=None, trace=None):
+    """The K-batched twin of :func:`run_monitored_steps`: drive
+    ``ceil((steps - start_step) / k)`` scan windows, each one jit call
+    running ``k`` train steps (``scan_factory(k)`` builds the window
+    function — :func:`build_train_step_scan`; a trailing remainder
+    window builds its own shorter scan, one extra compile the sanitize
+    contract documents).  Every host-side boundary lands on K-step
+    edges:
+
+    * **dispatch-free hot path** — each window is AOT-compiled
+      (``jit(...).lower().compile()``, timed and emitted as one
+      ``compile``/``aot_compile`` event) and the loop calls the
+      compiled executable, so the steady-state loop can never retrace;
+      with the persistent cache configured
+      (``APEX_TPU_COMPILE_CACHE_DIR``) a warmed host loads it from
+      disk.
+    * **telemetry** — per-step scalars accumulate in the device ring
+      *inside* the scan body; :meth:`DeferredTelemetry.maybe_drain`
+      performs one explicit ``device_get`` per window (ceil(N/K)
+      drains for the run), re-emitting the full per-step metric
+      series with reconstructed step numbers.
+    * **waterfall** — one attribution row per window, stamped
+      ``scan_k``: ``dispatch`` is the single enqueue for K steps,
+      ``device_compute`` the block on its outputs — the amortization
+      shows up directly as ``wall_device_ratio`` rising with K.
+    * **resilience** — fault injection, the escalation poll,
+      checkpoint cadence (a crossing check: the first window edge at
+      or past each ``ckpt_every`` multiple saves, so a cadence that
+      is not a multiple of K never aliases to silence) and
+      ``autoresume.termination_requested()`` all run between windows;
+      a kill mid-window resumes from the last K-boundary checkpoint.
+    * **sanitizer** — ``sanitizer.step()`` per window: for N a
+      multiple of K, exactly one compile (the first window's, during
+      warmup) for the whole run.
+
+    Returns ``(params, amp_state, last_loss, steps_done)`` with
+    ``steps_done`` always on a window edge.
+    """
+    import contextlib as _ctx
+    import time as _time
+
+    if k < 1:
+        raise ValueError(f"scan_steps must be >= 1, got {k}")
+    loss_f = None
+    done = start_step
+    wf = trace.waterfall if trace is not None else None
+    capture = trace.capture if trace is not None else None
+
+    def part(name):
+        return wf.part(name) if wf is not None else _ctx.nullcontext()
+
+    def save(step, sync=False):
+        ckpt.save(step, params, amp_opt, amp_state)
+        if sync:
+            ckpt.wait()
+
+    compiled = {}
+
+    def window_fn(n, *args):
+        ex = compiled.get(n)
+        if ex is None:
+            t0 = _time.perf_counter()
+            ex = scan_factory(n).lower(*args).compile()
+            compiled[n] = ex
+            monitor.event("compile", "aot_compile",
+                          value=round((_time.perf_counter() - t0) * 1e3,
+                                      2), scan_k=n)
+        return ex
+
+    # AOT-precompile every window length this run will use BEFORE the
+    # first step: compile cost lands in its own `compile` events (and,
+    # under --sanitize, in the warmup bucket), never in a window's
+    # waterfall — the steady-state `dispatch` part measures dispatch,
+    # not a hidden cold start.  Lengths: the full K window plus (for
+    # runs where steps - start_step is not a multiple of K) the
+    # trailing remainder.
+    remaining = steps - start_step
+    if remaining > 0:
+        lengths = {min(k, remaining)}
+        if remaining > k and remaining % k:
+            lengths.add(remaining % k)
+        for n in sorted(lengths, reverse=True):
+            window_fn(n, params, amp_state, telemetry.state)
+
+    per_step_tokens = monitor.tokens_per_step
+    per_step_flops = monitor.flops_per_step
+    w_start = start_step
+    try:
+        while w_start < steps:
+            k_eff = min(k, steps - w_start)
+            w_last = w_start + k_eff - 1
+            if wf is not None:
+                wf.begin_step(w_start)
+            with part("data_load"):
+                # synthetic smoke workload (see run_monitored_steps);
+                # a fault aimed anywhere in this window fires at its
+                # start edge (the only host boundary that exists)
+                if fault is not None:
+                    fault.before_window(w_start, k_eff)
+            monitor.start_step(w_start)
+            timers("step").start()
+            with part("dispatch"):
+                # ONE enqueue for k_eff steps — the amortization
+                fn = window_fn(k_eff, params, amp_state,
+                               telemetry.state)
+                params, amp_state, loss, gnorm, info = \
+                    telemetry.scan_window(fn, params, amp_state,
+                                          start=w_start, k=k_eff)
+            with part("device_compute"):
+                timers("step").stop(wait_on=loss)
+            with part("telemetry_drain"):
+                # host-clock metrics for the whole window (step_ms is
+                # the window wall; tokens/MFU scale by k_eff)
+                if per_step_flops:
+                    monitor.flops_per_step = per_step_flops * k_eff
+                monitor.end_step(w_last, lr=lr,
+                                 tokens=(per_step_tokens or 0) * k_eff
+                                 or None)
+                if telemetry.maybe_drain(monitor):
+                    loss_f = telemetry.last_metrics.get("loss")
+                timers.events(monitor, w_last, reset=True)
+                if trace is not None:
+                    trace.flush(monitor, step=w_last)
+            if sanitizer is not None:
+                sanitizer.step()
+            done = w_start + k_eff
+            if _boundary_tail(done, w_start, w_last, monitor=monitor,
+                              ckpt=ckpt, ckpt_every=ckpt_every,
+                              save=save, part=part, wf=wf,
+                              capture=capture, escalation=escalation,
+                              autoresume=autoresume,
+                              wf_extras={"scan_k": k_eff}):
+                break
+            w_start = done
+    finally:
+        monitor.flops_per_step = per_step_flops
+    if telemetry.maybe_drain(monitor, force=True):
         loss_f = telemetry.last_metrics.get("loss")
     return params, amp_state, loss_f, done
 
@@ -547,7 +884,8 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
                 fault=None, autoresume="auto", escalation=None,
                 return_state: bool = False, sanitize: bool = False,
                 trace_dir: Optional[str] = None,
-                drain_every: Optional[int] = None):
+                drain_every: Optional[int] = None,
+                scan_steps: Optional[int] = None):
     """Tiny single-device GPT train loop wired end-to-end through
     :mod:`apex_tpu.monitor` — the CPU telemetry smoke (exercised by
     tools/ci.sh on every run): step metrics (loss, grad-norm, lr,
@@ -589,23 +927,29 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
     transfer guard proves it); None reads
     ``APEX_TPU_TELEMETRY_DRAIN_EVERY``, 0 is the classic synchronous
     path.
-    """
-    from ..analysis.flags import flag_int
-    from ..transformer.pipeline_parallel.utils import Timers
 
+    ``scan_steps`` >= 1 switches to the **batched-step scan driver**
+    (:func:`build_train_step_scan` + :func:`run_scan_windows`): K
+    train steps per jit call with amp state and the telemetry ring in
+    the scan carry, AOT-compiled windows, ceil(N/K) telemetry drains,
+    and checkpoint/watchdog/waterfall boundaries on K-step edges; None
+    reads ``APEX_TPU_SCAN_STEPS``, 0 is the classic per-step loop.
+    Scan mode implies deferred telemetry at cadence K (a conflicting
+    explicit ``drain_every`` is rejected — the window IS the drain
+    cadence).
+    """
+    from ..transformer.pipeline_parallel.utils import Timers
+    from ..utils.compile_cache import configure_compile_cache
+
+    configure_compile_cache()
     setup = make_smoke_setup(
         vocab=vocab, hidden=hidden, num_heads=num_heads,
         num_layers=num_layers, batch=batch, seq=seq,
         opt_level=opt_level, lr=lr, seed=seed)
-    if drain_every is None:
-        drain_every = flag_int("APEX_TPU_TELEMETRY_DRAIN_EVERY")
-    telemetry = None
-    if drain_every and drain_every > 0:
-        from ..monitor.tracing import DeferredTelemetry
-
-        telemetry = DeferredTelemetry(drain_every)
-    step = build_train_step(
-        setup, telemetry=telemetry.buffer if telemetry else None)
+    scan_steps, telemetry, step, scan_factory = resolve_driver_mode(
+        setup, scan_steps, drain_every,
+        build_step=build_train_step,
+        build_step_scan=build_train_step_scan)
     params, amp_opt, amp_state = (setup.params, setup.amp_opt,
                                   setup.amp_state)
     n_params = setup.n_params
@@ -617,6 +961,7 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         run_attrs={"driver": "standalone_gpt.train_smoke",
                    "params": int(n_params), "opt_level": opt_level,
                    "batch": batch, "seq": seq,
+                   "scan_steps": scan_steps or 0,
                    "telemetry": "deferred" if telemetry else "sync"})
     timers = Timers()
     trace = None
@@ -630,22 +975,32 @@ def train_smoke(steps: int = 8, *, jsonl: Optional[str] = None,
         ckpt_dir=ckpt_dir, ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
         resume=resume, fault=fault, autoresume=autoresume,
         escalation=escalation, return_state=return_state,
-        sanitize=sanitize, trace=trace, telemetry=telemetry)
+        sanitize=sanitize, trace=trace, telemetry=telemetry,
+        scan_steps=scan_steps or 0, scan_factory=scan_factory)
 
 
 def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                     timers, *, lr, ckpt_dir, ckpt_every, ckpt_keep,
                     resume, fault, autoresume, escalation, return_state,
-                    sanitize: bool = False, trace=None, telemetry=None):
+                    sanitize: bool = False, trace=None, telemetry=None,
+                    scan_steps: int = 0, scan_factory=None):
     """Resilience-wired driver shell shared by the GPT and BERT smokes:
     checkpoint manager + auto-resume bootstrap around
-    :func:`run_monitored_steps`, ``run_error`` emission on a crashing
-    step, and guaranteed teardown (watchdog heartbeat, JSONL sink,
-    pending async saves, trace session -> Chrome artifact) via
-    ``try/finally``.  With ``telemetry`` (deferred mode) the
-    ``sanitize`` contract tightens: the device→host transfer guard is
-    armed too, so ANY per-step implicit host readback fails the run —
-    the zero-transfer proof, not just the recompile budget."""
+    :func:`run_monitored_steps` (or, with ``scan_steps`` >= 1,
+    :func:`run_scan_windows` — K steps per jit call via
+    ``scan_factory``), ``run_error`` emission on a crashing step, and
+    guaranteed teardown (watchdog heartbeat, JSONL sink, pending async
+    saves, trace session -> Chrome artifact) via ``try/finally``.
+    With ``telemetry`` (deferred mode — always on under the scan
+    driver) the ``sanitize`` contract tightens: the device→host
+    transfer guard is armed too, so ANY per-step implicit host
+    readback fails the run — the zero-transfer proof, not just the
+    recompile budget.  Under the scan driver the recompile budget
+    additionally proves ONE compile per run when ``steps`` is a
+    multiple of K (a trailing remainder window compiles its own
+    shorter scan, but :func:`run_scan_windows` AOT-precompiles every
+    window length before the first step, so both compiles land in the
+    warmup bucket and the budget stays clean for any N)."""
     from ..resilience import AutoResume, parse_fault
     from ..utils import CheckpointManager
 
@@ -696,13 +1051,22 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                                     else None),
                     transfer_scope="device_to_host",
                     recompile_budget=0, warmup_steps=1))
-            params, amp_state, loss_f, done = run_monitored_steps(
-                step_fn, params, amp_state, steps, monitor, timers,
-                lr=lr, start_step=start_step, ckpt=mgr,
-                ckpt_every=ckpt_every, amp_opt=amp_opt,
-                autoresume=autoresume, escalation=escalation,
-                fault=fault, sanitizer=san, trace=trace,
-                telemetry=telemetry)
+            if scan_steps and scan_steps > 0:
+                params, amp_state, loss_f, done = run_scan_windows(
+                    scan_factory, scan_steps, params, amp_state, steps,
+                    monitor, timers, telemetry, lr=lr,
+                    start_step=start_step, ckpt=mgr,
+                    ckpt_every=ckpt_every, amp_opt=amp_opt,
+                    autoresume=autoresume, escalation=escalation,
+                    fault=fault, sanitizer=san, trace=trace)
+            else:
+                params, amp_state, loss_f, done = run_monitored_steps(
+                    step_fn, params, amp_state, steps, monitor, timers,
+                    lr=lr, start_step=start_step, ckpt=mgr,
+                    ckpt_every=ckpt_every, amp_opt=amp_opt,
+                    autoresume=autoresume, escalation=escalation,
+                    fault=fault, sanitizer=san, trace=trace,
+                    telemetry=telemetry)
     except BaseException as e:
         # terminal record first — the re-raise may end the process
         monitor.event("run", "run_error", step=done,
@@ -792,6 +1156,13 @@ def _main(argv=None):
                         "steps (zero per-step host transfers); "
                         "default: APEX_TPU_TELEMETRY_DRAIN_EVERY "
                         "(0 = classic synchronous readback)")
+    p.add_argument("--scan-steps", type=int, default=None, metavar="K",
+                   help="batched-step scan driver: K train steps per "
+                        "jit call (lax.scan; amp state + telemetry "
+                        "ring in the donated carry, AOT-compiled "
+                        "windows, drains/checkpoints on K-step "
+                        "edges); default: APEX_TPU_SCAN_STEPS "
+                        "(0 = classic per-step loop)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     loss, _, _, done = train_smoke(
@@ -800,7 +1171,8 @@ def _main(argv=None):
         ckpt_every=args.ckpt_every, resume=not args.no_resume,
         fault=args.fault, return_state=True, sanitize=args.sanitize,
         trace_dir=args.trace,
-        drain_every=args.telemetry_drain_every)
+        drain_every=args.telemetry_drain_every,
+        scan_steps=args.scan_steps)
     print(f"SMOKE_DONE steps_done={done}"
           + (f" loss={loss:.4f}" if loss is not None else "")
           + (f" jsonl={args.jsonl}" if args.jsonl else ""))

@@ -1,0 +1,84 @@
+"""Persistent XLA compilation-cache wiring (ROADMAP item 2, ISSUE-8).
+
+Compile cost is the other half of the wall-vs-device gap: the scan
+driver amortizes per-step dispatch, but every *process* still pays the
+full XLA compile of each entry point it touches — minutes of apparent
+"wall" on a cold host that have nothing to do with the step being
+measured.  JAX's persistent compilation cache keys a lowered module to
+a disk entry; :func:`configure_compile_cache` points it at the
+``APEX_TPU_COMPILE_CACHE_DIR`` registry flag (or an explicit
+directory) and relaxes the min-size/min-compile-time floors so even
+smoke-sized programs are cached — exactly the programs CI and the
+drivers recompile most often.
+
+One ``python -m apex_tpu.testing.entry_points --aot`` run per host
+pre-populates the cache for every registered entry point
+(``jit(...).lower().compile()`` — no execution); every later process
+warm-starts from disk.  tests/test_scan_driver.py proves the
+second-process hit with jax's own compile/cache-hit log records.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..analysis.flags import flag_str
+from .log_util import get_logger
+
+__all__ = ["configure_compile_cache"]
+
+logger = get_logger(__name__)
+
+_configured: Optional[str] = None
+
+
+def configure_compile_cache(directory: Optional[str] = None,
+                            ) -> Optional[str]:
+    """Wire jax's persistent compilation cache to ``directory`` (default:
+    the ``APEX_TPU_COMPILE_CACHE_DIR`` flag).  Returns the directory in
+    effect, or None when the flag is unset (no-op — callers wire this
+    unconditionally).  Idempotent; re-pointing at a different directory
+    logs and re-configures.
+
+    The min-entry-size and min-compile-time floors are relaxed so the
+    smoke/test-tier programs (fast compiles, small modules) are cached
+    too — on a laptop-class CPU host those floors would exclude exactly
+    the programs whose cold-start this cache exists to kill.
+    """
+    global _configured
+    if directory is None:
+        directory = flag_str("APEX_TPU_COMPILE_CACHE_DIR")
+    if not directory:
+        return None
+    if _configured == directory:
+        return directory
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    for name, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        if hasattr(jax.config, name):
+            jax.config.update(name, val)
+    # jax initializes the cache AT MOST ONCE, on the first compile: if
+    # any compile ran before this call (or the dir changed), the
+    # latched no-cache/old-dir state silently wins and every later
+    # config.update is a no-op.  Reset so the next compile re-reads
+    # the directory (verified against jax 0.4.37
+    # compilation_cache._initialize_cache).
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError) as e:
+        logger.warning(
+            "compilation-cache reset unavailable (%s): the persistent "
+            "cache only takes effect if no compile preceded this "
+            "call", str(e)[:120])
+    if _configured is not None:
+        logger.info("compile cache re-pointed: %s -> %s", _configured,
+                    directory)
+    _configured = directory
+    return directory

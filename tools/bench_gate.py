@@ -48,15 +48,36 @@ def _get(d, *path):
 
 
 def _section_state(full, section):
-    """'ok' | 'skipped' | 'error' | 'missing' for an extras section."""
-    row = _get(full, "extras", section)
-    if row is None:
-        return "missing"
-    if isinstance(row, dict) and row.get("skipped"):
-        return "skipped"
-    if isinstance(row, dict) and "error" in row:
-        return "error"
-    return "ok"
+    """'ok' | 'skipped' | 'error' | 'missing' for an extras section.
+    ``section`` may be a tuple of fallback locations (the pipeline
+    rows moved from optimizer_step into their own optimizer_pipeline
+    section in ISSUE-8): the first present one wins, and an explicit
+    skip/error in ANY of them excuses absence."""
+    sections = section if isinstance(section, tuple) else (section,)
+    states = []
+    for s in sections:
+        row = _get(full, "extras", s)
+        if row is None:
+            states.append("missing")
+        elif isinstance(row, dict) and row.get("skipped"):
+            states.append("skipped")
+        elif isinstance(row, dict) and "error" in row:
+            states.append("error")
+        else:
+            states.append("ok")
+    for want in ("skipped", "error", "ok"):
+        if want in states:
+            return want
+    return "missing"
+
+
+def _pipeline_rows(full):
+    """The persistent-pipeline rows, from their ISSUE-8 home
+    (extras.optimizer_pipeline.pipeline) or the pre-split location
+    (extras.optimizer_step.pipeline) for older artifacts."""
+    return (_get(full, "extras", "optimizer_pipeline", "pipeline")
+            or _get(full, "extras", "optimizer_step", "pipeline")
+            or [])
 
 
 def headline_metrics(full):
@@ -96,11 +117,11 @@ def headline_metrics(full):
                 if v is not None:
                     out[f"long_context.{cfg}_tflops"] = (
                         v, "long_context")
-    pipe = _get(full, "extras", "optimizer_step", "pipeline") or []
-    for row in pipe:
+    for row in _pipeline_rows(full):
         if isinstance(row, dict) and row.get("speedup") is not None:
             key = f"pipeline.{row.get('params')}/{row.get('optimizer')}"
-            out[key] = (row["speedup"], "optimizer_step")
+            out[key] = (row["speedup"],
+                        ("optimizer_pipeline", "optimizer_step"))
     return out
 
 
@@ -108,13 +129,15 @@ DEFAULT_RATIO_MIN = 0.9
 
 
 def ratio_warnings(fresh, min_ratio=DEFAULT_RATIO_MIN):
-    """Warn-only wall/device attribution gate (ISSUE-7): the
-    ``attribution.wall_device_ratio`` sub-rows bench.py now emits are
+    """Wall/device attribution check (ISSUE-7/ISSUE-8): the
+    ``attribution.wall_device_ratio`` sub-rows bench.py emits are
     checked on the long_context and optimizer-pipeline headline rows
     against ROADMAP item 2's exit bar (wall/device > 0.9).  Returns
-    human-readable warning lines — WARN-ONLY until item 2 lands its
-    fix (the known state is ~0.4 on long_context; the gate exists so
-    the number is watched, not so today's build goes red)."""
+    human-readable lines.  WARN-only by default;
+    ``APEX_TPU_BENCH_GATE_RATIO=1`` escalates them to gating
+    regressions (ISSUE-8: the scan driver + donation + AOT work exists
+    to make this bar pass — armed on the nightly tier first, where a
+    red ratio means the fix regressed, not that the fix is pending)."""
     warns = []
     lc = _get(fresh, "extras", "long_context") or {}
     if isinstance(lc, dict):
@@ -127,8 +150,7 @@ def ratio_warnings(fresh, min_ratio=DEFAULT_RATIO_MIN):
                     f"long_context.{cfg}: wall_device_ratio {r} < "
                     f"{min_ratio} (host/dispatch overhead — ROADMAP "
                     f"item 2)")
-    for row in _get(fresh, "extras", "optimizer_step", "pipeline") \
-            or []:
+    for row in _pipeline_rows(fresh):
         if not isinstance(row, dict):
             continue
         r = _get(row, "attribution", "wall_device_ratio")
@@ -137,6 +159,18 @@ def ratio_warnings(fresh, min_ratio=DEFAULT_RATIO_MIN):
                 f"pipeline.{row.get('params')}/{row.get('optimizer')}"
                 f": wall_device_ratio {r} < {min_ratio}")
     return warns
+
+
+def ratio_enforced(environ=None) -> bool:
+    """Whether the wall/device ratio check gates (fails) the run:
+    the APEX_TPU_BENCH_GATE_RATIO env flag (registered in
+    apex_tpu/analysis/flags.py; read directly here so the gate stays
+    importable without the package, like APEX_TPU_BENCH_GATE)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    return str(env.get("APEX_TPU_BENCH_GATE_RATIO", "0")).lower() \
+        in ("1", "true", "on", "yes")
 
 
 def compare(fresh, committed, max_drop=DEFAULT_MAX_DROP):
@@ -243,6 +277,32 @@ def self_test() -> int:
     assert ratio_warnings(ok_ratio) == []
     # a null ratio (no device measurement) never warns
     assert ratio_warnings(committed) == []
+    # pipeline rows in their ISSUE-8 section (optimizer_pipeline) are
+    # read exactly like the pre-split location: same headline key,
+    # same ratio check, and the new section's explicit skip excuses
+    # a fresh run without them
+    split = json.loads(json.dumps(committed))
+    split["extras"]["optimizer_pipeline"] = {
+        "pipeline": split["extras"]["optimizer_step"].pop("pipeline")}
+    assert "pipeline.rn50_26m/adam" in headline_metrics(split), \
+        headline_metrics(split)
+    r, _ = compare(split, committed)
+    assert r == [], r
+    split["extras"]["optimizer_pipeline"]["pipeline"][0][
+        "attribution"] = {"wall_device_ratio": 0.4}
+    assert any("rn50_26m" in x for x in ratio_warnings(split)), \
+        ratio_warnings(split)
+    pipe_gone = json.loads(json.dumps(split))
+    pipe_gone["extras"]["optimizer_pipeline"] = {"skipped": "budget"}
+    r, notes = compare(pipe_gone, split)
+    assert r == [] and any("pipeline.rn50_26m" in n for n in notes), \
+        (r, notes)
+    # the ratio escalation switch (satellite: WARN -> gate behind
+    # APEX_TPU_BENCH_GATE_RATIO=1)
+    assert not ratio_enforced({})
+    assert not ratio_enforced({"APEX_TPU_BENCH_GATE_RATIO": "0"})
+    assert ratio_enforced({"APEX_TPU_BENCH_GATE_RATIO": "1"})
+    assert ratio_enforced({"APEX_TPU_BENCH_GATE_RATIO": "true"})
     print("[bench-gate] self-test OK")
     return 0
 
@@ -262,9 +322,11 @@ def main(argv=None) -> int:
     ap.add_argument("--ratio-min", type=float,
                     default=DEFAULT_RATIO_MIN,
                     help="wall_device_ratio threshold for the "
-                         "warn-only attribution check on the "
-                         "long_context + optimizer pipeline rows "
-                         "(default 0.9; ROADMAP item 2 exit bar)")
+                         "attribution check on the long_context + "
+                         "optimizer pipeline rows (default 0.9; "
+                         "ROADMAP item 2 exit bar).  WARN-only "
+                         "unless APEX_TPU_BENCH_GATE_RATIO=1, which "
+                         "escalates failures to gating regressions")
     ap.add_argument("--self-test", action="store_true",
                     help="run the gate-logic self-test and exit")
     args = ap.parse_args(argv)
@@ -281,9 +343,16 @@ def main(argv=None) -> int:
                                  max_drop=args.max_drop)
     for n in notes:
         print(f"[bench-gate] {n}")
+    enforce = ratio_enforced()
     for w in ratio_warnings(fresh, min_ratio=args.ratio_min):
-        print(f"[bench-gate] WARN (wall/device, not gating): {w}",
-              file=sys.stderr)
+        if enforce:
+            # APEX_TPU_BENCH_GATE_RATIO=1: ROADMAP item 2's exit bar
+            # is armed — a below-threshold ratio is a regression
+            regressions.append(f"wall/device ratio gate "
+                               f"(APEX_TPU_BENCH_GATE_RATIO=1): {w}")
+        else:
+            print(f"[bench-gate] WARN (wall/device, not gating): {w}",
+                  file=sys.stderr)
     for r in regressions:
         print(f"[bench-gate] REGRESSION {r}", file=sys.stderr)
     if regressions:

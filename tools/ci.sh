@@ -64,28 +64,42 @@
 #                            host transfers, metrics drained through
 #                            the device ring (docs/api/
 #                            observability.md)
+#  10. scan-driver smoke     — the ISSUE-8 batched-step driver: a
+#                            2-window x K=3 standalone_gpt run under
+#                            --sanitize must prove ONE compile for
+#                            all 6 steps (AOT window + recompile
+#                            budget 0) with zero per-step host
+#                            transfers, exactly ceil(N/K)=2 telemetry
+#                            drains and the full 6-loss series in the
+#                            log, and K-sized waterfall windows
+#                            (tools/trace_check.py --scan-k); then the
+#                            AOT + persistent-compile-cache leg: the
+#                            registry warmup runs twice against one
+#                            APEX_TPU_COMPILE_CACHE_DIR and the second
+#                            process must warm-start from the cache
+#                            (--expect-cache-hits)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/9 default test tier"
+echo "[ci] 1/10 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/9 README drift guard"
+echo "[ci] 2/10 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/9 8-device multichip dryrun"
+echo "[ci] 3/10 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/9 monitor smoke"
+echo "[ci] 4/10 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/9 kill->resume smoke"
+echo "[ci] 5/10 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -105,16 +119,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/9 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/10 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/9 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/10 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/9 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/10 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -123,7 +137,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/9 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/10 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -143,5 +157,29 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
     || { echo "[ci] FAIL: deferred run drained no loss metrics"; \
          exit 1; }
 rm -rf "$TRACE_DIR"
+
+echo "[ci] 10/10 scan-driver smoke (K-batched steps + AOT compile cache)"
+SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
+# leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
+# after warmup, d->h transfer guard armed (scan mode is deferred-
+# telemetry by construction), waterfall rows are K-step windows
+python -m apex_tpu.testing.standalone_gpt --steps 6 --scan-steps 3 \
+    --jsonl "$SCAN_DIR/scan.jsonl" --trace "$SCAN_DIR" --sanitize \
+    | grep -q "steps_done=6" \
+    || { echo "[ci] FAIL: scan driver did not reach step 6"; exit 1; }
+python tools/trace_check.py "$SCAN_DIR/scan.jsonl" --scan-k 3 --steps 6 \
+    --chrome "$SCAN_DIR/trace.chrome.json"
+[ "$(grep -c '"kind":"metric","name":"loss"' "$SCAN_DIR/scan.jsonl")" = 6 ] \
+    || { echo "[ci] FAIL: scan run did not drain all 6 losses"; exit 1; }
+[ "$(grep -c '"kind":"telemetry","name":"telemetry_drain"' "$SCAN_DIR/scan.jsonl")" = 2 ] \
+    || { echo "[ci] FAIL: expected ceil(6/3)=2 telemetry drains"; exit 1; }
+# leg 2: AOT + persistent compile cache — the second process must
+# warm-start every compile from the first one's cache entries
+APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
+    python -m apex_tpu.testing.entry_points --aot --entry fused_pipeline_step
+APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
+    python -m apex_tpu.testing.entry_points --aot --entry fused_pipeline_step \
+    --expect-cache-hits
+rm -rf "$SCAN_DIR"
 
 echo "[ci] all green"

@@ -74,6 +74,20 @@ def render(full: dict, artifact_name: str) -> str:
         if "speedup" in r:
             row(f"fused/unfused {r['optimizer']} @ {r['params']} "
                 "(device ratio)", f"{r['speedup']}x")
+    # persistent-pipeline rows: their own section since ISSUE-8, with
+    # the pre-split optimizer_step location as fallback for artifacts
+    # older than the split
+    pipe_sec = ex.get("optimizer_pipeline") or opt
+    if isinstance(pipe_sec, dict):
+        for r in pipe_sec.get("pipeline", []):
+            if "speedup" in r:
+                row(f"packed-pipeline/staged post-backward "
+                    f"{r['optimizer']} @ {r['params']} (device ratio)",
+                    f"{r['speedup']}x")
+    sd = ex.get("scan_driver", {})
+    if isinstance(sd, dict) and sd.get("k8_vs_k1_wall") is not None:
+        row("scan driver K=8 vs K=1 wall (smoke GPT, dispatch "
+            "amortization)", f"{sd['k8_vs_k1_wall']}x")
     z = ex.get("zero_sharded_adam", {})
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
