@@ -290,6 +290,38 @@ register_flag(
     "regression (--ratio-min, default 0.9 — ROADMAP item 2's exit "
     "bar).  Off by default so the nightly bench tier arms it first.")
 register_flag(
+    "APEX_TPU_SERVE_KV_BLOCK", "int", 16,
+    "Tokens per KV-cache block in the serving stack "
+    "(docs/api/serving.md): the paging grain the flash-decode kernel "
+    "gathers by and the unit the block pool allocates.  128 matches "
+    "the MXU lane width on a real TPU; the smoke/CI default keeps "
+    "tiny prompts multi-page so the paging paths are exercised.",
+    lo=1, hi=4096)
+register_flag(
+    "APEX_TPU_SERVE_KV_DTYPE", "str", "model",
+    "KV-cache storage dtype: 'model' stores k/v in the model compute "
+    "dtype, 'bf16' forces bfloat16, 'int8' stores weight-only-"
+    "quantized rows with per-token fp32 scales (appending never "
+    "requantizes history; the kernel dequantizes per page in VMEM).")
+register_flag(
+    "APEX_TPU_SERVE_BLOCKS", "int", 64,
+    "KV-cache pool size (blocks, INCLUDING the reserved dump block 0) "
+    "for drivers that size the cache from flags (standalone_gpt "
+    "--serve); engine callers may pass an explicit pool.", lo=2)
+register_flag(
+    "APEX_TPU_SERVE_BATCH_BUCKETS", "str", "1,2,4,8",
+    "Registered decode batch-size ladder (comma-separated, "
+    "ascending): a decode step's batch rounds up to the smallest "
+    "rung, so steady-state serving compiles exactly one program per "
+    "(batch, pages) bucket — the recompile budget sanitize() "
+    "enforces.")
+register_flag(
+    "APEX_TPU_SERVE_PAGE_BUCKETS", "str", "1,2,4,8",
+    "Registered page-span ladder: the decode step's block-table "
+    "width (and the prefill padding, in blocks) rounds up to the "
+    "smallest rung.  max rung x APEX_TPU_SERVE_KV_BLOCK bounds the "
+    "servable sequence length.")
+register_flag(
     "APEX_TPU_FULL", "bool", False,
     "CI switch: run the full (slow-inclusive) test tier in "
     "tools/ci.sh.")

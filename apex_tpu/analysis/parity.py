@@ -59,6 +59,12 @@ KERNEL_TWINS: Dict[Tuple[str, str], TwinSpec] = {
                   "_flash_bwd_packed", "_flash_fwd_e",
                   "_flash_fwd_e_blocked", "_flash_bwd_e",
                   "_flash_bwd_e_blocked")},
+    # flash decode: the paged single-query serving kernel is specified
+    # by the dense gather-and-softmax reference (also the naive decode
+    # baseline the serving bench row measures against)
+    ("flash_decode.py", "_decode_paged"): _spec(
+        "flash_decode", "paged_attention_reference",
+        "apex_tpu/ops/flash_decode.py", "tests/test_serving.py"),
     ("layer_norm.py", "_ln_forward"): _spec(
         "layer_norm", "_layer_norm_reference",
         "apex_tpu/ops/layer_norm.py", "tests/test_layer_norm.py"),

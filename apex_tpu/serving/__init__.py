@@ -1,0 +1,35 @@
+"""apex_tpu.serving — flash-decode inference stack.
+
+The serving counterpart of the training pipeline (ROADMAP item 1):
+
+* :mod:`.kv_cache` — block-paged KV cache: device layout
+  (:class:`PagedKVCache`), host block pool
+  (:class:`KVCacheManager`), bf16/int8 storage.
+* :mod:`.model` — pure-function GPT prefill + paged decode over the
+  extracted :class:`GPTServingWeights`.
+* :mod:`.engine` — continuous batching: bucket-laddered jitted steps,
+  reservation admission, SIGTERM clean drain, tokens/s + p50/p99
+  metrics (:class:`ServingEngine`).
+
+Entry point: ``python -m apex_tpu.testing.standalone_gpt --serve``;
+docs/api/serving.md walks the architecture.
+"""
+from .engine import (BucketLadder, Request, ServeSummary,
+                     ServingEngine, default_cache_config)
+from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
+                       KVCacheManager, PagedKVCache, init_cache,
+                       quantize_kv_rows, write_prefill_kv,
+                       write_token_kv)
+from .model import (GPTServingWeights, LayerWeights,
+                    ServingModelConfig, extract_serving_weights,
+                    gpt_decode_step, gpt_prefill_step)
+
+__all__ = [
+    "BucketLadder", "Request", "ServeSummary", "ServingEngine",
+    "default_cache_config",
+    "DUMP_BLOCK", "CachePoolExhausted", "KVCacheConfig",
+    "KVCacheManager", "PagedKVCache", "init_cache",
+    "quantize_kv_rows", "write_prefill_kv", "write_token_kv",
+    "GPTServingWeights", "LayerWeights", "ServingModelConfig",
+    "extract_serving_weights", "gpt_decode_step", "gpt_prefill_step",
+]

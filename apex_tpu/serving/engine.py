@@ -1,0 +1,540 @@
+"""Continuous-batching serving engine.
+
+The serving loop alternates two worlds on a fixed cadence:
+
+* **between** jitted steps (host, this module): finished requests are
+  evicted (their cache blocks return to the pool), queued requests are
+  admitted (blocks allocated, prompt prefilled), and the next decode
+  batch is assembled;
+* **inside** jitted steps (:mod:`.model`): one prefill per admission,
+  then one batched decode step per engine tick — cache donated through
+  every call, greedy sampling in-graph, one int32 per row of output
+  traffic.
+
+Shapes are **bucketed**: the decode batch rounds up to a registered
+batch bucket and the page span to a page bucket
+(:class:`BucketLadder`, ``APEX_TPU_SERVE_BATCH_BUCKETS`` /
+``APEX_TPU_SERVE_PAGE_BUCKETS``), prompt lengths to page-bucket
+multiples of the block size — so the set of compiled programs is the
+(small, finite) ladder product, every member AOT-compiled by
+:meth:`ServingEngine.warmup` before traffic.  Steady-state serving
+under :func:`apex_tpu.analysis.sanitize` therefore compiles exactly
+once per bucket and never again — the same recompile budget the
+training smoke enforces, now on the serving path (the tests and
+tools/ci.sh step 11 prove it).
+
+Admission control is **reservation-based**: a request is admitted only
+when the pool can cover its whole worst case (prompt + max new
+tokens), so a mid-flight decode can never exhaust the pool — eviction
+is always "request finished", never "victim chosen".  Utilization-
+optimistic admission (overcommit + preempt) layers on top of the same
+pool primitives; this engine ships the safe policy.
+
+Per-token latency is the engine tick wall (each active request gains
+one token per tick); the run summary reports p50/p99 over every
+generated token plus decode tokens/s — the rows ``standalone_gpt
+--serve`` prints and bench.py's ``serving`` section commits.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.flags import flag_int, flag_str
+from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
+                       init_cache)
+from .model import (GPTServingWeights, ServingModelConfig,
+                    gpt_decode_step, gpt_prefill_step)
+
+__all__ = ["Request", "BucketLadder", "ServingEngine", "ServeSummary",
+           "default_cache_config"]
+
+# per-token latency samples kept for the p50/p99 window (a lifetime
+# list would grow without bound on a long-running serve)
+_LATENCY_WINDOW = 100_000
+
+
+def _parse_ladder(raw: str) -> Tuple[int, ...]:
+    vals = tuple(sorted({int(x) for x in raw.split(",") if x.strip()}))
+    if not vals or vals[0] < 1:
+        raise ValueError(f"bucket ladder {raw!r} must name positive "
+                         f"integers")
+    return vals
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """The registered (batch, pages) shape ladder.  ``pick`` rounds a
+    live size up to the smallest rung, so steady-state serving runs a
+    finite, precompilable program set."""
+
+    batch: Tuple[int, ...]
+    pages: Tuple[int, ...]
+
+    @classmethod
+    def from_flags(cls) -> "BucketLadder":
+        return cls(
+            batch=_parse_ladder(flag_str("APEX_TPU_SERVE_BATCH_BUCKETS")),
+            pages=_parse_ladder(flag_str("APEX_TPU_SERVE_PAGE_BUCKETS")))
+
+    @staticmethod
+    def _pick(rungs: Tuple[int, ...], n: int, what: str) -> int:
+        for r in rungs:
+            if n <= r:
+                return r
+        raise ValueError(f"{what} {n} exceeds the ladder {rungs} — "
+                         f"register a bigger rung or admit less")
+
+    def pick_batch(self, n: int) -> int:
+        return self._pick(self.batch, n, "batch size")
+
+    def pick_pages(self, n: int) -> int:
+        return self._pick(self.pages, n, "page span")
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch[-1]
+
+    @property
+    def max_pages(self) -> int:
+        return self.pages[-1]
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its accumulated results."""
+
+    rid: Any
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token: Optional[int] = None
+    # engine-owned:
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    token_latency_s: List[float] = dataclasses.field(
+        default_factory=list)
+    admitted_at_step: Optional[int] = None
+    preempted: bool = False
+
+    @property
+    def done(self) -> bool:
+        if self.out_tokens and self.eos_token is not None \
+                and self.out_tokens[-1] == self.eos_token:
+            return True
+        return len(self.out_tokens) >= self.max_new_tokens
+
+
+@dataclasses.dataclass
+class ServeSummary:
+    """What a serve run measured (the --serve / bench row source)."""
+
+    requests_done: int
+    requests_preempted: int
+    tokens_generated: int
+    prefill_tokens: int
+    wall_s: float
+    decode_steps: int
+    tokens_per_sec: float
+    # decode ticks only (prefill wall excluded) — the honest basis for
+    # kernel-vs-baseline decode comparisons
+    decode_wall_s: float
+    decode_tokens_per_sec: float
+    latency_p50_ms: Optional[float]
+    latency_p99_ms: Optional[float]
+    compiles: Dict[str, int]
+    drained: bool = False
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _percentile(xs: Sequence[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    return float(np.percentile(np.asarray(xs, np.float64), q))
+
+
+class ServingEngine:
+    """Continuous-batching driver over one model + one paged cache.
+
+    ``weights``/``model_cfg`` come from :mod:`.model`;
+    ``cache_cfg`` sizes the pool.  ``monitor`` is an optional
+    :class:`apex_tpu.monitor.StepMonitor` (or anything with its
+    ``event`` method) receiving ``serving`` events; ``autoresume`` an
+    installed :class:`apex_tpu.resilience.AutoResume` polled between
+    steps for the SIGTERM clean-drain path.
+
+    Long-running serves: summary totals come from lifetime counters
+    and latency percentiles from a bounded window of the most recent
+    samples, so a caller may drain ``done`` (pop finished requests)
+    at any time to keep host memory flat without corrupting the
+    summary."""
+
+    def __init__(self, weights: GPTServingWeights,
+                 model_cfg: ServingModelConfig,
+                 cache_cfg: KVCacheConfig, *,
+                 ladder: Optional[BucketLadder] = None,
+                 monitor=None, autoresume=None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.weights = weights
+        self.model_cfg = model_cfg
+        self.cache_cfg = cache_cfg
+        self.ladder = ladder if ladder is not None \
+            else BucketLadder.from_flags()
+        max_need = self.ladder.max_pages
+        if max_need > cache_cfg.usable_blocks:
+            raise ValueError(
+                f"page ladder max {max_need} exceeds the pool's "
+                f"{cache_cfg.usable_blocks} usable blocks")
+        self.monitor = monitor
+        self.autoresume = autoresume
+        self._clock = clock
+        self.manager = KVCacheManager(cache_cfg)
+        self.cache = init_cache(cache_cfg)
+        self.queue: deque = deque()
+        self.active: Dict[Any, Request] = {}
+        self.done: List[Request] = []
+        self.steps = 0
+        self.prefill_tokens = 0
+        self._run_wall_s = 0.0
+        # bounded: a weeks-long serve must not grow host memory per
+        # token — percentiles read the most recent window only
+        self._latencies: deque = deque(maxlen=_LATENCY_WINDOW)
+        self._done_count = 0
+        self._preempted_count = 0
+        self._done_tokens = 0
+        self.decode_wall_s = 0.0
+        self.decode_tokens = 0
+        self._decode_exec: Dict[Tuple[int, int], Any] = {}
+        self._prefill_exec: Dict[int, Any] = {}
+        self._compiles: Dict[str, int] = {}
+
+    # --- events -------------------------------------------------------
+
+    def _event(self, name: str, value=None, **attrs) -> None:
+        if self.monitor is not None:
+            self.monitor.event("serving", name, value=value,
+                               step=self.steps, **attrs)
+
+    # --- compiled-program cache ---------------------------------------
+
+    def _jit_decode(self):
+        cfg, ccfg = self.model_cfg, self.cache_cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(weights, cache, tokens, positions, block_tables,
+                 seq_lens, write_blocks, write_offsets):
+            return gpt_decode_step(weights, cfg, ccfg, cache, tokens,
+                                   positions, block_tables, seq_lens,
+                                   write_blocks, write_offsets)
+
+        return step
+
+    def _jit_prefill(self):
+        cfg, ccfg = self.model_cfg, self.cache_cfg
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(weights, cache, tokens, length, blocks):
+            return gpt_prefill_step(weights, cfg, ccfg, cache, tokens,
+                                    length, blocks)
+
+        return step
+
+    def _decode_args(self, bb: int, pb: int):
+        z = jnp.zeros((bb,), jnp.int32)
+        return (self.weights, self.cache, z, z,
+                jnp.zeros((bb, pb), jnp.int32), z, z, z)
+
+    def _prefill_args(self, s_pad: int):
+        return (self.weights, self.cache,
+                jnp.zeros((s_pad,), jnp.int32), jnp.int32(1),
+                jnp.zeros((s_pad // self.cache_cfg.block_size,),
+                          jnp.int32))
+
+    def _compiled(self, cache: dict, key, jit_builder, args, label):
+        ex = cache.get(key)
+        if ex is None:
+            t0 = self._clock()
+            ex = jit_builder().lower(*args).compile()
+            cache[key] = ex
+            self._compiles[f"{label}:{key}"] = \
+                self._compiles.get(f"{label}:{key}", 0) + 1
+            self._event("serve_compile", value=round(
+                (self._clock() - t0) * 1e3, 2), what=label,
+                bucket=str(key))
+        return ex
+
+    def _decode_fn(self, bb: int, pb: int):
+        return self._compiled(self._decode_exec, (bb, pb),
+                              self._jit_decode,
+                              self._decode_args(bb, pb), "decode")
+
+    def _prefill_fn(self, s_pad: int):
+        return self._compiled(self._prefill_exec, s_pad,
+                              self._jit_prefill,
+                              self._prefill_args(s_pad), "prefill")
+
+    def warmup(self) -> Dict[str, float]:
+        """AOT-compile every ladder bucket (decode: batch x pages;
+        prefill: one program per page rung) BEFORE traffic, so a
+        sanitized serve charges every compile to warmup and the
+        steady state compiles exactly once per bucket.  Returns
+        ``{bucket label: compile count}`` (all 1 after a fresh
+        warmup)."""
+        for pb in self.ladder.pages:
+            self._prefill_fn(pb * self.cache_cfg.block_size)
+        for bb in self.ladder.batch:
+            for pb in self.ladder.pages:
+                self._decode_fn(bb, pb)
+        return dict(self._compiles)
+
+    # --- request lifecycle --------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        if len(request.prompt) < 1:
+            raise ValueError(f"request {request.rid!r}: empty prompt")
+        if request.max_new_tokens < 1:
+            # prefill always emits one token, and a negative budget
+            # would undercount the reservation _can_admit sizes —
+            # admission could then exhaust the pool mid-decode
+            raise ValueError(
+                f"request {request.rid!r}: max_new_tokens "
+                f"{request.max_new_tokens} < 1")
+        limit = self.ladder.max_pages * self.cache_cfg.block_size
+        worst = len(request.prompt) + request.max_new_tokens
+        if worst > limit:
+            raise ValueError(
+                f"request {request.rid!r}: prompt + max_new_tokens = "
+                f"{worst} exceeds the ladder's {limit}-token span")
+        if worst > self.model_cfg.max_seq:
+            raise ValueError(
+                f"request {request.rid!r}: {worst} tokens exceed the "
+                f"model's max_seq {self.model_cfg.max_seq}")
+        self.queue.append(request)
+        self._event("request_submitted", rid=str(request.rid),
+                    prompt_len=len(request.prompt))
+
+    def _reserved_blocks(self) -> int:
+        """Blocks the free pool already owes to active requests: each
+        one may still grow to its worst case (prompt + max_new), and
+        only the pages it has claimed so far left the free list."""
+        total = 0
+        for rid, req in self.active.items():
+            worst = self.cache_cfg.blocks_for(
+                len(req.prompt) + req.max_new_tokens)
+            total += max(0, worst - self.manager.num_pages(rid))
+        return total
+
+    def _can_admit(self, req: Request) -> bool:
+        # reservation policy lives in the manager — one build site
+        # for the no-mid-decode-exhaustion contract
+        return self.manager.can_admit(
+            len(req.prompt), req.max_new_tokens,
+            reserved_blocks=self._reserved_blocks())
+
+    def _admit(self, req: Request) -> None:
+        p_len = len(req.prompt)
+        self.manager.alloc(req.rid, p_len)
+        bs = self.cache_cfg.block_size
+        pages_bucket = self.ladder.pick_pages(
+            self.cache_cfg.blocks_for(p_len))
+        s_pad = pages_bucket * bs
+        bt = self.manager.block_table(req.rid, s_pad // bs)
+        tokens = np.zeros(s_pad, np.int32)
+        tokens[:p_len] = req.prompt
+        fn = self._prefill_fn(s_pad)
+        t0 = self._clock()
+        self.cache, next_token = fn(
+            self.weights, self.cache, jnp.asarray(tokens),
+            jnp.int32(p_len), jnp.asarray(bt))
+        first = int(next_token)          # explicit host sync: the
+        # admission boundary needs the token to seed the decode batch
+        dt = self._clock() - t0
+        req.out_tokens.append(first)
+        req.token_latency_s.append(dt)
+        self._latencies.append(dt)
+        req.admitted_at_step = self.steps
+        self.active[req.rid] = req
+        self.prefill_tokens += p_len
+        self._event("request_admitted", value=round(dt * 1e3, 2),
+                    rid=str(req.rid), prompt_len=p_len, s_pad=s_pad)
+
+    def _finish(self, req: Request) -> None:
+        self.manager.free(req.rid)
+        del self.active[req.rid]
+        self.done.append(req)
+        if req.preempted:
+            self._preempted_count += 1
+        else:
+            self._done_count += 1
+        self._done_tokens += len(req.out_tokens)
+        self._event("request_done", rid=str(req.rid),
+                    new_tokens=len(req.out_tokens),
+                    preempted=req.preempted)
+
+    def _terminating(self) -> bool:
+        return (self.autoresume is not None
+                and self.autoresume.termination_requested())
+
+    # --- the engine tick ----------------------------------------------
+
+    def step(self) -> int:
+        """One continuous-batching tick: evict finished, admit (unless
+        draining), run one bucketed decode step over every active
+        request.  Returns the number of tokens generated this tick."""
+        for rid in [r for r, q in self.active.items() if q.done]:
+            self._finish(self.active[rid])
+        if not self._terminating():
+            while (self.queue
+                   and len(self.active) < self.ladder.max_batch
+                   and self._can_admit(self.queue[0])):
+                self._admit(self.queue.popleft())
+        # requests may finish at admission (max_new_tokens == 1)
+        for rid in [r for r, q in self.active.items() if q.done]:
+            self._finish(self.active[rid])
+        if not self.active:
+            return 0
+        reqs = [self.active[r] for r in sorted(self.active,
+                                               key=lambda r: str(r))]
+        n = len(reqs)
+        bb = self.ladder.pick_batch(n)
+        slots = [self.manager.append(q.rid) for q in reqs]
+        pb = self.ladder.pick_pages(
+            max(self.manager.num_pages(q.rid) for q in reqs))
+        tokens = np.zeros(bb, np.int32)
+        positions = np.zeros(bb, np.int32)
+        seq_lens = np.zeros(bb, np.int32)
+        wb = np.full(bb, DUMP_BLOCK, np.int32)
+        wo = np.zeros(bb, np.int32)
+        bt = np.full((bb, pb), DUMP_BLOCK, np.int32)
+        for i, (q, (blk, off)) in enumerate(zip(reqs, slots)):
+            new_len = self.manager.seq_len(q.rid)   # post-append
+            tokens[i] = q.out_tokens[-1]
+            positions[i] = new_len - 1
+            seq_lens[i] = new_len
+            wb[i], wo[i] = blk, off
+            bt[i] = self.manager.block_table(q.rid, pb)
+        fn = self._decode_fn(bb, pb)
+        t0 = self._clock()
+        self.cache, next_tokens = fn(
+            self.weights, self.cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(bt),
+            jnp.asarray(seq_lens), jnp.asarray(wb), jnp.asarray(wo))
+        out = np.asarray(next_tokens)    # the tick's ONE device fetch
+        dt = self._clock() - t0
+        for i, q in enumerate(reqs):
+            q.out_tokens.append(int(out[i]))
+            q.token_latency_s.append(dt)
+            self._latencies.append(dt)
+        self.decode_wall_s += dt
+        self.decode_tokens += n
+        self.steps += 1
+        self._event("decode_step", value=round(dt * 1e3, 3),
+                    batch=n, batch_bucket=bb, pages_bucket=pb)
+        return n
+
+    def run(self, *, max_steps: Optional[int] = None,
+            before_tick: Optional[Callable[[int], None]] = None,
+            after_tick: Optional[Callable[[int], None]] = None
+            ) -> ServeSummary:
+        """Serve until every submitted request finishes (or a
+        termination request / ``max_steps`` drains the run).  On
+        SIGTERM (via ``autoresume``) the engine stops admitting,
+        abandons in-flight generation cleanly (blocks freed, requests
+        marked preempted) and still returns a complete summary — the
+        clean-drain contract CI kills a serve mid-run to prove.
+        ``before_tick``/``after_tick`` receive the tick index (fault
+        injection and the sanitizer's step boundary in the smoke
+        driver).
+
+        The summary covers the engine's **lifetime**: token/request
+        totals accumulate across every ``run()`` call on this engine,
+        and ``wall_s`` accumulates the time spent inside ``run()`` —
+        so a paused-and-resumed serve (``max_steps``, or bench's
+        staggered tail admissions) reports the same honest tokens/s
+        as a single uninterrupted run, never lifetime tokens over
+        one run's wall."""
+        t0 = self._clock()
+        drained = False
+        while self.queue or self.active:
+            if self._terminating():
+                drained = True
+                for rid in list(self.active):
+                    q = self.active[rid]
+                    q.preempted = True
+                    self._finish(q)
+                while self.queue:
+                    # accepted but never admitted: no blocks to free,
+                    # but the drain still accounts for every request —
+                    # preempted, in ``done``, with a request_done event
+                    q = self.queue.popleft()
+                    q.preempted = True
+                    self.done.append(q)
+                    self._preempted_count += 1
+                    self._event("request_done", rid=str(q.rid),
+                                new_tokens=0, preempted=True)
+                self._event("serve_preempt",
+                            source=self.autoresume.source)
+                break
+            if max_steps is not None and self.steps >= max_steps:
+                drained = True
+                break
+            if before_tick is not None:
+                before_tick(self.steps)
+            self.step()
+            if after_tick is not None:
+                after_tick(self.steps)
+        self._run_wall_s += self._clock() - t0
+        wall = max(self._run_wall_s, 1e-9)
+        gen = self._done_tokens \
+            + sum(len(q.out_tokens) for q in self.active.values())
+        summary = ServeSummary(
+            requests_done=self._done_count,
+            requests_preempted=self._preempted_count,
+            tokens_generated=gen,
+            prefill_tokens=self.prefill_tokens,
+            wall_s=round(wall, 4),
+            decode_steps=self.steps,
+            tokens_per_sec=round(gen / wall, 2),
+            decode_wall_s=round(self.decode_wall_s, 4),
+            decode_tokens_per_sec=round(
+                self.decode_tokens / max(self.decode_wall_s, 1e-9), 2)
+            if self.decode_tokens else 0.0,
+            latency_p50_ms=_round_ms(_percentile(self._latencies, 50)),
+            latency_p99_ms=_round_ms(_percentile(self._latencies, 99)),
+            compiles=dict(self._compiles),
+            drained=drained)
+        self._event("serve_done", value=summary.tokens_per_sec,
+                    **{k: v for k, v in summary.as_dict().items()
+                       if k not in ("compiles", "tokens_per_sec")})
+        return summary
+
+
+def _round_ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 3)
+
+
+def default_cache_config(model_cfg: ServingModelConfig,
+                         num_blocks: Optional[int] = None,
+                         block_size: Optional[int] = None,
+                         kv_dtype: Optional[str] = None) -> KVCacheConfig:
+    """Cache plan from the registered serving flags
+    (``APEX_TPU_SERVE_KV_BLOCK`` / ``APEX_TPU_SERVE_KV_DTYPE`` /
+    ``APEX_TPU_SERVE_BLOCKS``); explicit arguments override."""
+    return KVCacheConfig(
+        num_layers=model_cfg.num_layers,
+        num_heads=model_cfg.num_heads,
+        head_dim=model_cfg.head_dim,
+        num_blocks=(num_blocks if num_blocks is not None
+                    else flag_int("APEX_TPU_SERVE_BLOCKS")),
+        block_size=(block_size if block_size is not None
+                    else flag_int("APEX_TPU_SERVE_KV_BLOCK")),
+        kv_dtype=(kv_dtype if kv_dtype is not None
+                  else flag_str("APEX_TPU_SERVE_KV_DTYPE")),
+        model_dtype=model_cfg.dtype)

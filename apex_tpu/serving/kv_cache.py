@@ -1,0 +1,356 @@
+"""Block-paged KV cache: device layout + host block-pool bookkeeping.
+
+The serving cache is a fixed pool of ``num_blocks`` blocks of
+``block_size`` tokens each, shared by every in-flight request.  A
+request owns an ordered list of block ids (its *block table*); growing
+a sequence past a block boundary appends one block from the free list,
+finishing a request returns its blocks.  Nothing is ever moved or
+compacted — **defrag-free paging**: the flash-decode kernel gathers
+pages through the block table (scalar-prefetched index map), so block
+ids need no spatial locality, and admission/eviction cost is O(pages
+touched), never O(cache).
+
+Two cleanly separated halves:
+
+* :class:`PagedKVCache` — the DEVICE state: per-layer k/v block arrays
+  stacked over layers, ``(L, nb, hk, bs, dk)``, plus optional int8
+  per-row scales ``(L, nb, h, bs)``.  A pytree, threaded through the
+  jitted prefill/decode steps and **donated** every step (the same
+  carry discipline as the scan driver's amp state — the cache is the
+  largest buffer in the serving process, double-buffering it halves
+  capacity).  ``hk``/``dk`` follow the d=64 head-pair packing decision
+  (:func:`apex_tpu.ops.flash_decode.use_decode_head_packing`) so the
+  kernel and the layout can never disagree.
+* :class:`KVCacheManager` — the HOST bookkeeping: free list, per-
+  request tables and lengths.  Pure Python, no device work; the engine
+  consults it between jitted steps (the continuous-batching boundary).
+
+Block 0 is reserved as the **dump page**: it is never handed to a
+request, block-table padding points at it, and inactive batch rows
+write their (masked-out) k/v there — so a bucketed decode step needs
+no write masking and a dead page read contributes exactly 0.
+
+Storage dtype (``APEX_TPU_SERVE_KV_DTYPE``): ``model`` stores k/v in
+the model compute dtype, ``bf16`` forces bfloat16 (the O4/O5-native
+choice), ``int8`` stores weight-only-quantized rows with per-token,
+per-head fp32 scales — appending never requantizes history, and the
+kernel dequantizes per page in VMEM (docs/api/serving.md#kv-dtype).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.flash_decode import use_decode_head_packing
+
+__all__ = ["KVCacheConfig", "PagedKVCache", "KVCacheManager",
+           "CachePoolExhausted", "init_cache", "write_token_kv",
+           "write_prefill_kv", "quantize_kv_rows", "DUMP_BLOCK"]
+
+# block 0: never allocated, pads every block table, absorbs inactive
+# rows' writes.  Reads of it are always masked to an exact 0 weight.
+DUMP_BLOCK = 0
+
+_KV_DTYPES = ("model", "bf16", "int8")
+
+
+class CachePoolExhausted(RuntimeError):
+    """The block pool cannot cover a requested allocation — the
+    admission-control signal (callers check :meth:`KVCacheManager.
+    can_admit` first; racing past it raises this)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static shape/dtype plan for one paged cache."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_blocks: int          # INCLUDING the reserved dump block
+    block_size: int
+    kv_dtype: str = "model"  # 'model' | 'bf16' | 'int8'
+    model_dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.kv_dtype not in _KV_DTYPES:
+            raise ValueError(f"kv_dtype {self.kv_dtype!r} not in "
+                             f"{_KV_DTYPES}")
+        if self.num_blocks < 2:
+            raise ValueError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved dump page)")
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+
+    @property
+    def packed(self) -> bool:
+        return use_decode_head_packing(self.num_heads, self.head_dim)
+
+    @property
+    def storage_dtype(self):
+        if self.kv_dtype == "int8":
+            return jnp.int8
+        if self.kv_dtype == "bf16":
+            return jnp.bfloat16
+        return self.model_dtype
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    @property
+    def kv_shape(self):
+        """(L, nb, hk, bs, dk) — the packed storage head axes."""
+        h, d = self.num_heads, self.head_dim
+        hk, dk = (h // 2, 2 * d) if self.packed else (h, d)
+        return (self.num_layers, self.num_blocks, hk,
+                self.block_size, dk)
+
+    @property
+    def scale_shape(self):
+        """(L, nb, h, bs) — scales keep GLOBAL head order."""
+        return (self.num_layers, self.num_blocks, self.num_heads,
+                self.block_size)
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    def blocks_for(self, length: int) -> int:
+        return -(-max(int(length), 1) // self.block_size)
+
+    def cache_nbytes(self) -> int:
+        per = np.dtype(self.storage_dtype).itemsize
+        n = 2 * int(np.prod(self.kv_shape)) * per
+        if self.quantized:
+            n += 2 * int(np.prod(self.scale_shape)) * 4
+        return n
+
+
+class PagedKVCache(NamedTuple):
+    """Device half of the cache (a pytree — jit/donation friendly)."""
+
+    k: jnp.ndarray                     # (L, nb, hk, bs, dk)
+    v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray]     # (L, nb, h, bs) fp32 | None
+    v_scale: Optional[jnp.ndarray]
+
+    def layer(self, i: int):
+        """(k, v, k_scale, v_scale) views of layer ``i``."""
+        return (self.k[i], self.v[i],
+                None if self.k_scale is None else self.k_scale[i],
+                None if self.v_scale is None else self.v_scale[i])
+
+
+def init_cache(config: KVCacheConfig) -> PagedKVCache:
+    """All-zero cache (zeros are the safe dead-page filler: even an
+    unmasked read of a never-written row contributes finite values)."""
+    k = jnp.zeros(config.kv_shape, config.storage_dtype)
+    v = jnp.zeros(config.kv_shape, config.storage_dtype)
+    if config.quantized:
+        # k/v scales must be DISTINCT buffers: the cache pytree is
+        # donated every step, and aliased leaves would donate the same
+        # buffer twice
+        return PagedKVCache(k, v,
+                            jnp.zeros(config.scale_shape, jnp.float32),
+                            jnp.zeros(config.scale_shape, jnp.float32))
+    return PagedKVCache(k, v, None, None)
+
+
+def quantize_kv_rows(x: jnp.ndarray):
+    """Per-row symmetric int8: ``x`` (..., d) -> (int8 values,
+    (...,) fp32 scales).  Each cached token row quantizes against its
+    own amax, so appends never touch history."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _to_storage(x, config: KVCacheConfig):
+    """(..., h, d) new rows -> (storage values (..., hk, dk),
+    scales (..., h) | None) per the cache layout."""
+    if config.quantized:
+        q, scale = quantize_kv_rows(x)
+        if config.packed:
+            q = q.reshape(*q.shape[:-2], config.num_heads // 2,
+                          2 * config.head_dim)
+        return q, scale
+    if config.packed:
+        x = x.reshape(*x.shape[:-2], config.num_heads // 2,
+                      2 * config.head_dim)
+    return x.astype(config.storage_dtype), None
+
+
+def write_token_kv(cache: PagedKVCache, config: KVCacheConfig,
+                   layer: int, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                   blocks: jnp.ndarray,
+                   offsets: jnp.ndarray) -> PagedKVCache:
+    """Scatter ONE token's k/v per batch row into layer ``layer``'s
+    page slots.
+
+    ``k_new``/``v_new`` (b, h, d) in model dtype; ``blocks``/
+    ``offsets`` (b,) int32 address each row's current page and in-page
+    slot (inactive rows point at the dump block).  Per-layer because
+    the decode step interleaves write -> attend inside its layer loop
+    (the new token attends to itself through the cache).  Traced code
+    — runs inside the jitted decode step; the cache argument is
+    donated by the caller so the scatter is in-place on device."""
+    kq, ks = _to_storage(k_new, config)
+    vq, vs = _to_storage(v_new, config)
+    # scalar layer index collapses axis 0; the (blocks@0, offsets@2)
+    # advanced pair around the head slice selects (b, hk, dk) rows
+    k = cache.k.at[layer, blocks, :, offsets, :].set(kq)
+    v = cache.v.at[layer, blocks, :, offsets, :].set(vq)
+    k_scale, v_scale = cache.k_scale, cache.v_scale
+    if config.quantized:
+        k_scale = k_scale.at[layer, blocks, :, offsets].set(ks)
+        v_scale = v_scale.at[layer, blocks, :, offsets].set(vs)
+    return PagedKVCache(k, v, k_scale, v_scale)
+
+
+def write_prefill_kv(cache: PagedKVCache, config: KVCacheConfig,
+                     layer: int, k_all: jnp.ndarray,
+                     v_all: jnp.ndarray,
+                     blocks: jnp.ndarray) -> PagedKVCache:
+    """Scatter a prefilled prompt's whole k/v for one layer into its
+    pages.
+
+    ``k_all``/``v_all`` (s_pad, h, d) with ``s_pad = len(blocks) *
+    block_size``; ``blocks`` (n_pages,) int32 — pages past the
+    request's owned tail point at the dump block (duplicate dump
+    writes race harmlessly: the dump page is never read unmasked)."""
+    s_pad, h, d = k_all.shape
+    bs = config.block_size
+    n_pages = s_pad // bs
+
+    def paged(x):
+        q, scale = _to_storage(x, config)
+        # (P*bs, hk, dk) -> (P, hk, bs, dk)
+        q = q.reshape(n_pages, bs, *q.shape[-2:]).transpose(0, 2, 1, 3)
+        if scale is not None:
+            scale = scale.reshape(n_pages, bs, h).transpose(0, 2, 1)
+        return q, scale
+
+    kq, ks = paged(k_all)
+    vq, vs = paged(v_all)
+    k = cache.k.at[layer, blocks].set(kq)
+    v = cache.v.at[layer, blocks].set(vq)
+    k_scale, v_scale = cache.k_scale, cache.v_scale
+    if config.quantized:
+        k_scale = k_scale.at[layer, blocks].set(ks)
+        v_scale = v_scale.at[layer, blocks].set(vs)
+    return PagedKVCache(k, v, k_scale, v_scale)
+
+
+class KVCacheManager:
+    """Host-side block pool + per-request block tables.
+
+    Free blocks form a LIFO stack: an evict-then-readmit cycle hands
+    the same ids back (the tests' bitwise block-reuse proof), and hot
+    blocks stay hot.  All methods are O(pages touched)."""
+
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        # stack: pop() from the end; ids descend so the FIRST blocks
+        # handed out are 1, 2, 3, ... (stable, test-friendly)
+        self._free: List[int] = list(range(config.num_blocks - 1, 0,
+                                           -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lens: Dict[object, int] = {}
+
+    # --- capacity -----------------------------------------------------
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.config.usable_blocks - len(self._free)
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int, *,
+                  reserved_blocks: int = 0) -> bool:
+        """Reservation admission: the request's WHOLE worst case
+        (``prompt_len + max_new_tokens``) must fit the pool right
+        now, net of ``reserved_blocks`` the pool already owes
+        in-flight requests (their own worst cases minus the pages
+        they hold) — so a later :meth:`append` can never exhaust the
+        pool mid-decode.  Admitting on anything weaker (e.g. prompt
+        plus one token of headroom) re-opens exactly that crash."""
+        need = self.config.blocks_for(prompt_len + max_new_tokens)
+        return need <= len(self._free) - reserved_blocks
+
+    # --- lifecycle ----------------------------------------------------
+
+    def alloc(self, rid, length: int) -> List[int]:
+        """Claim blocks covering ``length`` tokens for a new request."""
+        if rid in self._tables:
+            raise ValueError(f"request {rid!r} already has blocks")
+        if length < 1:
+            raise ValueError("length must be >= 1")
+        need = self.config.blocks_for(length)
+        if need > len(self._free):
+            raise CachePoolExhausted(
+                f"request {rid!r} needs {need} block(s) for length "
+                f"{length}, pool has {len(self._free)} free of "
+                f"{self.config.usable_blocks}")
+        blocks = [self._free.pop() for _ in range(need)]
+        self._tables[rid] = blocks
+        self._lens[rid] = int(length)
+        return list(blocks)
+
+    def append(self, rid):
+        """Grow ``rid`` by one token, allocating a fresh block when
+        the token starts a new page.  Returns ``(block_id, offset)``
+        — the page slot the new token's k/v must be written to (its
+        position is the pre-append ``seq_len``)."""
+        blocks = self._tables[rid]
+        pos = self._lens[rid]
+        page, off = divmod(pos, self.config.block_size)
+        if page == len(blocks):
+            if not self._free:
+                raise CachePoolExhausted(
+                    f"request {rid!r} crossed a block edge at length "
+                    f"{pos + 1} with the pool empty — admission "
+                    f"control must keep headroom (can_admit)")
+            blocks.append(self._free.pop())
+        self._lens[rid] = pos + 1
+        return blocks[page], off
+
+    def free(self, rid) -> List[int]:
+        """Return ``rid``'s blocks to the pool (LIFO, reverse order so
+        a readmit walks them back out first-block-first)."""
+        blocks = self._tables.pop(rid)
+        del self._lens[rid]
+        self._free.extend(reversed(blocks))
+        return blocks
+
+    # --- views --------------------------------------------------------
+
+    def requests(self):
+        return list(self._tables)
+
+    def seq_len(self, rid) -> int:
+        return self._lens[rid]
+
+    def blocks(self, rid) -> List[int]:
+        return list(self._tables[rid])
+
+    def block_table(self, rid, max_pages: int) -> np.ndarray:
+        """(max_pages,) int32, padded with the dump block."""
+        blocks = self._tables[rid]
+        if len(blocks) > max_pages:
+            raise ValueError(
+                f"request {rid!r} owns {len(blocks)} pages > bucket "
+                f"max_pages {max_pages} — the ladder pick is wrong")
+        bt = np.full(max_pages, DUMP_BLOCK, np.int32)
+        bt[:len(blocks)] = blocks
+        return bt
+
+    def num_pages(self, rid) -> int:
+        return len(self._tables[rid])

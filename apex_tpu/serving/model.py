@@ -1,0 +1,288 @@
+"""Pure-function GPT forward for serving: prefill + paged decode.
+
+The training-side :class:`~apex_tpu.testing.standalone_gpt.GPTModel`
+is a flax module built for whole-sequence teacher forcing; serving
+needs the same math re-staged around a KV cache: a **prefill** that
+runs the prompt once through the existing flash forward kernel while
+writing every layer's k/v into the request's pages, and a **decode
+step** that advances one token per sequence against the paged cache
+through the :func:`~apex_tpu.ops.flash_decode.flash_decode` kernel.
+
+Rather than threading mutable cache collections through flax, the
+serving path extracts the model's parameters into a plain pytree
+(:class:`GPTServingWeights` — same arrays, no copies beyond unboxing)
+and runs an explicit forward whose math mirrors the flax stack
+operation-for-operation: fp32 :func:`~apex_tpu.ops.layer_norm.
+layer_norm` statistics, ``x @ kernel + bias`` in the model compute
+dtype, fp32-softmax attention, gelu MLP, tied LM head.  The serving
+tests pin this against ``GPTModel.apply`` so the two stacks cannot
+drift.
+
+Everything here is traced code (the engine jits these per bucket) —
+shapes are static per call site, per-request dynamics ride data
+(block tables, sequence lengths, write slots).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_decode import flash_decode, paged_attention_reference
+from ..ops.layer_norm import layer_norm
+from .kv_cache import (KVCacheConfig, PagedKVCache, write_prefill_kv,
+                       write_token_kv)
+
+__all__ = ["GPTServingWeights", "LayerWeights", "ServingModelConfig",
+           "extract_serving_weights", "gpt_prefill_step",
+           "gpt_decode_step"]
+
+
+class LayerWeights(NamedTuple):
+    """One transformer layer's parameters (plain arrays)."""
+
+    ln1_w: jnp.ndarray
+    ln1_b: jnp.ndarray
+    qkv_k: jnp.ndarray        # (H, 3H)
+    qkv_b: jnp.ndarray
+    dense_k: jnp.ndarray      # (H, H)
+    dense_b: jnp.ndarray
+    ln2_w: jnp.ndarray
+    ln2_b: jnp.ndarray
+    fc1_k: jnp.ndarray        # (H, F)
+    fc1_b: jnp.ndarray
+    fc2_k: jnp.ndarray        # (F, H)
+    fc2_b: jnp.ndarray
+
+
+class GPTServingWeights(NamedTuple):
+    """The whole model as a pytree of plain arrays."""
+
+    wte: jnp.ndarray          # (V, H) — tied LM head
+    wpe: jnp.ndarray          # (S, H)
+    layers: Tuple[LayerWeights, ...]
+    lnf_w: jnp.ndarray
+    lnf_b: jnp.ndarray
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingModelConfig:
+    """Static model geometry + serving knobs (hashable — safe to
+    close over in jitted builders)."""
+
+    vocab_size: int
+    hidden_size: int
+    num_heads: int
+    num_layers: int
+    max_seq: int
+    dtype: Any = jnp.float32
+    layernorm_eps: float = 1e-5
+    # prefill attention: the existing flash fwd kernel, or the dense
+    # reference (manual-axis contexts / debugging)
+    prefill_flash: bool = True
+    # decode attention: 'kernel' = the Pallas flash-decode kernel;
+    # 'reference' = the dense gather twin — the naive full-attention
+    # baseline bench.py's serving section measures the kernel against
+    decode_attention: str = "kernel"
+
+    def __post_init__(self):
+        if self.hidden_size % self.num_heads:
+            raise ValueError(
+                f"hidden {self.hidden_size} not divisible by heads "
+                f"{self.num_heads}")
+        if self.decode_attention not in ("kernel", "reference"):
+            raise ValueError(
+                f"decode_attention {self.decode_attention!r} not in "
+                f"('kernel', 'reference')")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_model(cls, model, **overrides) -> "ServingModelConfig":
+        """Geometry from a :class:`~apex_tpu.testing.standalone_gpt.
+        GPTModel` instance."""
+        return cls(vocab_size=model.vocab_size,
+                   hidden_size=model.hidden_size,
+                   num_heads=model.num_attention_heads,
+                   num_layers=model.num_layers,
+                   max_seq=model.max_sequence_length,
+                   dtype=model.dtype, **overrides)
+
+
+def _unbox(tree):
+    import flax.linen as nn
+
+    return jax.tree.map(
+        lambda l: l.unbox() if isinstance(l, nn.Partitioned) else l,
+        tree, is_leaf=lambda l: isinstance(l, nn.Partitioned))
+
+
+def extract_serving_weights(params,
+                            num_layers: int) -> GPTServingWeights:
+    """Flatten a ``GPTModel`` param tree (as returned by ``init`` /
+    held by the train loop) into :class:`GPTServingWeights`.  Arrays
+    are referenced, not copied — a freshly trained tree serves
+    without a round-trip through a checkpoint."""
+    p = _unbox(params)
+    emb = p["embedding"]
+    tr = p["transformer"]
+    layers = []
+    for i in range(num_layers):
+        lp = tr[f"layer_{i}"]
+        attn = lp["self_attention"]
+        mlp = lp["mlp"]
+        layers.append(LayerWeights(
+            ln1_w=lp["input_layernorm"]["weight"],
+            ln1_b=lp["input_layernorm"]["bias"],
+            qkv_k=attn["query_key_value"]["kernel"],
+            qkv_b=attn["query_key_value"]["bias"],
+            dense_k=attn["dense"]["kernel"],
+            dense_b=attn["dense"]["bias"],
+            ln2_w=lp["post_attention_layernorm"]["weight"],
+            ln2_b=lp["post_attention_layernorm"]["bias"],
+            fc1_k=mlp["dense_h_to_4h"]["kernel"],
+            fc1_b=mlp["dense_h_to_4h"]["bias"],
+            fc2_k=mlp["dense_4h_to_h"]["kernel"],
+            fc2_b=mlp["dense_4h_to_h"]["bias"]))
+    return GPTServingWeights(
+        wte=emb["word_embeddings"]["embedding"],
+        wpe=emb["position_embeddings"]["embedding"],
+        layers=tuple(layers),
+        lnf_w=tr["final_layernorm"]["weight"],
+        lnf_b=tr["final_layernorm"]["bias"])
+
+
+def _linear(x, kernel, bias, dtype):
+    """The ColumnParallelLinear/RowParallelLinear single-device math:
+    compute-dtype matmul, bias in compute dtype."""
+    y = x.astype(dtype) @ kernel.astype(dtype)
+    return y + bias.astype(dtype)
+
+
+def _layer_tail(x, lw: LayerWeights, attn_out, cfg):
+    """residual + LN + MLP + residual — shared by prefill and decode."""
+    x = x + attn_out.astype(x.dtype)
+    m_in = layer_norm(x, lw.ln2_w, lw.ln2_b,
+                      cfg.layernorm_eps).astype(cfg.dtype)
+    h1 = jax.nn.gelu(_linear(m_in, lw.fc1_k, lw.fc1_b, cfg.dtype))
+    mlp_out = _linear(h1, lw.fc2_k, lw.fc2_b, cfg.dtype)
+    return x + mlp_out.astype(x.dtype)
+
+
+def _lm_head(x, weights: GPTServingWeights, cfg):
+    """Final LN + tied-embedding projection (GPTHead + attend)."""
+    hf = layer_norm(x, weights.lnf_w, weights.lnf_b,
+                    cfg.layernorm_eps).astype(cfg.dtype)
+    return hf.astype(cfg.dtype) @ weights.wte.astype(cfg.dtype).T
+
+
+def _embed(weights: GPTServingWeights, tokens, positions, cfg):
+    dtype = cfg.dtype
+    return (jnp.take(weights.wte.astype(dtype), tokens, axis=0)
+            + jnp.take(weights.wpe.astype(dtype), positions, axis=0))
+
+
+def gpt_prefill_step(weights: GPTServingWeights,
+                     cfg: ServingModelConfig,
+                     cache_cfg: KVCacheConfig, cache: PagedKVCache,
+                     tokens: jnp.ndarray, length: jnp.ndarray,
+                     blocks: jnp.ndarray):
+    """Run one prompt through the model, writing every layer's k/v
+    into the request's pages; returns ``(cache, next_token)``.
+
+    ``tokens`` (s_pad,) int32, right-padded to the prompt-length
+    bucket (``s_pad = len(blocks) * block_size``); ``length`` the true
+    prompt length (traced — one compile covers the whole bucket);
+    ``blocks`` (n_pages,) int32 with dump-page padding past the owned
+    tail.  Attention is causal over the padded prompt — padded KEYS
+    sit in the causal future of every real query, so the row at
+    ``length - 1`` (whose argmax is the first generated token) never
+    sees them; their own garbage rows land in pages the masked decode
+    reads never weight.  The attention itself is the existing flash
+    forward kernel (:func:`~apex_tpu.ops.flash_attention.
+    flash_attention`) — prefill is exactly a training forward at
+    batch 1."""
+    from ..ops.flash_attention import flash_attention, mha_reference
+
+    s_pad = tokens.shape[0]
+    h, d = cfg.num_heads, cfg.head_dim
+    scale = d ** -0.5
+    x = _embed(weights, tokens[None, :],
+               jnp.arange(s_pad, dtype=jnp.int32)[None, :], cfg)
+    for i, lw in enumerate(weights.layers):
+        a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
+                          cfg.layernorm_eps).astype(cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = qkv.reshape(1, s_pad, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)      # (1, s, h, d)
+        cache = write_prefill_kv(cache, cache_cfg, i, k[0], v[0],
+                                 blocks)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        attn = flash_attention if cfg.prefill_flash else mha_reference
+        ctx = attn(qt, kt, vt, scale=scale, causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(1, s_pad, h * d)
+        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        x = _layer_tail(x, lw, attn_out, cfg)
+    logits = _lm_head(x, weights, cfg)[0]          # (s_pad, V)
+    last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=0,
+                                        keepdims=False)
+    next_token = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    return cache, next_token
+
+
+def gpt_decode_step(weights: GPTServingWeights,
+                    cfg: ServingModelConfig,
+                    cache_cfg: KVCacheConfig, cache: PagedKVCache,
+                    tokens: jnp.ndarray, positions: jnp.ndarray,
+                    block_tables: jnp.ndarray, seq_lens: jnp.ndarray,
+                    write_blocks: jnp.ndarray,
+                    write_offsets: jnp.ndarray):
+    """Advance every batch row one token against the paged cache;
+    returns ``(cache, next_tokens)``.
+
+    Per row ``b``: ``tokens[b]`` is the token at position
+    ``positions[b]`` (the previously sampled or last prompt token);
+    its k/v is written to ``(write_blocks[b], write_offsets[b])``
+    layer by layer *before* that layer's attention, so the token
+    attends to itself through the cache; ``seq_lens[b] =
+    positions[b] + 1`` bounds the attended span.  Inactive bucket
+    rows carry ``seq_lens = 0``, point their writes at the dump page,
+    and produce a (discarded) deterministic token.  Greedy argmax
+    sampling happens in-graph — the step's only output traffic is the
+    cache carry and one int32 per row.
+
+    Every row's math touches only that row's pages and lanes, so a
+    request's token stream is invariant to bucket shape and admission
+    interleave — the continuous-batching determinism the serving
+    tests prove.
+    """
+    h, d = cfg.num_heads, cfg.head_dim
+    b = tokens.shape[0]
+    scale = d ** -0.5
+    x = _embed(weights, tokens, positions, cfg)   # (b, H)
+    for i, lw in enumerate(weights.layers):
+        a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
+                          cfg.layernorm_eps).astype(cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = qkv.reshape(b, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)       # (b, h, d)
+        cache = write_token_kv(cache, cache_cfg, i, k, v,
+                               write_blocks, write_offsets)
+        kc, vc, ks, vs = cache.layer(i)
+        if cfg.decode_attention == "kernel":
+            ctx = flash_decode(q, kc, vc, block_tables, seq_lens,
+                               scale=scale, k_scale=ks, v_scale=vs)
+        else:
+            ctx = paged_attention_reference(
+                q, kc, vc, block_tables, seq_lens, scale=scale,
+                k_scale=ks, v_scale=vs)
+        ctx = ctx.reshape(b, h * d)
+        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        x = _layer_tail(x, lw, attn_out, cfg)
+    logits = _lm_head(x, weights, cfg)             # (b, V)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return cache, next_tokens

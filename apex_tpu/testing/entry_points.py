@@ -57,6 +57,10 @@ POLICY_FP32_REGIONS = (
     # param_l2_norm / loss averaging: fp32 norm accumulation is the
     # same sanctioned class as multi_tensor.sumsq
     "apex_tpu/transformer/pipeline_parallel/utils.py",
+    # serving: fp32 softmax/layer-norm statistics and int8 KV dequant
+    # scales are the decode path's sanctioned fp32 regions
+    "apex_tpu/serving/",
+    "apex_tpu/ops/flash_decode.py",
 )
 
 
@@ -168,6 +172,34 @@ def _build_gpt_train_step_scan():
             (setup.params, setup.amp_state, buf.init()))
 
 
+def _build_gpt_decode_step():
+    """The serving stack's hot path (ISSUE-9): one bucketed
+    continuous-batching decode step — embed one token per sequence,
+    per layer write its k/v into the block-paged cache then attend
+    over the pages through the Pallas flash-decode kernel, greedy-
+    sample in-graph.  Auditing it proves the per-token serving cost
+    statically: the paged cache (the largest serving buffer — double-
+    buffering it halves capacity) donates through every step (APX601),
+    and zero host transfers compile in (APX604) — the engine's only
+    per-tick fetch is the explicit (b,) next-token readout.  Built at
+    the bf16 O5 surface so APX602 guards the decode path's precision
+    regime exactly as it guards training."""
+    import jax.numpy as jnp
+
+    from ..serving import (BucketLadder, ServingEngine,
+                           ServingModelConfig, default_cache_config,
+                           extract_serving_weights)
+    from .standalone_gpt import make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
+    cfg = ServingModelConfig.from_model(setup.model)
+    weights = extract_serving_weights(setup.params, cfg.num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=8, block_size=4)
+    engine = ServingEngine(weights, cfg, cache_cfg,
+                           ladder=BucketLadder(batch=(2,), pages=(2,)))
+    return engine._jit_decode(), engine._decode_args(2, 2)
+
+
 def _build_fused_pipeline_step():
     """The PR-4 persistent packed optimizer pipeline as its own entry:
     one full amp post-backward step (pack -> norm/finite sweep ->
@@ -260,6 +292,14 @@ register_entry_point(
         "state/ring donated through the scan carry; the "
         "dispatch-amortized hot path the smoke drivers run under "
         "--scan-steps / APEX_TPU_SCAN_STEPS")
+register_entry_point(
+    "gpt_decode_step", _build_gpt_decode_step, policy="O5",
+    dead_args=(1,),
+    doc="serving-stack continuous-batching decode step (paged KV "
+        "write + flash-decode attention + in-graph greedy sampling, "
+        "one (batch=2, pages=2) bucket) — the cache carry donated, "
+        "zero compiled-in host transfers; what standalone_gpt "
+        "--serve runs per tick")
 register_entry_point(
     "fused_pipeline_step", _build_fused_pipeline_step, policy="O5",
     dead_args=(0, 1, 2),

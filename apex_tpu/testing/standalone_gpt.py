@@ -1108,6 +1108,131 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
     return loss_f
 
 
+# ---------------------------------------------------------------------------
+# Serving smoke — the continuous-batching acceptance path (ISSUE-9)
+# ---------------------------------------------------------------------------
+
+def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
+                sink=None, vocab: int = 64, hidden: int = 32,
+                num_heads: int = 4, num_layers: int = 2,
+                max_seq: int = 64, max_new_tokens: int = 6,
+                seed: int = 0, dtype=jnp.float32,
+                decode_attention: str = "kernel",
+                prefill_flash: bool = True,
+                num_blocks: Optional[int] = None,
+                block_size: Optional[int] = None,
+                kv_dtype: Optional[str] = None, ladder=None,
+                sanitize: bool = False, fault=None,
+                autoresume="auto", stall_timeout: float = 300.0,
+                return_engine: bool = False):
+    """Continuous-batched serving smoke: a tiny GPT serves
+    ``num_requests`` mixed-length prompts through the
+    :mod:`apex_tpu.serving` engine — prefill via the flash forward
+    kernel, decode via the paged flash-decode kernel, admissions and
+    evictions interleaving with jitted decode steps — and reports
+    decode tokens/s plus p50/p99 per-token latency through the
+    monitor stack (the ``--serve`` acceptance path, tools/ci.sh step
+    11).
+
+    ``sanitize=True`` proves the bucket-ladder compile discipline:
+    every (batch, pages) bucket is AOT-compiled by ``engine.warmup()``
+    before traffic, so the whole serve holds a post-warmup recompile
+    budget of ZERO — a shape leaking past the ladder fails the run.
+    ``fault`` accepts the resilience spec syntax (``"sigterm@3"``
+    fires at decode tick 3) and ``autoresume="auto"`` installs the
+    flag-only SIGTERM handler: a mid-serve termination stops
+    admissions, frees every block, marks in-flight requests
+    preempted, and still returns a full summary — the clean-drain
+    contract.  ``decode_attention="reference"`` swaps the kernel for
+    the dense gather twin (the naive decode baseline bench.py's
+    serving section measures against).
+
+    Returns the :class:`~apex_tpu.serving.ServeSummary` (with
+    ``return_engine=True``, ``(summary, engine)`` — how tests read
+    per-request token streams)."""
+    import numpy as np
+
+    from ..resilience import AutoResume, parse_fault
+    from ..serving import (BucketLadder, Request, ServingEngine,
+                           ServingModelConfig, default_cache_config,
+                           extract_serving_weights)
+
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=dtype)
+    key = jax.random.PRNGKey(seed)
+    params = jax.jit(model.init)(
+        key, jnp.zeros((1, min(8, max_seq)), jnp.int32))["params"]
+    cfg = ServingModelConfig.from_model(
+        model, prefill_flash=prefill_flash,
+        decode_attention=decode_attention)
+    weights = extract_serving_weights(params, num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
+                                     block_size=block_size,
+                                     kv_dtype=kv_dtype)
+    if ladder is None:
+        ladder = BucketLadder.from_flags()
+    monitor = make_smoke_monitor(
+        jsonl, sink, tokens_per_step=None, flops_per_step=None,
+        stall_timeout=stall_timeout, escalation=None,
+        run_attrs={"driver": "standalone_gpt.serve_smoke",
+                   "requests": num_requests, "max_seq": max_seq,
+                   "kv_dtype": cache_cfg.kv_dtype,
+                   "block_size": cache_cfg.block_size,
+                   "decode_attention": decode_attention})
+    if isinstance(fault, str):
+        fault = parse_fault(fault)
+    own_autoresume = False
+    if autoresume == "auto":
+        autoresume = AutoResume(sink=monitor).install()
+        own_autoresume = True
+    engine = ServingEngine(weights, cfg, cache_cfg, ladder=ladder,
+                           monitor=monitor, autoresume=autoresume)
+    # mixed-length prompts, deterministic per seed; every request
+    # fits the ladder span and the model's position table
+    rng = np.random.RandomState(seed)
+    span = ladder.max_pages * cache_cfg.block_size
+    max_prompt = max(1, min(max_seq, span) - max_new_tokens)
+    lengths = [1 + (int(x) % max_prompt)
+               for x in rng.randint(1, 10 ** 6, num_requests)]
+    for i, n in enumerate(lengths):
+        engine.submit(Request(
+            rid=f"req{i:03d}",
+            prompt=[int(t) for t in rng.randint(0, vocab, n)],
+            max_new_tokens=max_new_tokens))
+    before = fault.before_step if fault is not None else None
+    try:
+        with contextlib.ExitStack() as stack:
+            san = None
+            if sanitize:
+                from ..analysis import sanitize as sanitize_ctx
+
+                # every ladder bucket AOT-compiles in warmup(), so the
+                # serve holds recompile_budget=0 after the first tick
+                san = stack.enter_context(sanitize_ctx(
+                    transfer_guard=None, recompile_budget=0,
+                    warmup_steps=1))
+            engine.warmup()
+            summary = engine.run(
+                before_tick=before,
+                after_tick=(lambda i: san.step()) if san else None)
+    except BaseException as e:
+        monitor.event("run", "run_error", step=engine.steps,
+                      error=type(e).__name__, message=str(e)[:200])
+        raise
+    finally:
+        try:
+            monitor.close()
+        finally:
+            if own_autoresume:
+                autoresume.uninstall()
+    if return_engine:
+        return summary, engine
+    return summary
+
+
 def add_resilience_cli(p) -> None:
     """The shared GPT/BERT smoke-driver resilience flags."""
     p.add_argument("--ckpt-dir", default=None,
@@ -1163,8 +1288,45 @@ def _main(argv=None):
                         "windows, drains/checkpoints on K-step "
                         "edges); default: APEX_TPU_SCAN_STEPS "
                         "(0 = classic per-step loop)")
+    p.add_argument("--serve", action="store_true",
+                   help="run the continuous-batching serving smoke "
+                        "instead of the train loop: mixed-length "
+                        "requests through the apex_tpu.serving "
+                        "engine (prefill = flash fwd kernel, decode "
+                        "= paged flash-decode kernel), tokens/s and "
+                        "p50/p99 per-token latency reported; with "
+                        "--sanitize proves one compile per ladder "
+                        "bucket; --fault sigterm@K proves the clean "
+                        "drain")
+    p.add_argument("--requests", type=int, default=6,
+                   help="(--serve) number of requests to serve")
+    p.add_argument("--new-tokens", type=int, default=6,
+                   help="(--serve) tokens generated per request")
+    p.add_argument("--serve-max-seq", type=int, default=64,
+                   help="(--serve) model position-table length")
+    p.add_argument("--decode-reference", action="store_true",
+                   help="(--serve) dense full-gather decode instead "
+                        "of the paged kernel (the naive baseline)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
+    if args.serve:
+        s = serve_smoke(
+            args.requests, jsonl=args.jsonl, sanitize=args.sanitize,
+            max_new_tokens=args.new_tokens,
+            max_seq=args.serve_max_seq,
+            decode_attention=("reference" if args.decode_reference
+                              else "kernel"),
+            stall_timeout=args.stall_timeout, fault=args.fault)
+        print(f"SERVE_DONE requests={s.requests_done} "
+              f"preempted={s.requests_preempted} "
+              f"tokens={s.tokens_generated} "
+              f"tokens_s={s.tokens_per_sec} "
+              f"p50_ms={s.latency_p50_ms} p99_ms={s.latency_p99_ms} "
+              f"steps={s.decode_steps} "
+              f"compiles={len(s.compiles)} "
+              f"drained={int(s.drained)}"
+              + (f" jsonl={args.jsonl}" if args.jsonl else ""))
+        return
     loss, _, _, done = train_smoke(
         steps=args.steps, jsonl=args.jsonl, opt_level=args.opt_level,
         stall_timeout=args.stall_timeout, ckpt_dir=args.ckpt_dir,

@@ -842,6 +842,138 @@ def bench_scan_driver():
     return out
 
 
+def bench_serving():
+    """The ISSUE-9 serving stack measured end to end: a GPT serves
+    mixed-length requests through the continuous-batching engine —
+    prefill via the flash fwd kernel, decode via the paged
+    flash-decode kernel — and the row records decode tokens/s and
+    p50/p99 per-token latency.  Two comparisons ride along:
+
+    * ``kernel_vs_naive`` — the same trace decoded through the dense
+      full-gather reference attention (the classic no-paging decode:
+      every step re-materializes a contiguous (b, pages*bs, h, d)
+      copy of the history), compared on DECODE-TICK time only — both
+      engines run the identical flash prefill, so whole-serve wall
+      would dilute the ratio toward 1.0 on prefill-heavy traces.
+      The paged kernel's win grows with context; the row pins it.
+    * ``prefill_interleave`` — p99 per-token latency with every
+      request admitted up front vs admissions staggered across the
+      run (prefills interleaving decode steps): the latency cost a
+      decode-in-flight pays for continuous admission.
+
+    Smoke tier keeps d=64 so the head-packed decode path is the one
+    measured; bucket ladders are pinned per tier so the compiled-
+    program set (and the AOT warmup cost, recorded as
+    ``warmup_compile_ms``) is a row constant, not flag weather."""
+    import numpy as np
+
+    from apex_tpu.serving import (BucketLadder, KVCacheConfig, Request,
+                                  ServingEngine, ServingModelConfig,
+                                  extract_serving_weights)
+    from apex_tpu.testing.standalone_gpt import GPTModel
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1" \
+        or jax.default_backend() != "tpu"
+    if smoke:
+        vocab, hidden, heads, layers = 256, 128, 2, 2
+        max_seq, block, blocks = 128, 16, 48
+        requests, new_tokens = 6, 8
+        ladder = BucketLadder(batch=(2, 4, 8), pages=(2, 4, 8))
+    else:
+        vocab, hidden, heads, layers = 8192, 1024, 16, 4
+        max_seq, block, blocks = 2048, 128, 192
+        requests, new_tokens = 16, 64
+        ladder = BucketLadder(batch=(8, 16), pages=(4, 8, 16))
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.bfloat16 if not smoke else jnp.float32)
+    key = jax.random.PRNGKey(0)
+    params = jax.jit(model.init)(key,
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+    weights = extract_serving_weights(params, layers)
+    cache_cfg = KVCacheConfig(
+        num_layers=layers, num_heads=heads, head_dim=hidden // heads,
+        num_blocks=blocks, block_size=block,
+        model_dtype=model.dtype)
+    span = ladder.max_pages * block
+    rng = np.random.RandomState(0)
+    max_prompt = max(1, min(max_seq, span) - new_tokens)
+    prompts = [[int(t) for t in rng.randint(0, vocab,
+                                            1 + i % max_prompt)]
+               for i in rng.randint(1, max_prompt, requests)]
+
+    def serve(attention, staggered):
+        cfg = ServingModelConfig.from_model(
+            model, decode_attention=attention)
+        eng = ServingEngine(weights, cfg, cache_cfg, ladder=ladder)
+        t0 = time.perf_counter()
+        eng.warmup()
+        warm_ms = (time.perf_counter() - t0) * 1e3
+        reqs = [Request(rid=f"r{i:03d}", prompt=list(p),
+                        max_new_tokens=new_tokens)
+                for i, p in enumerate(prompts)]
+        if staggered:
+            # half up front, the rest dripped in while decode runs —
+            # prefills interleave with in-flight generation
+            for r in reqs[:len(reqs) // 2]:
+                eng.submit(r)
+            pending = reqs[len(reqs) // 2:]
+
+            def drip(step):
+                if pending and step % 2 == 0:
+                    eng.submit(pending.pop(0))
+
+            s = eng.run(before_tick=drip)
+            while pending:            # tail admissions, if any
+                eng.submit(pending.pop(0))
+                s = eng.run()
+        else:
+            for r in reqs:
+                eng.submit(r)
+            s = eng.run()
+        return s, warm_ms
+
+    s_kernel, warm_ms = serve("kernel", staggered=False)
+    s_naive, _ = serve("reference", staggered=False)
+    s_inter, _ = serve("kernel", staggered=True)
+    out = {
+        "config": {"hidden": hidden, "heads": heads, "layers": layers,
+                   "head_dim": hidden // heads, "block_size": block,
+                   "num_blocks": blocks, "requests": requests,
+                   "new_tokens": new_tokens,
+                   "kv_dtype": cache_cfg.kv_dtype,
+                   "tier": "smoke" if smoke else "full"},
+        "decode": {"tokens_per_sec": s_kernel.tokens_per_sec,
+                   "decode_tokens_per_sec":
+                       s_kernel.decode_tokens_per_sec,
+                   "p50_ms": s_kernel.latency_p50_ms,
+                   "p99_ms": s_kernel.latency_p99_ms,
+                   "steps": s_kernel.decode_steps,
+                   "tokens": s_kernel.tokens_generated},
+        "naive_baseline": {"tokens_per_sec": s_naive.tokens_per_sec,
+                           "decode_tokens_per_sec":
+                               s_naive.decode_tokens_per_sec,
+                           "p50_ms": s_naive.latency_p50_ms,
+                           "p99_ms": s_naive.latency_p99_ms},
+        "kernel_vs_naive": round(
+            s_kernel.decode_tokens_per_sec
+            / max(s_naive.decode_tokens_per_sec, 1e-9), 2),
+        "prefill_interleave": {
+            "p99_ms_steady": s_kernel.latency_p99_ms,
+            "p99_ms_interleaved": s_inter.latency_p99_ms,
+            "p99_impact": round(
+                (s_inter.latency_p99_ms or 0.0)
+                / max(s_kernel.latency_p99_ms or 1e-9, 1e-9), 2)},
+        "warmup_compile_ms": round(warm_ms, 1),
+    }
+    print(f"[bench] serving: {out['decode']['tokens_per_sec']} tok/s "
+          f"p99 {out['decode']['p99_ms']} ms, kernel/naive "
+          f"{out['kernel_vs_naive']}x", file=sys.stderr)
+    return out
+
+
 def bench_collective():
     n_dev = jax.device_count()
     out = {"devices": n_dev}
@@ -1382,6 +1514,14 @@ def _compact_summary(full):
     if isinstance(sd, dict) and sd.get("k8_vs_k1_wall") is not None:
         # dispatch amortization: K=8 scan windows vs per-step dispatch
         ce["scan_k8_x"] = sd["k8_vs_k1_wall"]
+    sv = ex.get("serving", {})
+    if isinstance(sv, dict) and isinstance(sv.get("decode"), dict):
+        # continuous-batched decode: tokens/s, p99 latency, paged
+        # kernel vs the naive full-gather decode
+        ce["serve"] = {
+            "tok_s": sv["decode"].get("tokens_per_sec"),
+            "p99_ms": sv["decode"].get("p99_ms"),
+            "vs_naive": sv.get("kernel_vs_naive")}
     col = ex.get("collective", {})
     if "hbm_read_gbps" in col:
         ce["hbm_gbps"] = col["hbm_read_gbps"]
@@ -1568,7 +1708,7 @@ class SectionBudget:
 # the per-section seconds in BENCH_EVENTS.jsonl from complete sweeps.
 SECTION_ESTIMATES_S = {
     "resnet50": 600, "optimizer_step": 600, "optimizer_pipeline": 600,
-    "scan_driver": 120, "collective": 240,
+    "scan_driver": 120, "serving": 300, "collective": 240,
     "long_context": 900, "ring_flash": 360, "gpt2_345m": 600,
     "gpt2_345m_s2048": 480, "gpt2_345m_dropout": 480,
     "bert_large": 600, "zero_sharded_adam": 480,
@@ -1628,10 +1768,10 @@ def _run_section(extras, name, fn, writer, sink=None, budget=None,
 
 
 SECTION_NAMES = ("resnet50", "optimizer_step",
-                 "optimizer_pipeline", "scan_driver", "collective",
-                 "long_context", "ring_flash", "gpt2_345m",
-                 "gpt2_345m_s2048", "gpt2_345m_dropout", "bert_large",
-                 "zero_sharded_adam")
+                 "optimizer_pipeline", "scan_driver", "serving",
+                 "collective", "long_context", "ring_flash",
+                 "gpt2_345m", "gpt2_345m_s2048", "gpt2_345m_dropout",
+                 "bert_large", "zero_sharded_adam")
 
 
 def _parse_args(argv=None):
@@ -1756,6 +1896,7 @@ def main(argv=None):
                 ("optimizer_step", bench_optimizers),
                 ("optimizer_pipeline", bench_optimizer_pipeline),
                 ("scan_driver", bench_scan_driver),
+                ("serving", bench_serving),
                 ("collective", bench_collective),
                 ("long_context", bench_long_context),
                 ("ring_flash", bench_ring_flash),

@@ -80,10 +80,16 @@ def _pipeline_rows(full):
             or [])
 
 
+# Metrics where SMALLER is the good direction (latencies): the gate
+# inverts its comparison for these — a >5% INCREASE fails.
+LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms"})
+
+
 def headline_metrics(full):
     """{metric name: (value or None, owning section)} for every named
     headline metric.  Sections are bench.py SECTION_NAMES members so
-    budget skips can excuse absent metrics."""
+    budget skips can excuse absent metrics.  All are higher-is-better
+    except the members of :data:`LOWER_IS_BETTER`."""
     out = {
         "resnet50_wall_ips": (_get(full, "value"), "resnet50"),
         "resnet50_device_ips": (_get(full, "rn50_device_ips"),
@@ -107,6 +113,14 @@ def headline_metrics(full):
                                        "zero_sharded_adam",
                                        "sharded_vs_dense_device"),
                                   "zero_sharded_adam"),
+        # ISSUE-9 serving rows: continuous-batched decode throughput
+        # and tail latency gate like the training rows
+        "serving_decode_tokens_per_sec": (
+            _get(full, "extras", "serving", "decode",
+                 "tokens_per_sec"), "serving"),
+        "serving_p99_latency_ms": (
+            _get(full, "extras", "serving", "decode", "p99_ms"),
+            "serving"),
     }
     lc = _get(full, "extras", "long_context") or {}
     if isinstance(lc, dict):
@@ -208,6 +222,16 @@ def compare(fresh, committed, max_drop=DEFAULT_MAX_DROP):
                 f"state: {state}) — a truncated sweep may not pass "
                 f"the gate")
             continue
+        if name in LOWER_IS_BETTER:
+            ceil_v = old_v * (1.0 + max_drop)
+            if new_v > ceil_v:
+                regressions.append(
+                    f"{name}: {old_v} -> {new_v} "
+                    f"({(new_v / old_v - 1.0) * 100:+.1f}%, gate "
+                    f"+{max_drop * 100:.0f}% — lower is better)")
+            else:
+                notes.append(f"{name}: {old_v} -> {new_v} ok")
+            continue
         floor = old_v * (1.0 - max_drop)
         if new_v < floor:
             regressions.append(
@@ -297,6 +321,32 @@ def self_test() -> int:
     r, notes = compare(pipe_gone, split)
     assert r == [] and any("pipeline.rn50_26m" in n for n in notes), \
         (r, notes)
+    # serving rows (ISSUE-9): tokens/s gates like any throughput;
+    # p99 latency gates in the LOWER_IS_BETTER direction, and an
+    # explicit serving skip row excuses both
+    srv = json.loads(json.dumps(committed))
+    srv["extras"]["serving"] = {
+        "decode": {"tokens_per_sec": 500.0, "p99_ms": 20.0}}
+    r, _ = compare(json.loads(json.dumps(srv)), srv)
+    assert r == [], r
+    slow = json.loads(json.dumps(srv))
+    slow["extras"]["serving"]["decode"]["tokens_per_sec"] = 300.0
+    r, _ = compare(slow, srv)
+    assert len(r) == 1 and "serving_decode_tokens_per_sec" in r[0], r
+    laggy = json.loads(json.dumps(srv))
+    laggy["extras"]["serving"]["decode"]["p99_ms"] = 30.0   # +50%
+    r, _ = compare(laggy, srv)
+    assert len(r) == 1 and "serving_p99_latency_ms" in r[0] \
+        and "lower is better" in r[0], r
+    faster = json.loads(json.dumps(srv))
+    faster["extras"]["serving"]["decode"]["p99_ms"] = 10.0  # improved
+    r, _ = compare(faster, srv)
+    assert r == [], r
+    srv_skip = json.loads(json.dumps(srv))
+    srv_skip["extras"]["serving"] = {"skipped": "budget"}
+    r, notes = compare(srv_skip, srv)
+    assert r == [] and any("serving" in n and "skipped" in n
+                           for n in notes), (r, notes)
     # the ratio escalation switch (satellite: WARN -> gate behind
     # APEX_TPU_BENCH_GATE_RATIO=1)
     assert not ratio_enforced({})

@@ -78,28 +78,42 @@
 #                            APEX_TPU_COMPILE_CACHE_DIR and the second
 #                            process must warm-start from the cache
 #                            (--expect-cache-hits)
+#  11. serving smoke         — the ISSUE-9 continuous-batching stack:
+#                            a sanitized `--serve` run (mixed-length
+#                            requests, prefill via the flash fwd
+#                            kernel, decode via the paged flash-decode
+#                            kernel) must AOT-compile exactly one
+#                            program per (batch, pages) ladder bucket
+#                            and hold a post-warmup recompile budget
+#                            of ZERO while sustaining tokens/s > 0;
+#                            then a SIGTERM mid-serve must drain
+#                            clean — admissions stop, every cache
+#                            block returns to the pool, in-flight
+#                            requests are marked preempted, and the
+#                            summary + JSONL record still land
+#                            (docs/api/serving.md)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/10 default test tier"
+echo "[ci] 1/11 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/10 README drift guard"
+echo "[ci] 2/11 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/10 8-device multichip dryrun"
+echo "[ci] 3/11 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/10 monitor smoke"
+echo "[ci] 4/11 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/10 kill->resume smoke"
+echo "[ci] 5/11 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -119,16 +133,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/10 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/11 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/10 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/11 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/10 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/11 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -137,7 +151,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/10 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/11 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -158,7 +172,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/10 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/11 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -181,5 +195,36 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     python -m apex_tpu.testing.entry_points --aot --entry fused_pipeline_step \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
+
+echo "[ci] 11/11 serving smoke (continuous batching + clean drain)"
+SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
+# leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
+# (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
+# a post-warmup recompile budget of 0: one compile per bucket, ever
+SERVE_OUT="$(APEX_TPU_SERVE_BATCH_BUCKETS=2,4 \
+    APEX_TPU_SERVE_PAGE_BUCKETS=2 \
+    python -m apex_tpu.testing.standalone_gpt --serve --requests 5 \
+    --new-tokens 4 --jsonl "$SERVE_DIR/serve.jsonl" --sanitize)"
+echo "$SERVE_OUT"
+echo "$SERVE_OUT" | grep -q "requests=5 " \
+    || { echo "[ci] FAIL: serve did not finish all 5 requests"; exit 1; }
+echo "$SERVE_OUT" | grep -q "compiles=3 " \
+    || { echo "[ci] FAIL: expected one compile per bucket (2 decode + 1 prefill)"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "tokens_s=[1-9]" \
+    || { echo "[ci] FAIL: serve reported zero tokens/s"; exit 1; }
+# leg 2: SIGTERM mid-serve (flag-only handler, --fault sigterm@2) —
+# the engine stops admitting, frees every block, marks in-flight
+# requests preempted and still returns a full summary
+SERVE_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
+    --requests 4 --new-tokens 32 --jsonl "$SERVE_DIR/drain.jsonl" \
+    --fault sigterm@2)"
+echo "$SERVE_OUT"
+echo "$SERVE_OUT" | grep -q "drained=1" \
+    || { echo "[ci] FAIL: SIGTERM serve did not drain"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "preempted=[1-9]" \
+    || { echo "[ci] FAIL: no requests marked preempted"; exit 1; }
+grep -q '"name":"serve_preempt"' "$SERVE_DIR/drain.jsonl" \
+    || { echo "[ci] FAIL: no serve_preempt event in the JSONL"; exit 1; }
+rm -rf "$SERVE_DIR"
 
 echo "[ci] all green"

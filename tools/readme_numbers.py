@@ -88,10 +88,34 @@ def render(full: dict, artifact_name: str) -> str:
     if isinstance(sd, dict) and sd.get("k8_vs_k1_wall") is not None:
         row("scan driver K=8 vs K=1 wall (smoke GPT, dispatch "
             "amortization)", f"{sd['k8_vs_k1_wall']}x")
+    sv = ex.get("serving", {})
+    if isinstance(sv, dict) and isinstance(sv.get("decode"), dict):
+        dec = sv["decode"]
+        if dec.get("tokens_per_sec") is not None:
+            row("serving: continuous-batched decode throughput "
+                "(paged flash-decode kernel)",
+                f"{dec['tokens_per_sec']} tok/s")
+        if dec.get("p99_ms") is not None:
+            row("serving: p99 per-token latency",
+                f"{dec['p99_ms']} ms")
+        if sv.get("kernel_vs_naive") is not None:
+            row("serving: paged kernel vs naive full-gather decode",
+                f"{sv['kernel_vs_naive']}x")
     z = ex.get("zero_sharded_adam", {})
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
             f"{z['sharded_vs_dense_device']}x")
+    # sections the committed artifact carries only as explicit skip
+    # rows (added after the last full-tier TPU sweep): render a VISIBLE
+    # pending marker — bench_gate reads the skip, and the README must
+    # not silently omit what the gate is excusing
+    for sec, what in (
+            ("optimizer_pipeline", "packed-pipeline device ratios"),
+            ("scan_driver", "K=8 vs K=1 dispatch amortization"),
+            ("serving", "decode tokens/s + p50/p99 latency")):
+        r = ex.get(sec)
+        if isinstance(r, dict) and r.get("skipped"):
+            row(f"{sec} — {what}", "*pending TPU full tier*")
 
     lines = [START,
              f"  Closing numbers, generated from `{artifact_name}` by "
