@@ -21,12 +21,17 @@ generated from it):
   (:mod:`apex_tpu.testing.entry_points`): missed donations, silent
   dtype promotions, the collective census and a peak-live-memory
   estimate diffed against ``tools/hlo_baseline.json``.
+* :mod:`.sharding` — SPMD sharding auditor over the *partitioned*
+  multichip entries: declared :class:`apex_tpu.mesh_plan.MeshPlan`
+  specs vs the partitioner's propagated shardings, reshard chains,
+  overlap advisories, and per-device memory diffed against
+  ``tools/sharding_baseline.json``.
 * :mod:`.sanitizer` — runtime ``sanitize()`` context: JAX transfer
   guard plus a per-step recompile budget driven by ``jax_log_compiles``.
 
-CLI: ``python -m apex_tpu.analysis --check`` / ``--check-hlo``
-(self-hosted in tools/ci.sh steps 7 and 8; see ``--help`` for the
-rest).
+CLI: ``python -m apex_tpu.analysis --check`` / ``--check-hlo`` /
+``--check-sharding`` (self-hosted in tools/ci.sh steps 7, 8, and 12;
+see ``--help`` for the rest).
 """
 # flags is the one submodule production code imports at module scope
 # (ops/amp/monitor read the registry on import); keep this package
@@ -45,6 +50,9 @@ _LAZY = {
     "EntryAudit": "hlo", "audit_entry_points": "hlo",
     "run_hlo_check": "hlo", "peak_live_bytes": "hlo",
     "write_hlo_baseline": "hlo",
+    "ShardingAudit": "sharding", "audit_sharding": "sharding",
+    "run_sharding_check": "sharding",
+    "write_sharding_baseline": "sharding",
 }
 
 __all__ = [

@@ -1,9 +1,12 @@
 """CLI for apex_tpu.analysis — the repo's self-hosted static pass.
 
     python -m apex_tpu.analysis --check          # lint + parity vs baseline
+    python -m apex_tpu.analysis --check --paths a.py b.py   # changed-file
     python -m apex_tpu.analysis --check-hlo      # compiled-graph audit
+    python -m apex_tpu.analysis --check-sharding # SPMD plan audit
     python -m apex_tpu.analysis --update-baseline
     python -m apex_tpu.analysis --update-hlo-baseline
+    python -m apex_tpu.analysis --update-sharding-baseline
     python -m apex_tpu.analysis --flag-table     # print the env-flag table
     python -m apex_tpu.analysis --rule-table     # print the APX rule table
     python -m apex_tpu.analysis --check-docs     # docs table drift guard
@@ -76,9 +79,30 @@ def main(argv=None) -> int:
                          "current lowerings (censuses + memory only; "
                          "APX601/602/604 findings must still be fixed "
                          "or suppressed)")
+    ap.add_argument("--check-sharding", action="store_true",
+                    help="SPMD sharding audit: compile every "
+                         "plan-carrying entry point under its mesh "
+                         "and check declared-vs-propagated shardings, "
+                         "reshard chains, collective budgets, overlap "
+                         "preconditions, and per-device memory "
+                         "against tools/sharding_baseline.json "
+                         "(APX701-705; needs the 8-device "
+                         "host-platform mesh)")
+    ap.add_argument("--update-sharding-baseline", action="store_true",
+                    help="rewrite tools/sharding_baseline.json "
+                         "(plans + per-device memory + censuses) from "
+                         "the current compilations; APX701-703 "
+                         "findings must still be fixed or suppressed")
     ap.add_argument("--entry", action="append", default=None,
-                    help="restrict --check-hlo/--update-hlo-baseline "
-                         "to this entry point (repeatable)")
+                    help="restrict --check-hlo/--check-sharding/"
+                         "--update-*-baseline to this entry point "
+                         "(repeatable)")
+    ap.add_argument("--paths", nargs="+", default=None, metavar="FILE",
+                    help="with --check: lint ONLY these repo-relative "
+                         "files (the changed-file pre-commit fast "
+                         "path; skips the kernel-parity audit and "
+                         "baseline-staleness judgment — full CI keeps "
+                         "the full walk)")
     ap.add_argument("--flag-table", action="store_true",
                     help="print the generated env-flag markdown table")
     ap.add_argument("--rule-table", action="store_true",
@@ -179,6 +203,55 @@ def main(argv=None) -> int:
               f"0 unsuppressed findings")
         return 0
 
+    if args.check_sharding or args.update_sharding_baseline:
+        from ..testing.entry_points import ENTRY_POINTS
+        from .sharding import (audit_sharding, run_sharding_check,
+                               write_sharding_baseline)
+
+        if args.entry:
+            unknown = sorted(set(args.entry) - set(ENTRY_POINTS))
+            if unknown:
+                ap.error(f"unknown entry point(s) {unknown}; "
+                         f"registered: {sorted(ENTRY_POINTS)}")
+        if args.update_sharding_baseline:
+            audits = audit_sharding(args.root, names=args.entry)
+            write_sharding_baseline(audits, repo_root=args.root)
+            print(f"[analysis] sharding baseline rewritten: "
+                  f"{len(audits)} planned entry point(s)")
+            leftover = [f for a in audits.values() for f in a.findings]
+            for f in leftover:
+                print(f"[analysis] note: unbaselined finding remains "
+                      f"(fix or suppress): {f.render()}",
+                      file=sys.stderr)
+            return 0
+        unsuppressed, advisories, stale, audits = run_sharding_check(
+            args.root, names=args.entry)
+        for f in sorted(advisories, key=lambda x: (x.path, x.line)):
+            # APX704 is advisory by design: printed, never red
+            print(f.render() if not args.json
+                  else json.dumps(dataclasses.asdict(f)))
+        for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
+            if args.json:
+                print(json.dumps(dataclasses.asdict(f)))
+            else:
+                print(f.render())
+        for k in sorted(stale):
+            print(f"[analysis] stale sharding suppression (finding no "
+                  f"longer fires — delete the line): {k}",
+                  file=sys.stderr)
+        if unsuppressed or stale:
+            print(f"[analysis] FAIL: {len(unsuppressed)} unsuppressed "
+                  f"sharding finding(s), {len(stale)} stale "
+                  f"suppression(s)", file=sys.stderr)
+            return 1
+        ncoll = sum(sum(a.census.values()) for a in audits.values())
+        print(f"[analysis] sharding clean: {len(audits)} planned "
+              f"entry point(s) audited under their meshes, {ncoll} "
+              f"collective op(s) within budget, "
+              f"{len(advisories)} advisory(ies), 0 unsuppressed "
+              f"findings")
+        return 0
+
     if args.smoke:
         from .sanitizer import sanitize_smoke
 
@@ -197,7 +270,8 @@ def main(argv=None) -> int:
 
     # default: --check
     unsuppressed, stale = run_check(baseline=args.baseline,
-                                    repo_root=args.root)
+                                    repo_root=args.root,
+                                    paths=args.paths)
     for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
         if args.json:
             print(json.dumps(dataclasses.asdict(f)))

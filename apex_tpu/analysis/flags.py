@@ -322,6 +322,13 @@ register_flag(
     "smallest rung.  max rung x APEX_TPU_SERVE_KV_BLOCK bounds the "
     "servable sequence length.")
 register_flag(
+    "APEX_TPU_SHARDING_MIN_BYTES", "int", 1024,
+    "Size floor for the SPMD auditor's APX701 replication rule "
+    "(docs/api/analysis.md): a plan-sharded tensor smaller than this "
+    "may propagate replicated without failing — replicating a scalar "
+    "step count costs nothing, and the rule exists for param/state/"
+    "activation buffers whose 1/N sharding IS the memory plan.", lo=0)
+register_flag(
     "APEX_TPU_FULL", "bool", False,
     "CI switch: run the full (slow-inclusive) test tier in "
     "tools/ci.sh.")

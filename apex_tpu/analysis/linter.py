@@ -558,11 +558,49 @@ COMPAT_SCAN_PATHS = ("tests", "examples", "bench.py",
 
 
 def lint_paths(package_root: str = "apex_tpu", *,
-               repo_root: str = ".") -> List[Finding]:
+               repo_root: str = ".",
+               paths: Optional[Sequence[str]] = None) -> List[Finding]:
     """Lint every .py under ``package_root`` (repo-relative), plus the
-    compat-routing rule (APX501) over :data:`COMPAT_SCAN_PATHS`."""
+    compat-routing rule (APX501) over :data:`COMPAT_SCAN_PATHS`.
+
+    ``paths`` restricts the walk to the named repo-relative files —
+    the changed-file pre-commit fast path (``--check --paths ...``,
+    sub-second where the full walk costs seconds).  Each named file
+    gets exactly the rule set the full walk would give it: full lint
+    under ``package_root``, APX501-only under
+    :data:`COMPAT_SCAN_PATHS`, nothing elsewhere (a data file or doc
+    is not lint surface, not an error).  Missing files are skipped —
+    a deleted file carries no findings, and pre-commit hands deletions
+    over too."""
     repo = Path(repo_root).resolve()
     findings: List[Finding] = []
+
+    def _in_package(rel: str) -> bool:
+        return rel == package_root or rel.startswith(package_root + "/")
+
+    def _compat_scope(rel: str) -> bool:
+        return any(rel == entry or rel.startswith(entry + "/")
+                   for entry in COMPAT_SCAN_PATHS)
+
+    if paths is not None:
+        for name in paths:
+            p = repo / name
+            if not p.exists() or p.suffix != ".py":
+                continue
+            try:
+                rel = p.resolve().relative_to(repo).as_posix()
+            except ValueError:
+                continue  # outside the repo: not lint surface
+            if _in_package(rel):
+                findings.extend(lint_source(
+                    p.read_text(), rel,
+                    flags_module=rel.endswith("analysis/flags.py")))
+            elif _compat_scope(rel):
+                findings.extend(
+                    f for f in lint_source(p.read_text(), rel)
+                    if f.rule == "APX501")
+        return findings
+
     for p in _iter_py(repo / package_root):
         rel = p.relative_to(repo).as_posix()
         is_flags = rel.endswith("analysis/flags.py")
@@ -617,14 +655,25 @@ def write_baseline(findings: Sequence[Finding],
 
 def run_check(package_root: str = "apex_tpu", *,
               baseline: str = DEFAULT_BASELINE,
-              repo_root: str = ".") -> Tuple[List[Finding], List[str]]:
-    """(unsuppressed findings, stale baseline keys)."""
-    findings = lint_paths(package_root, repo_root=repo_root)
-    from .parity import audit_kernel_parity
+              repo_root: str = ".",
+              paths: Optional[Sequence[str]] = None
+              ) -> Tuple[List[Finding], List[str]]:
+    """(unsuppressed findings, stale baseline keys).
 
-    findings.extend(audit_kernel_parity(repo_root=repo_root))
+    With ``paths`` (the pre-commit fast path) only those files are
+    linted; the kernel-parity audit (whole-repo by construction) and
+    baseline-staleness judgment (only a full walk can prove a
+    suppression dead) are skipped — full CI keeps both.
+    """
+    findings = lint_paths(package_root, repo_root=repo_root,
+                          paths=paths)
+    if paths is None:
+        from .parity import audit_kernel_parity
+
+        findings.extend(audit_kernel_parity(repo_root=repo_root))
     base = load_baseline(baseline, repo_root=repo_root)
     live_keys = {f.key for f in findings}
     unsuppressed = [f for f in findings if f.key not in base]
-    stale = [k for k in base if k not in live_keys]
+    stale = ([] if paths is not None
+             else [k for k in base if k not in live_keys])
     return unsuppressed, stale

@@ -17,6 +17,11 @@ Layers:
   (:mod:`apex_tpu.testing.entry_points`), which source-level review
   cannot (missed donations, promotion converts the tracer inserted,
   collectives emitted by transpositions).
+* ``sharding`` — the SPMD auditor (:mod:`.sharding`): compiles the
+  planned multichip entries under their mesh and checks the
+  partitioner's actual output (propagated shardings, per-device
+  memory, the collective schedule) against each entry's
+  :class:`apex_tpu.mesh_plan.MeshPlan` contract.
 
 Import-light on purpose (stdlib only), like :mod:`.flags`.
 """
@@ -42,7 +47,7 @@ RULES: Dict[str, Rule] = {}
 
 
 def register_rule(id: str, layer: str, scope: str, doc: str) -> Rule:
-    if layer not in ("source", "kernel", "compiled"):
+    if layer not in ("source", "kernel", "compiled", "sharding"):
         raise ValueError(f"unknown rule layer {layer!r}")
     if id in RULES:
         raise ValueError(f"duplicate rule registration: {id}")
@@ -135,6 +140,37 @@ register_rule(
     "APX605", "compiled", "entry points",
     "peak-live-memory estimate drift: buffer liveness over the "
     "lowered jaxpr exceeds the committed baseline by >10% (shrinks "
+    "fail too — refresh the baseline)")
+register_rule(
+    "APX701", "sharding", "planned entry points",
+    "unintended full replication: a tensor above the "
+    "`APEX_TPU_SHARDING_MIN_BYTES` floor whose MeshPlan spec shards it "
+    "over an axis but whose propagated sharding is fully replicated — "
+    "the silent-ZeRO-regression (every device pays full-tensor memory "
+    "where the plan promised 1/N)")
+register_rule(
+    "APX702", "sharding", "planned entry points",
+    "reshard chain: an `all_gather` whose result feeds a "
+    "`reduce_scatter` / `dynamic_slice` re-partition of the same "
+    "operand — gathered bytes immediately thrown away, reported with "
+    "both ops' jaxpr provenance")
+register_rule(
+    "APX703", "sharding", "planned entry points",
+    "declared-vs-propagated drift: a plan spec the partitioner "
+    "resolved differently, a plan pattern matching no tensor, a "
+    "MeshPlan change vs the committed baseline, or a collective-budget "
+    "overrun / unbudgeted collective kind (innermost repo frame named)")
+register_rule(
+    "APX704", "sharding", "planned entry points (advisory)",
+    "non-overlappable collective: an all_to_all/all_gather consumed by "
+    "the immediately following equation while later independent "
+    "compute exists — the MoE a2a/expert-compute overlap precondition "
+    "is not met as written; printed, never red")
+register_rule(
+    "APX705", "sharding", "planned entry points",
+    "per-device memory drift: XLA's memory analysis of the partitioned "
+    "executable (arguments+outputs+temps−aliased, per device) exceeds "
+    "the committed tools/sharding_baseline.json row by >10% (shrinks "
     "fail too — refresh the baseline)")
 register_rule(
     "APX900", "source", "everywhere",

@@ -1,8 +1,10 @@
 """ZeRO-style distributed fused optimizers (ref: apex/contrib/optimizers)."""
 from .distributed_fused_adam import (DistributedFusedAdam,
-                                     distributed_fused_adam)
+                                     distributed_fused_adam,
+                                     zero_adam_plan)
 from .distributed_fused_lamb import (DistributedFusedLAMB,
                                      distributed_fused_lamb)
 
 __all__ = ["distributed_fused_adam", "DistributedFusedAdam",
-           "distributed_fused_lamb", "DistributedFusedLAMB"]
+           "distributed_fused_lamb", "DistributedFusedLAMB",
+           "zero_adam_plan"]

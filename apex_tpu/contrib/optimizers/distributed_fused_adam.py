@@ -25,6 +25,7 @@ from ..._compat import axis_index, axis_size
 import jax.numpy as jnp
 import optax
 
+from ...mesh_plan import MeshPlan
 from ...ops import fused_optim, multi_tensor
 from ...optimizers.fused_adam import ScalarOrSchedule, _adam_jnp, _lr_at
 
@@ -39,6 +40,46 @@ def _shard_padded(meta: multi_tensor.FlatMeta, world: int) -> int:
     """Padded group length divisible by world * LANE-tile."""
     unit = world * multi_tensor._PAD_TO
     return -(-meta.padded // unit) * unit
+
+
+def zero_adam_plan(world: int, num_groups: int = 1, *,
+                   axis_name: str = "data") -> MeshPlan:
+    """The ZeRO topology contract as data: ONE ``zero``-kind axis; the
+    optimizer state (``m``/``v`` flat buffers) sharded 1/world over it
+    — the memory saving that IS ZeRO, and exactly what a replicated-
+    state regression silently destroys (rule APX701); params and the
+    pre-reduce grads full per device; one psum_scatter (grad reduce)
+    plus one all_gather (delta sync) per dtype group per step.
+
+    Declaring the state spec here is what turned up the real finding
+    this plan shipped with: the ZeRO bench driver carried the sharded
+    state through its shard_map boundary as ``P()`` (replicated) —
+    right on its 1-device bench mesh, silently wrong on any real one.
+    The boundary specs now derive from this plan
+    (``plan.partition_spec``)."""
+    import jax
+
+    # pre-vma jax routes _compat.axis_index through ONE extra
+    # psum_scatter (the partition_id-free rank derivation); the budget
+    # prices the implementation as it actually lowers on this stack —
+    # a jax upgrade that drops the hop shows up as a reviewed
+    # baseline diff, not a silent under-budget
+    rank_hop = 0 if hasattr(jax, "shard_map") else 1
+    return MeshPlan.build(
+        axes=((axis_name, world, "zero"),),
+        tensor_specs={
+            # the sharded flat state buffers: global (padded,) arrays,
+            # one 1/world slice per device (matched on NamedTuple field
+            # names — state.m / state.v — however the entry spells its
+            # argument paths)
+            r"\.(m|v)\b": (axis_name,),
+            # scalar step count: replicated
+            r"\.count\b": (),
+        },
+        # psum_scatter traces as the reduce_scatter primitive — the
+        # census speaks jaxpr
+        collective_budget={"reduce_scatter": num_groups + rank_hop,
+                           "all_gather": num_groups})
 
 
 def distributed_fused_adam(

@@ -1,0 +1,268 @@
+"""MeshPlan — a parallel topology as *data*, not code paths.
+
+ROADMAP item 3's unification refactor: before this module,
+``expert_parallel``, ``sequence_parallel``, the pipeline schedules, and
+the ZeRO optimizers each owned ad-hoc axis names and implicit sharding
+conventions — a topology lived in scattered string constants and
+``in_specs`` tuples, and nothing could check that what a layer
+*declared* is what the partitioner *did*.  A :class:`MeshPlan` is one
+frozen object carrying:
+
+* the mesh **axes** — name, size, and *parallelism kind* (``data`` /
+  ``tensor`` / ``pipeline`` / ``sequence`` / ``expert`` / ``zero``), so
+  "which axis is the ZeRO axis" is a query, not a convention;
+* per-tensor **partition specs** — ``(path pattern, spec)`` pairs
+  declaring how named tensors shard over the axes (the contract the
+  SPMD auditor checks against the partitioner's propagated shardings,
+  rules APX701/APX703);
+* a **collective budget** — the maximum collective ops per kind one
+  step of this topology is allowed to emit (an accidental extra
+  all-gather is a budget overrun, APX703).
+
+Constructed by the parallel stack itself (``parallel_state.
+initialize_model_parallel``, ``ExpertParallelMLP.mesh_plan``,
+``SequenceParallelTransformerLayer.mesh_plan``, ``pipeline_plan``,
+``zero_adam_plan``) and consumed by BOTH the runtime (shard_map
+in/out_specs derive from :meth:`MeshPlan.partition_spec`) and the
+static auditor (:mod:`apex_tpu.analysis.sharding`): one object, so
+drift between the plan and the program is a CI failure, not a TPU
+bill.
+
+Import-light on purpose (stdlib only — the linter's ``--paths`` fast
+path and the doc generators never pay a jax import); jax is imported
+lazily inside :meth:`MeshPlan.make_mesh` / :meth:`partition_spec`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple, Union
+
+__all__ = ["MeshAxis", "MeshPlan", "PARALLELISM_KINDS", "Spec"]
+
+# The parallelism alphabet the framework implements (SURVEY §2.10).
+PARALLELISM_KINDS = ("data", "tensor", "pipeline", "sequence", "expert",
+                     "zero")
+
+# One tensor dimension's sharding: replicated (None), one axis name, or
+# a tuple of axis names (multi-axis sharding of one dim).  A Spec is a
+# tuple of those over the leading dims; trailing dims are replicated.
+DimSpec = Union[None, str, Tuple[str, ...]]
+Spec = Tuple[DimSpec, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxis:
+    """One mesh axis: the name programs use, its size, and what KIND of
+    parallelism rides it — the kind is what makes a topology diffable
+    (``data=8`` and ``zero=8`` are different contracts on the same
+    8-device mesh)."""
+
+    name: str
+    size: int
+    kind: str
+
+    def __post_init__(self):
+        if self.kind not in PARALLELISM_KINDS:
+            raise ValueError(
+                f"unknown parallelism kind {self.kind!r} for axis "
+                f"{self.name!r}; known: {PARALLELISM_KINDS}")
+        if self.size < 1:
+            raise ValueError(
+                f"axis {self.name!r} size must be >= 1, got {self.size}")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A frozen parallel-topology contract.
+
+    ``tensor_specs`` maps *path patterns* (regex, searched against the
+    auditor's rendered tensor paths — ``in0['wi']``, ``out1.m`` — or
+    any other consumer's naming) to declared :data:`Spec` tuples.
+    First match wins, so order from specific to general.
+    """
+
+    axes: Tuple[MeshAxis, ...]
+    tensor_specs: Tuple[Tuple[str, Spec], ...] = ()
+    collective_budget: Tuple[Tuple[str, int], ...] = ()
+
+    def __post_init__(self):
+        names = [a.name for a in self.axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis names in plan: {names}")
+        known = set(names)
+        for pattern, spec in self.tensor_specs:
+            for dim in spec:
+                for ax in () if dim is None else (
+                        dim if isinstance(dim, tuple) else (dim,)):
+                    if ax not in known:
+                        raise ValueError(
+                            f"spec for {pattern!r} names axis {ax!r} "
+                            f"not in the plan's axes {sorted(known)}")
+        for kind, budget in self.collective_budget:
+            if budget < 0:
+                raise ValueError(
+                    f"collective budget for {kind!r} must be >= 0")
+
+    # --- construction helpers ---------------------------------------------
+
+    @classmethod
+    def build(cls, axes: Sequence[Tuple[str, int, str]],
+              tensor_specs: Optional[Mapping[str, Sequence[DimSpec]]]
+              = None,
+              collective_budget: Optional[Mapping[str, int]] = None
+              ) -> "MeshPlan":
+        """Dict-friendly constructor (the dataclass itself is tuples so
+        it can be frozen/hashable)."""
+        return cls(
+            axes=tuple(MeshAxis(n, int(s), k) for n, s, k in axes),
+            tensor_specs=tuple(
+                (p, tuple(spec)) for p, spec in
+                (tensor_specs or {}).items()),
+            collective_budget=tuple(sorted(
+                (collective_budget or {}).items())))
+
+    def with_specs(self, extra: Mapping[str, Sequence[DimSpec]],
+                   budget: Optional[Mapping[str, int]] = None
+                   ) -> "MeshPlan":
+        """A copy with entry-specific specs PREPENDED (they win over the
+        layer's generic patterns) and budget entries replaced/added —
+        how an entry point specializes a layer's plan to its own
+        argument naming."""
+        merged = dict(self.collective_budget)
+        merged.update(budget or {})
+        return MeshPlan(
+            axes=self.axes,
+            tensor_specs=tuple((p, tuple(s)) for p, s in extra.items())
+            + self.tensor_specs,
+            collective_budget=tuple(sorted(merged.items())))
+
+    # --- queries ------------------------------------------------------------
+
+    def axis(self, name: str) -> MeshAxis:
+        for a in self.axes:
+            if a.name == name:
+                return a
+        raise KeyError(f"no axis {name!r} in plan "
+                       f"{[a.name for a in self.axes]}")
+
+    def axes_of_kind(self, kind: str) -> Tuple[MeshAxis, ...]:
+        return tuple(a for a in self.axes if a.kind == kind)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a.name for a in self.axes)
+
+    @property
+    def world_size(self) -> int:
+        n = 1
+        for a in self.axes:
+            n *= a.size
+        return n
+
+    def budget(self) -> Dict[str, int]:
+        return dict(self.collective_budget)
+
+    def spec_for(self, path: str) -> Optional[Spec]:
+        """Declared spec of the first pattern matching ``path`` (regex
+        search), or None when the plan declares nothing for it."""
+        import re
+
+        for pattern, spec in self.tensor_specs:
+            if re.search(pattern, path):
+                return spec
+        return None
+
+    def expected_shard_shape(self, shape: Sequence[int],
+                             spec: Spec) -> Tuple[int, ...]:
+        """Per-device shape of a ``shape``-d tensor under ``spec``.
+        Raises ValueError when the spec does not divide the shape —
+        a mis-declared plan must fail loudly, not round."""
+        if len(spec) > len(shape):
+            raise ValueError(
+                f"spec {spec} has more dims than shape {tuple(shape)}")
+        out = []
+        for d, dim in enumerate(shape):
+            entry = spec[d] if d < len(spec) else None
+            factor = 1
+            for ax in () if entry is None else (
+                    entry if isinstance(entry, tuple) else (entry,)):
+                factor *= self.axis(ax).size
+            if dim % factor != 0:
+                raise ValueError(
+                    f"dim {d} of shape {tuple(shape)} not divisible by "
+                    f"sharding factor {factor} ({entry!r})")
+            out.append(dim // factor)
+        return tuple(out)
+
+    # --- jax bridges (lazy imports) -----------------------------------------
+
+    def make_mesh(self, devices=None):
+        """Build the ``jax.sharding.Mesh`` this plan describes from the
+        first ``world_size`` devices (axis order = plan order)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        n = self.world_size
+        if len(devices) < n:
+            raise ValueError(
+                f"plan needs {n} devices, host has {len(devices)}")
+        grid = np.asarray(devices[:n], dtype=object).reshape(
+            tuple(a.size for a in self.axes))
+        return Mesh(grid, self.axis_names())
+
+    def partition_spec(self, path: str):
+        """``jax.sharding.PartitionSpec`` for ``path`` per the declared
+        specs (replicated when undeclared) — the runtime-side consumer:
+        shard_map in/out_specs derive from the same object the auditor
+        checks."""
+        from jax.sharding import PartitionSpec
+
+        spec = self.spec_for(path)
+        if spec is None:
+            return PartitionSpec()
+        return PartitionSpec(*spec)
+
+    # --- serialization ------------------------------------------------------
+
+    def to_json(self) -> Dict[str, Any]:
+        """JSON-stable form: what MULTICHIP rows record and
+        tools/sharding_baseline.json commits — the diffable topology.
+        ``tensor_specs`` serializes as an ORDERED pair list, never a
+        dict: first-match-wins means a with_specs override and the
+        base pattern it shadows can share a pattern string, and a
+        pattern-keyed dict would keep the losing spec."""
+        return {
+            "axes": [{"name": a.name, "size": a.size, "kind": a.kind}
+                     for a in self.axes],
+            "tensor_specs": [
+                [p, [list(d) if isinstance(d, tuple) else d
+                     for d in spec]]
+                for p, spec in self.tensor_specs],
+            "collective_budget": dict(self.collective_budget),
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, Any]) -> "MeshPlan":
+        specs = data.get("tensor_specs", ())
+        pairs = specs.items() if isinstance(specs, Mapping) else specs
+        return cls(
+            axes=tuple(MeshAxis(a["name"], int(a["size"]), a["kind"])
+                       for a in data.get("axes", ())),
+            tensor_specs=tuple(
+                (p, tuple(tuple(d) if isinstance(d, list) else d
+                          for d in spec))
+                for p, spec in pairs),
+            collective_budget=tuple(sorted(
+                {k: int(v) for k, v in
+                 data.get("collective_budget", {}).items()}.items())))
+
+    def describe(self) -> str:
+        """Human one-liner: ``data=2(data) x tensor=2(tensor) ...``."""
+        return " x ".join(f"{a.name}={a.size}({a.kind})"
+                          for a in self.axes)
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)

@@ -27,6 +27,8 @@ from ._compat import axis_index
 import numpy as np
 from jax.sharding import Mesh
 
+from .mesh_plan import MeshAxis, MeshPlan  # noqa: F401  (re-export)
+
 # Canonical mesh-axis names.  Everything in apex_tpu refers to these.
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"
@@ -48,6 +50,7 @@ class _ParallelState:
     data_parallel_size: int = 1
     virtual_pipeline_model_parallel_size: Optional[int] = None
     virtual_pipeline_model_parallel_rank: Optional[int] = None
+    plan: Optional[MeshPlan] = None
 
 
 _STATE = _ParallelState()
@@ -98,6 +101,9 @@ def initialize_model_parallel(
     device_grid = np.asarray(devices, dtype=object).reshape(pp, dp, tp)
     mesh = Mesh(device_grid, MESH_AXIS_ORDER)
 
+    _STATE.plan = MeshPlan.build(
+        axes=((PIPE_AXIS, pp, "pipeline"), (DATA_AXIS, dp, "data"),
+              (TENSOR_AXIS, tp, "tensor")))
     _STATE.mesh = mesh
     _STATE.tensor_model_parallel_size = tp
     _STATE.pipeline_model_parallel_size = pp
@@ -122,6 +128,18 @@ def get_mesh() -> Mesh:
             "initialize_model_parallel() first"
         )
     return _STATE.mesh
+
+
+def get_mesh_plan() -> MeshPlan:
+    """The registered topology as data (:class:`MeshPlan`): what the
+    dryrun stamps into MULTICHIP rows and the SPMD auditor checks
+    entries against."""
+    if _STATE.plan is None:
+        raise ParallelStateError(
+            "parallel state is not initialized; call "
+            "initialize_model_parallel() first"
+        )
+    return _STATE.plan
 
 
 def destroy_model_parallel() -> None:

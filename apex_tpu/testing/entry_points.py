@@ -86,6 +86,15 @@ class EntryPoint:
     allow_upcast: Tuple[str, ...] = ()
     min_devices: int = 1
     doc: str = ""
+    # Lazy MeshPlan constructor: the entry's declared topology contract
+    # (axes + kinds, per-tensor partition specs, collective budget).
+    # Entries that carry one are compiled under their mesh by the SPMD
+    # auditor (apex_tpu.analysis.sharding, APX701-705) and their plan
+    # is committed to tools/sharding_baseline.json — a topology change
+    # is a reviewed JSON diff.  The builder itself must derive its
+    # runtime in/out specs from the SAME plan, or the auditor will
+    # report the drift.
+    plan: Optional[Callable[[], Any]] = None
 
 
 ENTRY_POINTS: Dict[str, EntryPoint] = {}
@@ -318,7 +327,30 @@ register_entry_point(
 # ---------------------------------------------------------------------------
 # Multichip entries (8-device host-platform mesh): the collective
 # census must cover the parallel stack, not just single-chip steps.
+# Each carries a MeshPlan — the SPMD auditor compiles it under its mesh
+# and checks the partitioner's output against the plan (APX701-705).
 # ---------------------------------------------------------------------------
+
+def plan_shardings(plan, mesh, args: tuple):
+    """Per-leaf ``NamedSharding`` tree for ``args`` from the plan's
+    declared specs, named exactly as the auditor names them (``in0``,
+    ``in1['w']``, ``in2.m[0]``): the builder's ``in_shardings`` and the
+    audit read the SAME contract, so a builder that stops consulting
+    the plan becomes an APX701/703 finding, not a silent regression."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def leaf(prefix):
+        def f(path, _):
+            name = prefix + jax.tree_util.keystr(path)
+            return NamedSharding(mesh, plan.partition_spec(name))
+
+        return f
+
+    return tuple(
+        jax.tree_util.tree_map_with_path(leaf(f"in{i}"), a)
+        for i, a in enumerate(args))
+
 
 def _build_dp8_train_step():
     """Pure data-parallel GPT loss step over an 8-way mesh: pmean of
@@ -329,8 +361,7 @@ def _build_dp8_train_step():
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from .._compat import shard_map
     from ..optimizers import fused_adam
@@ -350,7 +381,8 @@ def _build_dp8_train_step():
     params = jax.jit(model.init)(key, tokens[:2])["params"]
     tx = fused_adam(1e-3)
     opt_state = jax.jit(tx.init)(params)
-    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    plan = _dp8_plan()
+    mesh = plan.make_mesh()
 
     def loss_fn(p, t, l):
         def shard(p, t, l):
@@ -361,7 +393,11 @@ def _build_dp8_train_step():
                          in_specs=(P(), P("data"), P("data")),
                          out_specs=P(), check_vma=False)(p, t, l)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    args = (params, opt_state, tokens, labels)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=plan_shardings(plan, mesh, args))
     def train_step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens,
                                                   labels)
@@ -370,7 +406,7 @@ def _build_dp8_train_step():
 
         return optax.apply_updates(params, updates), new_opt, loss
 
-    return train_step, (params, opt_state, tokens, labels)
+    return train_step, args
 
 
 def _build_zero_dp8_update_step():
@@ -382,8 +418,7 @@ def _build_zero_dp8_update_step():
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from .._compat import shard_map
 
@@ -392,7 +427,8 @@ def _build_zero_dp8_update_step():
     key = jax.random.PRNGKey(0)
     params = jax.random.normal(key, (dim, 64), jnp.float32)
     grads = params * 1e-3
-    mesh = Mesh(np.array(jax.devices()[:n]), ("zero",))
+    plan = _zero_update_plan()
+    mesh = plan.make_mesh()
 
     def update(p, g):
         def shard(p, g):
@@ -411,20 +447,201 @@ def _build_zero_dp8_update_step():
         return shard_map(shard, mesh=mesh, in_specs=(P(), P()),
                          out_specs=P(), check_vma=False)(p, g)
 
-    return (functools.partial(jax.jit, donate_argnums=(0,))(update),
-            (params, grads))
+    args = (params, grads)
+    return (functools.partial(
+        jax.jit, donate_argnums=(0,),
+        in_shardings=plan_shardings(plan, mesh, args))(update), args)
+
+
+def _build_zero_dp8_adam_step():
+    """The REAL ZeRO optimizer over 8 devices with its persistent
+    state crossing the jit boundary: DistributedFusedAdam's m/v flat
+    buffers live sharded 1/8 over the ``zero`` axis (the memory saving
+    that IS ZeRO), enter and leave the step as ``P('zero')`` globals,
+    and the in/out specs derive from :func:`zero_adam_plan` — the same
+    object the SPMD auditor checks.  A builder change that stops
+    consulting the plan (the bench-driver bug this PR fixed carried
+    the state as ``P()``) makes the state replicated and fires
+    APX701 here instead of surfacing as a TPU bill."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..contrib.optimizers import distributed_fused_adam
+
+    plan = _zero_adam_entry_plan()
+    mesh = plan.make_mesh()
+    key = jax.random.PRNGKey(3)
+    params = {"w": jax.random.normal(key, (512, 16), jnp.float32),
+              "b": jnp.zeros((16,), jnp.float32)}
+    grads = jax.tree_util.tree_map(lambda x: x * 1e-3 + 1e-4, params)
+    tx = distributed_fused_adam(1e-2, axis_name="zero",
+                                use_pallas=False)
+
+    def state_specs(state):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: plan.partition_spec(
+                "state" + jax.tree_util.keystr(path)), state)
+
+    # init must run inside shard_map (shard sizes read the axis size);
+    # learn the state's tree structure first (out_specs P() never
+    # executes under eval_shape), then stitch the per-device shards
+    # into P('zero') globals with the plan's real per-leaf specs
+    shapes = jax.eval_shape(
+        lambda p: shard_map(tx.init, mesh=mesh, in_specs=P(),
+                            out_specs=P(), check_vma=False)(p),
+        params)
+    state = shard_map(tx.init, mesh=mesh, in_specs=P(),
+                      out_specs=state_specs(shapes),
+                      check_vma=False)(params)
+
+    def step(params, state, grads):
+        def shard(p, s, g):
+            updates, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, updates), s2
+
+        return shard_map(
+            shard, mesh=mesh,
+            in_specs=(P(), state_specs(state), P()),
+            out_specs=(P(), state_specs(state)),
+            check_vma=False)(params, state, grads)
+
+    args = (params, state, grads)
+    return (functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        in_shardings=plan_shardings(plan, mesh, args))(step), args)
+
+
+def _build_moe_ep8_train_step():
+    """Top-2 (GShard) expert-parallel MoE train step over an 8-way
+    ``expert`` mesh: the layer's OWN :meth:`ExpertParallelMLP.
+    mesh_plan` supplies the axes, the wi/wo-sharded + router-replicated
+    specs, and the all_to_all budget (2 dispatch hops forward, their
+    transposes backward) the census is held to."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .._compat import shard_map
+    from ..transformer.expert_parallel import ExpertParallelMLP
+
+    n = 8
+    layer = ExpertParallelMLP(hidden_size=16, ffn_hidden_size=32,
+                              num_experts=n, capacity_factor=4.0,
+                              router="top2")
+    plan = _moe_ep8_plan()
+    mesh = plan.make_mesh()
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (16 * n, 16),
+                          jnp.float32) * 0.5
+
+    def loss_fn(p, x):
+        def f(p, x):
+            y, aux = layer.apply(p, x)
+            return jax.lax.psum(jnp.sum(y ** 2) + 0.01 * aux,
+                                "expert")
+
+        return shard_map(
+            f, mesh=mesh,
+            in_specs=({"router": P(), "wi": P("expert"),
+                       "wo": P("expert")}, P("expert")),
+            out_specs=P(), check_vma=False)(p, x)
+
+    args = (params, x)
+    return (functools.partial(
+        jax.jit,
+        in_shardings=plan_shardings(plan, mesh, args))(
+            jax.value_and_grad(loss_fn)), args)
+
+
+def _dp8_plan():
+    """gpt_dp8_train_step's contract: one data axis, batch sharded,
+    params/opt-state replicated (plain DP — ZeRO is the other entry),
+    and the DP collective pair: ONE loss pmean + ONE fused gradient
+    psum from the boundary transposition."""
+    from ..mesh_plan import MeshPlan
+
+    return MeshPlan.build(
+        axes=(("data", 8, "data"),),
+        tensor_specs={
+            r"^in[23]$": ("data",),     # tokens / labels, batch dim
+            r"^in[01]": (),             # params + adam state: replicated
+        },
+        # 1 loss pmean + one psum per replicated param leaf from the
+        # boundary transposition (the UNFUSED per-leaf grad sync —
+        # fusing it into one tree-psum is the budget cut ROADMAP item
+        # 3 can bank, and this number is where it would show)
+        collective_budget={"psum": 30})
+
+
+def _zero_update_plan():
+    """zero_dp8_update_step's contract: one zero-kind axis; params and
+    grads replicated at the boundary (the entry models the update
+    glue, not persistent state — zero_dp8_adam_step audits that); one
+    reduce_scatter + one all_gather per step."""
+    from ..mesh_plan import MeshPlan
+
+    return MeshPlan.build(
+        axes=(("zero", 8, "zero"),),
+        tensor_specs={r"^in[01]$": (), r"^out0$": ()},
+        collective_budget={"reduce_scatter": 1, "all_gather": 1})
+
+
+def _zero_adam_entry_plan():
+    """zero_dp8_adam_step's contract = the OPTIMIZER's own plan
+    (:func:`~apex_tpu.contrib.optimizers.zero_adam_plan`: m/v sharded
+    1/8 over the zero axis, count replicated, one reduce_scatter + one
+    all_gather per dtype group) specialized with the entry's
+    replicated params/grads boundary."""
+    from ..contrib.optimizers import zero_adam_plan
+
+    return zero_adam_plan(8, axis_name="zero").with_specs(
+        {r"^in[02]": (), r"^out0$": ()})
+
+
+def _moe_ep8_plan():
+    """moe_ep8_train_step's contract = the LAYER's own
+    :meth:`ExpertParallelMLP.mesh_plan` (wi/wo expert-sharded, router
+    replicated, 4 all_to_all with the backward) specialized with the
+    entry's token sharding and its loss/grad psum pair."""
+    from ..transformer.expert_parallel import ExpertParallelMLP
+
+    layer = ExpertParallelMLP(hidden_size=16, ffn_hidden_size=32,
+                              num_experts=8, capacity_factor=4.0,
+                              router="top2")
+    # psum: the forward loss psum + its per-operand backward partials
+    # as this jax transposes them (measured 5 on the pre-vma stack)
+    return layer.mesh_plan(8).with_specs(
+        {r"^in1$": ("expert",)}, budget={"psum": 5})
 
 
 register_entry_point(
     "gpt_dp8_train_step", _build_dp8_train_step, policy="O0",
-    dead_args=(0, 1), min_devices=8,
+    dead_args=(0, 1), min_devices=8, plan=_dp8_plan,
     doc="8-way data-parallel GPT train step (pmean loss, psum grad "
         "sync from boundary transposition)")
 register_entry_point(
     "zero_dp8_update_step", _build_zero_dp8_update_step, policy="O0",
-    dead_args=(0,), min_devices=8,
+    dead_args=(0,), min_devices=8, plan=_zero_update_plan,
     doc="ZeRO-sharded update: psum_scatter grads -> local shard "
         "update -> all_gather params")
+register_entry_point(
+    "zero_dp8_adam_step", _build_zero_dp8_adam_step, policy="O0",
+    dead_args=(0, 1), min_devices=8, plan=_zero_adam_entry_plan,
+    doc="DistributedFusedAdam ZeRO step with the sharded m/v state "
+        "crossing the jit boundary as P('zero') globals — specs "
+        "derived from zero_adam_plan, the APX701 guard surface")
+register_entry_point(
+    "moe_ep8_train_step", _build_moe_ep8_train_step, policy="O0",
+    dead_args=(), min_devices=8, plan=_moe_ep8_plan,
+    doc="top-2 GShard MoE train step over expert=8 — the layer's own "
+        "mesh_plan supplies specs and the all_to_all budget")
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,7 @@ import jax
 from .._compat import axis_size
 import jax.numpy as jnp
 
+from ..mesh_plan import MeshPlan
 from ..parallel_state import EXPERT_AXIS  # noqa: F401
 
 
@@ -208,12 +209,28 @@ class ExpertParallelMLP:
     def __init__(self, hidden_size: int, ffn_hidden_size: int,
                  num_experts: int, capacity_factor: float = 1.25,
                  axis_name: Optional[str] = EXPERT_AXIS,
-                 router: str = "top1", second_policy: str = "all"):
+                 router: str = "top1", second_policy: str = "all",
+                 plan: Optional[MeshPlan] = None):
         if router not in ("top1", "top2"):
             raise ValueError(f"router must be top1|top2, got {router!r}")
         if second_policy not in ("all", "random"):
             raise ValueError(f"second_policy must be 'all'|'random', "
                              f"got {second_policy!r}")
+        if plan is not None:
+            # topology as data: the plan's expert axis IS the axis name
+            # (passing both only to disagree is a config bug)
+            ep_axes = plan.axes_of_kind("expert")
+            if len(ep_axes) != 1:
+                raise ValueError(
+                    f"plan {plan.describe()!r} must carry exactly one "
+                    f"expert-kind axis to drive ExpertParallelMLP, "
+                    f"got {[a.name for a in ep_axes]}")
+            if axis_name not in (None, EXPERT_AXIS, ep_axes[0].name):
+                raise ValueError(
+                    f"plan names the expert axis "
+                    f"{ep_axes[0].name!r} but axis_name="
+                    f"{axis_name!r} was also given")
+            axis_name = ep_axes[0].name
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size
         self.num_experts = num_experts
@@ -221,6 +238,32 @@ class ExpertParallelMLP:
         self.axis_name = axis_name
         self.router = router
         self.second_policy = second_policy
+
+    def mesh_plan(self, num_shards: int,
+                  with_backward: bool = True) -> MeshPlan:
+        """This layer's topology contract: experts sharded over one
+        ``expert``-kind axis, router replicated, and the GShard
+        dispatch algebra's collective budget — ONE all_to_all each way
+        (2/layer forward; their transposes double it when the layer
+        trains).  The auditor checks a compiled entry against exactly
+        this object; the runtime builds its shard_map specs from it.
+        """
+        if self.num_experts % num_shards != 0:
+            raise ValueError(
+                f"num_experts {self.num_experts} not divisible by "
+                f"{num_shards} shards")
+        ax = self.axis_name or EXPERT_AXIS
+        return MeshPlan.build(
+            axes=((ax, num_shards, "expert"),),
+            tensor_specs={
+                # expert weights: stacked on dim 0, one slice per shard
+                r"\['w[io]'\]": (ax,),
+                # the router is the one intentionally-replicated param:
+                # every shard routes its own tokens with the same table
+                r"\['router'\]": (),
+            },
+            collective_budget={
+                "all_to_all": 4 if with_backward else 2})
 
     def init(self, key: jax.Array) -> dict:
         kr, k1, k2 = jax.random.split(key, 3)

@@ -10,7 +10,8 @@ from . import p2p_communication
 from .schedules import (build_stage_params, forward_backward_no_pipelining,
                         forward_backward_pipelining_with_interleaving,
                         forward_backward_pipelining_without_interleaving,
-                        get_forward_backward_func, pipeline_forward)
+                        get_forward_backward_func, pipeline_forward,
+                        pipeline_plan)
 from .utils import (average_losses_across_data_parallel_group,
                     get_current_global_batch_size, get_kth_microbatch,
                     get_ltor_masks_and_position_ids, get_micro_batch_size,
@@ -24,7 +25,7 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
-    "get_forward_backward_func", "pipeline_forward",
+    "get_forward_backward_func", "pipeline_forward", "pipeline_plan",
     "average_losses_across_data_parallel_group",
     "get_current_global_batch_size", "get_kth_microbatch",
     "get_ltor_masks_and_position_ids", "get_micro_batch_size",

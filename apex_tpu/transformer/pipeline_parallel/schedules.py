@@ -42,9 +42,41 @@ import jax
 from ..._compat import axis_index, axis_size, pcast, psum_replicated, typeof
 import jax.numpy as jnp
 
+from ...mesh_plan import MeshPlan
 from ...parallel_state import PIPE_AXIS
 from ..tensor_parallel.random import CHECKPOINT_POLICIES
 from . import p2p_communication
+
+
+def pipeline_plan(num_stages: int, num_microbatches: int, *,
+                  axis_name: str = PIPE_AXIS,
+                  virtual_pipeline_size: Optional[int] = None,
+                  with_backward: bool = True) -> MeshPlan:
+    """The pipeline schedules' topology contract as data.
+
+    One ``pipeline``-kind axis; stage parameters stacked on a leading
+    stage axis and sharded over it; the collective budget prices the
+    tick loop: every tick hands one activation to the successor with a
+    single ``ppermute`` (2 per tick interleaved — activation feed plus
+    the chunk-recirculation hop), over ``m + s·v - 1``-ish ticks, and
+    training doubles it (the scan transposes every hop into the
+    reverse ring).  The budget is a CEILING for the auditor's census,
+    not an exact count — schedules may mask bubble ticks but never emit
+    more hops than ticks.
+    """
+    v = virtual_pipeline_size or 1
+    ticks = num_microbatches * v + num_stages - 1
+    hops_per_tick = 2 if v > 1 else 1
+    mult = 2 if with_backward else 1
+    return MeshPlan.build(
+        axes=((axis_name, num_stages, "pipeline"),),
+        tensor_specs={
+            # build_stage_params stacks per-stage trees on dim 0 (dim 0
+            # is the vpp chunk when interleaving — the stage axis moves
+            # to dim 1); both spell "one stage slice per device"
+            r"stage": ((axis_name,) if v == 1 else (None, axis_name)),
+        },
+        collective_budget={"ppermute": ticks * hops_per_tick * mult})
 
 
 def _tree_where(pred, a, b):

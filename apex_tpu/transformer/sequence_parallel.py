@@ -27,6 +27,7 @@ import jax
 from .._compat import axis_index, axis_size
 import jax.numpy as jnp
 
+from ..mesh_plan import MeshPlan
 from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..parallel_state import TENSOR_AXIS
 
@@ -105,9 +106,24 @@ class SequenceParallelSelfAttention:
                  causal: bool = True, mode: str = "ring",
                  axis_name: Optional[str] = SEQUENCE_AXIS,
                  use_flash: Optional[bool] = None,
-                 attention_dropout: float = 0.0):
+                 attention_dropout: float = 0.0,
+                 plan: Optional[MeshPlan] = None):
         assert hidden_size % num_attention_heads == 0
         assert mode in ("ring", "ulysses")
+        if plan is not None:
+            sp_axes = plan.axes_of_kind("sequence")
+            if len(sp_axes) != 1:
+                raise ValueError(
+                    f"plan {plan.describe()!r} must carry exactly one "
+                    f"sequence-kind axis to drive this layer, got "
+                    f"{[a.name for a in sp_axes]}")
+            if axis_name not in (None, SEQUENCE_AXIS,
+                                 sp_axes[0].name):
+                raise ValueError(
+                    f"plan names the sequence axis "
+                    f"{sp_axes[0].name!r} but axis_name="
+                    f"{axis_name!r} was also given")
+            axis_name = sp_axes[0].name
         self.hidden_size = hidden_size
         self.num_heads = num_attention_heads
         self.head_dim = hidden_size // num_attention_heads
@@ -118,6 +134,30 @@ class SequenceParallelSelfAttention:
         # shard_map(check_vma=False) — the caller owns that choice
         self.use_flash = use_flash
         self.attention_dropout = attention_dropout
+
+    def mesh_plan(self, num_shards: int,
+                  with_backward: bool = True) -> MeshPlan:
+        """This attention's topology contract: ONE ``sequence``-kind
+        axis, projections replicated, activations sequence-sharded on
+        dim 1, and the mode's collective budget — ring rotates k and v
+        once per non-local block (2·(P-1) ppermutes forward; training
+        doubles it, the transposed reverse ring), Ulysses swaps
+        seq<->heads with one all_to_all per operand + one back
+        (4 forward, 8 with the backward)."""
+        ax = self.axis_name or SEQUENCE_AXIS
+        mult = 2 if with_backward else 1
+        if self.mode == "ring":
+            budget = {"ppermute": 2 * (num_shards - 1) * mult}
+        else:
+            budget = {"all_to_all": 4 * mult}
+        return MeshPlan.build(
+            axes=((ax, num_shards, "sequence"),),
+            tensor_specs={
+                # qkv/out projections + biases: per-token math,
+                # replicated over the sequence shards
+                r"\['(qkv|out)_(kernel|bias)'\]": (),
+            },
+            collective_budget=budget)
 
     def init(self, key) -> dict:
         k1, k2 = jax.random.split(key)
@@ -200,14 +240,26 @@ class SequenceParallelTransformerLayer:
                  layernorm_epsilon: float = 1e-5,
                  axis_name: Optional[str] = SEQUENCE_AXIS,
                  use_flash: Optional[bool] = None,
-                 attention_dropout: float = 0.0):
+                 attention_dropout: float = 0.0,
+                 plan: Optional[MeshPlan] = None):
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.eps = layernorm_epsilon
         self.attn = SequenceParallelSelfAttention(
             hidden_size, num_attention_heads, causal=causal, mode=mode,
             axis_name=axis_name, use_flash=use_flash,
-            attention_dropout=attention_dropout)
+            attention_dropout=attention_dropout, plan=plan)
+
+    def mesh_plan(self, num_shards: int,
+                  with_backward: bool = True) -> MeshPlan:
+        """The full layer's contract = the attention core's (LN, MLP,
+        and residuals are per-token — they add parameters but no
+        collectives), extended with the layer's own replicated-param
+        declarations."""
+        return self.attn.mesh_plan(
+            num_shards, with_backward=with_backward).with_specs({
+                r"\['(ln[12]_(weight|bias)|mlp_[wb][io])'\]": (),
+            })
 
     def init(self, key) -> dict:
         h, f = self.hidden_size, self.ffn_hidden_size

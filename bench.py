@@ -1113,19 +1113,39 @@ def _zero_adam_at(count):
     import optax
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from apex_tpu.contrib.optimizers import distributed_fused_adam
+    from apex_tpu.contrib.optimizers import (distributed_fused_adam,
+                                             zero_adam_plan)
     from apex_tpu.optimizers import fused_adam
 
     K = 8
     mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    # The ZeRO state's shard_map boundary specs derive from the
+    # optimizer's OWN MeshPlan (m/v sharded over the axis, count
+    # replicated).  This section used to carry the state as P() —
+    # replicated — which is a no-op on this 1-device bench mesh but on
+    # any real world silently regathers the whole m/v every step: the
+    # exact APX701 class the SPMD auditor now guards (the real finding
+    # this PR fixed; see zero_adam_plan's docstring).
+    plan = zero_adam_plan(mesh.shape["data"], axis_name="data")
+
+    def _state_specs(tree):
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, _: plan.partition_spec(
+                "state" + jax.tree_util.keystr(kp)), tree)
 
     def run(tx, sharded):
         p = _synthetic_params(count, jax.random.PRNGKey(5))
         g = jax.tree_util.tree_map(lambda x: x * 1e-3 + 1e-3, p)
         if sharded:
+            shapes = jax.eval_shape(
+                lambda p: shard_map(tx.init, mesh=mesh, in_specs=P(),
+                                    out_specs=P(), check_vma=False)(p),
+                p)
+            sspecs = _state_specs(shapes)
             s = shard_map(tx.init, mesh=mesh, in_specs=P(),
-                              out_specs=P(), check_vma=False)(p)
+                              out_specs=sspecs, check_vma=False)(p)
         else:
+            sspecs = None
             s = tx.init(p)
         s = jax.tree_util.tree_map(jnp.array, s)
 
@@ -1145,8 +1165,9 @@ def _zero_adam_at(count):
             return jax.lax.scan(body, (p, s), None, length=K)[0]
 
         inner = shard_map(kbody, mesh=mesh,
-                              in_specs=(P(), P(), P()),
-                              out_specs=P(), check_vma=False) \
+                              in_specs=(P(), sspecs, P()),
+                              out_specs=(P(), sspecs),
+                              check_vma=False) \
             if sharded else kbody
         steps = functools.partial(jax.jit, donate_argnums=(0, 1))(
             lambda p, s, g: inner(p, s, g))

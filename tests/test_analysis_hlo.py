@@ -481,3 +481,61 @@ class TestRulesRegistry:
         assert dataclasses.is_dataclass(ep)
         with pytest.raises(dataclasses.FrozenInstanceError):
             ep.policy = "O0"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-10 regression fixture: the 8-device ZeRO entries under the HLO
+# auditor — donation-clean, and the census rows cover BOTH halves of
+# the ZeRO exchange (psum_scatter -> reduce_scatter, all_gather) with
+# exact provenance, so a refactor that drops either collective (or
+# un-donates the state) fails here before it fails on a pod.
+# ---------------------------------------------------------------------------
+
+class TestZeroEntriesRegression:
+    ZERO_ENTRIES = ("zero_dp8_update_step", "zero_dp8_adam_step")
+
+    @pytest.fixture(scope="class")
+    def audits(self):
+        return hlo.audit_entry_points(REPO, names=list(
+            self.ZERO_ENTRIES))
+
+    def test_donation_clean(self, audits):
+        for name in self.ZERO_ENTRIES:
+            missed = [f for f in audits[name].findings
+                      if f.rule == "APX601"]
+            assert missed == [], "\n".join(
+                f.render() for f in missed)
+
+    def test_census_covers_scatter_and_gather_with_provenance(
+            self, audits):
+        for name in self.ZERO_ENTRIES:
+            kinds = {op.kind for op in audits[name].collectives}
+            assert {"reduce_scatter", "all_gather"} <= kinds, name
+        # exact provenance: the update entry's pair lives in its own
+        # shard fn; the adam entry's grad scatter + delta gather live
+        # in the OPTIMIZER (distributed_fused_adam.update), with the
+        # extra rank-derivation scatter priced to the compat shim
+        upd = audits["zero_dp8_update_step"].collectives
+        assert all(op.path == "apex_tpu/testing/entry_points.py"
+                   and op.function == "shard" for op in upd)
+        adam = audits["zero_dp8_adam_step"].collectives
+        opt = "apex_tpu/contrib/optimizers/distributed_fused_adam.py"
+        assert any(op.kind == "reduce_scatter" and op.path == opt
+                   and op.function == "update" for op in adam)
+        assert all(op.path == opt for op in adam
+                   if op.kind == "all_gather")
+
+    def test_committed_baseline_rows_price_both_kinds(self):
+        base = hlo.load_hlo_baseline(repo_root=REPO)["entries"]
+        for name in self.ZERO_ENTRIES:
+            cens = base[name]["collectives"]
+            assert {"reduce_scatter", "all_gather"} <= set(cens), name
+            for kind in ("reduce_scatter", "all_gather"):
+                assert cens[kind]["count"] >= 1
+                assert cens[kind]["bytes_per_step"] > 0
+        # the adam entry donates params AND every state leaf (the
+        # end-to-end requirement: a missed state donation doubles the
+        # largest buffers in the step)
+        adam = base["zero_dp8_adam_step"]
+        n_state_leaves = 3  # count + m[0] + v[0]
+        assert len(adam["donated_args"]) >= 2 + n_state_leaves - 1

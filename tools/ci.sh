@@ -92,28 +92,42 @@
 #                            requests are marked preempted, and the
 #                            summary + JSONL record still land
 #                            (docs/api/serving.md)
+#  12. SPMD sharding audit   — python -m apex_tpu.analysis
+#                            --check-sharding compiles every
+#                            plan-carrying multichip entry point under
+#                            its MeshPlan's mesh (8 host-platform
+#                            devices) and checks declared-vs-propagated
+#                            shardings, reshard chains, collective
+#                            budgets, overlap preconditions, and
+#                            per-device memory against
+#                            tools/sharding_baseline.json (APX701-705),
+#                            failing on stale sharding_findings.txt
+#                            suppressions; plus the committed
+#                            MULTICHIP_TOPOLOGY.json must match the
+#                            canonical MeshPlan constructors
+#                            (docs/api/analysis.md)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/11 default test tier"
+echo "[ci] 1/12 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/11 README drift guard"
+echo "[ci] 2/12 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/11 8-device multichip dryrun"
+echo "[ci] 3/12 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/11 monitor smoke"
+echo "[ci] 4/12 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/11 kill->resume smoke"
+echo "[ci] 5/12 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -133,16 +147,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/11 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/12 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/11 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/12 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/11 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/12 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -151,7 +165,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/11 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/12 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -172,7 +186,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/11 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/12 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -196,7 +210,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/11 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/12 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -226,5 +240,17 @@ echo "$SERVE_OUT" | grep -Eq "preempted=[1-9]" \
 grep -q '"name":"serve_preempt"' "$SERVE_DIR/drain.jsonl" \
     || { echo "[ci] FAIL: no serve_preempt event in the JSONL"; exit 1; }
 rm -rf "$SERVE_DIR"
+
+echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
+# Compile every plan-carrying multichip entry under its mesh on the
+# same 8-device host-platform trick the multichip tests use; fails on
+# APX701-703 findings, per-device-memory drift vs the committed
+# tools/sharding_baseline.json, and stale sharding_findings.txt
+# suppressions (the linter-baseline semantics).  Then prove the
+# committed MULTICHIP_TOPOLOGY.json still matches the canonical
+# MeshPlan constructors — a topology change must be a reviewed diff.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.analysis --check-sharding
+python __graft_entry__.py --plans 8
 
 echo "[ci] all green"

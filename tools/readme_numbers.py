@@ -11,16 +11,56 @@ Usage:
     python tools/readme_numbers.py --check
 """
 import argparse
+import glob
 import json
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 START = "<!-- BENCH_NUMBERS_START (tools/readme_numbers.py) -->"
 END = "<!-- BENCH_NUMBERS_END -->"
 
+_PLAN_LINE = re.compile(r"^\[dryrun\] plan (\S+): (.+)$", re.M)
 
-def render(full: dict, artifact_name: str) -> str:
+
+def topology_rows(repo: str = REPO) -> list:
+    """(leg, topology) pairs for the multichip-topology column.
+
+    Primary source: the ``[dryrun] plan <leg>: <axes>`` lines the
+    dryrun prints into the newest MULTICHIP_rNN.json's captured tail —
+    the artifact of record for what actually ran.  Artifacts captured
+    before the dryrun learned to print plans fall back to the
+    committed MULTICHIP_TOPOLOGY.json (same derivation, same
+    rendering), so the column is stable across the transition and only
+    drifts when a topology really changes — which is exactly when the
+    README drift guard SHOULD demand a reviewed regeneration."""
+    def _run_number(path):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        return (int(m.group(1)) if m else -1, path)
+
+    # numeric key: lexicographic sort would pin r99 above r100
+    latest = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")),
+                    key=_run_number)
+    if latest:
+        try:
+            with open(latest[-1]) as f:
+                tail = json.load(f).get("tail", "") or ""
+        except (OSError, ValueError):
+            tail = ""
+        pairs = _PLAN_LINE.findall(tail)
+        if pairs:
+            return sorted(pairs)
+    topo = os.path.join(repo, "MULTICHIP_TOPOLOGY.json")
+    if os.path.exists(topo):
+        with open(topo) as f:
+            legs = json.load(f).get("legs", {})
+        return sorted((leg, row.get("describe", ""))
+                      for leg, row in legs.items())
+    return []
+
+
+def render(full: dict, artifact_name: str, topo: list = None) -> str:
     ex = full.get("extras", {})
     rows = []
 
@@ -105,6 +145,11 @@ def render(full: dict, artifact_name: str) -> str:
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
             f"{z['sharded_vs_dense_device']}x")
+    # multichip topology column: which MeshPlan every dryrun leg ran
+    # under (axis=size(kind) per axis) — a parallelism change becomes
+    # a README diff the drift guard forces through review
+    for leg, topology in (topo or []):
+        row(f"multichip topology — {leg}", f"`{topology}`")
     # sections the committed artifact carries only as explicit skip
     # rows (added after the last full-tier TPU sweep): render a VISIBLE
     # pending marker — bench_gate reads the skip, and the README must
@@ -139,7 +184,9 @@ def main(argv=None):
 
     with open(args.artifact) as f:
         full = json.load(f)
-    block = render(full, os.path.basename(args.artifact))
+    block = render(full, os.path.basename(args.artifact),
+                   topo=topology_rows(os.path.dirname(args.readme)
+                                      or REPO))
 
     with open(args.readme) as f:
         readme = f.read()
