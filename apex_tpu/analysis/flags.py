@@ -322,6 +322,24 @@ register_flag(
     "smallest rung.  max rung x APEX_TPU_SERVE_KV_BLOCK bounds the "
     "servable sequence length.")
 register_flag(
+    "APEX_TPU_SERVE_TICK_EVERY", "int", 1,
+    "Engine-gauge cadence for the serving telemetry layer "
+    "(serving/metrics.py): one kind=\"serve_tick\" event leaves every "
+    "K engine ticks, carrying running batch, active bucket shape, "
+    "free/reserved blocks, queue depth, and the window's admissions/"
+    "evictions/preemptions/compiles — the feed a fleet router "
+    "load-balances on.  Counters accumulate across the window; a "
+    "trailing partial window flushes at run end.", lo=1)
+register_flag(
+    "APEX_TPU_SERVE_SNAPSHOT_FILE", "str", None,
+    "On-demand serving snapshot trigger: touching this file (or "
+    "SIGUSR1 in the --serve driver) dumps the live engine state — "
+    "queue depth, active requests and their progress, pool/"
+    "reservation bookkeeping, compile counts — as ONE engine_snapshot "
+    "JSON event at the next tick boundary (the file is consumed; "
+    "exactly one snapshot per trigger).  The wedged-serve "
+    "post-mortem hook (docs/api/serving.md).")
+register_flag(
     "APEX_TPU_SHARDING_MIN_BYTES", "int", 1024,
     "Size floor for the SPMD auditor's APX701 replication rule "
     "(docs/api/analysis.md): a plan-sharded tensor smaller than this "

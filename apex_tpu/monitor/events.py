@@ -50,18 +50,38 @@ SCHEMA_VERSION = 1
 #:               ``attempt_error`` / ``attempt_backoff`` /
 #:               ``attempt_done`` / ``run_giveup``,
 #:               ``escalation_abort``, ``ckpt_skipped`` / ``ckpt_gc``)
+#:   ``telemetry`` deferred-telemetry drain bookkeeping
+#:               (``telemetry_drain``: rows emitted + drain ordinal)
+#:   ``serving`` request lifecycle + engine events from
+#:               :mod:`apex_tpu.serving` (``request_submitted`` /
+#:               ``request_rejected`` / ``request_admitted`` /
+#:               ``request_first_token`` / ``request_done``,
+#:               ``decode_step``, ``serve_compile``, ``serve_preempt``,
+#:               ``serve_done``, ``engine_snapshot``)
+#:   ``serve_tick`` per-tick engine gauges (batch / bucket shape /
+#:               free+reserved blocks / queue depth / admissions+
+#:               evictions+preemptions this window — the fleet-router
+#:               feed, cadence ``APEX_TPU_SERVE_TICK_EVERY``)
 KINDS = ("run", "metric", "scale", "alarm", "timer", "span", "attr",
-         "trace", "section", "resilience")
+         "trace", "section", "resilience", "telemetry", "serving",
+         "serve_tick")
 
 
 def _jsonable(v: Any) -> Any:
-    """Coerce device scalars / numpy types to plain JSON values."""
+    """Coerce device scalars / numpy types to plain JSON values.
+    Mappings and sequences recurse, so a structured attr (the serving
+    ``engine_snapshot`` request list, rejection-reason counts) lands
+    as real JSON instead of a ``str()`` blob."""
     if v is None or isinstance(v, (bool, int, str)):
         return v
     if isinstance(v, float):
         # bare NaN/Infinity is not valid JSON; encode as a string so
         # every committed line parses everywhere
         return v if math.isfinite(v) else str(v)
+    if isinstance(v, Mapping):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
     try:
         f = float(v)
         return f if math.isfinite(f) else str(f)

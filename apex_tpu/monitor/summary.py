@@ -8,8 +8,11 @@ throughput, loss trajectory, amp overflow history, watchdog alarms,
 resilience lifecycle (preempts / resumes / restart attempts /
 checkpoint-integrity skips), phase-timer totals, wall-time attribution
 (the :mod:`~apex_tpu.monitor.tracing` waterfall: mean/p50/p99 per
-component + worst-step pointer), the captured-traces index, bench
-section outcomes — and :func:`render` prints it as tables.
+component + worst-step pointer), the captured-traces index, the
+serving digest (request lifecycle outcomes, queue-wait/TTFT/ITL
+percentiles, rejection reasons, pool high-water, per-bucket tick
+counts, engine snapshots), bench section outcomes — and
+:func:`render` prints it as tables.
 ``tools/monitor_summary.py`` is the CLI wrapper (``--chrome OUT.json``
 additionally rebuilds a Perfetto-loadable Chrome trace from the log's
 span/timer events).
@@ -213,6 +216,91 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
             digest["gave_up"] = dict(giveup[-1].attrs)
         out["resilience"] = digest
 
+    # serving (request lifecycle + engine gauges) -------------------------
+    srv = [e for e in events if e.kind == "serving"]
+    ticks = [e for e in events if e.kind == "serve_tick"]
+    if srv or ticks:
+        digest: Dict[str, object] = {}
+        done_events = [e for e in srv if e.name == "request_done"]
+        digest["submitted"] = sum(1 for e in srv
+                                  if e.name == "request_submitted")
+        digest["done"] = sum(1 for e in done_events
+                             if not e.attrs.get("preempted"))
+        digest["preempted"] = sum(1 for e in done_events
+                                  if e.attrs.get("preempted"))
+        rejected: Dict[str, int] = {}
+        for e in srv:
+            if e.name == "request_rejected":
+                r = str(e.attrs.get("reason", "unknown"))
+                rejected[r] = rejected.get(r, 0) + 1
+        if rejected:
+            digest["rejected"] = rejected
+        # distributions over the completed requests' terminal events
+        # (queue wait / TTFT) and the decode ticks.  ITL is the tick
+        # wall weighted by the tick's batch — every active request
+        # gains one token per tick, so this is the same population as
+        # the per-request samples ServeSummary.itl_p99_ms (and the
+        # bench_gate serving_itl_p99_ms headline) draw from
+        itl: List[float] = []
+        for e in srv:
+            if e.name == "decode_step" \
+                    and isinstance(e.value, (int, float)):
+                n = e.attrs.get("batch")
+                itl.extend([float(e.value)]
+                           * (n if isinstance(n, int) and n > 0
+                              else 1))
+        series = {
+            "queue_wait_ms": [e.attrs["queue_wait_ms"]
+                              for e in done_events
+                              if isinstance(e.attrs.get(
+                                  "queue_wait_ms"), (int, float))],
+            "ttft_ms": [e.attrs["ttft_ms"] for e in done_events
+                        if isinstance(e.attrs.get("ttft_ms"),
+                                      (int, float))],
+            "itl_ms": itl,
+        }
+        dists: Dict[str, object] = {}
+        for name, vals in series.items():
+            if vals:
+                dists[name] = {"mean": statistics.fmean(vals),
+                               "p50": _pct(vals, 50.0),
+                               "p90": _pct(vals, 90.0),
+                               "p99": _pct(vals, 99.0),
+                               "n": len(vals)}
+        if dists:
+            digest["latency"] = dists
+        # per-bucket tick counts (the compiled-program ladder in use)
+        buckets: Dict[str, int] = {}
+        for e in srv:
+            if e.name != "decode_step":
+                continue
+            bb, pb = e.attrs.get("batch_bucket"), \
+                e.attrs.get("pages_bucket")
+            if bb is not None and pb is not None:
+                key = f"b{bb}xp{pb}"
+                buckets[key] = buckets.get(key, 0) + 1
+        if buckets:
+            digest["bucket_ticks"] = buckets
+        # pool-utilization high-water mark from the engine gauges
+        hw = [e.attrs.get("used_blocks_high_water") for e in ticks]
+        hw = [v for v in hw if isinstance(v, (int, float))]
+        pool = [e.attrs.get("pool_blocks") for e in ticks]
+        pool = [v for v in pool if isinstance(v, (int, float))]
+        if hw:
+            digest["pool_high_water_blocks"] = int(max(hw))
+            if pool and max(pool) > 0:
+                digest["pool_high_water_share"] = \
+                    max(hw) / max(pool)
+        if ticks:
+            digest["gauge_events"] = len(ticks)
+        snaps = [e for e in srv if e.name == "engine_snapshot"]
+        if snaps:
+            digest["snapshots"] = [
+                {"tick": e.step, "reason": e.attrs.get("reason"),
+                 "active": e.attrs.get("active"),
+                 "queued": e.attrs.get("queued")} for e in snaps]
+        out["serving"] = digest
+
     # bench/driver sections ----------------------------------------------
     sections: Dict[str, Dict[str, object]] = {}
     for e in events:
@@ -344,6 +432,48 @@ def render(summary: dict) -> str:
                 f"{_fmt(w['wall_ms'], 2)} ms ("
                 + ", ".join(f"{k[:-3]} {_fmt(v, 2)}" for k, v in top)
                 + ")")
+
+    srv = summary.get("serving")
+    if srv:
+        lines.append("")
+        head = (f"serving: {srv.get('submitted', 0)} submitted, "
+                f"{srv.get('done', 0)} done, "
+                f"{srv.get('preempted', 0)} preempted")
+        rej = srv.get("rejected")
+        if rej:
+            head += (", rejected "
+                     + " ".join(f"{k}={v}"
+                                for k, v in sorted(rej.items())))
+        lines.append(head)
+        dists = srv.get("latency") or {}
+        if dists:
+            lines.append(f"{'series':<16} {'mean ms':>9} {'p50 ms':>9} "
+                         f"{'p90 ms':>9} {'p99 ms':>9} {'n':>6}")
+            for name in ("queue_wait_ms", "ttft_ms", "itl_ms"):
+                d = dists.get(name)
+                if d is None:
+                    continue
+                lines.append(
+                    f"{name[:-3]:<16} {d['mean']:>9.3f} "
+                    f"{d['p50']:>9.3f} {d['p90']:>9.3f} "
+                    f"{d['p99']:>9.3f} {d['n']:>6}")
+        if "pool_high_water_blocks" in srv:
+            share = srv.get("pool_high_water_share")
+            lines.append(
+                f"  pool high water: "
+                f"{srv['pool_high_water_blocks']} block(s)"
+                + (f" ({100.0 * share:.0f}% of pool)"
+                   if share is not None else ""))
+        bt = srv.get("bucket_ticks")
+        if bt:
+            lines.append("  ticks per bucket: "
+                         + " ".join(f"{k}={v}"
+                                    for k, v in sorted(bt.items())))
+        for s in srv.get("snapshots", []):
+            lines.append(f"  SNAPSHOT @ tick {s.get('tick')} "
+                         f"[{s.get('reason')}]: "
+                         f"{s.get('active')} active, "
+                         f"{s.get('queued')} queued")
 
     caps = summary.get("captures")
     if caps:

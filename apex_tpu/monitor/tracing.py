@@ -66,6 +66,8 @@ __all__ = [
     "DeviceMetricsBuffer", "MetricsBufferState", "DeferredTelemetry",
     "CaptureTrigger", "TraceSession",
     "chrome_trace_from_events", "write_chrome_trace", "check_trace",
+    "SERVE_PHASES", "serve_lane_events", "serve_lanes_from_events",
+    "serve_chrome_trace", "check_serve_trace",
 ]
 
 
@@ -324,9 +326,11 @@ def chrome_trace_from_events(events) -> dict:
     become host ``X`` (complete) events; ``timer`` events (phase times
     exported by ``Timers.events`` — value in seconds, stamped at stop)
     become complete events ending at their emission time on a synthetic
-    ``timers`` track.  The read-side join: any committed run JSONL can
-    be turned back into a Perfetto-loadable timeline
-    (``tools/monitor_summary.py --chrome OUT.json``)."""
+    ``timers`` track; serving ``request_done`` lifecycle events become
+    one per-request lane each with queued/prefill/decode phases
+    (:func:`serve_lanes_from_events`).  The read-side join: any
+    committed run JSONL can be turned back into a Perfetto-loadable
+    timeline (``tools/monitor_summary.py --chrome OUT.json``)."""
     pid = os.getpid()
     out: List[dict] = []
     timer_tid = 1
@@ -353,7 +357,205 @@ def chrome_trace_from_events(events) -> dict:
             if e.step is not None:
                 ev["args"] = {"step": e.step}
             out.append(ev)
+    out.extend(serve_lanes_from_events(events, pid=pid))
     return _chrome_json(out, pid=pid)
+
+
+# ---------------------------------------------------------------------------
+# Serving request lanes (apex_tpu.serving.metrics is the write side)
+# ---------------------------------------------------------------------------
+
+#: Per-request lane phases, in lifecycle order.  ``queued`` is
+#: submit → admission start, ``prefill`` admission → first token,
+#: ``decode`` first token → terminal — contiguous sub-intervals of the
+#: request wall, so the lane IS the request's waterfall.
+SERVE_PHASES = ("queued", "prefill", "decode")
+
+#: tid offset for request lanes so they sort below the host-span and
+#: timer tracks in Perfetto
+_SERVE_LANE_TID0 = 1000
+
+
+def serve_lane_events(rows: List[dict], *,
+                      pid: Optional[int] = None) -> List[dict]:
+    """Chrome trace events (one lane per request) from lane rows —
+    ``{rid, end (epoch s), queue_wait_ms, prefill_ms, decode_ms,
+    new_tokens, preempted, tick}`` as produced by
+    :meth:`apex_tpu.serving.metrics.RequestTrace.lane_row` (exact
+    timestamps) or reconstructed from terminal events
+    (:func:`serve_lanes_from_events`).  ``prefill_ms``/``decode_ms``
+    are None for a request preempted before admission (its lane is
+    queue wait only)."""
+    pid = os.getpid() if pid is None else pid
+    out: List[dict] = []
+    for i, r in enumerate(rows):
+        if r.get("end") is None:
+            continue
+        tid = _SERVE_LANE_TID0 + i
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"req {r['rid']}"}})
+        parts = [(p, r.get(_ATTR_FOR_PHASE[p]))
+                 for p in SERVE_PHASES]
+        total_ms = sum(v for _, v in parts
+                       if isinstance(v, (int, float)))
+        t = r["end"] * 1e6 - total_ms * 1e3   # lane start, us
+        args = {"rid": r["rid"]}
+        for k in ("new_tokens", "preempted", "tick"):
+            if r.get(k) is not None:
+                args[k] = r[k]
+        for phase, ms in parts:
+            if not isinstance(ms, (int, float)):
+                continue
+            out.append({"name": phase, "ph": "X", "cat": "serve",
+                        "ts": round(t, 3),
+                        "dur": round(ms * 1e3, 3),
+                        "pid": pid, "tid": tid, "args": args})
+            t += ms * 1e3
+    return out
+
+
+_ATTR_FOR_PHASE = {"queued": "queue_wait_ms", "prefill": "prefill_ms",
+                   "decode": "decode_ms"}
+
+
+def serve_lanes_from_events(events, *,
+                            pid: Optional[int] = None) -> List[dict]:
+    """Rebuild per-request Chrome lanes from a run JSONL's serving
+    lifecycle events: each terminal ``request_done`` carries the whole
+    queued/prefill/decode breakdown, anchored backwards from its own
+    emission time.  (The write-side export —
+    ``ServeMetrics.chrome_trace`` — uses the exact engine-clock
+    timestamps instead; the two agree to within the emit latency.)"""
+    rows = []
+    for e in events:
+        if e.kind != "serving" or e.name != "request_done":
+            continue
+        a = e.attrs
+        rows.append({
+            "rid": a.get("rid"),
+            "end": e.time,
+            "queue_wait_ms": a.get("queue_wait_ms"),
+            "prefill_ms": (a.get("prefill_ms")
+                           if "ttft_ms" in a else None),
+            "decode_ms": (a.get("decode_ms")
+                          if "ttft_ms" in a else None),
+            "new_tokens": a.get("new_tokens"),
+            "preempted": a.get("preempted"),
+            "tick": e.step,
+        })
+    return serve_lane_events(rows, pid=pid)
+
+
+def serve_chrome_trace(rows: List[dict]) -> dict:
+    """Chrome trace-event JSON object holding only request lanes (the
+    ``--serve --trace`` artifact; write with
+    :func:`write_chrome_trace`)."""
+    pid = os.getpid()
+    return _chrome_json(serve_lane_events(rows, pid=pid), pid=pid)
+
+
+def check_serve_trace(jsonl_path: str,
+                      chrome_path: Optional[str] = None, *,
+                      tolerance: float = 0.02) -> List[str]:
+    """Validate a serve run's telemetry (``tools/trace_check.py
+    --serve``, ci.sh step 11).  Returns failure strings (empty =
+    pass):
+
+    * lifecycle completeness — every submitted rid ends in exactly one
+      terminal ``request_done`` (N submitted ⇒ N terminal events), no
+      terminal without a submit;
+    * TTFT present for every non-preempted rid (``request_first_token``
+      event + ``ttft_ms`` on the terminal);
+    * per-request attribution — ``queue_wait + prefill + decode`` sums
+      to the rid's ``wall_ms`` within ``tolerance``;
+    * engine gauges — a run that decoded must carry ``serve_tick``
+      events;
+    * the Chrome artifact (when given) parses and carries one lane per
+      terminal rid with the canonical queued/prefill/decode phases.
+    """
+    from .summary import load_events
+
+    failures: List[str] = []
+    events, malformed = load_events(jsonl_path)
+    if malformed:
+        failures.append(f"{malformed} malformed line(s) in "
+                        f"{jsonl_path}")
+    srv = [e for e in events if e.kind == "serving"]
+    submitted = [str(e.attrs.get("rid")) for e in srv
+                 if e.name == "request_submitted"]
+    terminal: Dict[str, int] = {}
+    done_events = {}
+    for e in srv:
+        if e.name == "request_done":
+            rid = str(e.attrs.get("rid"))
+            terminal[rid] = terminal.get(rid, 0) + 1
+            done_events[rid] = e
+    first_token = {str(e.attrs.get("rid")) for e in srv
+                   if e.name == "request_first_token"}
+    if not submitted:
+        failures.append("no request_submitted events — not a serve "
+                        "run log?")
+    for rid in submitted:
+        n = terminal.get(rid, 0)
+        if n != 1:
+            failures.append(f"rid {rid}: {n} terminal request_done "
+                            f"event(s), want exactly 1")
+    for rid in terminal:
+        if rid not in submitted:
+            failures.append(f"rid {rid}: terminal event without a "
+                            f"request_submitted")
+    for rid, e in sorted(done_events.items()):
+        a = e.attrs
+        if not a.get("preempted"):
+            if "ttft_ms" not in a:
+                failures.append(f"rid {rid}: finished without a "
+                                f"ttft_ms — TTFT must exist for "
+                                f"every non-preempted request")
+            if rid not in first_token:
+                failures.append(f"rid {rid}: no request_first_token "
+                                f"event in the chain")
+        wall = a.get("wall_ms")
+        if isinstance(wall, (int, float)) and wall > 0:
+            parts = sum(float(a.get(k) or 0.0)
+                        for k in ("queue_wait_ms", "prefill_ms",
+                                  "decode_ms"))
+            if abs(parts - wall) > tolerance * wall + 1e-3:
+                failures.append(
+                    f"rid {rid}: queued+prefill+decode "
+                    f"{parts:.3f} ms != wall {wall:.3f} ms "
+                    f"(> {tolerance:.0%})")
+    decoded = any(e.name == "decode_step" for e in srv)
+    gauges = [e for e in events if e.kind == "serve_tick"]
+    if decoded and not gauges:
+        failures.append("run decoded but emitted no serve_tick "
+                        "engine gauges")
+    if chrome_path is not None:
+        try:
+            with open(chrome_path) as f:
+                trace = json.load(f)
+            evs = trace.get("traceEvents", [])
+            lanes: Dict[str, set] = {}
+            for t in evs:
+                if t.get("ph") == "X" and t.get("cat") == "serve":
+                    rid = str((t.get("args") or {}).get("rid"))
+                    lanes.setdefault(rid, set()).add(t.get("name"))
+            for rid, e in sorted(done_events.items()):
+                phases = lanes.get(rid)
+                if phases is None:
+                    failures.append(f"{chrome_path}: no lane for "
+                                    f"rid {rid}")
+                    continue
+                want = {"queued"}
+                if "ttft_ms" in e.attrs:
+                    want = set(SERVE_PHASES)
+                miss = sorted(want - phases)
+                if miss:
+                    failures.append(f"{chrome_path}: rid {rid} lane "
+                                    f"missing phase(s) {miss}")
+        except (OSError, json.JSONDecodeError) as e:
+            failures.append(f"{chrome_path}: unreadable Chrome trace "
+                            f"({e})")
+    return failures
 
 
 # ---------------------------------------------------------------------------
@@ -1069,14 +1271,33 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=None, metavar="N",
                     help="with --scan-k: require ceil(N/K) window "
                          "rows covering exactly N steps")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-run mode: validate the per-request "
+                         "lifecycle chains (every submitted rid ends "
+                         "in exactly one terminal event, TTFT present "
+                         "for every non-preempted rid, "
+                         "queued+prefill+decode sums to the request "
+                         "wall), engine gauges, and the per-request "
+                         "Chrome lanes instead of the train-loop "
+                         "waterfall")
     args = ap.parse_args(argv)
-    failures = check_trace(args.jsonl, args.chrome,
-                           tolerance=args.tolerance,
-                           scan_k=args.scan_k, steps=args.steps)
+    if args.serve:
+        failures = check_serve_trace(args.jsonl, args.chrome,
+                                     tolerance=args.tolerance)
+    else:
+        failures = check_trace(args.jsonl, args.chrome,
+                               tolerance=args.tolerance,
+                               scan_k=args.scan_k, steps=args.steps)
     for f in failures:
         print(f"[trace-check] FAIL: {f}", file=sys.stderr)
     if failures:
         return 1
+    if args.serve:
+        print(f"[trace-check] OK: {args.jsonl} carries complete "
+              "request lifecycle chains"
+              + (f"; {args.chrome} carries the per-request lanes"
+                 if args.chrome else ""))
+        return 0
     print(f"[trace-check] OK: {args.jsonl} carries the canonical "
           "waterfall"
           + (f" ({-(-args.steps // args.scan_k)} K={args.scan_k} "

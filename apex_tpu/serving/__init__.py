@@ -10,6 +10,11 @@ The serving counterpart of the training pipeline (ROADMAP item 1):
 * :mod:`.engine` — continuous batching: bucket-laddered jitted steps,
   reservation admission, SIGTERM clean drain, tokens/s + p50/p99
   metrics (:class:`ServingEngine`).
+* :mod:`.metrics` — per-request lifecycle telemetry (queue wait /
+  TTFT / ITL distributions, Perfetto request lanes), per-tick engine
+  gauges (``serve_tick``), and the on-demand engine snapshot
+  (:class:`ServeMetrics`, :class:`EngineGauges`,
+  :class:`SnapshotTrigger`).
 
 Entry point: ``python -m apex_tpu.testing.standalone_gpt --serve``;
 docs/api/serving.md walks the architecture.
@@ -20,6 +25,8 @@ from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
                        KVCacheManager, PagedKVCache, init_cache,
                        quantize_kv_rows, write_prefill_kv,
                        write_token_kv)
+from .metrics import (EngineGauges, RequestTrace, ServeMetrics,
+                      SnapshotTrigger)
 from .model import (GPTServingWeights, LayerWeights,
                     ServingModelConfig, extract_serving_weights,
                     gpt_decode_step, gpt_prefill_step)
@@ -32,4 +39,5 @@ __all__ = [
     "quantize_kv_rows", "write_prefill_kv", "write_token_kv",
     "GPTServingWeights", "LayerWeights", "ServingModelConfig",
     "extract_serving_weights", "gpt_decode_step", "gpt_prefill_step",
+    "EngineGauges", "RequestTrace", "ServeMetrics", "SnapshotTrigger",
 ]

@@ -50,6 +50,7 @@ import numpy as np
 from ..analysis.flags import flag_int, flag_str
 from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
                        init_cache)
+from .metrics import ServeMetrics
 from .model import (GPTServingWeights, ServingModelConfig,
                     gpt_decode_step, gpt_prefill_step)
 
@@ -149,6 +150,20 @@ class ServeSummary:
     latency_p99_ms: Optional[float]
     compiles: Dict[str, int]
     drained: bool = False
+    # per-request lifecycle distributions (serving/metrics.py, bounded
+    # windows): admission queue wait, time-to-first-token, and
+    # inter-token latency percentiles; None until a series has samples
+    queue_wait_p50_ms: Optional[float] = None
+    queue_wait_p99_ms: Optional[float] = None
+    ttft_p50_ms: Optional[float] = None
+    ttft_p99_ms: Optional[float] = None
+    itl_p50_ms: Optional[float] = None
+    itl_p99_ms: Optional[float] = None
+    # submits the engine refused, by reason (ladder_span / max_seq /
+    # empty_prompt / max_new_tokens) — rejected requests never enter
+    # the queue and never get lifecycle chains
+    requests_rejected: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -181,6 +196,8 @@ class ServingEngine:
                  cache_cfg: KVCacheConfig, *,
                  ladder: Optional[BucketLadder] = None,
                  monitor=None, autoresume=None,
+                 tick_every: Optional[int] = None,
+                 snapshot=None,
                  clock: Callable[[], float] = time.perf_counter):
         self.weights = weights
         self.model_cfg = model_cfg
@@ -195,6 +212,14 @@ class ServingEngine:
         self.monitor = monitor
         self.autoresume = autoresume
         self._clock = clock
+        # request-lifecycle + gauge telemetry (serving/metrics.py):
+        # pure host bookkeeping through the monitor sinks — no device
+        # traffic, so the one-fetch-per-tick budget is untouched.
+        # ``snapshot`` is an optional metrics.SnapshotTrigger polled
+        # at every tick boundary (the --serve driver wires SIGUSR1).
+        self.metrics = ServeMetrics(monitor=monitor, clock=clock,
+                                    tick_every=tick_every)
+        self.snapshot = snapshot
         self.manager = KVCacheManager(cache_cfg)
         self.cache = init_cache(cache_cfg)
         self.queue: deque = deque()
@@ -296,29 +321,41 @@ class ServingEngine:
 
     # --- request lifecycle --------------------------------------------
 
+    def _reject(self, request: Request, reason: str,
+                msg: str) -> None:
+        """Refuse a submit: counted + emitted (``request_rejected``,
+        the summary's ``requests_rejected`` reasons) before the raise,
+        so a caller swallowing the ValueError still leaves an audit
+        trail."""
+        self.metrics.on_reject(request.rid, reason, self.steps)
+        raise ValueError(msg)
+
     def submit(self, request: Request) -> None:
         if len(request.prompt) < 1:
-            raise ValueError(f"request {request.rid!r}: empty prompt")
+            self._reject(request, "empty_prompt",
+                         f"request {request.rid!r}: empty prompt")
         if request.max_new_tokens < 1:
             # prefill always emits one token, and a negative budget
             # would undercount the reservation _can_admit sizes —
             # admission could then exhaust the pool mid-decode
-            raise ValueError(
+            self._reject(
+                request, "max_new_tokens",
                 f"request {request.rid!r}: max_new_tokens "
                 f"{request.max_new_tokens} < 1")
         limit = self.ladder.max_pages * self.cache_cfg.block_size
         worst = len(request.prompt) + request.max_new_tokens
         if worst > limit:
-            raise ValueError(
+            self._reject(
+                request, "ladder_span",
                 f"request {request.rid!r}: prompt + max_new_tokens = "
                 f"{worst} exceeds the ladder's {limit}-token span")
         if worst > self.model_cfg.max_seq:
-            raise ValueError(
+            self._reject(
+                request, "max_seq",
                 f"request {request.rid!r}: {worst} tokens exceed the "
                 f"model's max_seq {self.model_cfg.max_seq}")
         self.queue.append(request)
-        self._event("request_submitted", rid=str(request.rid),
-                    prompt_len=len(request.prompt))
+        self.metrics.on_submit(request, self.steps)
 
     def _reserved_blocks(self) -> int:
         """Blocks the free pool already owes to active requests: each
@@ -362,8 +399,10 @@ class ServingEngine:
         req.admitted_at_step = self.steps
         self.active[req.rid] = req
         self.prefill_tokens += p_len
-        self._event("request_admitted", value=round(dt * 1e3, 2),
-                    rid=str(req.rid), prompt_len=p_len, s_pad=s_pad)
+        # request_admitted (queue wait) + request_first_token (TTFT):
+        # t0 is the instant queue wait ended and prefill began
+        self.metrics.on_admit(req, self.steps, t0, dt,
+                              prompt_len=p_len, s_pad=s_pad)
 
     def _finish(self, req: Request) -> None:
         self.manager.free(req.rid)
@@ -374,9 +413,9 @@ class ServingEngine:
         else:
             self._done_count += 1
         self._done_tokens += len(req.out_tokens)
-        self._event("request_done", rid=str(req.rid),
-                    new_tokens=len(req.out_tokens),
-                    preempted=req.preempted)
+        # terminal lifecycle event (request_done) with the full
+        # queued/prefill/decode breakdown
+        self.metrics.on_done(req, self.steps)
 
     def _terminating(self) -> bool:
         return (self.autoresume is not None
@@ -437,7 +476,59 @@ class ServingEngine:
         self.steps += 1
         self._event("decode_step", value=round(dt * 1e3, 3),
                     batch=n, batch_bucket=bb, pages_bucket=pb)
+        self._tick_tail(n, bb, pb)
         return n
+
+    def _tick_tail(self, batch: int, bb: int, pb: int) -> None:
+        """Per-tick telemetry boundary: engine gauges on the
+        registered cadence, snapshot-trigger poll, and the watchdog
+        stall heartbeat — all host bookkeeping the engine already
+        holds, after the tick's one device fetch."""
+        self.metrics.on_tick(
+            self.steps, batch=batch, batch_bucket=bb,
+            pages_bucket=pb,
+            free_blocks=self.manager.free_blocks,
+            used_blocks=self.manager.used_blocks,
+            reserved_blocks=self._reserved_blocks(),
+            pool_blocks=self.cache_cfg.usable_blocks,
+            queue_depth=len(self.queue),
+            compiles=sum(self._compiles.values()))
+        if self.snapshot is not None:
+            self.snapshot.poll(self.steps, self.snapshot_state,
+                               self.monitor)
+        # the serve loop's stall heartbeat: each tick feeds the same
+        # Watchdog the training loops drive through StepMonitor, so a
+        # wedged decode step raises the once-per-episode stall alarm
+        # (with the optional jax.profiler capture) mid-serve
+        wd = getattr(self.monitor, "watchdog", None)
+        if wd is not None:
+            wd.observe_step(self.steps)
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Live engine state as one JSON-able dict — what the
+        on-demand :class:`~apex_tpu.serving.metrics.SnapshotTrigger`
+        dumps as an ``engine_snapshot`` event for a wedged serve."""
+        return {
+            "tick": self.steps,
+            "active": len(self.active),
+            "queued": len(self.queue),
+            "done": self._done_count,
+            "preempted": self._preempted_count,
+            "free_blocks": self.manager.free_blocks,
+            "used_blocks": self.manager.used_blocks,
+            "reserved_blocks": self._reserved_blocks(),
+            "used_blocks_high_water":
+                self.metrics.gauges.used_blocks_hw,
+            "pool_blocks": self.cache_cfg.usable_blocks,
+            "compiles": sum(self._compiles.values()),
+            "requests": [
+                {"rid": str(rid),
+                 "seq_len": self.manager.seq_len(rid),
+                 "new_tokens": len(q.out_tokens),
+                 "max_new_tokens": q.max_new_tokens}
+                for rid, q in sorted(self.active.items(),
+                                     key=lambda kv: str(kv[0]))],
+        }
 
     def run(self, *, max_steps: Optional[int] = None,
             before_tick: Optional[Callable[[int], None]] = None,
@@ -472,13 +563,13 @@ class ServingEngine:
                 while self.queue:
                     # accepted but never admitted: no blocks to free,
                     # but the drain still accounts for every request —
-                    # preempted, in ``done``, with a request_done event
+                    # preempted, in ``done``, with a complete
+                    # lifecycle chain whose wall was all queue wait
                     q = self.queue.popleft()
                     q.preempted = True
                     self.done.append(q)
                     self._preempted_count += 1
-                    self._event("request_done", rid=str(q.rid),
-                                new_tokens=0, preempted=True)
+                    self.metrics.on_done(q, self.steps)
                 self._event("serve_preempt",
                             source=self.autoresume.source)
                 break
@@ -491,9 +582,13 @@ class ServingEngine:
             if after_tick is not None:
                 after_tick(self.steps)
         self._run_wall_s += self._clock() - t0
+        # a trailing partial gauge window (tick_every > 1) flushes so
+        # the final engine state is always in the log
+        self.metrics.flush_gauges(self.steps)
         wall = max(self._run_wall_s, 1e-9)
         gen = self._done_tokens \
             + sum(len(q.out_tokens) for q in self.active.values())
+        pct = self.metrics.percentiles()
         summary = ServeSummary(
             requests_done=self._done_count,
             requests_preempted=self._preempted_count,
@@ -509,7 +604,14 @@ class ServingEngine:
             latency_p50_ms=_round_ms(_percentile(self._latencies, 50)),
             latency_p99_ms=_round_ms(_percentile(self._latencies, 99)),
             compiles=dict(self._compiles),
-            drained=drained)
+            drained=drained,
+            queue_wait_p50_ms=pct["queue_wait_p50_ms"],
+            queue_wait_p99_ms=pct["queue_wait_p99_ms"],
+            ttft_p50_ms=pct["ttft_p50_ms"],
+            ttft_p99_ms=pct["ttft_p99_ms"],
+            itl_p50_ms=pct["itl_p50_ms"],
+            itl_p99_ms=pct["itl_p99_ms"],
+            requests_rejected=dict(self.metrics.rejected))
         self._event("serve_done", value=summary.tokens_per_sec,
                     **{k: v for k, v in summary.as_dict().items()
                        if k not in ("compiles", "tokens_per_sec")})

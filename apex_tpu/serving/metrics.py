@@ -1,0 +1,511 @@
+"""Per-request serving telemetry: request lifecycle, engine gauges,
+and the on-demand engine snapshot.
+
+PR 9's :class:`~apex_tpu.serving.engine.ServeSummary` reports lifetime
+totals — a request that waited 800 ms in the admission queue and one
+admitted instantly are indistinguishable.  This module gives the
+engine the Orca/vLLM serving vocabulary (queue wait, time-to-first-
+token, inter-token latency) with the same sync-free discipline as the
+PR-7 tracer: every number here is host bookkeeping the engine already
+holds, so the one-fetch-per-tick budget and the zero-recompile
+contract are untouched.  Three pieces:
+
+* :class:`RequestTrace` / :class:`ServeMetrics` — every request emits
+  a monotonic lifecycle chain through the monitor sinks
+  (``request_submitted → request_admitted → request_first_token →
+  request_done``; a rejected submit emits ``request_rejected``
+  instead, and a drained request ends in ``request_done`` with
+  ``preempted=true``), each event stamped with host wall time and the
+  engine tick index.  The terminal event carries the whole per-request
+  timing breakdown (``queue_wait_ms + prefill_ms + decode_ms ==
+  wall_ms`` by construction, from one clock), from which the summary
+  derives queue-wait / TTFT / ITL / decode-tokens-per-sec
+  distributions over a bounded window, and from which the Chrome
+  export (:func:`apex_tpu.monitor.tracing.serve_lanes_from_events`)
+  rebuilds one Perfetto lane per request with queued/prefill/decode
+  phases.
+* :class:`EngineGauges` — one ``kind="serve_tick"`` event per engine
+  tick (or every K ticks, ``APEX_TPU_SERVE_TICK_EVERY``): running
+  batch, active bucket shape, free/reserved blocks, queue depth,
+  admissions/evictions/preemptions this window, compile count — the
+  feed a fleet router load-balances on (ROADMAP item 1).
+* :class:`SnapshotTrigger` — file-touch or SIGUSR1 dumps the live
+  engine state as ONE ``engine_snapshot`` JSON event at the next tick
+  boundary (exactly one per trigger; the same flag-only-handler
+  discipline as :class:`~apex_tpu.monitor.tracing.CaptureTrigger`) —
+  the wedged-serve post-mortem hook.
+
+All clocks are injectable (fake-clock tests in
+tests/test_serving_metrics.py); the read side — ``monitor_summary``'s
+serving section and ``tools/trace_check.py --serve`` — lives in
+:mod:`apex_tpu.monitor`.  Worked example: docs/api/serving.md.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..analysis.flags import flag_int, flag_str
+from ..monitor.summary import _pct
+from ..monitor.tracing import serve_chrome_trace
+from ..utils.log_util import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["RequestTrace", "ServeMetrics", "EngineGauges",
+           "SnapshotTrigger"]
+
+# distribution samples kept per series (queue-wait / ttft / itl /
+# per-request decode tok/s) — same bound as the engine's per-token
+# latency window, so a weeks-long serve keeps host memory flat
+_SAMPLE_WINDOW = 100_000
+# completed RequestTrace records kept for the Chrome lane export (the
+# JSONL event log is the complete record; the in-memory list backs
+# the artifact a driver writes at close)
+_TRACE_WINDOW = 10_000
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    """One request's lifecycle timestamps, all on the engine clock.
+
+    The phase boundaries are shared instants — queue wait ends exactly
+    where prefill starts, prefill where decode starts — so
+    ``queue_wait_s + prefill_s + decode_s == wall_s`` holds by
+    construction (the 2% tolerance in the checkers covers float
+    rounding of the exported milliseconds, nothing else)."""
+
+    rid: str
+    prompt_len: int
+    submit_t: float
+    submit_tick: int
+    admit_t: Optional[float] = None
+    admit_tick: Optional[int] = None
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    done_tick: Optional[int] = None
+    done_wall: Optional[float] = None   # epoch seconds (Chrome anchor)
+    new_tokens: int = 0
+    preempted: bool = False
+
+    @property
+    def admitted(self) -> bool:
+        return self.admit_t is not None
+
+    @property
+    def queue_wait_s(self) -> float:
+        """Submit → admission start (for a never-admitted request the
+        whole wall was queue wait)."""
+        end = self.admit_t if self.admitted else self.done_t
+        return max(0.0, (end or self.submit_t) - self.submit_t)
+
+    @property
+    def prefill_s(self) -> float:
+        if not self.admitted:
+            return 0.0
+        return max(0.0, self.first_token_t - self.admit_t)
+
+    @property
+    def decode_s(self) -> float:
+        if not self.admitted or self.done_t is None:
+            return 0.0
+        return max(0.0, self.done_t - self.first_token_t)
+
+    @property
+    def wall_s(self) -> float:
+        return max(0.0, (self.done_t or self.submit_t) - self.submit_t)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Submit → first generated token (the prefill output token);
+        None for a request preempted before admission."""
+        if not self.admitted:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def decode_tokens_per_sec(self) -> Optional[float]:
+        """Steady-state decode rate (tokens after the first over the
+        decode span); None until >= 2 tokens exist."""
+        if self.new_tokens < 2 or self.decode_s <= 0.0:
+            return None
+        return (self.new_tokens - 1) / self.decode_s
+
+    def lane_row(self) -> Dict[str, Any]:
+        """The Chrome-lane row shape
+        :func:`apex_tpu.monitor.tracing.serve_lane_events` consumes."""
+        return {
+            "rid": self.rid,
+            "end": self.done_wall,
+            "queue_wait_ms": self.queue_wait_s * 1e3,
+            "prefill_ms": self.prefill_s * 1e3 if self.admitted
+            else None,
+            "decode_ms": self.decode_s * 1e3 if self.admitted
+            else None,
+            "new_tokens": self.new_tokens,
+            "preempted": self.preempted,
+            "tick": self.done_tick,
+        }
+
+
+def _percentile(xs, q: float) -> Optional[float]:
+    """Empty-tolerant facade over the summary renderer's
+    linear-interpolation percentile (one implementation of the math,
+    same method as np.percentile's default — the engine's latency
+    series and these stay comparable)."""
+    s = list(xs)
+    if not s:
+        return None
+    return float(_pct(s, q))
+
+
+class EngineGauges:
+    """Tick-gauge accumulator + cadence: the engine reports every tick,
+    one ``serve_tick`` event leaves every ``every`` ticks (counters —
+    admissions/evictions/preemptions/compiles — accumulate across the
+    window; level gauges — batch, buckets, pool, queue — carry the
+    window's last tick).  A trailing partial window flushes at run
+    end, so the final engine state is always in the log."""
+
+    def __init__(self, every: int = 1):
+        self.every = max(1, int(every))
+        self.emitted = 0
+        self.used_blocks_hw = 0
+        self._ticks = 0
+        self._admitted = 0
+        self._finished = 0
+        self._preempted = 0
+        self._compiles_seen = 0
+        self._last: Optional[Dict[str, Any]] = None
+
+    def on_admit(self) -> None:
+        self._admitted += 1
+
+    def on_finish(self, preempted: bool) -> None:
+        if preempted:
+            self._preempted += 1
+        else:
+            self._finished += 1
+
+    def observe(self, tick: int, **levels) -> Optional[Dict[str, Any]]:
+        """Record one engine tick's level gauges; returns the event
+        attrs when the cadence says this tick emits, else None."""
+        self._ticks += 1
+        self.used_blocks_hw = max(self.used_blocks_hw,
+                                  int(levels.get("used_blocks", 0)))
+        self._last = dict(levels, last_tick=tick)
+        if self._ticks >= self.every:
+            return self._roll()
+        return None
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Close a trailing partial window (None when nothing is
+        pending).  A window may hold counters but zero ticks: the
+        run's final evictions happen in a tick that decodes nothing,
+        so the flush is how they reach the log."""
+        if self._ticks == 0 and not (self._admitted or self._finished
+                                     or self._preempted):
+            return None
+        return self._roll()
+
+    def _roll(self) -> Dict[str, Any]:
+        attrs = dict(self._last or {})
+        compiles = int(attrs.get("compiles", self._compiles_seen))
+        attrs.update(
+            ticks=self._ticks,
+            admitted=self._admitted,
+            finished=self._finished,
+            preempted=self._preempted,
+            new_compiles=compiles - self._compiles_seen,
+            used_blocks_high_water=self.used_blocks_hw,
+        )
+        self._compiles_seen = compiles
+        self._ticks = 0
+        self._admitted = self._finished = self._preempted = 0
+        self.emitted += 1
+        return attrs
+
+
+class ServeMetrics:
+    """The engine's request-lifecycle + gauge telemetry layer.
+
+    Owned by :class:`~apex_tpu.serving.engine.ServingEngine`; every
+    hook is host-only bookkeeping (clock reads + dict/deque updates)
+    and emission goes through the engine's monitor (anything with the
+    ``StepMonitor.event`` signature; None records distributions but
+    emits nothing — the bench path).  Timestamps use the engine's
+    injectable monotonic clock, wall-anchored once at construction
+    (the :class:`~apex_tpu.monitor.tracing.SpanTracer` trick) so
+    exported Chrome lanes line up with device traces captured in the
+    same process."""
+
+    def __init__(self, *, monitor=None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 wall_clock: Callable[[], float] = time.time,
+                 tick_every: Optional[int] = None,
+                 window: int = _SAMPLE_WINDOW,
+                 trace_window: int = _TRACE_WINDOW):
+        self._monitor = monitor
+        self._clock = clock
+        self._perf0 = clock()
+        self._wall0 = wall_clock()
+        self.gauges = EngineGauges(
+            tick_every if tick_every is not None
+            else flag_int("APEX_TPU_SERVE_TICK_EVERY"))
+        self._open: Dict[str, RequestTrace] = {}
+        self.completed: deque = deque(maxlen=trace_window)
+        self.rejected: Dict[str, int] = {}
+        self._queue_wait_ms: deque = deque(maxlen=window)
+        self._ttft_ms: deque = deque(maxlen=window)
+        self._itl_ms: deque = deque(maxlen=window)
+        self._decode_tps: deque = deque(maxlen=window)
+
+    # -- emission ------------------------------------------------------------
+
+    def _emit(self, kind: str, name: str, value=None,
+              tick: Optional[int] = None, **attrs) -> None:
+        if self._monitor is not None:
+            self._monitor.event(kind, name, value=value, step=tick,
+                                **attrs)
+
+    def _wall_at(self, t: float) -> float:
+        return self._wall0 + (t - self._perf0)
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def on_reject(self, rid, reason: str, tick: int) -> None:
+        """A submit the engine refused (before it entered the queue)."""
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        self._emit("serving", "request_rejected", tick=tick,
+                   rid=str(rid), reason=reason)
+
+    def on_submit(self, request, tick: int) -> None:
+        t = self._clock()
+        self._open[str(request.rid)] = RequestTrace(
+            rid=str(request.rid), prompt_len=len(request.prompt),
+            submit_t=t, submit_tick=tick)
+        self._emit("serving", "request_submitted", tick=tick,
+                   rid=str(request.rid),
+                   prompt_len=len(request.prompt))
+
+    def on_admit(self, request, tick: int, admit_t: float,
+                 prefill_s: float, **attrs) -> None:
+        """Admission completed: ``admit_t`` is the engine-clock instant
+        admission (prefill) started, ``prefill_s`` its duration — the
+        first generated token exists at ``admit_t + prefill_s``.
+        Emits ``request_admitted`` (value = prefill ms, plus the
+        queue wait) and ``request_first_token`` (value = TTFT ms)."""
+        tr = self._open.get(str(request.rid))
+        if tr is None:  # engine-internal admit without a submit record
+            tr = RequestTrace(rid=str(request.rid),
+                              prompt_len=len(request.prompt),
+                              submit_t=admit_t, submit_tick=tick)
+            self._open[tr.rid] = tr
+        tr.admit_t = admit_t
+        tr.admit_tick = tick
+        tr.first_token_t = admit_t + prefill_s
+        qw_ms = tr.queue_wait_s * 1e3
+        ttft_ms = tr.ttft_s * 1e3
+        self._queue_wait_ms.append(qw_ms)
+        self._ttft_ms.append(ttft_ms)
+        self.gauges.on_admit()
+        self._emit("serving", "request_admitted",
+                   value=round(prefill_s * 1e3, 3), tick=tick,
+                   rid=tr.rid, queue_wait_ms=round(qw_ms, 3), **attrs)
+        self._emit("serving", "request_first_token",
+                   value=round(ttft_ms, 3), tick=tick, rid=tr.rid,
+                   ttft_ms=round(ttft_ms, 3),
+                   queue_wait_ms=round(qw_ms, 3),
+                   prefill_ms=round(prefill_s * 1e3, 3))
+
+    def on_done(self, request, tick: int) -> None:
+        """Terminal: finished or preempted (``request.preempted``) —
+        every submitted rid ends in exactly one of these."""
+        tr = self._open.pop(str(request.rid), None)
+        if tr is None:
+            tr = RequestTrace(rid=str(request.rid),
+                              prompt_len=len(request.prompt),
+                              submit_t=self._clock(), submit_tick=tick)
+        t = self._clock()
+        tr.done_t = t
+        tr.done_tick = tick
+        tr.done_wall = self._wall_at(t)
+        tr.new_tokens = len(request.out_tokens)
+        tr.preempted = bool(request.preempted)
+        # the first latency sample is the prefill; the rest are decode
+        # ticks — the per-request inter-token latencies
+        for itl in getattr(request, "token_latency_s", [])[1:]:
+            self._itl_ms.append(itl * 1e3)
+        tps = tr.decode_tokens_per_sec
+        if tps is not None:
+            self._decode_tps.append(tps)
+        self.completed.append(tr)
+        self.gauges.on_finish(tr.preempted)
+        attrs: Dict[str, Any] = {
+            "rid": tr.rid, "new_tokens": tr.new_tokens,
+            "preempted": tr.preempted,
+            "wall_ms": round(tr.wall_s * 1e3, 3),
+            "queue_wait_ms": round(tr.queue_wait_s * 1e3, 3),
+            "prefill_ms": round(tr.prefill_s * 1e3, 3),
+            "decode_ms": round(tr.decode_s * 1e3, 3),
+            "submit_tick": tr.submit_tick,
+        }
+        if tr.admitted:
+            attrs["ttft_ms"] = round(tr.ttft_s * 1e3, 3)
+            attrs["admit_tick"] = tr.admit_tick
+        if tps is not None:
+            attrs["decode_tokens_per_sec"] = round(tps, 2)
+        self._emit("serving", "request_done", tick=tick, **attrs)
+
+    # -- engine gauges -------------------------------------------------------
+
+    def on_tick(self, tick: int, **levels) -> None:
+        """Called once per engine tick with the level gauges (batch,
+        buckets, pool, queue, cumulative compile count); emits on the
+        registered cadence."""
+        attrs = self.gauges.observe(tick, **levels)
+        if attrs is not None:
+            self._emit("serve_tick", "serve_tick",
+                       value=attrs.get("batch"), tick=tick, **attrs)
+
+    def flush_gauges(self, tick: int) -> None:
+        """Emit a trailing partial gauge window (run teardown)."""
+        attrs = self.gauges.flush()
+        if attrs is not None:
+            self._emit("serve_tick", "serve_tick",
+                       value=attrs.get("batch"), tick=tick, **attrs)
+
+    # -- derived distributions ----------------------------------------------
+
+    def percentiles(self) -> Dict[str, Optional[float]]:
+        """The ServeSummary fields: p50/p99 over the bounded sample
+        windows (None until a series has samples)."""
+        out: Dict[str, Optional[float]] = {}
+        for name, xs in (("queue_wait", self._queue_wait_ms),
+                         ("ttft", self._ttft_ms),
+                         ("itl", self._itl_ms)):
+            for q in (50, 99):
+                v = _percentile(xs, q)
+                out[f"{name}_p{q}_ms"] = (None if v is None
+                                          else round(v, 3))
+        return out
+
+    def distributions(self) -> Dict[str, Dict[str, float]]:
+        """Full p50/p90/p99 digest for every series (the bench row /
+        docs surface; richer than the summary fields)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, xs in (("queue_wait_ms", self._queue_wait_ms),
+                         ("ttft_ms", self._ttft_ms),
+                         ("itl_ms", self._itl_ms),
+                         ("decode_tokens_per_sec", self._decode_tps)):
+            if not xs:
+                continue
+            out[name] = {
+                "p50": round(_percentile(xs, 50), 3),
+                "p90": round(_percentile(xs, 90), 3),
+                "p99": round(_percentile(xs, 99), 3),
+                "n": len(xs),
+            }
+        return out
+
+    # -- Chrome export -------------------------------------------------------
+
+    def lane_rows(self) -> List[Dict[str, Any]]:
+        return [tr.lane_row() for tr in self.completed]
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON: one lane per completed request
+        with queued/prefill/decode phases — loads in Perfetto next to
+        a device trace (write with :func:`apex_tpu.monitor.tracing.
+        write_chrome_trace`)."""
+        return serve_chrome_trace(self.lane_rows())
+
+
+class SnapshotTrigger:
+    """On-demand live-engine-state dump, exactly once per trigger.
+
+    Two sources, mirroring :class:`~apex_tpu.monitor.tracing.
+    CaptureTrigger`: a trigger file
+    (``APEX_TPU_SERVE_SNAPSHOT_FILE``) existing at a tick boundary
+    (consumed), or a signal (SIGUSR1 in the ``--serve`` driver) whose
+    handler only sets a flag.  The consuming :meth:`poll` emits ONE
+    ``engine_snapshot`` event whose attrs are the engine's
+    ``snapshot_state()`` dict — queue depth, active requests and
+    their progress, pool/reservation state, compile bookkeeping — the
+    post-mortem for a wedged serve (docs/api/serving.md)."""
+
+    def __init__(self, *, trigger_file: Optional[str] = None,
+                 signum: Optional[int] = None):
+        self.trigger_file = trigger_file
+        self.snapshots = 0
+        self._pending: Optional[str] = None
+        self._signum = signum
+        self._prev_handler = None
+        if signum is not None:
+            import signal as _signal
+
+            try:
+                self._prev_handler = _signal.signal(
+                    signum, lambda *_: self.request("signal"))
+            except ValueError as e:
+                # signal.signal only works on the main thread — a
+                # trigger built elsewhere keeps its file source
+                logger.warning("snapshot signal trigger unavailable: "
+                               "%s", str(e)[:120])
+                self._signum = None
+
+    @classmethod
+    def from_flags(cls, signum: Optional[int] = None
+                   ) -> "SnapshotTrigger":
+        return cls(trigger_file=flag_str("APEX_TPU_SERVE_SNAPSHOT_FILE"),
+                   signum=signum)
+
+    def request(self, reason: str) -> None:
+        """Arm a snapshot; consumed at the next :meth:`poll`."""
+        if self._pending is None:
+            self._pending = reason
+
+    def poll(self, tick: int, state_fn: Callable[[], Dict[str, Any]],
+             monitor=None) -> bool:
+        """Call once per tick boundary: consume a pending trigger and
+        emit the snapshot event.  Returns True iff a snapshot was
+        taken by *this* call."""
+        if (self.trigger_file is not None and self._pending is None
+                and os.path.exists(self.trigger_file)):
+            try:
+                os.unlink(self.trigger_file)
+            except OSError as e:
+                # the file cannot be consumed, so it would re-arm on
+                # every tick — take this one snapshot, then retire
+                # the file source (exactly-once must survive a
+                # read-only trigger directory)
+                logger.warning("snapshot trigger file unlink failed "
+                               "(disabling the file trigger): %s",
+                               str(e)[:120])
+                self.trigger_file = None
+            self._pending = "file"
+        if self._pending is None:
+            return False
+        reason, self._pending = self._pending, None
+        try:
+            state = dict(state_fn())
+        except Exception as e:  # telemetry must never kill the serve
+            logger.warning("engine snapshot state failed: %s",
+                           str(e)[:160])
+            state = {"error": str(e)[:200]}
+        self.snapshots += 1
+        if monitor is not None:
+            monitor.event("serving", "engine_snapshot", step=tick,
+                          reason=reason, **state)
+        return True
+
+    def close(self) -> None:
+        """Restore the signal handler."""
+        if self._signum is not None and self._prev_handler is not None:
+            import signal as _signal
+
+            _signal.signal(self._signum, self._prev_handler)
+            self._prev_handler = None

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
+import signal
 from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
@@ -535,13 +537,16 @@ def resolve_driver_mode(setup, scan_steps, drain_every, *, build_step,
 # ---------------------------------------------------------------------------
 
 def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
-                       stall_timeout, run_attrs, escalation=None):
+                       stall_timeout, run_attrs, escalation=None,
+                       watchdog_trace_dir=None):
     """Monitor bootstrap shared by the GPT/BERT smoke drivers: default
     sink selection (JSONL file if a path was given, else in-memory),
     watchdog wiring (optionally escalated through an
-    ``apex_tpu.resilience.EscalationPolicy``), and close-ownership —
-    the monitor closes the sink only when it created it, so a
-    caller-provided sink stays usable after the run."""
+    ``apex_tpu.resilience.EscalationPolicy``; ``watchdog_trace_dir``
+    arms the stall-alarm ``jax.profiler`` capture of a wedged step),
+    and close-ownership — the monitor closes the sink only when it
+    created it, so a caller-provided sink stays usable after the
+    run."""
     from ..monitor import JsonlSink, MemorySink, StepMonitor, Watchdog
 
     own_sink = sink is None
@@ -551,6 +556,7 @@ def make_smoke_monitor(jsonl, sink, *, tokens_per_step, flops_per_step,
         sink, tokens_per_step=tokens_per_step,
         flops_per_step=flops_per_step,
         watchdog=Watchdog(sink, stall_timeout=stall_timeout,
+                          trace_dir=watchdog_trace_dir,
                           on_alarm=None if escalation is None
                           else escalation.notify),
         run_attrs=run_attrs, close_sink=own_sink)
@@ -1124,6 +1130,9 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 kv_dtype: Optional[str] = None, ladder=None,
                 sanitize: bool = False, fault=None,
                 autoresume="auto", stall_timeout: float = 300.0,
+                trace_dir: Optional[str] = None,
+                tick_every: Optional[int] = None,
+                snapshot="auto",
                 return_engine: bool = False):
     """Continuous-batched serving smoke: a tiny GPT serves
     ``num_requests`` mixed-length prompts through the
@@ -1147,6 +1156,19 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     the dense gather twin (the naive decode baseline bench.py's
     serving section measures against).
 
+    Per-request telemetry (ISSUE-11) is always on: every request's
+    lifecycle chain (``request_submitted → request_admitted →
+    request_first_token → request_done``) and the per-tick
+    ``serve_tick`` engine gauges (cadence ``tick_every`` /
+    ``APEX_TPU_SERVE_TICK_EVERY``) land in the event log, and the
+    summary carries queue-wait/TTFT/ITL percentiles.  ``trace_dir``
+    additionally writes ``<dir>/serve.chrome.json`` — one Perfetto
+    lane per request with queued/prefill/decode phases — and arms the
+    watchdog's stall-capture under ``<dir>/stall``.  ``snapshot=
+    "auto"`` installs the on-demand engine snapshot trigger
+    (SIGUSR1 + ``APEX_TPU_SERVE_SNAPSHOT_FILE``); pass an explicit
+    :class:`~apex_tpu.serving.SnapshotTrigger` or None.
+
     Returns the :class:`~apex_tpu.serving.ServeSummary` (with
     ``return_engine=True``, ``(summary, engine)`` — how tests read
     per-request token streams)."""
@@ -1154,7 +1176,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
 
     from ..resilience import AutoResume, parse_fault
     from ..serving import (BucketLadder, Request, ServingEngine,
-                           ServingModelConfig, default_cache_config,
+                           ServingModelConfig, SnapshotTrigger,
+                           default_cache_config,
                            extract_serving_weights)
 
     model = GPTModel(
@@ -1177,6 +1200,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=None, flops_per_step=None,
         stall_timeout=stall_timeout, escalation=None,
+        watchdog_trace_dir=(os.path.join(trace_dir, "stall")
+                            if trace_dir else None),
         run_attrs={"driver": "standalone_gpt.serve_smoke",
                    "requests": num_requests, "max_seq": max_seq,
                    "kv_dtype": cache_cfg.kv_dtype,
@@ -1188,8 +1213,17 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     if autoresume == "auto":
         autoresume = AutoResume(sink=monitor).install()
         own_autoresume = True
+    own_snapshot = False
+    if snapshot == "auto":
+        # SIGUSR1 (flag-only handler) + the registered file trigger:
+        # a wedged serve dumps its live state as one engine_snapshot
+        # event at the next tick boundary
+        snapshot = SnapshotTrigger.from_flags(
+            signum=getattr(signal, "SIGUSR1", None))
+        own_snapshot = True
     engine = ServingEngine(weights, cfg, cache_cfg, ladder=ladder,
-                           monitor=monitor, autoresume=autoresume)
+                           monitor=monitor, autoresume=autoresume,
+                           tick_every=tick_every, snapshot=snapshot)
     # mixed-length prompts, deterministic per seed; every request
     # fits the ladder span and the model's position table
     rng = np.random.RandomState(seed)
@@ -1197,11 +1231,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     max_prompt = max(1, min(max_seq, span) - max_new_tokens)
     lengths = [1 + (int(x) % max_prompt)
                for x in rng.randint(1, 10 ** 6, num_requests)]
-    for i, n in enumerate(lengths):
-        engine.submit(Request(
-            rid=f"req{i:03d}",
-            prompt=[int(t) for t in rng.randint(0, vocab, n)],
-            max_new_tokens=max_new_tokens))
+    prompts = [[int(t) for t in rng.randint(0, vocab, n)]
+               for n in lengths]
     before = fault.before_step if fault is not None else None
     try:
         with contextlib.ExitStack() as stack:
@@ -1215,9 +1246,25 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                     transfer_guard=None, recompile_budget=0,
                     warmup_steps=1))
             engine.warmup()
+            # submit AFTER warmup so the reported queue-wait/TTFT
+            # distributions measure serving, not AOT compile time
+            for i, p in enumerate(prompts):
+                engine.submit(Request(
+                    rid=f"req{i:03d}", prompt=p,
+                    max_new_tokens=max_new_tokens))
             summary = engine.run(
                 before_tick=before,
                 after_tick=(lambda i: san.step()) if san else None)
+        if trace_dir is not None:
+            # one Perfetto lane per request (queued/prefill/decode),
+            # written through the PR-7 atomic Chrome writer so the
+            # serve loads next to a device trace
+            from ..monitor.tracing import write_chrome_trace
+
+            os.makedirs(trace_dir, exist_ok=True)
+            write_chrome_trace(
+                os.path.join(trace_dir, "serve.chrome.json"),
+                engine.metrics.chrome_trace())
     except BaseException as e:
         monitor.event("run", "run_error", step=engine.steps,
                       error=type(e).__name__, message=str(e)[:200])
@@ -1226,8 +1273,12 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         try:
             monitor.close()
         finally:
-            if own_autoresume:
-                autoresume.uninstall()
+            try:
+                if own_snapshot and snapshot is not None:
+                    snapshot.close()
+            finally:
+                if own_autoresume:
+                    autoresume.uninstall()
     if return_engine:
         return summary, engine
     return summary
@@ -1294,10 +1345,12 @@ def _main(argv=None):
                         "requests through the apex_tpu.serving "
                         "engine (prefill = flash fwd kernel, decode "
                         "= paged flash-decode kernel), tokens/s and "
-                        "p50/p99 per-token latency reported; with "
-                        "--sanitize proves one compile per ladder "
-                        "bucket; --fault sigterm@K proves the clean "
-                        "drain")
+                        "p50/p99 per-token latency plus TTFT/queue-"
+                        "wait percentiles reported; with --sanitize "
+                        "proves one compile per ladder bucket; "
+                        "--fault sigterm@K proves the clean drain; "
+                        "with --trace DIR also writes per-request "
+                        "Perfetto lanes to DIR/serve.chrome.json")
     p.add_argument("--requests", type=int, default=6,
                    help="(--serve) number of requests to serve")
     p.add_argument("--new-tokens", type=int, default=6,
@@ -1316,12 +1369,16 @@ def _main(argv=None):
             max_seq=args.serve_max_seq,
             decode_attention=("reference" if args.decode_reference
                               else "kernel"),
-            stall_timeout=args.stall_timeout, fault=args.fault)
+            stall_timeout=args.stall_timeout, fault=args.fault,
+            trace_dir=args.trace)
         print(f"SERVE_DONE requests={s.requests_done} "
               f"preempted={s.requests_preempted} "
               f"tokens={s.tokens_generated} "
               f"tokens_s={s.tokens_per_sec} "
               f"p50_ms={s.latency_p50_ms} p99_ms={s.latency_p99_ms} "
+              f"ttft_p50_ms={s.ttft_p50_ms} "
+              f"ttft_p99_ms={s.ttft_p99_ms} "
+              f"queue_wait_p99_ms={s.queue_wait_p99_ms} "
               f"steps={s.decode_steps} "
               f"compiles={len(s.compiles)} "
               f"drained={int(s.drained)}"

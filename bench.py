@@ -950,13 +950,23 @@ def bench_serving():
                        s_kernel.decode_tokens_per_sec,
                    "p50_ms": s_kernel.latency_p50_ms,
                    "p99_ms": s_kernel.latency_p99_ms,
+                   # ISSUE-11 per-request lifecycle columns: time to
+                   # first token and inter-token latency, the serving
+                   # metrics a router/SLO gate speaks
+                   "ttft_p50_ms": s_kernel.ttft_p50_ms,
+                   "ttft_p99_ms": s_kernel.ttft_p99_ms,
+                   "itl_p50_ms": s_kernel.itl_p50_ms,
+                   "itl_p99_ms": s_kernel.itl_p99_ms,
+                   "queue_wait_p99_ms": s_kernel.queue_wait_p99_ms,
                    "steps": s_kernel.decode_steps,
                    "tokens": s_kernel.tokens_generated},
         "naive_baseline": {"tokens_per_sec": s_naive.tokens_per_sec,
                            "decode_tokens_per_sec":
                                s_naive.decode_tokens_per_sec,
                            "p50_ms": s_naive.latency_p50_ms,
-                           "p99_ms": s_naive.latency_p99_ms},
+                           "p99_ms": s_naive.latency_p99_ms,
+                           "ttft_p99_ms": s_naive.ttft_p99_ms,
+                           "itl_p99_ms": s_naive.itl_p99_ms},
         "kernel_vs_naive": round(
             s_kernel.decode_tokens_per_sec
             / max(s_naive.decode_tokens_per_sec, 1e-9), 2),
@@ -965,11 +975,18 @@ def bench_serving():
             "p99_ms_interleaved": s_inter.latency_p99_ms,
             "p99_impact": round(
                 (s_inter.latency_p99_ms or 0.0)
-                / max(s_kernel.latency_p99_ms or 1e-9, 1e-9), 2)},
+                / max(s_kernel.latency_p99_ms or 1e-9, 1e-9), 2),
+            # staggered admissions are where queue wait and TTFT
+            # actually move — the steady run admits everything at
+            # tick 0
+            "ttft_p99_ms_interleaved": s_inter.ttft_p99_ms,
+            "queue_wait_p99_ms_interleaved":
+                s_inter.queue_wait_p99_ms},
         "warmup_compile_ms": round(warm_ms, 1),
     }
     print(f"[bench] serving: {out['decode']['tokens_per_sec']} tok/s "
-          f"p99 {out['decode']['p99_ms']} ms, kernel/naive "
+          f"p99 {out['decode']['p99_ms']} ms, ttft p99 "
+          f"{out['decode']['ttft_p99_ms']} ms, kernel/naive "
           f"{out['kernel_vs_naive']}x", file=sys.stderr)
     return out
 
@@ -1542,6 +1559,8 @@ def _compact_summary(full):
         ce["serve"] = {
             "tok_s": sv["decode"].get("tokens_per_sec"),
             "p99_ms": sv["decode"].get("p99_ms"),
+            "ttft_p99_ms": sv["decode"].get("ttft_p99_ms"),
+            "itl_p99_ms": sv["decode"].get("itl_p99_ms"),
             "vs_naive": sv.get("kernel_vs_naive")}
     col = ex.get("collective", {})
     if "hbm_read_gbps" in col:

@@ -82,7 +82,9 @@ def _pipeline_rows(full):
 
 # Metrics where SMALLER is the good direction (latencies): the gate
 # inverts its comparison for these — a >5% INCREASE fails.
-LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms"})
+LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms",
+                             "serving_ttft_p99_ms",
+                             "serving_itl_p99_ms"})
 
 
 def headline_metrics(full):
@@ -120,6 +122,15 @@ def headline_metrics(full):
                  "tokens_per_sec"), "serving"),
         "serving_p99_latency_ms": (
             _get(full, "extras", "serving", "decode", "p99_ms"),
+            "serving"),
+        # ISSUE-11 per-request lifecycle SLOs: time-to-first-token and
+        # inter-token latency gate as LOWER_IS_BETTER headline metrics
+        # alongside decode tokens/s
+        "serving_ttft_p99_ms": (
+            _get(full, "extras", "serving", "decode", "ttft_p99_ms"),
+            "serving"),
+        "serving_itl_p99_ms": (
+            _get(full, "extras", "serving", "decode", "itl_p99_ms"),
             "serving"),
     }
     lc = _get(full, "extras", "long_context") or {}
@@ -326,7 +337,8 @@ def self_test() -> int:
     # explicit serving skip row excuses both
     srv = json.loads(json.dumps(committed))
     srv["extras"]["serving"] = {
-        "decode": {"tokens_per_sec": 500.0, "p99_ms": 20.0}}
+        "decode": {"tokens_per_sec": 500.0, "p99_ms": 20.0,
+                   "ttft_p99_ms": 120.0, "itl_p99_ms": 18.0}}
     r, _ = compare(json.loads(json.dumps(srv)), srv)
     assert r == [], r
     slow = json.loads(json.dumps(srv))
@@ -341,6 +353,30 @@ def self_test() -> int:
     faster = json.loads(json.dumps(srv))
     faster["extras"]["serving"]["decode"]["p99_ms"] = 10.0  # improved
     r, _ = compare(faster, srv)
+    assert r == [], r
+    # ISSUE-11 TTFT/ITL legs: both gate in the LOWER_IS_BETTER
+    # direction; a drop (improvement) passes, silent absence is
+    # excused only by a section-level skip (tested above for serving)
+    slow_ttft = json.loads(json.dumps(srv))
+    slow_ttft["extras"]["serving"]["decode"]["ttft_p99_ms"] = 150.0
+    r, _ = compare(slow_ttft, srv)
+    assert len(r) == 1 and "serving_ttft_p99_ms" in r[0] \
+        and "lower is better" in r[0], r
+    slow_itl = json.loads(json.dumps(srv))
+    slow_itl["extras"]["serving"]["decode"]["itl_p99_ms"] = 25.0
+    r, _ = compare(slow_itl, srv)
+    assert len(r) == 1 and "serving_itl_p99_ms" in r[0], r
+    fast_ttft = json.loads(json.dumps(srv))
+    fast_ttft["extras"]["serving"]["decode"]["ttft_p99_ms"] = 60.0
+    fast_ttft["extras"]["serving"]["decode"]["itl_p99_ms"] = 9.0
+    r, _ = compare(fast_ttft, srv)
+    assert r == [], r
+    # a committed artifact predating the TTFT columns never gates
+    # them (old_v None is skipped), so the gate rolls forward cleanly
+    old = json.loads(json.dumps(srv))
+    del old["extras"]["serving"]["decode"]["ttft_p99_ms"]
+    del old["extras"]["serving"]["decode"]["itl_p99_ms"]
+    r, _ = compare(slow_ttft, old)
     assert r == [], r
     srv_skip = json.loads(json.dumps(srv))
     srv_skip["extras"]["serving"] = {"skipped": "budget"}

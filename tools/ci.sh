@@ -85,13 +85,21 @@
 #                            kernel) must AOT-compile exactly one
 #                            program per (batch, pages) ladder bucket
 #                            and hold a post-warmup recompile budget
-#                            of ZERO while sustaining tokens/s > 0;
-#                            then a SIGTERM mid-serve must drain
-#                            clean — admissions stop, every cache
-#                            block returns to the pool, in-flight
-#                            requests are marked preempted, and the
-#                            summary + JSONL record still land
-#                            (docs/api/serving.md)
+#                            of ZERO while sustaining tokens/s > 0,
+#                            with the ISSUE-11 telemetry on: every
+#                            submitted rid's lifecycle chain complete
+#                            (N submitted => N terminal events, TTFT
+#                            present for every non-preempted rid,
+#                            queued+prefill+decode summing to each
+#                            rid's wall), serve_tick engine gauges in
+#                            the log, and the per-request Chrome
+#                            lanes validated by tools/trace_check.py
+#                            --serve; then a SIGTERM mid-serve must
+#                            drain clean — admissions stop, every
+#                            cache block returns to the pool,
+#                            in-flight AND queued requests end in
+#                            terminal preempted events whose chains
+#                            still check out (docs/api/serving.md)
 #  12. SPMD sharding audit   — python -m apex_tpu.analysis
 #                            --check-sharding compiles every
 #                            plan-carrying multichip entry point under
@@ -218,7 +226,8 @@ SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 SERVE_OUT="$(APEX_TPU_SERVE_BATCH_BUCKETS=2,4 \
     APEX_TPU_SERVE_PAGE_BUCKETS=2 \
     python -m apex_tpu.testing.standalone_gpt --serve --requests 5 \
-    --new-tokens 4 --jsonl "$SERVE_DIR/serve.jsonl" --sanitize)"
+    --new-tokens 4 --jsonl "$SERVE_DIR/serve.jsonl" --sanitize \
+    --trace "$SERVE_DIR/tr")"
 echo "$SERVE_OUT"
 echo "$SERVE_OUT" | grep -q "requests=5 " \
     || { echo "[ci] FAIL: serve did not finish all 5 requests"; exit 1; }
@@ -226,9 +235,25 @@ echo "$SERVE_OUT" | grep -q "compiles=3 " \
     || { echo "[ci] FAIL: expected one compile per bucket (2 decode + 1 prefill)"; exit 1; }
 echo "$SERVE_OUT" | grep -Eq "tokens_s=[1-9]" \
     || { echo "[ci] FAIL: serve reported zero tokens/s"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "ttft_p50_ms=[0-9]" \
+    || { echo "[ci] FAIL: no TTFT percentiles in the serve summary"; exit 1; }
+# ISSUE-11 lifecycle completeness: 5 submitted => 5 terminal events,
+# TTFT on every non-preempted rid, parts summing to each rid's wall,
+# engine gauges present, and the per-request Chrome lanes parse —
+# all checked by trace_check --serve against the same JSONL
+[ "$(grep -c '"name":"request_submitted"' "$SERVE_DIR/serve.jsonl")" = 5 ] \
+    || { echo "[ci] FAIL: expected 5 request_submitted events"; exit 1; }
+[ "$(grep -c '"name":"request_done"' "$SERVE_DIR/serve.jsonl")" = 5 ] \
+    || { echo "[ci] FAIL: expected 5 terminal request_done events"; exit 1; }
+grep -q '"kind":"serve_tick"' "$SERVE_DIR/serve.jsonl" \
+    || { echo "[ci] FAIL: no serve_tick engine gauges in the JSONL"; exit 1; }
+python tools/trace_check.py "$SERVE_DIR/serve.jsonl" --serve \
+    --chrome "$SERVE_DIR/tr/serve.chrome.json"
+python tools/monitor_summary.py "$SERVE_DIR/serve.jsonl"
 # leg 2: SIGTERM mid-serve (flag-only handler, --fault sigterm@2) —
 # the engine stops admitting, frees every block, marks in-flight
-# requests preempted and still returns a full summary
+# requests preempted and still returns a full summary; preempted
+# requests carry complete lifecycle chains (trace_check --serve)
 SERVE_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
     --requests 4 --new-tokens 32 --jsonl "$SERVE_DIR/drain.jsonl" \
     --fault sigterm@2)"
@@ -239,6 +264,7 @@ echo "$SERVE_OUT" | grep -Eq "preempted=[1-9]" \
     || { echo "[ci] FAIL: no requests marked preempted"; exit 1; }
 grep -q '"name":"serve_preempt"' "$SERVE_DIR/drain.jsonl" \
     || { echo "[ci] FAIL: no serve_preempt event in the JSONL"; exit 1; }
+python tools/trace_check.py "$SERVE_DIR/drain.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
 echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
