@@ -322,6 +322,37 @@ register_flag(
     "smallest rung.  max rung x APEX_TPU_SERVE_KV_BLOCK bounds the "
     "servable sequence length.")
 register_flag(
+    "APEX_TPU_SERVE_SPECULATE_K", "int", 0,
+    "Speculative decoding for the serving engine "
+    "(docs/api/serving.md#speculative-decoding): K>=1 has the draft "
+    "model propose K tokens per tick, the target model score all of "
+    "them in ONE multi-token paged-attention call, and greedy-match "
+    "acceptance keep the longest agreeing prefix plus one corrected "
+    "token — output is token-for-token identical to non-speculative "
+    "greedy decode; rejected tokens roll the KV write cursor back.  "
+    "0 disables (one target call, one token per tick).  Requires a "
+    "draft model (standalone_gpt --serve --speculate-k builds one).",
+    lo=0, hi=16)
+register_flag(
+    "APEX_TPU_SERVE_PREFILL_CHUNK", "int", 0,
+    "Chunked prefill (docs/api/serving.md#chunked-prefill): N>=1 "
+    "splits prompt prefill into N-token chunks interleaved one per "
+    "engine tick with running requests' decode steps, bounding the "
+    "ITL spike a long-prompt admission inflicts.  The chunk size is "
+    "a bucket dimension (AOT-warmed like the rest of the ladder, so "
+    "the zero-steady-state-recompile contract holds).  0 prefills "
+    "whole prompts synchronously at admission.", lo=0)
+register_flag(
+    "APEX_TPU_SERVE_PREFIX_SHARE", "bool", False,
+    "Copy-on-write prompt-prefix sharing in the serving KV pool "
+    "(docs/api/serving.md#prefix-sharing): full prompt blocks are "
+    "content-chain-hashed into a shared read-only page index with "
+    "refcounts; a warm prefix maps shared pages instead of "
+    "re-prefilling them (prefill runs only on the unshared tail, and "
+    "admission reserves only the tail), eviction parks zero-ref "
+    "blocks in an idle LRU reclaimed under pool pressure, and any "
+    "write into a shared page copies it first.")
+register_flag(
     "APEX_TPU_SERVE_TICK_EVERY", "int", 1,
     "Engine-gauge cadence for the serving telemetry layer "
     "(serving/metrics.py): one kind=\"serve_tick\" event leaves every "

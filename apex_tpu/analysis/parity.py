@@ -65,6 +65,12 @@ KERNEL_TWINS: Dict[Tuple[str, str], TwinSpec] = {
     ("flash_decode.py", "_decode_paged"): _spec(
         "flash_decode", "paged_attention_reference",
         "apex_tpu/ops/flash_decode.py", "tests/test_serving.py"),
+    # multi-token paged attention (ISSUE-12): the speculative-verify /
+    # chunked-prefill chunk kernel, specified by the dense per-row
+    # causal gather reference
+    ("flash_decode.py", "_decode_paged_multi"): _spec(
+        "flash_decode_multi", "paged_attention_multi_reference",
+        "apex_tpu/ops/flash_decode.py", "tests/test_serving.py"),
     ("layer_norm.py", "_ln_forward"): _spec(
         "layer_norm", "_layer_norm_reference",
         "apex_tpu/ops/layer_norm.py", "tests/test_layer_norm.py"),

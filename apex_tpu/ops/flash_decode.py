@@ -70,7 +70,9 @@ from .flash_attention import (_LOG2E, _NEG, _dot, _interpret,
                               _packed_out, _packed_scores,
                               _pack_lane_cols, _use_head_packing)
 
-__all__ = ["flash_decode", "paged_attention_reference",
+__all__ = ["flash_decode", "flash_decode_multi",
+           "paged_attention_reference",
+           "paged_attention_multi_reference",
            "use_decode_head_packing", "pack_decode_heads",
            "unpack_decode_heads", "dequantize_kv"]
 
@@ -290,6 +292,201 @@ def flash_decode(q: jnp.ndarray, k_cache: jnp.ndarray,
     return unpack_decode_heads(out) if pack else out
 
 
+# --- multi-token path (speculative verify / chunked prefill) ---------------
+
+def _decode_multi_kernel(a, bs, t, pack, has_scale, *refs):
+    """One (batch row, head group, page) program over a CHUNK of ``t``
+    query rows.  Row ``r`` of batch ``b`` sits at global position
+    ``seq_lens[b] - t + r`` (chunk positions are contiguous and end at
+    the last written slot), so the per-row causal mask is
+    ``pos <= sl - t + r`` — at ``t == 1`` this is exactly the decode
+    kernel's ``pos < sl``.  m/l scratch carries one row per query in
+    columns 0..g-1; everything else mirrors :func:`_decode_kernel`."""
+    bt_ref, sl_ref, q_ref, k_ref, v_ref, *rest = refs
+    if has_scale:
+        ks_ref, vs_ref, *rest = rest
+    o_ref, m_sc, l_sc, acc = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    sl = sl_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[:] = jnp.zeros_like(acc)
+
+    @pl.when(j * bs < sl)
+    def _page():
+        q = q_ref[0, 0]                               # (t, dk)
+        k = k_ref[0, 0]                               # (bs, dk)
+        v = v_ref[0, 0]
+        if has_scale:
+            if pack:
+                ks = _pack_lane_cols(ks_ref[0, 0, :][:, None],
+                                     ks_ref[0, 1, :][:, None],
+                                     k.shape[-1])
+                vs = _pack_lane_cols(vs_ref[0, 0, :][:, None],
+                                     vs_ref[0, 1, :][:, None],
+                                     v.shape[-1])
+            else:
+                ks = ks_ref[0, 0, :][:, None]
+                vs = vs_ref[0, 0, :][:, None]
+            k = k.astype(jnp.float32) * ks
+            v = v.astype(jnp.float32) * vs
+        heads = _packed_scores(q, k) if pack \
+            else (_dot(q, k, trans_b=True),)           # (t, bs) fp32
+        # per-row causal mask: row r attends positions <= sl - t + r
+        shape = heads[0].shape
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        mask = pos <= sl - t + row
+        corrs = []
+        pas = []
+        for hh, s in enumerate(heads):
+            s = jnp.where(mask, s, _NEG)
+            m_prev = m_sc[:, hh:hh + 1]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1,
+                                                keepdims=True))
+            corr = jnp.exp2((m_prev - m_cur) * a)
+            p = jnp.exp2((s - m_cur) * a)
+            p = jnp.where(mask, p, 0.0)
+            l_sc[:, hh:hh + 1] = l_sc[:, hh:hh + 1] * corr \
+                + jnp.sum(p, axis=1, keepdims=True)
+            m_sc[:, hh:hh + 1] = m_cur
+            pas.append(p)
+            corrs.append(corr)
+        if pack:
+            corr_w = _pack_lane_cols(corrs[0], corrs[1], acc.shape[1])
+            acc[:] = acc[:] * corr_w + _packed_out(pas[0], pas[1], v)
+        else:
+            acc[:] = acc[:] * corrs[0] \
+                + _dot(pas[0].astype(v.dtype), v)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        if pack:
+            l0 = l_sc[:, :1]
+            l1 = l_sc[:, 1:2]
+            sl0 = jnp.where(l0 == 0.0, 1.0, l0)
+            sl1 = jnp.where(l1 == 0.0, 1.0, l1)
+            inv = _pack_lane_cols(1.0 / sl0, 1.0 / sl1, acc.shape[1])
+            dead = _pack_lane_cols(l0 == 0.0, l1 == 0.0, acc.shape[1])
+            o_ref[0, 0] = jnp.where(dead, 0.0,
+                                    acc[:] * inv).astype(o_ref.dtype)
+            return
+        l = l_sc[:, :1]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = jnp.where(l == 0.0, 0.0,
+                                acc[:] / safe).astype(o_ref.dtype)
+
+
+def _decode_paged_multi(q4, k_cache, v_cache, block_tables, seq_lens,
+                        scale, k_scale, v_scale, pack):
+    """pallas_call driver for the t-row chunk path: grid
+    (b, head groups, pages) like the single-token driver, q/o blocks
+    carry the whole (t, dk) chunk per program."""
+    b, hk, t, dk = q4.shape
+    nb, _, bs, _ = k_cache.shape
+    mp = block_tables.shape[1]
+    a = float(scale) * _LOG2E
+    has_scale = k_scale is not None
+    g = 2 if pack else 1
+
+    def qo_spec():
+        return pl.BlockSpec((1, 1, t, dk),
+                            lambda b_, h_, j, bt, sl: (b_, h_, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    kv_spec = pl.BlockSpec(
+        (1, 1, bs, dk),
+        lambda b_, h_, j, bt, sl: (bt[b_, j], h_, 0, 0),
+        memory_space=pltpu.VMEM)
+    in_specs = [qo_spec(), kv_spec, kv_spec]
+    operands = [q4, k_cache, v_cache]
+    if has_scale:
+        sc_spec = pl.BlockSpec(
+            (1, g, bs), lambda b_, h_, j, bt, sl: (bt[b_, j], h_, 0),
+            memory_space=pltpu.VMEM)
+        in_specs += [sc_spec, sc_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hk, mp),
+        in_specs=in_specs,
+        out_specs=qo_spec(),
+        scratch_shapes=[
+            pltpu.VMEM((t, 128), jnp.float32),
+            pltpu.VMEM((t, 128), jnp.float32),
+            pltpu.VMEM((t, dk), jnp.float32),
+        ])
+    return pl.pallas_call(
+        functools.partial(_decode_multi_kernel, a, bs, t, pack,
+                          has_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, t, dk), q4.dtype),
+        interpret=_interpret(),
+    )(block_tables, seq_lens, *operands)
+
+
+def flash_decode_multi(q: jnp.ndarray, k_cache: jnp.ndarray,
+                       v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                       seq_lens: jnp.ndarray, *,
+                       scale: Optional[float] = None,
+                       k_scale: Optional[jnp.ndarray] = None,
+                       v_scale: Optional[jnp.ndarray] = None
+                       ) -> jnp.ndarray:
+    """Multi-token paged attention: ``t`` contiguous query tokens per
+    sequence against the block-paged cache — the speculative-verify /
+    chunked-prefill counterpart of :func:`flash_decode`.
+
+    ``q`` is (b, t, h, d); row ``r`` of sequence ``b`` sits at global
+    position ``seq_lens[b] - t + r`` (its k/v, like every earlier
+    position's, must already be written to the cache — the serving
+    step writes the whole chunk before attending, so each token sees
+    itself and its in-chunk predecessors through the pages).  The
+    causal rule is per row: attend to positions ``<= seq_lens[b] - t
+    + r``.  Rows whose position is negative (front padding of a short
+    chunk) and rows of an inactive sequence (``seq_lens == 0``) emit
+    exactly 0.  Layout/packing/int8 conventions are identical to
+    :func:`flash_decode`; at ``t == 1`` the two paths compute the
+    same attention.  Inference-only (no VJP)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    nb, hk, bs, dk = k_cache.shape
+    if v_cache.shape != k_cache.shape:
+        raise ValueError(f"k/v cache shapes differ: {k_cache.shape} "
+                         f"vs {v_cache.shape}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if hk == h and dk == d:
+        pack = False
+    elif h % 2 == 0 and hk == h // 2 and dk == 2 * d:
+        pack = True
+    else:
+        raise ValueError(
+            f"cache head layout {(hk, dk)} matches neither unpacked "
+            f"{(h, d)} nor head-packed {(h // 2, 2 * d)} for q "
+            f"{q.shape}")
+    for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+        if sc is not None and sc.shape != (nb, h, bs):
+            raise ValueError(f"{name} shape {sc.shape} != expected "
+                             f"{(nb, h, bs)} (global head order)")
+    # (b, t, h, d) -> (b, hk, t, dk): the pack is a reshape on the
+    # trailing axes (same free-at-decode property as the single-token
+    # path), then heads move ahead of the chunk axis
+    q4 = pack_decode_heads(q) if pack else q
+    q4 = q4.transpose(0, 2, 1, 3)
+    out = _decode_paged_multi(q4, k_cache, v_cache,
+                              block_tables.astype(jnp.int32),
+                              seq_lens.astype(jnp.int32), scale,
+                              k_scale, v_scale, pack)
+    out = out.transpose(0, 2, 1, 3)                    # (b, t, hk, dk)
+    return unpack_decode_heads(out) if pack else out
+
+
 # --- jnp twin ---------------------------------------------------------------
 
 def dequantize_kv(cache: jnp.ndarray,
@@ -345,5 +542,48 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables,
     l = jnp.sum(p, axis=-1, keepdims=True)
     safe = jnp.where(l == 0.0, 1.0, l)
     o = jnp.einsum("bhk,bhkd->bhd", p / safe, v.astype(jnp.float32))
+    o = jnp.where(l == 0.0, 0.0, o)
+    return o.astype(q.dtype)
+
+
+def paged_attention_multi_reference(q, k_cache, v_cache, block_tables,
+                                    seq_lens, scale=None, k_scale=None,
+                                    v_scale=None):
+    """Dense jnp twin of :func:`flash_decode_multi`: gather every
+    row's pages, mask per query row by the contiguous-chunk causal
+    rule (row ``r`` attends positions ``<= seq_lens[b] - t + r``),
+    fp32 softmax.  The parity oracle for the multi-token kernel and
+    the dense verify/chunk baseline (``decode_attention="reference"``)."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    nb, hk, bs, dk = k_cache.shape
+    k_cache = dequantize_kv(k_cache, k_scale)
+    v_cache = dequantize_kv(v_cache, v_scale)
+    if hk != h:
+        k_cache = unpack_decode_heads(
+            k_cache.transpose(0, 2, 1, 3)).transpose(0, 2, 1, 3)
+        v_cache = unpack_decode_heads(
+            v_cache.transpose(0, 2, 1, 3)).transpose(0, 2, 1, 3)
+    mp = block_tables.shape[1]
+    k = k_cache[block_tables].transpose(0, 2, 1, 3, 4) \
+        .reshape(b, h, mp * bs, d)
+    v = v_cache[block_tables].transpose(0, 2, 1, 3, 4) \
+        .reshape(b, h, mp * bs, d)
+    s = jnp.einsum("bthd,bhkd->bthk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale    # (b, t, h, k)
+    pos = jnp.arange(mp * bs, dtype=jnp.int32)[None, None, None, :]
+    qpos = (seq_lens[:, None].astype(jnp.int32) - t
+            + jnp.arange(t, dtype=jnp.int32)[None, :])   # (b, t)
+    mask = pos <= qpos[:, :, None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    safe = jnp.where(l == 0.0, 1.0, l)
+    o = jnp.einsum("bthk,bhkd->bthd", p / safe,
+                   v.astype(jnp.float32))
     o = jnp.where(l == 0.0, 0.0, o)
     return o.astype(q.dtype)

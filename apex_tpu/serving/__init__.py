@@ -9,7 +9,11 @@ The serving counterpart of the training pipeline (ROADMAP item 1):
   extracted :class:`GPTServingWeights`.
 * :mod:`.engine` — continuous batching: bucket-laddered jitted steps,
   reservation admission, SIGTERM clean drain, tokens/s + p50/p99
-  metrics (:class:`ServingEngine`).
+  metrics (:class:`ServingEngine`), plus the decode fast path
+  (ISSUE-12): copy-on-write prompt-prefix sharing, speculative
+  decoding (draft-propose / multi-token verify, greedy-match
+  acceptance — token-identical to plain greedy), and chunked
+  prefill interleaved with decode ticks.
 * :mod:`.metrics` — per-request lifecycle telemetry (queue wait /
   TTFT / ITL distributions, Perfetto request lanes), per-tick engine
   gauges (``serve_tick``), and the on-demand engine snapshot
@@ -22,22 +26,24 @@ docs/api/serving.md walks the architecture.
 from .engine import (BucketLadder, Request, ServeSummary,
                      ServingEngine, default_cache_config)
 from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
-                       KVCacheManager, PagedKVCache, init_cache,
-                       quantize_kv_rows, write_prefill_kv,
+                       KVCacheManager, PagedKVCache, PrefixMatch,
+                       init_cache, quantize_kv_rows, write_prefill_kv,
                        write_token_kv)
 from .metrics import (EngineGauges, RequestTrace, ServeMetrics,
                       SnapshotTrigger)
 from .model import (GPTServingWeights, LayerWeights,
-                    ServingModelConfig, extract_serving_weights,
-                    gpt_decode_step, gpt_prefill_step)
+                    ServingModelConfig, copy_cache_block,
+                    extract_serving_weights, gpt_decode_step,
+                    gpt_extend_step, gpt_prefill_step)
 
 __all__ = [
     "BucketLadder", "Request", "ServeSummary", "ServingEngine",
     "default_cache_config",
     "DUMP_BLOCK", "CachePoolExhausted", "KVCacheConfig",
-    "KVCacheManager", "PagedKVCache", "init_cache",
+    "KVCacheManager", "PagedKVCache", "PrefixMatch", "init_cache",
     "quantize_kv_rows", "write_prefill_kv", "write_token_kv",
     "GPTServingWeights", "LayerWeights", "ServingModelConfig",
-    "extract_serving_weights", "gpt_decode_step", "gpt_prefill_step",
+    "copy_cache_block", "extract_serving_weights", "gpt_decode_step",
+    "gpt_extend_step", "gpt_prefill_step",
     "EngineGauges", "RequestTrace", "ServeMetrics", "SnapshotTrigger",
 ]

@@ -47,12 +47,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.flags import flag_int, flag_str
+from ..analysis.flags import flag_bool, flag_int, flag_str
 from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
-                       init_cache)
+                       PrefixMatch, init_cache)
 from .metrics import ServeMetrics
 from .model import (GPTServingWeights, ServingModelConfig,
-                    gpt_decode_step, gpt_prefill_step)
+                    copy_cache_block, gpt_decode_step,
+                    gpt_extend_step, gpt_prefill_step)
 
 __all__ = ["Request", "BucketLadder", "ServingEngine", "ServeSummary",
            "default_cache_config"]
@@ -72,18 +73,26 @@ def _parse_ladder(raw: str) -> Tuple[int, ...]:
 
 @dataclasses.dataclass(frozen=True)
 class BucketLadder:
-    """The registered (batch, pages) shape ladder.  ``pick`` rounds a
-    live size up to the smallest rung, so steady-state serving runs a
-    finite, precompilable program set."""
+    """The registered (batch, pages[, prefill-chunk]) shape ladder.
+    ``pick`` rounds a live size up to the smallest rung, so
+    steady-state serving runs a finite, precompilable program set.
+    ``chunks`` is the prefill-chunk token dimension (ISSUE-12): empty
+    means "derive from the page rungs" (one whole-padded-prompt chunk
+    per page rung — the warm-tail prefill shape when chunked prefill
+    is off); the ``APEX_TPU_SERVE_PREFILL_CHUNK`` flag registers a
+    single explicit rung."""
 
     batch: Tuple[int, ...]
     pages: Tuple[int, ...]
+    chunks: Tuple[int, ...] = ()
 
     @classmethod
     def from_flags(cls) -> "BucketLadder":
+        chunk = flag_int("APEX_TPU_SERVE_PREFILL_CHUNK")
         return cls(
             batch=_parse_ladder(flag_str("APEX_TPU_SERVE_BATCH_BUCKETS")),
-            pages=_parse_ladder(flag_str("APEX_TPU_SERVE_PAGE_BUCKETS")))
+            pages=_parse_ladder(flag_str("APEX_TPU_SERVE_PAGE_BUCKETS")),
+            chunks=(chunk,) if chunk > 0 else ())
 
     @staticmethod
     def _pick(rungs: Tuple[int, ...], n: int, what: str) -> int:
@@ -98,6 +107,29 @@ class BucketLadder:
 
     def pick_pages(self, n: int) -> int:
         return self._pick(self.pages, n, "page span")
+
+    def chunk_rungs(self, block_size: int) -> Tuple[int, ...]:
+        """The effective prefill-chunk rungs: the registered ones, or
+        (when none are) a derived set — one single-block rung (the
+        common warm-prefix tail is a handful of tokens; padding it to
+        the full page span would cost a whole prefill) plus one
+        whole-padded-prompt rung per page bucket for long unshared
+        tails — so a warm-tail prefill has a compiled shape even with
+        chunked prefill disabled."""
+        if self.chunks:
+            return self.chunks
+        return tuple(sorted({block_size}
+                            | {p * block_size for p in self.pages}))
+
+    def pick_chunk(self, n: int, block_size: int) -> int:
+        """Round a chunk of ``n`` tokens up to the smallest chunk
+        rung; a tail longer than every rung processes the largest
+        rung per tick (the caller loops)."""
+        rungs = self.chunk_rungs(block_size)
+        for r in rungs:
+            if n <= r:
+                return r
+        return rungs[-1]
 
     @property
     def max_batch(self) -> int:
@@ -164,6 +196,19 @@ class ServeSummary:
     # the queue and never get lifecycle chains
     requests_rejected: Dict[str, int] = dataclasses.field(
         default_factory=dict)
+    # ISSUE-12 decode fast path, all printed numbers (the ROADMAP
+    # exit criteria), not derived ones: speculative-decode acceptance
+    # (None when speculation is off), prompt-prefix sharing
+    # (warm admissions, prefill tokens skipped, shared-block
+    # high-water, copy-on-write count), and chunked-prefill volume
+    spec_accept_rate: Optional[float] = None
+    spec_tokens_proposed: int = 0
+    spec_tokens_accepted: int = 0
+    warm_prefix_admissions: int = 0
+    prefix_hit_tokens: int = 0
+    shared_blocks_hw: int = 0
+    cow_copies: int = 0
+    prefill_chunks: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -173,6 +218,21 @@ def _percentile(xs: Sequence[float], q: float) -> Optional[float]:
     if not xs:
         return None
     return float(np.percentile(np.asarray(xs, np.float64), q))
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """An admitted request whose prompt k/v is still being written —
+    blocks already owned (alloc'd at admission, shared prefix mapped),
+    ``written`` positions valid so far.  Advanced one chunk per engine
+    tick (chunked prefill) or drained synchronously at admission (the
+    warm-tail path when chunking is off)."""
+
+    req: Request
+    tokens: np.ndarray            # the whole prompt, int32
+    written: int                  # k/v-valid positions so far
+    start: int                    # prefix-shared positions (skipped)
+    admit_t: float                # prefill-start instant
 
 
 class ServingEngine:
@@ -198,6 +258,11 @@ class ServingEngine:
                  monitor=None, autoresume=None,
                  tick_every: Optional[int] = None,
                  snapshot=None,
+                 speculate_k: Optional[int] = None,
+                 draft_weights: Optional[GPTServingWeights] = None,
+                 draft_cfg: Optional[ServingModelConfig] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_share: Optional[bool] = None,
                  clock: Callable[[], float] = time.perf_counter):
         self.weights = weights
         self.model_cfg = model_cfg
@@ -212,6 +277,41 @@ class ServingEngine:
         self.monitor = monitor
         self.autoresume = autoresume
         self._clock = clock
+        # --- ISSUE-12 decode fast path knobs (flags unless pinned) --
+        self.speculate_k = speculate_k if speculate_k is not None \
+            else flag_int("APEX_TPU_SERVE_SPECULATE_K")
+        self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
+            else flag_int("APEX_TPU_SERVE_PREFILL_CHUNK")
+        if self.prefill_chunk > 0 and not self.ladder.chunks:
+            self.ladder = dataclasses.replace(
+                self.ladder, chunks=(self.prefill_chunk,))
+        self.prefix_share = prefix_share if prefix_share is not None \
+            else flag_bool("APEX_TPU_SERVE_PREFIX_SHARE")
+        if self.speculate_k > 0 and draft_weights is None:
+            raise ValueError(
+                "speculate_k > 0 needs a draft model: pass "
+                "draft_weights (+ draft_cfg) — e.g. "
+                "extract_serving_weights of a narrower GPT")
+        self.draft_weights = draft_weights
+        self.draft_cfg = draft_cfg
+        self.draft_cache_cfg: Optional[KVCacheConfig] = None
+        self.draft_cache = None
+        if draft_weights is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_weights without draft_cfg")
+            # the draft rides the SAME block pool geometry as the
+            # target (same block ids, same tables, one manager), so
+            # a (block, offset) slot means the same page in both
+            # caches and prefix-shared / CoW'd pages mirror for free
+            self.draft_cache_cfg = KVCacheConfig(
+                num_layers=draft_cfg.num_layers,
+                num_heads=draft_cfg.num_heads,
+                head_dim=draft_cfg.head_dim,
+                num_blocks=cache_cfg.num_blocks,
+                block_size=cache_cfg.block_size,
+                kv_dtype=cache_cfg.kv_dtype,
+                model_dtype=draft_cfg.dtype)
+            self.draft_cache = init_cache(self.draft_cache_cfg)
         # request-lifecycle + gauge telemetry (serving/metrics.py):
         # pure host bookkeeping through the monitor sinks — no device
         # traffic, so the one-fetch-per-tick budget is untouched.
@@ -220,13 +320,22 @@ class ServingEngine:
         self.metrics = ServeMetrics(monitor=monitor, clock=clock,
                                     tick_every=tick_every)
         self.snapshot = snapshot
-        self.manager = KVCacheManager(cache_cfg)
+        self.manager = KVCacheManager(cache_cfg,
+                                      prefix_sharing=self.prefix_share)
         self.cache = init_cache(cache_cfg)
         self.queue: deque = deque()
         self.active: Dict[Any, Request] = {}
+        # admitted requests whose chunked prefill is still running:
+        # rid -> _PrefillJob, advanced one chunk per engine tick
+        self.prefilling: "Dict[Any, _PrefillJob]" = {}
         self.done: List[Request] = []
         self.steps = 0
         self.prefill_tokens = 0
+        self.prefill_chunks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._warm_admissions = 0
+        self._prefix_hit_tokens = 0
         self._run_wall_s = 0.0
         # bounded: a weeks-long serve must not grow host memory per
         # token — percentiles read the most recent window only
@@ -238,6 +347,11 @@ class ServingEngine:
         self.decode_tokens = 0
         self._decode_exec: Dict[Tuple[int, int], Any] = {}
         self._prefill_exec: Dict[int, Any] = {}
+        self._extend_exec: Dict[Tuple[int, int, int], Any] = {}
+        self._draft_decode_exec: Dict[Tuple[int, int], Any] = {}
+        self._draft_prefill_exec: Dict[int, Any] = {}
+        self._draft_extend_exec: Dict[Tuple[int, int, int], Any] = {}
+        self._cow_exec: Dict[str, Any] = {}
         self._compiles: Dict[str, int] = {}
 
     # --- events -------------------------------------------------------
@@ -249,8 +363,9 @@ class ServingEngine:
 
     # --- compiled-program cache ---------------------------------------
 
-    def _jit_decode(self):
-        cfg, ccfg = self.model_cfg, self.cache_cfg
+    def _jit_decode(self, draft: bool = False):
+        cfg = self.draft_cfg if draft else self.model_cfg
+        ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step(weights, cache, tokens, positions, block_tables,
@@ -261,8 +376,9 @@ class ServingEngine:
 
         return step
 
-    def _jit_prefill(self):
-        cfg, ccfg = self.model_cfg, self.cache_cfg
+    def _jit_prefill(self, draft: bool = False):
+        cfg = self.draft_cfg if draft else self.model_cfg
+        ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def step(weights, cache, tokens, length, blocks):
@@ -271,16 +387,46 @@ class ServingEngine:
 
         return step
 
-    def _decode_args(self, bb: int, pb: int):
-        z = jnp.zeros((bb,), jnp.int32)
-        return (self.weights, self.cache, z, z,
-                jnp.zeros((bb, pb), jnp.int32), z, z, z)
+    def _jit_extend(self, draft: bool = False):
+        cfg = self.draft_cfg if draft else self.model_cfg
+        ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
-    def _prefill_args(self, s_pad: int):
-        return (self.weights, self.cache,
-                jnp.zeros((s_pad,), jnp.int32), jnp.int32(1),
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def step(weights, cache, tokens, block_tables, seq_lens,
+                 write_blocks, write_offsets):
+            return gpt_extend_step(weights, cfg, ccfg, cache, tokens,
+                                   block_tables, seq_lens,
+                                   write_blocks, write_offsets)
+
+        return step
+
+    def _jit_cow(self):
+        return functools.partial(jax.jit, donate_argnums=(0,))(
+            copy_cache_block)
+
+    def _wc(self, draft: bool):
+        return (self.draft_weights, self.draft_cache) if draft \
+            else (self.weights, self.cache)
+
+    def _decode_args(self, bb: int, pb: int, draft: bool = False):
+        z = jnp.zeros((bb,), jnp.int32)
+        w, c = self._wc(draft)
+        return (w, c, z, z, jnp.zeros((bb, pb), jnp.int32), z, z, z)
+
+    def _prefill_args(self, s_pad: int, draft: bool = False):
+        w, c = self._wc(draft)
+        return (w, c, jnp.zeros((s_pad,), jnp.int32), jnp.int32(1),
                 jnp.zeros((s_pad // self.cache_cfg.block_size,),
                           jnp.int32))
+
+    def _extend_args(self, bb: int, t: int, pb: int,
+                     draft: bool = False):
+        w, c = self._wc(draft)
+        return (w, c, jnp.zeros((bb, t), jnp.int32),
+                jnp.zeros((bb, pb), jnp.int32),
+                jnp.zeros((bb,), jnp.int32),
+                jnp.zeros((bb, t), jnp.int32),
+                jnp.zeros((bb, t), jnp.int32))
 
     def _compiled(self, cache: dict, key, jit_builder, args, label):
         ex = cache.get(key)
@@ -305,18 +451,75 @@ class ServingEngine:
                               self._jit_prefill,
                               self._prefill_args(s_pad), "prefill")
 
+    def _extend_fn(self, bb: int, t: int, pb: int):
+        return self._compiled(self._extend_exec, (bb, t, pb),
+                              self._jit_extend,
+                              self._extend_args(bb, t, pb), "extend")
+
+    def _draft_decode_fn(self, bb: int, pb: int):
+        return self._compiled(
+            self._draft_decode_exec, (bb, pb),
+            functools.partial(self._jit_decode, True),
+            self._decode_args(bb, pb, draft=True), "draft_decode")
+
+    def _draft_prefill_fn(self, s_pad: int):
+        return self._compiled(
+            self._draft_prefill_exec, s_pad,
+            functools.partial(self._jit_prefill, True),
+            self._prefill_args(s_pad, draft=True), "draft_prefill")
+
+    def _draft_extend_fn(self, bb: int, t: int, pb: int):
+        return self._compiled(
+            self._draft_extend_exec, (bb, t, pb),
+            functools.partial(self._jit_extend, True),
+            self._extend_args(bb, t, pb, draft=True), "draft_extend")
+
+    def _cow_fn(self, which: str):
+        cache = self.draft_cache if which == "draft" else self.cache
+        return self._compiled(
+            self._cow_exec, which, self._jit_cow,
+            (cache, jnp.int32(0), jnp.int32(0)), "cow")
+
+    @property
+    def _chunking(self) -> bool:
+        return self.prefill_chunk > 0
+
     def warmup(self) -> Dict[str, float]:
-        """AOT-compile every ladder bucket (decode: batch x pages;
-        prefill: one program per page rung) BEFORE traffic, so a
+        """AOT-compile every ladder bucket BEFORE traffic, so a
         sanitized serve charges every compile to warmup and the
-        steady state compiles exactly once per bucket.  Returns
+        steady state compiles exactly once per bucket, ever — across
+        every enabled path: whole-prompt prefill (one program per page
+        rung; skipped when chunked prefill replaces it), chunk/extend
+        programs per (chunk rung x page rung) when chunked prefill or
+        prefix sharing can route through them, decode per
+        (batch x pages), and with speculation the draft's mirror
+        programs plus the (batch x K+1 x pages) verify ladder and the
+        copy-on-write block-copy program per cache.  Returns
         ``{bucket label: compile count}`` (all 1 after a fresh
         warmup)."""
-        for pb in self.ladder.pages:
-            self._prefill_fn(pb * self.cache_cfg.block_size)
+        bs = self.cache_cfg.block_size
+        spec = self.speculate_k > 0
+        if not self._chunking:
+            for pb in self.ladder.pages:
+                self._prefill_fn(pb * bs)
+                if spec:
+                    self._draft_prefill_fn(pb * bs)
+        if self._chunking or self.prefix_share:
+            for ct in self.ladder.chunk_rungs(bs):
+                for pb in self.ladder.pages:
+                    self._extend_fn(1, ct, pb)
+                    if spec:
+                        self._draft_extend_fn(1, ct, pb)
         for bb in self.ladder.batch:
             for pb in self.ladder.pages:
                 self._decode_fn(bb, pb)
+                if spec:
+                    self._draft_decode_fn(bb, pb)
+                    self._extend_fn(bb, self.speculate_k + 1, pb)
+        if self.prefix_share:
+            self._cow_fn("target")
+            if self.draft_cache is not None:
+                self._cow_fn("draft")
         return dict(self._compiles)
 
     # --- request lifecycle --------------------------------------------
@@ -336,7 +539,7 @@ class ServingEngine:
                          f"request {request.rid!r}: empty prompt")
         if request.max_new_tokens < 1:
             # prefill always emits one token, and a negative budget
-            # would undercount the reservation _can_admit sizes —
+            # would undercount the reservation can_admit sizes —
             # admission could then exhaust the pool mid-decode
             self._reject(
                 request, "max_new_tokens",
@@ -358,51 +561,161 @@ class ServingEngine:
         self.metrics.on_submit(request, self.steps)
 
     def _reserved_blocks(self) -> int:
-        """Blocks the free pool already owes to active requests: each
-        one may still grow to its worst case (prompt + max_new), and
-        only the pages it has claimed so far left the free list."""
+        """Blocks the free pool already owes to in-flight requests
+        (active AND mid-prefill): each may still grow to its worst
+        case (prompt + max_new), only the pages it has claimed so far
+        left the free list, and a request whose next append will
+        copy-on-write a shared page owes one replacement block too."""
         total = 0
-        for rid, req in self.active.items():
+        in_flight = list(self.active.items()) \
+            + [(rid, job.req) for rid, job in self.prefilling.items()]
+        for rid, req in in_flight:
             worst = self.cache_cfg.blocks_for(
                 len(req.prompt) + req.max_new_tokens)
             total += max(0, worst - self.manager.num_pages(rid))
+            if self.prefix_share:
+                total += self.manager.pending_cow_blocks(rid)
         return total
 
-    def _can_admit(self, req: Request) -> bool:
-        # reservation policy lives in the manager — one build site
-        # for the no-mid-decode-exhaustion contract
-        return self.manager.can_admit(
-            len(req.prompt), req.max_new_tokens,
-            reserved_blocks=self._reserved_blocks())
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Device-side copy-on-write of one page, mirrored into the
+        draft cache (same block ids by construction)."""
+        fn = self._cow_fn("target")
+        self.cache = fn(self.cache, jnp.int32(src), jnp.int32(dst))
+        if self.draft_cache is not None:
+            fnd = self._cow_fn("draft")
+            self.draft_cache = fnd(self.draft_cache, jnp.int32(src),
+                                   jnp.int32(dst))
+        self._event("cow_block", src=int(src), dst=int(dst))
 
-    def _admit(self, req: Request) -> None:
+    def _admit(self, req: Request,
+               prefix: Optional[PrefixMatch] = None) -> None:
         p_len = len(req.prompt)
-        self.manager.alloc(req.rid, p_len)
-        bs = self.cache_cfg.block_size
-        pages_bucket = self.ladder.pick_pages(
-            self.cache_cfg.blocks_for(p_len))
-        s_pad = pages_bucket * bs
-        bt = self.manager.block_table(req.rid, s_pad // bs)
-        tokens = np.zeros(s_pad, np.int32)
-        tokens[:p_len] = req.prompt
-        fn = self._prefill_fn(s_pad)
         t0 = self._clock()
-        self.cache, next_token = fn(
-            self.weights, self.cache, jnp.asarray(tokens),
-            jnp.int32(p_len), jnp.asarray(bt))
-        first = int(next_token)          # explicit host sync: the
-        # admission boundary needs the token to seed the decode batch
-        dt = self._clock() - t0
-        req.out_tokens.append(first)
-        req.token_latency_s.append(dt)
-        self._latencies.append(dt)
+        if prefix is None:          # step() passes its admission match
+            prefix = self.manager.match_prefix(req.prompt)
+        self.manager.alloc(req.rid, p_len,
+                           shared_blocks=prefix.blocks)
+        if prefix.warm:
+            self._warm_admissions += 1
+            self._prefix_hit_tokens += prefix.tokens
+        if prefix.cow:
+            # full-prompt warm hit: the tail (the final token) will be
+            # re-written into the last mapped page — copy it private
+            # before any write touches it
+            cow = self.manager.make_private(req.rid,
+                                            len(prefix.blocks) - 1)
+            if cow is not None:
+                self._cow_copy(*cow)
         req.admitted_at_step = self.steps
-        self.active[req.rid] = req
-        self.prefill_tokens += p_len
-        # request_admitted (queue wait) + request_first_token (TTFT):
-        # t0 is the instant queue wait ended and prefill began
-        self.metrics.on_admit(req, self.steps, t0, dt,
-                              prompt_len=p_len, s_pad=s_pad)
+        if not prefix.warm and not self._chunking:
+            # cold whole-prompt path: one flash-forward prefill (plus
+            # the draft's, under speculation) covers the prompt
+            bs = self.cache_cfg.block_size
+            pages_bucket = self.ladder.pick_pages(
+                self.cache_cfg.blocks_for(p_len))
+            s_pad = pages_bucket * bs
+            bt = self.manager.block_table(req.rid, s_pad // bs)
+            tokens = np.zeros(s_pad, np.int32)
+            tokens[:p_len] = req.prompt
+            fn = self._prefill_fn(s_pad)
+            self.cache, next_token = fn(
+                self.weights, self.cache, jnp.asarray(tokens),
+                jnp.int32(p_len), jnp.asarray(bt))
+            if self.draft_cache is not None:
+                dfn = self._draft_prefill_fn(s_pad)
+                self.draft_cache, _ = dfn(
+                    self.draft_weights, self.draft_cache,
+                    jnp.asarray(tokens), jnp.int32(p_len),
+                    jnp.asarray(bt))
+            first = int(next_token)      # explicit host sync: the
+            # admission boundary needs the token to seed the decode
+            dt = self._clock() - t0
+            req.out_tokens.append(first)
+            req.token_latency_s.append(dt)
+            self._latencies.append(dt)
+            self.active[req.rid] = req
+            self.prefill_tokens += p_len
+            self.manager.register_prefix(req.rid, req.prompt)
+            # request_admitted (queue wait) + request_first_token
+            # (TTFT): t0 is the instant queue wait ended
+            self.metrics.on_admit(req, self.steps, t0, dt,
+                                  prompt_len=p_len, s_pad=s_pad)
+            return
+        # chunk path: warm tail, and/or chunked prefill.  The job owns
+        # its blocks already; k/v streams in via extend-step chunks —
+        # one per tick when chunking is on (interleaved with decode,
+        # so a long admission cannot monopolize a tick), or drained
+        # right here for a warm tail with chunking off.
+        job = _PrefillJob(req=req,
+                          tokens=np.asarray(req.prompt, np.int32),
+                          written=prefix.tokens, start=prefix.tokens,
+                          admit_t=t0)
+        self.metrics.on_admit(req, self.steps, t0, None,
+                              prompt_len=p_len,
+                              warm_tokens=prefix.tokens)
+        if self._chunking:
+            self.prefilling[req.rid] = job
+            return
+        while not self._prefill_step(job):
+            pass
+
+    def _prefill_step(self, job: _PrefillJob) -> bool:
+        """Write one prefill chunk of ``job``'s prompt (valid tokens
+        back-aligned in the chunk bucket, front padding writing to the
+        dump page); on the chunk that completes the prompt, fetch the
+        first generated token and move the request into the decode
+        set.  Returns True when the prefill finished."""
+        req = job.req
+        p_len = len(job.tokens)
+        bs = self.cache_cfg.block_size
+        rem = p_len - job.written
+        ct = self.ladder.pick_chunk(rem, bs)
+        n = min(rem, ct)
+        pb = self.ladder.pick_pages(self.manager.num_pages(req.rid))
+        bt = self.manager.block_table(req.rid, pb)
+        table = self.manager.blocks(req.rid)
+        toks = np.zeros(ct, np.int32)
+        wb = np.full(ct, DUMP_BLOCK, np.int32)
+        wo = np.zeros(ct, np.int32)
+        toks[ct - n:] = job.tokens[job.written:job.written + n]
+        for j in range(n):
+            p = job.written + j
+            wb[ct - n + j] = table[p // bs]
+            wo[ct - n + j] = p % bs
+        sl = np.asarray([job.written + n], np.int32)
+        t0 = self._clock()
+        fn = self._extend_fn(1, ct, pb)
+        self.cache, out = fn(
+            self.weights, self.cache, jnp.asarray(toks[None]),
+            jnp.asarray(bt[None]), jnp.asarray(sl),
+            jnp.asarray(wb[None]), jnp.asarray(wo[None]))
+        if self.draft_cache is not None:
+            dfn = self._draft_extend_fn(1, ct, pb)
+            self.draft_cache, _ = dfn(
+                self.draft_weights, self.draft_cache,
+                jnp.asarray(toks[None]), jnp.asarray(bt[None]),
+                jnp.asarray(sl), jnp.asarray(wb[None]),
+                jnp.asarray(wo[None]))
+        job.written += n
+        self.prefill_chunks += 1
+        done = job.written >= p_len
+        first = int(np.asarray(out)[0, -1]) if done else None
+        # ^ the only host sync: non-final chunks stay async
+        dt = self._clock() - t0
+        self._event("prefill_chunk", value=round(dt * 1e3, 3),
+                    rid=str(req.rid), tokens=int(n),
+                    written=int(job.written), prompt_len=p_len)
+        if done:
+            req.out_tokens.append(first)
+            req.token_latency_s.append(dt)
+            self._latencies.append(dt)
+            self.active[req.rid] = req
+            self.prefill_tokens += p_len - job.start
+            self.manager.register_prefix(req.rid, job.tokens)
+            self.metrics.on_first_token(req, self.steps,
+                                        self._clock())
+        return done
 
     def _finish(self, req: Request) -> None:
         self.manager.free(req.rid)
@@ -424,26 +737,70 @@ class ServingEngine:
     # --- the engine tick ----------------------------------------------
 
     def step(self) -> int:
-        """One continuous-batching tick: evict finished, admit (unless
-        draining), run one bucketed decode step over every active
+        """One continuous-batching tick: evict finished, advance ONE
+        pending prefill chunk (chunked prefill interleaves admission
+        cost with decode — a long prompt never monopolizes a tick),
+        admit (unless draining), run one bucketed decode step —
+        speculative when ``speculate_k > 0`` — over every active
         request.  Returns the number of tokens generated this tick."""
         for rid in [r for r, q in self.active.items() if q.done]:
             self._finish(self.active[rid])
+        advanced_prefill = False
+        if self.prefilling:
+            # FIFO: the oldest admission's next chunk, exactly one
+            # per tick
+            rid = next(iter(self.prefilling))
+            if self._prefill_step(self.prefilling[rid]):
+                del self.prefilling[rid]
+            advanced_prefill = True
         if not self._terminating():
             while (self.queue
-                   and len(self.active) < self.ladder.max_batch
-                   and self._can_admit(self.queue[0])):
-                self._admit(self.queue.popleft())
+                   and (len(self.active) + len(self.prefilling)
+                        < self.ladder.max_batch)):
+                # one match per admission attempt: the PrefixMatch
+                # feeds both the reservation check and the admission
+                # itself (hashing the prompt every tick for a blocked
+                # queue head would sit on the hot path for nothing)
+                req = self.queue[0]
+                prefix = self.manager.match_prefix(req.prompt)
+                if not self.manager.can_admit(
+                        len(req.prompt), req.max_new_tokens,
+                        reserved_blocks=self._reserved_blocks(),
+                        prefix=prefix):
+                    break
+                self._admit(self.queue.popleft(), prefix=prefix)
         # requests may finish at admission (max_new_tokens == 1)
         for rid in [r for r, q in self.active.items() if q.done]:
             self._finish(self.active[rid])
         if not self.active:
+            if advanced_prefill:
+                # a pure-prefill tick still crosses the telemetry
+                # boundary: gauges, snapshot poll, and the watchdog
+                # stall heartbeat must see chunked-prefill progress
+                # even before anything decodes
+                self._tick_tail(0, 0, 0)
             return 0
         reqs = [self.active[r] for r in sorted(self.active,
                                                key=lambda r: str(r))]
+        if self.speculate_k > 0:
+            return self._spec_tick(reqs)
+        return self._decode_tick(reqs)
+
+    def _append_slot(self, req: Request):
+        """One KV append with the copy-on-write guard: a slot landing
+        in a shared page (the owner's registered partial prompt
+        block) copies the page private first — append never mutates
+        a shared page."""
+        if self.prefix_share:
+            cow = self.manager.cow_for_append(req.rid)
+            if cow is not None:
+                self._cow_copy(*cow)
+        return self.manager.append(req.rid)
+
+    def _decode_tick(self, reqs: List[Request]) -> int:
         n = len(reqs)
         bb = self.ladder.pick_batch(n)
-        slots = [self.manager.append(q.rid) for q in reqs]
+        slots = [self._append_slot(q) for q in reqs]
         pb = self.ladder.pick_pages(
             max(self.manager.num_pages(q.rid) for q in reqs))
         tokens = np.zeros(bb, np.int32)
@@ -479,6 +836,152 @@ class ServingEngine:
         self._tick_tail(n, bb, pb)
         return n
 
+    def _spec_tick(self, reqs: List[Request]) -> int:
+        """One speculative tick: the draft proposes K tokens row by
+        row (K small decode dispatches), the target scores all K+1
+        positions in ONE multi-token extend call, and greedy-match
+        acceptance keeps the longest draft prefix agreeing with the
+        target plus one corrected token — so every emitted token is
+        exactly what non-speculative greedy decode would have
+        produced, and a tick advances each row by 1..K+1 tokens.
+        Rejected positions roll the KV write cursor back through the
+        manager's (block, offset) slot accounting; the draft cache
+        catches up its one unwritten position on full acceptance so
+        the next tick's proposals stay on-policy."""
+        K = self.speculate_k
+        T = K + 1
+        n = len(reqs)
+        bb = self.ladder.pick_batch(n)
+        base = np.zeros(bb, np.int32)
+        caps = np.zeros(bb, np.int32)
+        slots: List[List[Tuple[int, int]]] = []
+        for i, q in enumerate(reqs):
+            base[i] = self.manager.seq_len(q.rid)
+            # a row near its token budget writes fewer real slots —
+            # the reservation contract (prompt + max_new) caps the
+            # pages a tick may claim, so overshoot positions go to
+            # the dump page and their (unused) logits are garbage
+            caps[i] = max(1, min(T, q.max_new_tokens
+                                 - len(q.out_tokens)))
+            row = []
+            for j in range(int(caps[i])):
+                if j == 0:
+                    row.append(self._append_slot(q))
+                else:
+                    row.append(self.manager.append(q.rid))
+            slots.append(row)
+        pb = self.ladder.pick_pages(
+            max(self.manager.num_pages(q.rid) for q in reqs))
+        bt = np.full((bb, pb), DUMP_BLOCK, np.int32)
+        for i, q in enumerate(reqs):
+            bt[i] = self.manager.block_table(q.rid, pb)
+        bt_j = jnp.asarray(bt)
+        t0 = self._clock()
+        # --- draft proposals: K sequential single-token steps -------
+        d = np.zeros((bb, K), np.int32)
+        prev = np.zeros(bb, np.int32)
+        for i, q in enumerate(reqs):
+            prev[i] = q.out_tokens[-1]
+        for k in range(1, K + 1):
+            toks = prev if k == 1 else d[:, k - 2]
+            pos = np.zeros(bb, np.int32)
+            sl = np.zeros(bb, np.int32)
+            wbk = np.full(bb, DUMP_BLOCK, np.int32)
+            wok = np.zeros(bb, np.int32)
+            for i in range(n):
+                pos[i] = base[i] + k - 1
+                sl[i] = base[i] + k
+                if k - 1 < caps[i]:
+                    wbk[i], wok[i] = slots[i][k - 1]
+            dfn = self._draft_decode_fn(bb, pb)
+            self.draft_cache, nt = dfn(
+                self.draft_weights, self.draft_cache,
+                jnp.asarray(toks), jnp.asarray(pos), bt_j,
+                jnp.asarray(sl), jnp.asarray(wbk), jnp.asarray(wok))
+            d[:, k - 1] = np.asarray(nt)
+        # --- target verification: ONE teacher-forced extend call ----
+        vt = np.zeros((bb, T), np.int32)
+        wbv = np.full((bb, T), DUMP_BLOCK, np.int32)
+        wov = np.zeros((bb, T), np.int32)
+        slv = np.zeros(bb, np.int32)
+        for i in range(n):
+            vt[i, 0] = prev[i]
+            vt[i, 1:] = d[i]
+            slv[i] = base[i] + T
+            for j, (blk, off) in enumerate(slots[i]):
+                wbv[i, j], wov[i, j] = blk, off
+        fn = self._extend_fn(bb, T, pb)
+        self.cache, out = fn(
+            self.weights, self.cache, jnp.asarray(vt), bt_j,
+            jnp.asarray(slv), jnp.asarray(wbv), jnp.asarray(wov))
+        a = np.asarray(out)              # (bb, T) — the tick's fetch
+        # --- greedy-match acceptance + rollback ---------------------
+        gained = 0
+        full_rows: List[int] = []
+        keeps: List[int] = []
+        tick_proposed = 0
+        tick_accepted = 0
+        for i, q in enumerate(reqs):
+            cap = int(caps[i])
+            emit = [int(a[i, 0])]
+            j = 0
+            while j < cap - 1 and int(d[i, j]) == emit[-1]:
+                emit.append(int(a[i, j + 1]))
+                j += 1
+            tick_proposed += max(0, cap - 1)
+            tick_accepted += j
+            if q.eos_token is not None and q.eos_token in emit:
+                emit = emit[:emit.index(q.eos_token) + 1]
+            keep = len(emit)
+            if keep < cap:
+                self.manager.truncate(q.rid, int(base[i]) + keep)
+            if keep == T:
+                full_rows.append(i)
+            q.out_tokens.extend(emit)
+            keeps.append(keep)
+            gained += keep
+        dt = self._clock() - t0
+        # amortize the tick wall over each row's gained tokens — the
+        # tokens arrive together, so the honest per-token figure is
+        # the tick cost split across them (the same population
+        # ServeSummary.itl draws from)
+        for q, keep in zip(reqs, keeps):
+            share = dt / keep
+            for _ in range(keep):
+                q.token_latency_s.append(share)
+                self._latencies.append(share)
+        self.spec_proposed += tick_proposed
+        self.spec_accepted += tick_accepted
+        self.metrics.gauges.on_spec(tick_proposed, tick_accepted)
+        # --- draft catch-up: on full acceptance the draft never wrote
+        # position base + K (the target's verify did) — one masked
+        # draft step fills it so next tick's proposals read real k/v
+        if full_rows:
+            toks = np.zeros(bb, np.int32)
+            pos = np.zeros(bb, np.int32)
+            sl = np.zeros(bb, np.int32)
+            wbk = np.full(bb, DUMP_BLOCK, np.int32)
+            wok = np.zeros(bb, np.int32)
+            for i in full_rows:
+                toks[i] = reqs[i].out_tokens[-2]     # the token AT
+                pos[i] = base[i] + K                 # position base+K
+                sl[i] = base[i] + T
+                wbk[i], wok[i] = slots[i][K]
+            dfn = self._draft_decode_fn(bb, pb)
+            self.draft_cache, _ = dfn(
+                self.draft_weights, self.draft_cache,
+                jnp.asarray(toks), jnp.asarray(pos), bt_j,
+                jnp.asarray(sl), jnp.asarray(wbk), jnp.asarray(wok))
+        self.decode_wall_s += dt
+        self.decode_tokens += gained
+        self.steps += 1
+        self._event("decode_step", value=round(dt * 1e3, 3),
+                    batch=n, batch_bucket=bb, pages_bucket=pb,
+                    spec_proposed=tick_proposed,
+                    spec_accepted=tick_accepted, tokens=gained)
+        self._tick_tail(n, bb, pb)
+        return gained
+
     def _tick_tail(self, batch: int, bb: int, pb: int) -> None:
         """Per-tick telemetry boundary: engine gauges on the
         registered cadence, snapshot-trigger poll, and the watchdog
@@ -490,8 +993,10 @@ class ServingEngine:
             free_blocks=self.manager.free_blocks,
             used_blocks=self.manager.used_blocks,
             reserved_blocks=self._reserved_blocks(),
+            shared_blocks=self.manager.shared_blocks,
             pool_blocks=self.cache_cfg.usable_blocks,
             queue_depth=len(self.queue),
+            prefilling=len(self.prefilling),
             compiles=sum(self._compiles.values()))
         if self.snapshot is not None:
             self.snapshot.poll(self.steps, self.snapshot_state,
@@ -504,6 +1009,20 @@ class ServingEngine:
         if wd is not None:
             wd.observe_step(self.steps)
 
+    def tokens_digest(self) -> str:
+        """Deterministic digest of every request's output token
+        stream — the cheap cross-run identity proof the CI spec leg
+        compares against the plain leg (same submitted trace + same
+        digest == token-for-token identical output)."""
+        import hashlib
+
+        h = hashlib.md5()
+        allq = list(self.done) + list(self.active.values())
+        for q in sorted(allq, key=lambda q: str(q.rid)):
+            h.update(f"{q.rid}:"
+                     f"{','.join(map(str, q.out_tokens))};".encode())
+        return h.hexdigest()[:12]
+
     def snapshot_state(self) -> Dict[str, Any]:
         """Live engine state as one JSON-able dict — what the
         on-demand :class:`~apex_tpu.serving.metrics.SnapshotTrigger`
@@ -512,14 +1031,22 @@ class ServingEngine:
             "tick": self.steps,
             "active": len(self.active),
             "queued": len(self.queue),
+            "prefilling": [
+                {"rid": str(rid), "written": job.written,
+                 "prompt_len": len(job.tokens)}
+                for rid, job in self.prefilling.items()],
             "done": self._done_count,
             "preempted": self._preempted_count,
             "free_blocks": self.manager.free_blocks,
             "used_blocks": self.manager.used_blocks,
             "reserved_blocks": self._reserved_blocks(),
+            "shared_blocks": self.manager.shared_blocks,
+            "idle_blocks": self.manager.idle_blocks,
             "used_blocks_high_water":
                 self.metrics.gauges.used_blocks_hw,
             "pool_blocks": self.cache_cfg.usable_blocks,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
             "compiles": sum(self._compiles.values()),
             "requests": [
                 {"rid": str(rid),
@@ -553,13 +1080,23 @@ class ServingEngine:
         one run's wall."""
         t0 = self._clock()
         drained = False
-        while self.queue or self.active:
+        while self.queue or self.active or self.prefilling:
             if self._terminating():
                 drained = True
                 for rid in list(self.active):
                     q = self.active[rid]
                     q.preempted = True
                     self._finish(q)
+                for rid in list(self.prefilling):
+                    # admitted but still prefilling: blocks freed,
+                    # preempted into done — no first token, the whole
+                    # post-admission wall reads as prefill
+                    q = self.prefilling.pop(rid).req
+                    q.preempted = True
+                    self.manager.free(rid)
+                    self.done.append(q)
+                    self._preempted_count += 1
+                    self.metrics.on_done(q, self.steps)
                 while self.queue:
                     # accepted but never admitted: no blocks to free,
                     # but the drain still accounts for every request —
@@ -611,7 +1148,18 @@ class ServingEngine:
             ttft_p99_ms=pct["ttft_p99_ms"],
             itl_p50_ms=pct["itl_p50_ms"],
             itl_p99_ms=pct["itl_p99_ms"],
-            requests_rejected=dict(self.metrics.rejected))
+            requests_rejected=dict(self.metrics.rejected),
+            spec_accept_rate=(
+                round(self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed
+                else (0.0 if self.speculate_k > 0 else None)),
+            spec_tokens_proposed=self.spec_proposed,
+            spec_tokens_accepted=self.spec_accepted,
+            warm_prefix_admissions=self._warm_admissions,
+            prefix_hit_tokens=self._prefix_hit_tokens,
+            shared_blocks_hw=self.manager.shared_blocks_hw,
+            cow_copies=self.manager.cow_copies,
+            prefill_chunks=self.prefill_chunks)
         self._event("serve_done", value=summary.tokens_per_sec,
                     **{k: v for k, v in summary.as_dict().items()
                        if k not in ("compiles", "tokens_per_sec")})

@@ -39,7 +39,9 @@ kernel dequantizes per page in VMEM (docs/api/serving.md#kv-dtype).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, NamedTuple, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -47,8 +49,9 @@ import numpy as np
 from ..ops.flash_decode import use_decode_head_packing
 
 __all__ = ["KVCacheConfig", "PagedKVCache", "KVCacheManager",
-           "CachePoolExhausted", "init_cache", "write_token_kv",
-           "write_prefill_kv", "quantize_kv_rows", "DUMP_BLOCK"]
+           "PrefixMatch", "CachePoolExhausted", "init_cache",
+           "write_token_kv", "write_prefill_kv", "quantize_kv_rows",
+           "DUMP_BLOCK"]
 
 # block 0: never allocated, pads every block table, absorbs inactive
 # rows' writes.  Reads of it are always masked to an exact 0 weight.
@@ -247,14 +250,56 @@ def write_prefill_kv(cache: PagedKVCache, config: KVCacheConfig,
     return PagedKVCache(k, v, k_scale, v_scale)
 
 
+class PrefixMatch(NamedTuple):
+    """What :meth:`KVCacheManager.match_prefix` found for a prompt.
+
+    ``blocks`` are the shared page ids to map (in page order),
+    ``tokens`` the prompt positions their cached k/v covers (the
+    prefill-skipped span — always ``<= len(prompt) - 1``, so at least
+    one tail token runs through the model to produce the first
+    generated token), ``cow`` whether the LAST mapped block must be
+    copied-on-write before the tail prefill (the tail's first write
+    lands inside it — the full-prompt warm-hit case)."""
+
+    blocks: Tuple[int, ...]
+    tokens: int
+    cow: bool
+
+    @property
+    def warm(self) -> bool:
+        return bool(self.blocks)
+
+
+_NO_MATCH = PrefixMatch(blocks=(), tokens=0, cow=False)
+
+
 class KVCacheManager:
-    """Host-side block pool + per-request block tables.
+    """Host-side block pool + per-request block tables, with optional
+    copy-on-write prompt-prefix sharing.
 
     Free blocks form a LIFO stack: an evict-then-readmit cycle hands
     the same ids back (the tests' bitwise block-reuse proof), and hot
-    blocks stay hot.  All methods are O(pages touched)."""
+    blocks stay hot.  All methods are O(pages touched).
 
-    def __init__(self, config: KVCacheConfig):
+    **Prefix sharing** (``prefix_sharing=True``): full prompt blocks
+    are chain-content-hashed into ``_index`` (hash of block ``i``
+    commits to every token before it, so a hit is a hit on the whole
+    prefix, not one block's bytes), plus one entry for the prompt's
+    final partial block.  A shared block carries a refcount = number
+    of request tables mapping it; it is **read-only** while mapped —
+    a write into it (the owner's first decode append into its partial
+    prompt block, or a warm full-prompt hit's tail re-prefill) must go
+    through :meth:`cow_for_append` / :meth:`make_private`, which swap
+    in a fresh private block and hand the caller the (src, dst) pair
+    to device-copy.  Eviction decrements refcounts; a block reaching
+    zero moves to an **idle LRU** (still cached, OFF the free list) so
+    a later identical prompt still hits warm — idle blocks are
+    reclaimed (unregistered) only when an allocation finds the free
+    list empty.  ``can_admit`` counts idle blocks as available and a
+    warm request's need as only its unshared tail."""
+
+    def __init__(self, config: KVCacheConfig, *,
+                 prefix_sharing: bool = False):
         self.config = config
         # stack: pop() from the end; ids descend so the FIRST blocks
         # handed out are 1, 2, 3, ... (stable, test-friendly)
@@ -262,6 +307,17 @@ class KVCacheManager:
                                            -1))
         self._tables: Dict[object, List[int]] = {}
         self._lens: Dict[object, int] = {}
+        self.prefix_sharing = bool(prefix_sharing)
+        self._index: Dict[bytes, int] = {}       # chain key -> block
+        self._block_key: Dict[int, bytes] = {}   # reverse
+        self._refs: Dict[int, int] = {}          # active mappings
+        self._idle: "OrderedDict[int, None]" = OrderedDict()
+        self._shared_of: Dict[object, set] = {}
+        # lifetime stats (the ServeSummary / gauge feed; the engine
+        # owns the token-level warm-hit accounting)
+        self.prefix_hits = 0
+        self.cow_copies = 0
+        self.shared_blocks_hw = 0
 
     # --- capacity -----------------------------------------------------
 
@@ -270,64 +326,310 @@ class KVCacheManager:
         return len(self._free)
 
     @property
+    def idle_blocks(self) -> int:
+        """Shared blocks no live request maps (cached, reclaimable)."""
+        return len(self._idle)
+
+    @property
+    def available_blocks(self) -> int:
+        """What an allocation can actually draw on: the free list
+        plus idle shared blocks (reclaimed LRU-first on demand)."""
+        return len(self._free) + len(self._idle)
+
+    @property
+    def shared_blocks(self) -> int:
+        return len(self._block_key)
+
+    @property
     def used_blocks(self) -> int:
         return self.config.usable_blocks - len(self._free)
 
     def can_admit(self, prompt_len: int, max_new_tokens: int, *,
-                  reserved_blocks: int = 0) -> bool:
+                  reserved_blocks: int = 0,
+                  prefix: Optional[PrefixMatch] = None) -> bool:
         """Reservation admission: the request's WHOLE worst case
         (``prompt_len + max_new_tokens``) must fit the pool right
         now, net of ``reserved_blocks`` the pool already owes
         in-flight requests (their own worst cases minus the pages
         they hold) — so a later :meth:`append` can never exhaust the
         pool mid-decode.  Admitting on anything weaker (e.g. prompt
-        plus one token of headroom) re-opens exactly that crash."""
-        need = self.config.blocks_for(prompt_len + max_new_tokens)
-        return need <= len(self._free) - reserved_blocks
+        plus one token of headroom) re-opens exactly that crash.
+
+        A warm ``prefix`` (from :meth:`match_prefix`) shrinks the
+        bill: mapped shared pages come from the index, not the pool,
+        so only the unshared tail (plus one replacement block when
+        ``prefix.cow`` says the last mapped page will be
+        copied-on-write) counts against the free list — warm prefixes
+        admit more load, not just faster.  Matched blocks currently
+        parked idle are excluded from the available count (mapping
+        them consumes their idle slot, not a free block)."""
+        s = len(prefix.blocks) if prefix is not None else 0
+        cow = prefix.cow if prefix is not None else False
+        idle_matched = sum(1 for b in (prefix.blocks if prefix
+                                       else ()) if b in self._idle)
+        need = self.config.blocks_for(prompt_len + max_new_tokens) \
+            - s + (1 if cow else 0)
+        return need <= self.available_blocks - idle_matched \
+            - reserved_blocks
+
+    # --- prefix index -------------------------------------------------
+
+    def _chain_keys(self, prompt: Sequence[int]):
+        """(full-block chain keys, partial-tail key or None).  Key i
+        commits to tokens [0, (i+1)*bs) — a chain, so matching key i
+        implies matching every earlier block too."""
+        bs = self.config.block_size
+        h = hashlib.blake2b(b"apex-prefix", digest_size=16)
+        keys: List[bytes] = []
+        full = len(prompt) // bs
+        for i in range(full):
+            h.update(np.asarray(prompt[i * bs:(i + 1) * bs],
+                                np.int64).tobytes())
+            keys.append(h.digest())
+        pkey = None
+        tail = prompt[full * bs:]
+        if len(tail):
+            hp = h.copy()
+            hp.update(b"partial")
+            hp.update(np.asarray(tail, np.int64).tobytes())
+            pkey = hp.digest()
+        return keys, pkey
+
+    def match_prefix(self, prompt: Sequence[int]) -> PrefixMatch:
+        """Longest warm prefix of ``prompt`` in the shared index.
+        Never covers the final token (the tail prefill must emit the
+        first generated token); a match reaching the whole prompt maps
+        every page and flags the last one for copy-on-write instead."""
+        if not self.prefix_sharing or len(prompt) < 2:
+            return _NO_MATCH
+        keys, pkey = self._chain_keys(prompt)
+        blocks: List[int] = []
+        for key in keys:
+            blk = self._index.get(key)
+            if blk is None:
+                break
+            blocks.append(blk)
+        tokens = len(blocks) * self.config.block_size
+        cow = False
+        if len(blocks) == len(keys) and pkey is not None:
+            blk = self._index.get(pkey)
+            if blk is not None:
+                blocks.append(blk)
+                tokens = len(prompt)
+        if not blocks:
+            return _NO_MATCH
+        if tokens >= len(prompt):
+            # full-prompt hit: the tail is the final token, whose page
+            # is the last mapped block — copy-on-write before writing
+            tokens = len(prompt) - 1
+            cow = True
+        return PrefixMatch(blocks=tuple(blocks), tokens=tokens,
+                           cow=cow)
+
+    def register_prefix(self, rid, prompt: Sequence[int]) -> int:
+        """Index ``rid``'s freshly prefilled prompt pages as shared:
+        every full block plus the final partial block, keyed by the
+        content chain.  Pages already mapped from the index stay as
+        they are; content another block already owns is not
+        re-registered (two identical cold admissions race — first
+        writer wins, the second's pages stay private).  Returns the
+        number of newly registered blocks.  Call only after the
+        prompt's k/v is fully written (a concurrent warm admission
+        must never map unwritten pages)."""
+        if not self.prefix_sharing:
+            return 0
+        keys, pkey = self._chain_keys(prompt)
+        table = self._tables[rid]
+        shared = self._shared_of.setdefault(rid, set())
+        new = 0
+        entries = list(enumerate(keys))
+        if pkey is not None:
+            entries.append((len(keys), pkey))
+        for page, key in entries:
+            blk = table[page]
+            owner = self._index.get(key)
+            if owner is not None:
+                continue                  # mapped warm, or a duplicate
+            if blk in self._block_key:
+                continue                  # block already shared as
+            self._index[key] = blk        # different content (cannot
+            self._block_key[blk] = key    # happen via alloc, belt+
+            self._refs[blk] = 1           # braces)
+            shared.add(blk)
+            new += 1
+        self.shared_blocks_hw = max(self.shared_blocks_hw,
+                                    len(self._block_key))
+        return new
+
+    def _map_shared(self, rid, blk: int) -> None:
+        self._refs[blk] = self._refs.get(blk, 0) + 1
+        self._idle.pop(blk, None)
+        self._shared_of.setdefault(rid, set()).add(blk)
+
+    def _unmap_shared(self, blk: int) -> None:
+        self._refs[blk] -= 1
+        if self._refs[blk] == 0:
+            # cached but unmapped: off the free list, reclaimable LRU
+            self._idle[blk] = None
+
+    def _take_block(self, why: str) -> int:
+        """One block off the free list, reclaiming the LRU idle shared
+        block (unregistering its prefix entry) when the list is dry."""
+        if self._free:
+            return self._free.pop()
+        if self._idle:
+            blk, _ = self._idle.popitem(last=False)
+            key = self._block_key.pop(blk)
+            del self._index[key]
+            del self._refs[blk]
+            return blk
+        raise CachePoolExhausted(why)
+
+    def is_shared(self, rid, block: int) -> bool:
+        """Whether ``block`` is a read-only shared mapping in
+        ``rid``'s table (a write must CoW it first)."""
+        return block in self._shared_of.get(rid, ())
 
     # --- lifecycle ----------------------------------------------------
 
-    def alloc(self, rid, length: int) -> List[int]:
-        """Claim blocks covering ``length`` tokens for a new request."""
+    def alloc(self, rid, length: int, *,
+              shared_blocks: Sequence[int] = ()) -> List[int]:
+        """Claim blocks covering ``length`` tokens for a new request.
+        ``shared_blocks`` (from :meth:`match_prefix`) are mapped
+        read-only as the table's leading pages — refcounted, never
+        drawn from the pool — and only the tail is allocated."""
         if rid in self._tables:
             raise ValueError(f"request {rid!r} already has blocks")
         if length < 1:
             raise ValueError("length must be >= 1")
-        need = self.config.blocks_for(length)
-        if need > len(self._free):
+        need = self.config.blocks_for(length) - len(shared_blocks)
+        if need < 0:
+            raise ValueError(
+                f"request {rid!r}: {len(shared_blocks)} shared pages "
+                f"exceed the {self.config.blocks_for(length)} pages "
+                f"length {length} occupies")
+        idle_matched = sum(1 for b in shared_blocks
+                           if b in self._idle)
+        if need > self.available_blocks - idle_matched:
             raise CachePoolExhausted(
                 f"request {rid!r} needs {need} block(s) for length "
-                f"{length}, pool has {len(self._free)} free of "
-                f"{self.config.usable_blocks}")
-        blocks = [self._free.pop() for _ in range(need)]
+                f"{length}, pool has {self.available_blocks} "
+                f"available of {self.config.usable_blocks}")
+        blocks = list(shared_blocks)
+        for blk in shared_blocks:
+            self._map_shared(rid, blk)
+        blocks.extend(self._take_block(
+            f"request {rid!r}: pool drained mid-alloc")
+            for _ in range(need))
         self._tables[rid] = blocks
         self._lens[rid] = int(length)
+        if shared_blocks:
+            self.prefix_hits += 1
         return list(blocks)
+
+    def cow_for_append(self, rid):
+        """Copy-on-write guard for the next :meth:`append`: when the
+        slot the next token lands in sits inside a shared (read-only)
+        page — the owner's first append into its registered partial
+        prompt block — swap in a fresh private block and return
+        ``(src, dst)`` for the caller to device-copy.  Returns None
+        when the next write is already private."""
+        pos = self._lens[rid]
+        page = pos // self.config.block_size
+        if page >= len(self._tables[rid]):
+            return None                       # append opens a new page
+        return self.make_private(rid, page)
+
+    def make_private(self, rid, page: int):
+        """CoW page ``page`` of ``rid``'s table if it is a shared
+        mapping: allocate a private replacement, swap the table entry,
+        release the shared ref.  Returns ``(src_block, dst_block)``
+        to device-copy, or None if the page is already private."""
+        blocks = self._tables[rid]
+        src = blocks[page]
+        if not self.is_shared(rid, src):
+            return None
+        dst = self._take_block(
+            f"request {rid!r}: no block for the copy-on-write of "
+            f"shared page {page}")
+        blocks[page] = dst
+        self._shared_of[rid].discard(src)
+        self._unmap_shared(src)
+        self.cow_copies += 1
+        return src, dst
+
+    def pending_cow_blocks(self, rid) -> int:
+        """1 when ``rid``'s next append will CoW a shared page (the
+        reservation math must hold that block back), else 0."""
+        pos = self._lens[rid]
+        page = pos // self.config.block_size
+        blocks = self._tables[rid]
+        if page < len(blocks) and self.is_shared(rid, blocks[page]):
+            return 1
+        return 0
 
     def append(self, rid):
         """Grow ``rid`` by one token, allocating a fresh block when
         the token starts a new page.  Returns ``(block_id, offset)``
         — the page slot the new token's k/v must be written to (its
-        position is the pre-append ``seq_len``)."""
+        position is the pre-append ``seq_len``).  Writing into a
+        shared page is a contract violation: call
+        :meth:`cow_for_append` first (the engine does, copying the
+        block on device)."""
         blocks = self._tables[rid]
         pos = self._lens[rid]
         page, off = divmod(pos, self.config.block_size)
         if page == len(blocks):
-            if not self._free:
-                raise CachePoolExhausted(
-                    f"request {rid!r} crossed a block edge at length "
-                    f"{pos + 1} with the pool empty — admission "
-                    f"control must keep headroom (can_admit)")
-            blocks.append(self._free.pop())
+            blocks.append(self._take_block(
+                f"request {rid!r} crossed a block edge at length "
+                f"{pos + 1} with the pool empty — admission "
+                f"control must keep headroom (can_admit)"))
+        elif self.is_shared(rid, blocks[page]):
+            raise RuntimeError(
+                f"request {rid!r}: append would write into shared "
+                f"page {page} (block {blocks[page]}) — the caller "
+                f"must cow_for_append() first")
         self._lens[rid] = pos + 1
         return blocks[page], off
 
+    def truncate(self, rid, new_len: int) -> List[int]:
+        """Roll ``rid``'s write cursor back to ``new_len`` tokens
+        (speculative-decode rejection), returning pages past the new
+        end to the pool.  Only ever sheds private blocks the same
+        tick's appends claimed — a rollback never reaches below the
+        prompt, so shared pages are untouchable by construction."""
+        if not 1 <= new_len <= self._lens[rid]:
+            raise ValueError(
+                f"request {rid!r}: truncate to {new_len} outside "
+                f"[1, {self._lens[rid]}]")
+        blocks = self._tables[rid]
+        keep = self.config.blocks_for(new_len)
+        freed: List[int] = []
+        while len(blocks) > keep:
+            blk = blocks.pop()
+            if blk in self._block_key:
+                raise RuntimeError(
+                    f"request {rid!r}: truncate would free shared "
+                    f"block {blk} — rollback crossed the prompt")
+            self._free.append(blk)
+            freed.append(blk)
+        self._lens[rid] = int(new_len)
+        return freed
+
     def free(self, rid) -> List[int]:
         """Return ``rid``'s blocks to the pool (LIFO, reverse order so
-        a readmit walks them back out first-block-first)."""
+        a readmit walks them back out first-block-first).  Shared
+        mappings are unref'd instead — a block another table still
+        maps stays live, and one reaching zero refs parks in the idle
+        LRU (still indexed, warm for the next identical prompt)."""
         blocks = self._tables.pop(rid)
         del self._lens[rid]
-        self._free.extend(reversed(blocks))
+        shared = self._shared_of.pop(rid, set())
+        for blk in reversed(blocks):
+            if blk in shared:
+                self._unmap_shared(blk)
+            else:
+                self._free.append(blk)
         return blocks
 
     # --- views --------------------------------------------------------

@@ -104,13 +104,21 @@ class RequestTrace:
 
     @property
     def prefill_s(self) -> float:
+        """Admission start → first token.  A request preempted while
+        its (possibly chunked) prefill was still running has no first
+        token: its whole post-admission wall counts as prefill, so
+        the parts still sum to the wall."""
         if not self.admitted:
             return 0.0
-        return max(0.0, self.first_token_t - self.admit_t)
+        end = self.first_token_t if self.first_token_t is not None \
+            else (self.done_t if self.done_t is not None
+                  else self.admit_t)
+        return max(0.0, end - self.admit_t)
 
     @property
     def decode_s(self) -> float:
-        if not self.admitted or self.done_t is None:
+        if not self.admitted or self.done_t is None \
+                or self.first_token_t is None:
             return 0.0
         return max(0.0, self.done_t - self.first_token_t)
 
@@ -121,8 +129,9 @@ class RequestTrace:
     @property
     def ttft_s(self) -> Optional[float]:
         """Submit → first generated token (the prefill output token);
-        None for a request preempted before admission."""
-        if not self.admitted:
+        None for a request preempted before admission or before its
+        chunked prefill produced the token."""
+        if not self.admitted or self.first_token_t is None:
             return None
         return self.first_token_t - self.submit_t
 
@@ -174,15 +183,29 @@ class EngineGauges:
         self.every = max(1, int(every))
         self.emitted = 0
         self.used_blocks_hw = 0
+        self.shared_blocks_hw = 0
         self._ticks = 0
         self._admitted = 0
+        self._warm_admitted = 0
         self._finished = 0
         self._preempted = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._compiles_seen = 0
         self._last: Optional[Dict[str, Any]] = None
 
-    def on_admit(self) -> None:
+    def on_admit(self, warm: bool = False) -> None:
         self._admitted += 1
+        if warm:
+            self._warm_admitted += 1
+
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        """One speculative tick's draft bookkeeping: ``proposed``
+        draft tokens scored, ``accepted`` kept by the greedy match —
+        the window's acceptance feed (``spec_accept_rate`` on the
+        rolled gauge event)."""
+        self._spec_proposed += int(proposed)
+        self._spec_accepted += int(accepted)
 
     def on_finish(self, preempted: bool) -> None:
         if preempted:
@@ -196,6 +219,9 @@ class EngineGauges:
         self._ticks += 1
         self.used_blocks_hw = max(self.used_blocks_hw,
                                   int(levels.get("used_blocks", 0)))
+        self.shared_blocks_hw = max(self.shared_blocks_hw,
+                                    int(levels.get("shared_blocks",
+                                                   0)))
         self._last = dict(levels, last_tick=tick)
         if self._ticks >= self.every:
             return self._roll()
@@ -207,7 +233,8 @@ class EngineGauges:
         run's final evictions happen in a tick that decodes nothing,
         so the flush is how they reach the log."""
         if self._ticks == 0 and not (self._admitted or self._finished
-                                     or self._preempted):
+                                     or self._preempted
+                                     or self._spec_proposed):
             return None
         return self._roll()
 
@@ -217,14 +244,24 @@ class EngineGauges:
         attrs.update(
             ticks=self._ticks,
             admitted=self._admitted,
+            warm_admitted=self._warm_admitted,
             finished=self._finished,
             preempted=self._preempted,
             new_compiles=compiles - self._compiles_seen,
             used_blocks_high_water=self.used_blocks_hw,
         )
+        if self.shared_blocks_hw:
+            attrs["shared_blocks_high_water"] = self.shared_blocks_hw
+        if self._spec_proposed:
+            attrs["spec_proposed"] = self._spec_proposed
+            attrs["spec_accepted"] = self._spec_accepted
+            attrs["spec_accept_rate"] = round(
+                self._spec_accepted / self._spec_proposed, 4)
         self._compiles_seen = compiles
         self._ticks = 0
-        self._admitted = self._finished = self._preempted = 0
+        self._admitted = self._warm_admitted = 0
+        self._finished = self._preempted = 0
+        self._spec_proposed = self._spec_accepted = 0
         self.emitted += 1
         return attrs
 
@@ -292,12 +329,15 @@ class ServeMetrics:
                    prompt_len=len(request.prompt))
 
     def on_admit(self, request, tick: int, admit_t: float,
-                 prefill_s: float, **attrs) -> None:
-        """Admission completed: ``admit_t`` is the engine-clock instant
-        admission (prefill) started, ``prefill_s`` its duration — the
-        first generated token exists at ``admit_t + prefill_s``.
-        Emits ``request_admitted`` (value = prefill ms, plus the
-        queue wait) and ``request_first_token`` (value = TTFT ms)."""
+                 prefill_s: Optional[float] = None, **attrs) -> None:
+        """Admission happened: ``admit_t`` is the engine-clock instant
+        queue wait ended and prefill began.  With ``prefill_s`` (the
+        synchronous whole-prompt path) the first generated token
+        exists at ``admit_t + prefill_s`` and both lifecycle events
+        emit here; a chunked prefill passes ``prefill_s=None`` and
+        reports the token later through :meth:`on_first_token` — TTFT
+        is always measured to the REAL first token, however many
+        ticks the prefill spans."""
         tr = self._open.get(str(request.rid))
         if tr is None:  # engine-internal admit without a submit record
             tr = RequestTrace(rid=str(request.rid),
@@ -306,20 +346,35 @@ class ServeMetrics:
             self._open[tr.rid] = tr
         tr.admit_t = admit_t
         tr.admit_tick = tick
-        tr.first_token_t = admit_t + prefill_s
+        qw_ms = tr.queue_wait_s * 1e3
+        self._queue_wait_ms.append(qw_ms)
+        self.gauges.on_admit(warm=bool(attrs.get("warm_tokens")))
+        self._emit("serving", "request_admitted",
+                   value=(None if prefill_s is None
+                          else round(prefill_s * 1e3, 3)), tick=tick,
+                   rid=tr.rid, queue_wait_ms=round(qw_ms, 3), **attrs)
+        if prefill_s is not None:
+            self.on_first_token(request, tick, admit_t + prefill_s)
+
+    def on_first_token(self, request, tick: int, t: float) -> None:
+        """The request's first generated token exists at engine-clock
+        instant ``t`` (the end of its last prefill chunk, or of the
+        synchronous prefill).  Emits ``request_first_token`` and
+        records the TTFT sample."""
+        tr = self._open.get(str(request.rid))
+        if tr is None or tr.admit_t is None \
+                or tr.first_token_t is not None:
+            return
+        tr.first_token_t = t
         qw_ms = tr.queue_wait_s * 1e3
         ttft_ms = tr.ttft_s * 1e3
-        self._queue_wait_ms.append(qw_ms)
+        prefill_ms = tr.prefill_s * 1e3
         self._ttft_ms.append(ttft_ms)
-        self.gauges.on_admit()
-        self._emit("serving", "request_admitted",
-                   value=round(prefill_s * 1e3, 3), tick=tick,
-                   rid=tr.rid, queue_wait_ms=round(qw_ms, 3), **attrs)
         self._emit("serving", "request_first_token",
                    value=round(ttft_ms, 3), tick=tick, rid=tr.rid,
                    ttft_ms=round(ttft_ms, 3),
                    queue_wait_ms=round(qw_ms, 3),
-                   prefill_ms=round(prefill_s * 1e3, 3))
+                   prefill_ms=round(prefill_ms, 3))
 
     def on_done(self, request, tick: int) -> None:
         """Terminal: finished or preempted (``request.preempted``) —
@@ -354,8 +409,9 @@ class ServeMetrics:
             "submit_tick": tr.submit_tick,
         }
         if tr.admitted:
-            attrs["ttft_ms"] = round(tr.ttft_s * 1e3, 3)
             attrs["admit_tick"] = tr.admit_tick
+            if tr.ttft_s is not None:
+                attrs["ttft_ms"] = round(tr.ttft_s * 1e3, 3)
         if tps is not None:
             attrs["decode_tokens_per_sec"] = round(tps, 2)
         self._emit("serving", "request_done", tick=tick, **attrs)

@@ -30,14 +30,16 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.flash_decode import flash_decode, paged_attention_reference
+from ..ops.flash_decode import (flash_decode, flash_decode_multi,
+                                paged_attention_multi_reference,
+                                paged_attention_reference)
 from ..ops.layer_norm import layer_norm
 from .kv_cache import (KVCacheConfig, PagedKVCache, write_prefill_kv,
                        write_token_kv)
 
 __all__ = ["GPTServingWeights", "LayerWeights", "ServingModelConfig",
            "extract_serving_weights", "gpt_prefill_step",
-           "gpt_decode_step"]
+           "gpt_decode_step", "gpt_extend_step", "copy_cache_block"]
 
 
 class LayerWeights(NamedTuple):
@@ -286,3 +288,89 @@ def gpt_decode_step(weights: GPTServingWeights,
     logits = _lm_head(x, weights, cfg)             # (b, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return cache, next_tokens
+
+
+def gpt_extend_step(weights: GPTServingWeights,
+                    cfg: ServingModelConfig,
+                    cache_cfg: KVCacheConfig, cache: PagedKVCache,
+                    tokens: jnp.ndarray, block_tables: jnp.ndarray,
+                    seq_lens: jnp.ndarray,
+                    write_blocks: jnp.ndarray,
+                    write_offsets: jnp.ndarray):
+    """Advance every batch row by a CHUNK of ``t`` tokens against the
+    paged cache — the one program behind speculative verification,
+    chunked prefill, and warm-prefix tail prefill; returns
+    ``(cache, next_tokens)`` with one argmax token per chunk slot.
+
+    ``tokens`` is (b, t): row ``b``'s chunk occupies the contiguous
+    positions ``seq_lens[b] - t .. seq_lens[b] - 1`` (``seq_lens``
+    counts every k/v-written token INCLUDING this chunk).  Each
+    token's k/v goes to ``(write_blocks[b, j], write_offsets[b, j])``
+    layer by layer before that layer's attention — the chunk attends
+    to itself through the cache, exactly the decode step's discipline
+    — and the per-row causal rule is
+    :func:`~apex_tpu.ops.flash_decode.flash_decode_multi`'s.  Chunks
+    shorter than ``t`` are FRONT-padded (valid tokens last, so the
+    final row is always the newest position): padding rows carry
+    negative positions, point their writes at the dump page, and emit
+    a discarded deterministic token.  ``next_tokens[b, -1]`` after the
+    chunk that completes a prompt is the request's first generated
+    token; ``next_tokens[b, j]`` under verification is the target
+    model's greedy choice after consuming position ``seq_lens[b] - t
+    + j`` — the acceptance comparator.
+
+    One compile per (batch bucket, t bucket, pages bucket) — the
+    chunk/verify dimensions the engine's warmup adds to the ladder
+    product."""
+    h, d = cfg.num_heads, cfg.head_dim
+    b, t = tokens.shape
+    scale = d ** -0.5
+    pos = seq_lens.astype(jnp.int32)[:, None] - t \
+        + jnp.arange(t, dtype=jnp.int32)[None, :]       # (b, t)
+    # padding rows sit at negative positions: clamp the embedding
+    # lookup (their output is discarded; attention masks them to 0)
+    x = _embed(weights, tokens, jnp.maximum(pos, 0), cfg)  # (b, t, H)
+    wb = write_blocks.reshape(b * t)
+    wo = write_offsets.reshape(b * t)
+    for i, lw in enumerate(weights.layers):
+        a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
+                          cfg.layernorm_eps).astype(cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = qkv.reshape(b, t, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)       # (b, t, h, d)
+        cache = write_token_kv(cache, cache_cfg, i,
+                               k.reshape(b * t, h, d),
+                               v.reshape(b * t, h, d), wb, wo)
+        kc, vc, ks, vs = cache.layer(i)
+        if cfg.decode_attention == "kernel":
+            ctx = flash_decode_multi(q, kc, vc, block_tables,
+                                     seq_lens, scale=scale,
+                                     k_scale=ks, v_scale=vs)
+        else:
+            ctx = paged_attention_multi_reference(
+                q, kc, vc, block_tables, seq_lens, scale=scale,
+                k_scale=ks, v_scale=vs)
+        ctx = ctx.reshape(b, t, h * d)
+        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        x = _layer_tail(x, lw, attn_out, cfg)
+    logits = _lm_head(x, weights, cfg)             # (b, t, V)
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return cache, next_tokens
+
+
+def copy_cache_block(cache: PagedKVCache, src: jnp.ndarray,
+                     dst: jnp.ndarray) -> PagedKVCache:
+    """Device-side copy-on-write: duplicate block ``src`` (all layers,
+    k+v+scales) into block ``dst``.  Traced code — the engine jits it
+    once per cache (src/dst ride as data, so every CoW reuses the one
+    compiled program) with the cache donated, making the copy an
+    in-place page-sized DMA."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    k = cache.k.at[:, dst].set(cache.k[:, src])
+    v = cache.v.at[:, dst].set(cache.v[:, src])
+    k_scale, v_scale = cache.k_scale, cache.v_scale
+    if k_scale is not None:
+        k_scale = k_scale.at[:, dst].set(k_scale[:, src])
+        v_scale = v_scale.at[:, dst].set(v_scale[:, src])
+    return PagedKVCache(k, v, k_scale, v_scale)

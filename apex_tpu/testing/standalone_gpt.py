@@ -1133,6 +1133,10 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 trace_dir: Optional[str] = None,
                 tick_every: Optional[int] = None,
                 snapshot="auto",
+                speculate_k: Optional[int] = None,
+                prefill_chunk: Optional[int] = None,
+                prefix_share: Optional[bool] = None,
+                draft: str = "self",
                 return_engine: bool = False):
     """Continuous-batched serving smoke: a tiny GPT serves
     ``num_requests`` mixed-length prompts through the
@@ -1155,6 +1159,17 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     contract.  ``decode_attention="reference"`` swaps the kernel for
     the dense gather twin (the naive decode baseline bench.py's
     serving section measures against).
+
+    The ISSUE-12 decode fast path rides the same smoke:
+    ``speculate_k=K`` builds a draft GPT (``draft="self"`` reuses the
+    target's weights — the acceptance-rate ceiling and the CI
+    machinery proof; ``draft="narrow"`` initializes a 1-layer,
+    half-width model — the low-acceptance rollback stress) and the
+    engine emits 1..K+1 tokens per tick, token-for-token identical to
+    plain greedy decode; ``prefix_share=True`` turns on copy-on-write
+    prompt-prefix sharing; ``prefill_chunk=N`` splits admissions into
+    N-token chunks interleaved with decode.  All three default to
+    their ``APEX_TPU_SERVE_*`` flags.
 
     Per-request telemetry (ISSUE-11) is always on: every request's
     lifecycle chain (``request_submitted → request_admitted →
@@ -1197,6 +1212,34 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                                      kv_dtype=kv_dtype)
     if ladder is None:
         ladder = BucketLadder.from_flags()
+    from ..analysis.flags import flag_int as _flag_int
+
+    spec_k = speculate_k if speculate_k is not None \
+        else _flag_int("APEX_TPU_SERVE_SPECULATE_K")
+    draft_weights = draft_cfg = None
+    if spec_k > 0:
+        if draft == "self":
+            # the target proposes for itself: acceptance is exactly
+            # 1.0, proving the verify/rollback machinery end to end
+            # with the output-identity bar still armed
+            draft_weights, draft_cfg = weights, cfg
+        elif draft == "narrow":
+            draft_model = GPTModel(
+                vocab_size=vocab, hidden_size=max(hidden // 2,
+                                                  2 * num_heads),
+                num_layers=1, num_attention_heads=num_heads,
+                max_sequence_length=max_seq, attention_dropout=0.0,
+                hidden_dropout=0.0, use_flash=False, dtype=dtype)
+            draft_params = jax.jit(draft_model.init)(
+                jax.random.PRNGKey(seed + 1),
+                jnp.zeros((1, min(8, max_seq)), jnp.int32))["params"]
+            draft_cfg = ServingModelConfig.from_model(
+                draft_model, prefill_flash=prefill_flash,
+                decode_attention=decode_attention)
+            draft_weights = extract_serving_weights(draft_params, 1)
+        else:
+            raise ValueError(f"draft {draft!r} not in "
+                             f"('self', 'narrow')")
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=None, flops_per_step=None,
         stall_timeout=stall_timeout, escalation=None,
@@ -1223,7 +1266,12 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         own_snapshot = True
     engine = ServingEngine(weights, cfg, cache_cfg, ladder=ladder,
                            monitor=monitor, autoresume=autoresume,
-                           tick_every=tick_every, snapshot=snapshot)
+                           tick_every=tick_every, snapshot=snapshot,
+                           speculate_k=spec_k,
+                           draft_weights=draft_weights,
+                           draft_cfg=draft_cfg,
+                           prefill_chunk=prefill_chunk,
+                           prefix_share=prefix_share)
     # mixed-length prompts, deterministic per seed; every request
     # fits the ladder span and the model's position table
     rng = np.random.RandomState(seed)
@@ -1360,17 +1408,57 @@ def _main(argv=None):
     p.add_argument("--decode-reference", action="store_true",
                    help="(--serve) dense full-gather decode instead "
                         "of the paged kernel (the naive baseline)")
+    p.add_argument("--speculate-k", type=int, default=None,
+                   metavar="K",
+                   help="(--serve) speculative decoding: a draft "
+                        "model proposes K tokens per tick, the "
+                        "target scores all of them in one paged "
+                        "multi-token call; greedy-match acceptance "
+                        "keeps output token-identical to plain "
+                        "greedy decode (default: "
+                        "APEX_TPU_SERVE_SPECULATE_K)")
+    p.add_argument("--draft", choices=("self", "narrow"),
+                   default="self",
+                   help="(--serve --speculate-k) draft model: "
+                        "'self' reuses the target (acceptance 1.0 "
+                        "ceiling), 'narrow' a 1-layer half-width "
+                        "GPT (rollback stress)")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   metavar="N",
+                   help="(--serve) chunked prefill: split prompt "
+                        "admission into N-token chunks interleaved "
+                        "one per tick with decode (default: "
+                        "APEX_TPU_SERVE_PREFILL_CHUNK)")
+    p.add_argument("--prefix-share", action="store_true",
+                   default=None,
+                   help="(--serve) copy-on-write prompt-prefix "
+                        "sharing: warm prefixes map shared KV pages "
+                        "instead of re-prefilling (default: "
+                        "APEX_TPU_SERVE_PREFIX_SHARE)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     if args.serve:
-        s = serve_smoke(
+        s, eng = serve_smoke(
             args.requests, jsonl=args.jsonl, sanitize=args.sanitize,
             max_new_tokens=args.new_tokens,
             max_seq=args.serve_max_seq,
             decode_attention=("reference" if args.decode_reference
                               else "kernel"),
             stall_timeout=args.stall_timeout, fault=args.fault,
-            trace_dir=args.trace)
+            trace_dir=args.trace, speculate_k=args.speculate_k,
+            prefill_chunk=args.prefill_chunk,
+            prefix_share=args.prefix_share, draft=args.draft,
+            return_engine=True)
+        spec = "" if s.spec_accept_rate is None else (
+            f" spec_accept_rate={s.spec_accept_rate}"
+            f" spec_proposed={s.spec_tokens_proposed}")
+        share = "" if not (s.warm_prefix_admissions
+                           or s.shared_blocks_hw) else (
+            f" warm_admissions={s.warm_prefix_admissions}"
+            f" shared_blocks_hw={s.shared_blocks_hw}"
+            f" cow_copies={s.cow_copies}")
+        chunks = f" prefill_chunks={s.prefill_chunks}" \
+            if s.prefill_chunks else ""
         print(f"SERVE_DONE requests={s.requests_done} "
               f"preempted={s.requests_preempted} "
               f"tokens={s.tokens_generated} "
@@ -1382,6 +1470,8 @@ def _main(argv=None):
               f"steps={s.decode_steps} "
               f"compiles={len(s.compiles)} "
               f"drained={int(s.drained)}"
+              f"{spec}{share}{chunks} "
+              f"digest={eng.tokens_digest()}"
               + (f" jsonl={args.jsonl}" if args.jsonl else ""))
         return
     loss, _, _, done = train_smoke(

@@ -16,7 +16,9 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.flash_attention import set_head_packing
 from apex_tpu.ops.flash_decode import (flash_decode,
+                                       flash_decode_multi,
                                        pack_decode_heads,
+                                       paged_attention_multi_reference,
                                        paged_attention_reference,
                                        unpack_decode_heads,
                                        use_decode_head_packing)
@@ -203,6 +205,91 @@ class TestFlashDecodeParity:
             set_head_packing(True)
         assert not use_decode_head_packing(3, 64)   # odd heads
         assert not use_decode_head_packing(4, 32)   # d != 64
+
+
+def make_multi_case(b=3, t=3, h=2, d=32, nb=10, bs=8, mp=3, *, seed=0,
+                    dtype=jnp.float32):
+    """Random (b, t) chunk queries + paged cache: row 0 inactive,
+    row 1 straddling mid-block, row 2 exactly filling its pages."""
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d), dtype)
+    kd = jax.random.normal(ks[1], (nb, h, bs, d), dtype)
+    vd = jax.random.normal(ks[2], (nb, h, bs, d), dtype)
+    bt = np.full((b, mp), DUMP_BLOCK, np.int32)
+    sl = np.zeros(b, np.int32)
+    sl[1] = mp * bs - bs // 2 - 1
+    bt[1, :2] = [3, 4]
+    sl[2] = mp * bs
+    bt[2] = [5, 6, 7]
+    return q, kd, vd, jnp.asarray(bt), jnp.asarray(sl)
+
+
+class TestFlashDecodeMultiParity:
+    """The APX402 anchor for the multi-token (speculative-verify /
+    chunked-prefill) kernel: :func:`flash_decode_multi` vs
+    :func:`paged_attention_multi_reference`."""
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_parity_unpacked(self, dtype):
+        q, kd, vd, bt, sl = make_multi_case(dtype=dtype)
+        got = flash_decode_multi(q, kd, vd, bt, sl)
+        want = paged_attention_multi_reference(q, kd, vd, bt, sl)
+        assert got.dtype == dtype
+        _assert_close(got, want, dtype)
+
+    def test_parity_packed_d64(self):
+        q, kd, vd, bt, sl = make_multi_case(h=4, d=64, bs=4)
+        got = flash_decode_multi(q, _pack_cache(kd), _pack_cache(vd),
+                                 bt, sl)
+        want = paged_attention_multi_reference(
+            q, _pack_cache(kd), _pack_cache(vd), bt, sl)
+        _assert_close(got, want, jnp.float32)
+
+    def test_parity_int8(self):
+        q, kd, vd, bt, sl = make_multi_case(seed=3)
+        kq, ksc = quantize_kv_rows(kd)
+        vq, vsc = quantize_kv_rows(vd)
+        got = flash_decode_multi(q, kq, vq, bt, sl, k_scale=ksc,
+                                 v_scale=vsc)
+        want = paged_attention_multi_reference(
+            q, kq, vq, bt, sl, k_scale=ksc, v_scale=vsc)
+        _assert_close(got, want, jnp.float32)
+
+    def test_t1_matches_single_token_decode(self):
+        # the degenerate chunk is exactly the decode kernel's math
+        q, kc, vc, bt, sl, _, _ = make_paged_case()
+        one = flash_decode(q, kc, vc, bt, sl)
+        multi = flash_decode_multi(q[:, None], kc, vc, bt, sl)[:, 0]
+        _assert_close(multi, one, jnp.float32)
+
+    def test_inactive_and_padding_rows_zero(self):
+        # inactive sequences (sl=0) and front-padding rows (negative
+        # chunk positions, sl < t) both emit exactly 0
+        q, kd, vd, bt, sl = make_multi_case(t=5)
+        out = np.asarray(flash_decode_multi(q, kd, vd, bt, sl))
+        assert np.all(out[0] == 0.0)            # inactive row
+        short = jnp.asarray(np.asarray([2, 2, 2], np.int32))
+        out2 = np.asarray(flash_decode_multi(q, kd, vd, bt, short))
+        assert np.all(out2[:, :3] == 0.0)       # positions -3..-1
+        want = paged_attention_multi_reference(q, kd, vd, bt, short)
+        _assert_close(out2, want, jnp.float32)
+
+    def test_per_row_causality(self):
+        # poisoning position p must change only rows whose causal
+        # span reaches p: row r attends pos <= sl - t + r
+        q, kd, vd, bt, sl = make_multi_case(seed=5)
+        clean = np.asarray(flash_decode_multi(q, kd, vd, bt, sl))
+        i, bs = 2, kd.shape[2]
+        last = int(sl[i]) - 1                   # newest position
+        blk, off = int(bt[i, last // bs]), last % bs
+        poisoned = np.asarray(kd).copy()
+        poisoned[blk, :, off, :] += 3.0
+        got = np.asarray(flash_decode_multi(
+            q, jnp.asarray(poisoned), vd, bt, sl))
+        t = q.shape[1]
+        # only the final row of row-i's chunk sees the newest slot
+        _assert_close(got[i, :t - 1], clean[i, :t - 1], jnp.float32)
+        assert not np.allclose(got[i, t - 1], clean[i, t - 1])
 
 
 # ---------------------------------------------------------------------------

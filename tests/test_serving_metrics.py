@@ -610,3 +610,91 @@ class TestServeSummaryAndDriver:
         json.dumps(d)   # the bench row / serve_done event shape
         done_ev = mon.sink.by_name("serve_done")[0]
         assert "ttft_p99_ms" in done_ev.attrs
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill spanning ticks (ISSUE-12)
+# ---------------------------------------------------------------------------
+
+class TestMultiTickPrefillLifecycle:
+    def _req(self, rid="r0", prompt=(1, 2, 3), new=3):
+        return Request(rid=rid, prompt=list(prompt),
+                       max_new_tokens=new)
+
+    def test_split_admit_first_token_chain(self):
+        # chunked prefill: request_admitted at prefill start,
+        # request_first_token TICKS later at the real first token —
+        # TTFT and the parts-sum identity measured to that instant
+        clock = FakeClock()                      # init consumes t=1
+        mon = StubMonitor()
+        m = ServeMetrics(monitor=mon, clock=clock, tick_every=1)
+        req = self._req()
+        m.on_submit(req, 0)                      # submit_t = 2
+        m.on_admit(req, 0, admit_t=clock(),      # admit_t = 3
+                   prefill_s=None, warm_tokens=0)
+        clock.t = 7.0
+        m.on_first_token(req, 2, clock())        # first token @ 8
+        req.out_tokens = [5, 6, 7]
+        req.token_latency_s = [5.0, 0.5, 0.25]
+        clock.t = 10.0
+        m.on_done(req, 4)                        # done_t = 11
+        names = [e.name for e in mon.sink.by_kind("serving")]
+        assert names == ["request_submitted", "request_admitted",
+                         "request_first_token", "request_done"]
+        admitted = mon.sink.by_name("request_admitted")[0]
+        assert admitted.value is None            # duration unknown yet
+        ft = mon.sink.by_name("request_first_token")[0]
+        assert ft.attrs["ttft_ms"] == pytest.approx(6000.0)
+        assert ft.attrs["prefill_ms"] == pytest.approx(5000.0)
+        done = mon.sink.by_name("request_done")[0].attrs
+        assert done["prefill_ms"] == pytest.approx(5000.0)
+        assert done["queue_wait_ms"] + done["prefill_ms"] \
+            + done["decode_ms"] == pytest.approx(done["wall_ms"])
+        assert m.percentiles()["ttft_p50_ms"] == pytest.approx(6000.0)
+
+    def test_preempted_mid_prefill_parts_still_sum(self):
+        # a request drained while its chunked prefill was running has
+        # no first token: its post-admission wall reads as prefill,
+        # the chain stays complete, and no ttft_ms is claimed
+        clock = FakeClock()
+        mon = StubMonitor()
+        m = ServeMetrics(monitor=mon, clock=clock, tick_every=1)
+        req = self._req()
+        m.on_submit(req, 0)                      # submit_t = 2
+        m.on_admit(req, 0, admit_t=clock(), prefill_s=None)  # t = 3
+        req.preempted = True
+        clock.t = 8.0
+        m.on_done(req, 3)                        # done_t = 9
+        done = mon.sink.by_name("request_done")[0].attrs
+        assert done["preempted"] and "ttft_ms" not in done
+        assert done["prefill_ms"] == pytest.approx(6000.0)
+        assert done["decode_ms"] == 0.0
+        assert done["queue_wait_ms"] + done["prefill_ms"] \
+            == pytest.approx(done["wall_ms"])
+        assert not mon.sink.by_name("request_first_token")
+
+    def test_chunked_serve_passes_trace_check(self, tmp_path):
+        # the acceptance bar end to end: lifecycle chains complete
+        # (N submitted => N terminal, TTFT on every finished rid,
+        # parts-sum <= 2%) when every prefill spans multiple ticks
+        jsonl = tmp_path / "serve.jsonl"
+        summary = serve_smoke(
+            4, max_new_tokens=3, jsonl=str(jsonl),
+            ladder=BucketLadder(batch=(2, 4), pages=(4,),
+                                chunks=(2,)),
+            num_blocks=48, block_size=4, autoresume=None,
+            snapshot=None, prefill_chunk=2)
+        assert summary.requests_done == 4
+        assert summary.prefill_chunks >= 4
+        assert check_serve_trace(str(jsonl)) == []
+        assert summary.ttft_p50_ms is not None
+
+    def test_spec_serve_passes_trace_check(self, tmp_path):
+        jsonl = tmp_path / "serve.jsonl"
+        summary = serve_smoke(
+            3, max_new_tokens=4, jsonl=str(jsonl),
+            ladder=BucketLadder(batch=(2, 4), pages=(2,)),
+            num_blocks=24, block_size=4, autoresume=None,
+            snapshot=None, speculate_k=2, draft="self")
+        assert summary.spec_accept_rate == 1.0
+        assert check_serve_trace(str(jsonl)) == []

@@ -84,7 +84,9 @@ def _pipeline_rows(full):
 # inverts its comparison for these — a >5% INCREASE fails.
 LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms",
                              "serving_ttft_p99_ms",
-                             "serving_itl_p99_ms"})
+                             "serving_itl_p99_ms",
+                             "serving_warm_admission_ms",
+                             "serving_chunked_itl_p99_ms"})
 
 
 def headline_metrics(full):
@@ -132,6 +134,23 @@ def headline_metrics(full):
         "serving_itl_p99_ms": (
             _get(full, "extras", "serving", "decode", "itl_p99_ms"),
             "serving"),
+        # ISSUE-12 decode fast path: speculative throughput and
+        # acceptance gate upward, warm-prefix admission latency and
+        # the chunked-prefill staggered ITL gate LOWER_IS_BETTER.
+        # Artifacts predating the columns roll forward (old_v None
+        # is never gated — the PR-11 TTFT precedent).
+        "serving_spec_tokens_per_sec": (
+            _get(full, "extras", "serving", "speculative",
+                 "spec_tokens_per_sec"), "serving"),
+        "serving_spec_accept_rate": (
+            _get(full, "extras", "serving", "speculative",
+                 "acceptance_rate"), "serving"),
+        "serving_warm_admission_ms": (
+            _get(full, "extras", "serving", "prefix_share",
+                 "warm_prefix_admission_ms"), "serving"),
+        "serving_chunked_itl_p99_ms": (
+            _get(full, "extras", "serving", "chunked_prefill",
+                 "itl_p99_ms_staggered_chunked"), "serving"),
     }
     lc = _get(full, "extras", "long_context") or {}
     if isinstance(lc, dict):
@@ -383,6 +402,51 @@ def self_test() -> int:
     r, notes = compare(srv_skip, srv)
     assert r == [] and any("serving" in n and "skipped" in n
                            for n in notes), (r, notes)
+    # ISSUE-12 fast-path legs: speculative tokens/s + acceptance gate
+    # like throughput, warm-admission latency and the chunked
+    # staggered ITL gate LOWER_IS_BETTER, and artifacts predating the
+    # columns roll forward ungated (the PR-11 TTFT precedent)
+    fast = json.loads(json.dumps(srv))
+    fast["extras"]["serving"]["speculative"] = {
+        "spec_tokens_per_sec": 900.0, "acceptance_rate": 0.8}
+    fast["extras"]["serving"]["prefix_share"] = {
+        "warm_prefix_admission_ms": 5.0}
+    fast["extras"]["serving"]["chunked_prefill"] = {
+        "itl_p99_ms_staggered_chunked": 22.0}
+    r, _ = compare(json.loads(json.dumps(fast)), fast)
+    assert r == [], r
+    slow_spec = json.loads(json.dumps(fast))
+    slow_spec["extras"]["serving"]["speculative"][
+        "spec_tokens_per_sec"] = 700.0                       # -22%
+    r, _ = compare(slow_spec, fast)
+    assert len(r) == 1 and "serving_spec_tokens_per_sec" in r[0], r
+    low_accept = json.loads(json.dumps(fast))
+    low_accept["extras"]["serving"]["speculative"][
+        "acceptance_rate"] = 0.5
+    r, _ = compare(low_accept, fast)
+    assert len(r) == 1 and "serving_spec_accept_rate" in r[0], r
+    cold_adm = json.loads(json.dumps(fast))
+    cold_adm["extras"]["serving"]["prefix_share"][
+        "warm_prefix_admission_ms"] = 9.0                    # +80%
+    r, _ = compare(cold_adm, fast)
+    assert len(r) == 1 and "serving_warm_admission_ms" in r[0] \
+        and "lower is better" in r[0], r
+    spiky = json.loads(json.dumps(fast))
+    spiky["extras"]["serving"]["chunked_prefill"][
+        "itl_p99_ms_staggered_chunked"] = 40.0
+    r, _ = compare(spiky, fast)
+    assert len(r) == 1 and "serving_chunked_itl_p99_ms" in r[0], r
+    improved = json.loads(json.dumps(fast))
+    improved["extras"]["serving"]["prefix_share"][
+        "warm_prefix_admission_ms"] = 2.0
+    improved["extras"]["serving"]["chunked_prefill"][
+        "itl_p99_ms_staggered_chunked"] = 15.0
+    r, _ = compare(improved, fast)
+    assert r == [], r
+    # roll-forward: gating a fast-path fresh run against a committed
+    # artifact WITHOUT the columns never fires
+    r, _ = compare(slow_spec, srv)
+    assert r == [], r
     # the ratio escalation switch (satellite: WARN -> gate behind
     # APEX_TPU_BENCH_GATE_RATIO=1)
     assert not ratio_enforced({})

@@ -99,7 +99,16 @@
 #                            cache block returns to the pool,
 #                            in-flight AND queued requests end in
 #                            terminal preempted events whose chains
-#                            still check out (docs/api/serving.md)
+#                            still check out (docs/api/serving.md);
+#                            finally the ISSUE-12 fast path: the same
+#                            trace with --speculate-k 2 --prefix-share
+#                            under --sanitize must keep the
+#                            zero-recompile contract (draft/verify/
+#                            CoW programs all in warmup), report
+#                            acceptance_rate > 0 and shared blocks,
+#                            and emit a tokens digest IDENTICAL to
+#                            the plain leg's (speculative greedy ==
+#                            greedy, token for token)
 #  12. SPMD sharding audit   — python -m apex_tpu.analysis
 #                            --check-sharding compiles every
 #                            plan-carrying multichip entry point under
@@ -250,6 +259,7 @@ grep -q '"kind":"serve_tick"' "$SERVE_DIR/serve.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/serve.jsonl" --serve \
     --chrome "$SERVE_DIR/tr/serve.chrome.json"
 python tools/monitor_summary.py "$SERVE_DIR/serve.jsonl"
+SERVE_OUT_LEG1="$SERVE_OUT"   # leg 3 compares output digests
 # leg 2: SIGTERM mid-serve (flag-only handler, --fault sigterm@2) —
 # the engine stops admitting, frees every block, marks in-flight
 # requests preempted and still returns a full summary; preempted
@@ -265,6 +275,30 @@ echo "$SERVE_OUT" | grep -Eq "preempted=[1-9]" \
 grep -q '"name":"serve_preempt"' "$SERVE_DIR/drain.jsonl" \
     || { echo "[ci] FAIL: no serve_preempt event in the JSONL"; exit 1; }
 python tools/trace_check.py "$SERVE_DIR/drain.jsonl" --serve
+# leg 3 (ISSUE-12): the decode fast path — speculative decoding +
+# copy-on-write prefix sharing under --sanitize.  The same trace as
+# leg 1 must (a) hold the zero-recompile ladder contract with the
+# draft/verify/CoW programs in the warmup set, (b) record a positive
+# acceptance rate (self-draft: exactly 1.0), and (c) emit
+# token-for-token identical output to the plain engine — proven by
+# comparing the SERVE_DONE tokens digests across the two legs.
+PLAIN_DIGEST="$(echo "$SERVE_OUT_LEG1" | grep -o 'digest=[0-9a-f]*')"
+SERVE_OUT="$(APEX_TPU_SERVE_BATCH_BUCKETS=2,4 \
+    APEX_TPU_SERVE_PAGE_BUCKETS=2 \
+    python -m apex_tpu.testing.standalone_gpt --serve --requests 5 \
+    --new-tokens 4 --jsonl "$SERVE_DIR/spec.jsonl" --sanitize \
+    --speculate-k 2 --prefix-share)"
+echo "$SERVE_OUT"
+echo "$SERVE_OUT" | grep -q "requests=5 " \
+    || { echo "[ci] FAIL: spec serve did not finish all 5 requests"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "spec_accept_rate=(1\.0|0\.[0-9]*[1-9])" \
+    || { echo "[ci] FAIL: speculative serve reported zero acceptance"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "shared_blocks_hw=[1-9]" \
+    || { echo "[ci] FAIL: prefix sharing registered no shared blocks"; exit 1; }
+SPEC_DIGEST="$(echo "$SERVE_OUT" | grep -o 'digest=[0-9a-f]*')"
+[ -n "$PLAIN_DIGEST" ] && [ "$SPEC_DIGEST" = "$PLAIN_DIGEST" ] \
+    || { echo "[ci] FAIL: speculative output digest $SPEC_DIGEST != plain $PLAIN_DIGEST"; exit 1; }
+python tools/trace_check.py "$SERVE_DIR/spec.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
 echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
