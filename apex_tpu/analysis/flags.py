@@ -362,6 +362,38 @@ register_flag(
     "load-balances on.  Counters accumulate across the window; a "
     "trailing partial window flushes at run end.", lo=1)
 register_flag(
+    "APEX_TPU_SERVE_DEADLINE_MS", "float", 0.0,
+    "Default request deadline (milliseconds, submit -> last token) "
+    "for serving requests that do not carry their own: a queued "
+    "request past its deadline is expired terminal "
+    "`deadline_exceeded`, a running one evicted terminal `deadline` "
+    "(blocks freed) — enforced at tick boundaries, AFTER the "
+    "expiring tick's tokens were delivered.  0 disables "
+    "(docs/api/resilience.md#serving-resilience).", lo=0.0)
+register_flag(
+    "APEX_TPU_SERVE_SHED_POOL_HW", "float", 0.0,
+    "Load-shedding high-water mark on KV-pool pressure (fraction of "
+    "usable blocks an allocation could not draw on): crossing it "
+    "engages shedding — admissions stop and lowest-priority/"
+    "shortest-progress work sheds — until pressure drops below the "
+    "low-water mark (high-water minus 0.15, the hysteresis band).  "
+    "0 disables the pool trigger.", lo=0.0, hi=1.0)
+register_flag(
+    "APEX_TPU_SERVE_SHED_QUEUE_HW", "int", 0,
+    "Load-shedding high-water mark on the admission backlog (queued "
+    "+ mid-prefill requests): crossing it engages shedding until the "
+    "backlog drops below half the mark (hysteresis).  0 disables the "
+    "queue trigger.", lo=0)
+register_flag(
+    "APEX_TPU_SERVE_JOURNAL_DIR", "str", None,
+    "Directory for the serving request journal "
+    "(serving/resilience.py): when set, the --serve driver records "
+    "every request's submit/progress/terminal transitions to "
+    "<dir>/serve.journal.jsonl (crash-safe append-only JSONL), and a "
+    "supervised serve (--supervise) replays it after an engine-loop "
+    "crash — every non-terminal request re-submitted, warm through "
+    "prefix sharing.  The --journal CLI flag overrides.")
+register_flag(
     "APEX_TPU_SERVE_SNAPSHOT_FILE", "str", None,
     "On-demand serving snapshot trigger: touching this file (or "
     "SIGUSR1 in the --serve driver) dumps the live engine state — "

@@ -57,7 +57,16 @@ SCHEMA_VERSION = 1
 #:               ``request_rejected`` / ``request_admitted`` /
 #:               ``request_first_token`` / ``request_done``,
 #:               ``decode_step``, ``serve_compile``, ``serve_preempt``,
-#:               ``serve_done``, ``engine_snapshot``)
+#:               ``serve_done``, ``engine_snapshot``; resilience:
+#:               ``deadline_exceeded``, ``request_shed``,
+#:               ``request_replayed``, ``journal_replay``,
+#:               ``crash_reset``, ``alloc_rejected``,
+#:               ``escalation_drain`` — ``request_done`` carries a
+#:               ``terminal`` reason on every path)
+#:   ``journal``  serving request-journal records
+#:               (serving/resilience.RequestJournal: ``submit`` /
+#:               ``progress`` / ``terminal`` / ``replay`` — its OWN
+#:               JSONL file, not the run log)
 #:   ``serve_tick`` per-tick engine gauges (batch / bucket shape /
 #:               free+reserved blocks / queue depth / admissions+
 #:               evictions+preemptions this window — the fleet-router
@@ -128,6 +137,18 @@ class Event:
                      name=d["name"],
                      value=d.get("value"),
                      attrs=d.get("attrs") or {})
+
+
+def terminal_reason(attrs: Mapping[str, Any]) -> str:
+    """The terminal reason of a serving ``request_done`` event's
+    attrs: the ``terminal`` attr when present (finished / preempted /
+    deadline / deadline_exceeded / shed), else the pre-ISSUE-13
+    fallback on the ``preempted`` flag — ONE implementation shared by
+    every consumer (summary digest, ``trace_check --serve``) so they
+    cannot disagree about the same event."""
+    return str(attrs.get("terminal")
+               or ("preempted" if attrs.get("preempted")
+                   else "finished"))
 
 
 def emit_resilience(sink, name: str, *, value=None,

@@ -23,7 +23,7 @@ import statistics
 import sys
 from typing import Dict, List, Optional
 
-from .events import Event
+from .events import Event, terminal_reason
 
 
 def load_events(path: str) -> tuple:
@@ -224,10 +224,34 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
         done_events = [e for e in srv if e.name == "request_done"]
         digest["submitted"] = sum(1 for e in srv
                                   if e.name == "request_submitted")
+
+        def _terminal(e):
+            return terminal_reason(e.attrs)
+
         digest["done"] = sum(1 for e in done_events
-                             if not e.attrs.get("preempted"))
+                             if _terminal(e) == "finished")
         digest["preempted"] = sum(1 for e in done_events
-                                  if e.attrs.get("preempted"))
+                                  if _terminal(e) == "preempted")
+        # ISSUE-13 terminal paths: deadline expiry (queued OR
+        # running) and load shedding — rendered so N submitted still
+        # visibly reconciles against N terminal
+        deadline = sum(1 for e in done_events
+                       if _terminal(e).startswith("deadline"))
+        shed = sum(1 for e in done_events if _terminal(e) == "shed")
+        if deadline:
+            digest["deadline_exceeded"] = deadline
+        if shed:
+            digest["shed"] = shed
+        replays = [e for e in srv if e.name == "journal_replay"]
+        if replays:
+            digest["journal_replays"] = [
+                {"tick": e.step,
+                 "replayed": e.attrs.get("replayed"),
+                 "skipped_terminal": e.attrs.get("skipped_terminal")}
+                for e in replays]
+        replayed = sum(1 for e in srv if e.name == "request_replayed")
+        if replayed:
+            digest["replayed_requests"] = replayed
         rejected: Dict[str, int] = {}
         for e in srv:
             if e.name == "request_rejected":
@@ -439,12 +463,21 @@ def render(summary: dict) -> str:
         head = (f"serving: {srv.get('submitted', 0)} submitted, "
                 f"{srv.get('done', 0)} done, "
                 f"{srv.get('preempted', 0)} preempted")
+        if srv.get("deadline_exceeded"):
+            head += f", {srv['deadline_exceeded']} deadline-expired"
+        if srv.get("shed"):
+            head += f", {srv['shed']} shed"
         rej = srv.get("rejected")
         if rej:
             head += (", rejected "
                      + " ".join(f"{k}={v}"
                                 for k, v in sorted(rej.items())))
         lines.append(head)
+        for r in srv.get("journal_replays", []):
+            lines.append(f"  JOURNAL REPLAY @ tick {r.get('tick')}: "
+                         f"{r.get('replayed')} request(s) re-entered, "
+                         f"{r.get('skipped_terminal')} already "
+                         f"terminal")
         dists = srv.get("latency") or {}
         if dists:
             lines.append(f"{'series':<16} {'mean ms':>9} {'p50 ms':>9} "

@@ -56,7 +56,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from ..analysis.flags import flag_float, flag_int, flag_str
 from ..utils.log_util import get_logger
-from .events import Event, Sink
+from .events import Event, Sink, terminal_reason
 
 logger = get_logger(__name__)
 
@@ -463,9 +463,15 @@ def check_serve_trace(jsonl_path: str,
 
     * lifecycle completeness — every submitted rid ends in exactly one
       terminal ``request_done`` (N submitted ⇒ N terminal events), no
-      terminal without a submit;
-    * TTFT present for every non-preempted rid (``request_first_token``
-      event + ``ttft_ms`` on the terminal);
+      terminal without a submit.  This holds on EVERY terminal path:
+      finished, drain-preempted, ``deadline``/``deadline_exceeded``
+      expiry, ``shed``, and across a supervised crash-replay (a
+      journal-replayed rid re-enters WITHOUT a second submit event,
+      so the chain still closes exactly once);
+    * TTFT present for every rid that *finished* (``request_first_
+      token`` event + ``ttft_ms`` on the terminal); preempted / shed /
+      deadline-expired requests may legitimately end before their
+      first token;
     * per-request attribution — ``queue_wait + prefill + decode`` sums
       to the rid's ``wall_ms`` within ``tolerance``;
     * engine gauges — a run that decoded must carry ``serve_tick``
@@ -506,11 +512,12 @@ def check_serve_trace(jsonl_path: str,
                             f"request_submitted")
     for rid, e in sorted(done_events.items()):
         a = e.attrs
-        if not a.get("preempted"):
+        term = terminal_reason(a)
+        if term == "finished":
             if "ttft_ms" not in a:
                 failures.append(f"rid {rid}: finished without a "
                                 f"ttft_ms — TTFT must exist for "
-                                f"every non-preempted request")
+                                f"every finished request")
             if rid not in first_token:
                 failures.append(f"rid {rid}: no request_first_token "
                                 f"event in the chain")

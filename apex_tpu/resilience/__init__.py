@@ -37,15 +37,19 @@ from .escalation import (
     ABORT,
     CHECKPOINT_THEN_ABORT,
     DEFAULT_POLICY,
+    DEFAULT_SERVE_POLICY,
     IGNORE,
+    SNAPSHOT_THEN_DRAIN,
     EscalationAbort,
     EscalationPolicy,
+    serve_policy,
 )
 from .faults import (
     FaultInjector,
     InjectedCrash,
     InjectedFault,
     corrupt_checkpoint,
+    corrupt_journal,
     parse_fault,
 )
 
@@ -53,7 +57,8 @@ __all__ = [
     "AutoResume", "read_clean_exit", "CLEAN_EXIT_MARKER",
     "run_resumable", "backoff_delay", "GiveUp",
     "EscalationPolicy", "EscalationAbort", "DEFAULT_POLICY",
-    "IGNORE", "ABORT", "CHECKPOINT_THEN_ABORT",
+    "DEFAULT_SERVE_POLICY", "serve_policy",
+    "IGNORE", "ABORT", "CHECKPOINT_THEN_ABORT", "SNAPSHOT_THEN_DRAIN",
     "FaultInjector", "parse_fault", "InjectedFault", "InjectedCrash",
-    "corrupt_checkpoint",
+    "corrupt_checkpoint", "corrupt_journal",
 ]

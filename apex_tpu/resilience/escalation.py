@@ -40,14 +40,42 @@ from typing import Dict, NamedTuple, Optional
 IGNORE = "ignore"
 ABORT = "abort"
 CHECKPOINT_THEN_ABORT = "checkpoint_then_abort"
+#: Serving-side action (ISSUE-13): dump ONE structured engine
+#: snapshot, then drain the serve cleanly — blocks freed, every
+#: request terminal ``preempted``, summary returned.  The serve
+#: answer to ``stall``: unlike a training step, a serve can end
+#: usefully without a checkpoint, so a wedged decode should never be
+#: ``ignore``\ d — it should leave a post-mortem and stop honestly.
+SNAPSHOT_THEN_DRAIN = "snapshot_then_drain"
 
-ACTIONS = (IGNORE, ABORT, CHECKPOINT_THEN_ABORT)
+ACTIONS = (IGNORE, ABORT, CHECKPOINT_THEN_ABORT, SNAPSHOT_THEN_DRAIN)
 
 DEFAULT_POLICY: Dict[str, str] = {
     "nonfinite_loss": ABORT,
     "overflow_streak": CHECKPOINT_THEN_ABORT,
     "stall": IGNORE,
 }
+
+#: The serving default (:func:`serve_policy`): the stall rationale
+#: flips — the serve loop's heartbeat fires off-thread while decode is
+#: wedged, and once the tick boundary is reached again the engine CAN
+#: act: snapshot the live state, then drain.  Training alarms that
+#: cannot occur in a serve (no loss, no scaler) are left ignored.
+DEFAULT_SERVE_POLICY: Dict[str, str] = {
+    "stall": SNAPSHOT_THEN_DRAIN,
+}
+
+
+def serve_policy(policy: Optional[Dict[str, str]] = None
+                 ) -> "EscalationPolicy":
+    """An :class:`EscalationPolicy` with the serving defaults
+    (``stall`` → ``snapshot_then_drain``; the training alarms —
+    nonfinite loss, overflow streaks — cannot occur on the serve path
+    and stay ignored); ``policy`` overrides merge on top.  Plug into
+    ``Watchdog(on_alarm=...)`` and hand the same object to
+    :class:`~apex_tpu.serving.ServingEngine`, which polls it at tick
+    boundaries."""
+    return EscalationPolicy(policy, defaults=DEFAULT_SERVE_POLICY)
 
 
 class EscalationAbort(RuntimeError):
@@ -78,8 +106,10 @@ class EscalationPolicy:
     default escalation.
     """
 
-    def __init__(self, policy: Optional[Dict[str, str]] = None):
-        self.policy = dict(DEFAULT_POLICY)
+    def __init__(self, policy: Optional[Dict[str, str]] = None, *,
+                 defaults: Optional[Dict[str, str]] = None):
+        self.policy = dict(DEFAULT_POLICY if defaults is None
+                           else defaults)
         if policy:
             for name, action in policy.items():
                 if action not in ACTIONS:

@@ -33,7 +33,17 @@ import time
 from typing import List, Optional
 
 #: Step-triggered fault kinds understood by :func:`parse_fault`.
-KINDS = ("crash", "kill", "sigterm", "sigint", "nan", "inf", "stall")
+#: ``reject_alloc`` and ``corrupt_journal`` are SERVE-path injectors
+#: (ISSUE-13): ``reject_alloc@K`` makes the engine treat tick K's
+#: admissions as pool-exhausted (one tick, once); ``corrupt_journal@K
+#: [:mode]`` damages the live request journal at tick K (``truncate``
+#: = torn trailing line, ``unfinalize`` = the last terminal record
+#: stripped — a request that finished looks in-flight, the
+#: at-least-once replay drill).  Both fire through the serve driver's
+#: :meth:`FaultInjector.before_tick` / the engine's admission poll,
+#: with the same once-semantics as the training kinds.
+KINDS = ("crash", "kill", "sigterm", "sigint", "nan", "inf", "stall",
+         "reject_alloc", "corrupt_journal")
 
 
 class InjectedFault(RuntimeError):
@@ -96,6 +106,40 @@ class FaultInjector:
                 s.fired = True
                 time.sleep(float(s.arg or 1.0))
 
+    def before_tick(self, tick: int, *,
+                    journal_path: Optional[str] = None) -> None:
+        """Serve-loop form of :meth:`before_step`: fires the
+        process-level kinds (crash/kill/signal/stall) exactly as the
+        training hook does, plus ``corrupt_journal`` against the live
+        journal at ``journal_path`` (a spec with no journal wired is a
+        no-op that still disarms — once-semantics over silent
+        re-arming)."""
+        for s in self.specs:
+            if s.fired or s.step != tick \
+                    or s.kind != "corrupt_journal":
+                continue
+            s.fired = True
+            if journal_path is not None:
+                corrupt_journal(journal_path,
+                                mode=str(s.arg or "truncate"))
+        self.before_step(tick)
+
+    def reject_alloc(self, tick: int) -> bool:
+        """True exactly once, at the first admission poll AT OR AFTER
+        an armed ``reject_alloc@K`` spec's tick — the serving engine
+        polls this in its admission path and skips the tick's
+        admissions (simulated pool exhaustion).  At-or-after, not
+        exact-match: the engine only polls on ticks that would admit,
+        so a drain/shed tick landing exactly on K must defer the
+        fault to the next admitting tick instead of leaving the spec
+        armed-but-dead forever."""
+        for s in self.specs:
+            if not s.fired and tick >= s.step \
+                    and s.kind == "reject_alloc":
+                s.fired = True
+                return True
+        return False
+
     def before_window(self, start: int, k: int) -> None:
         """Scan-driver form of :meth:`before_step`: fire every armed
         process-level spec whose step lands anywhere in the K-step
@@ -137,8 +181,21 @@ def parse_fault(spec: Optional[str]) -> Optional[FaultInjector]:
             kind = kind.strip().lower()
             if kind not in KINDS:
                 raise ValueError(f"unknown fault kind {kind!r}")
-            out.append(_Spec(kind, int(stepstr),
-                             float(argstr) if argstr else None))
+            arg: Optional[object] = None
+            if argstr:
+                if kind == "corrupt_journal":
+                    # the one string-arg kind; validated HERE so a
+                    # typo'd mode fails at parse time, not mid-run
+                    arg = argstr.strip()
+                    if arg not in JOURNAL_CORRUPTION_MODES:
+                        raise ValueError(
+                            f"corrupt_journal mode {arg!r} not in "
+                            f"{JOURNAL_CORRUPTION_MODES}")
+                else:
+                    # numeric-arg kinds stay strict: a malformed
+                    # number must fail the CLI, not fire time
+                    arg = float(argstr)
+            out.append(_Spec(kind, int(stepstr), arg))
         except ValueError as e:
             raise ValueError(
                 f"bad fault spec {part!r} (expected kind@step[:arg] "
@@ -196,3 +253,59 @@ def corrupt_checkpoint(directory: str, step: Optional[int] = None,
             with open(p, "r+b") as f:
                 f.truncate(os.path.getsize(p) // 2)
     return step
+
+
+# ---------------------------------------------------------------------------
+# Request-journal corruption (serving, ISSUE-13)
+# ---------------------------------------------------------------------------
+
+JOURNAL_CORRUPTION_MODES = ("truncate", "unfinalize")
+
+
+def corrupt_journal(path: str, mode: str = "truncate") -> None:
+    """Deterministically damage a serving
+    :class:`~apex_tpu.serving.resilience.RequestJournal` the ways a
+    real crash does:
+
+    * ``truncate`` — cut the file mid-line (a torn trailing record:
+      the flush raced the kill).  The loader must tolerate it — the
+      malformed tail is counted, every complete line still parses.
+    * ``unfinalize`` — strip the LAST ``terminal`` record: a request
+      that finished now looks in-flight, so a replay re-runs it — the
+      at-least-once delivery drill for journal-corruption recovery
+      (greedy determinism makes the re-run token-identical; the
+      duplicate terminal is the documented degraded mode).
+    """
+    if mode not in JOURNAL_CORRUPTION_MODES:
+        raise ValueError(f"mode {mode!r} not in "
+                         f"{JOURNAL_CORRUPTION_MODES}")
+    if mode == "truncate":
+        with open(path, "r+b") as f:
+            data = f.read()
+            body = data.rstrip(b"\n")
+            if len(body) < 2:
+                return
+            # tear through exactly the FINAL record (cut mid-line):
+            # every earlier line stays independently valid JSONL — the
+            # torn-trailing-line shape a real kill leaves.  Terminate
+            # the fragment with a newline so a LIVE journal's next
+            # append starts its own line instead of gluing onto (and
+            # corrupting) the fragment.
+            start = body.rfind(b"\n") + 1
+            cut = start + max(1, (len(body) - start) // 2)
+            f.truncate(cut)
+            f.seek(0, os.SEEK_END)
+            f.write(b"\n")
+        return
+    # rewrite IN PLACE (same inode): the live journal's append-mode
+    # sink keeps writing at the new end — an os.replace would strand
+    # its fd on the unlinked file and silently drop every later record
+    with open(path, "r+b") as f:
+        lines = f.read().splitlines(keepends=True)
+        for i in range(len(lines) - 1, -1, -1):
+            if b'"name":"terminal"' in lines[i]:
+                del lines[i]
+                break
+        f.seek(0)
+        f.writelines(lines)
+        f.truncate()

@@ -19,6 +19,13 @@ The serving counterpart of the training pipeline (ROADMAP item 1):
   gauges (``serve_tick``), and the on-demand engine snapshot
   (:class:`ServeMetrics`, :class:`EngineGauges`,
   :class:`SnapshotTrigger`).
+* :mod:`.resilience` — serving fault-tolerance (ISSUE-13): request
+  deadlines + hysteresis load shedding (:class:`ShedPolicy`), the
+  crash-safe :class:`RequestJournal` with supervised
+  restart-and-replay (:func:`run_serving`, the PR-3 bounded-backoff
+  semantics around one engine), and degraded modes
+  (:class:`SpeculationGovernor` auto-disabling a mismatching draft,
+  watchdog stall → snapshot-then-drain).
 
 Entry point: ``python -m apex_tpu.testing.standalone_gpt --serve``;
 docs/api/serving.md walks the architecture.
@@ -35,6 +42,9 @@ from .model import (GPTServingWeights, LayerWeights,
                     ServingModelConfig, copy_cache_block,
                     extract_serving_weights, gpt_decode_step,
                     gpt_extend_step, gpt_prefill_step)
+from .resilience import (RequestJournal, ServeRunResult, ShedPolicy,
+                         SpeculationGovernor, recover_engine,
+                         run_serving)
 
 __all__ = [
     "BucketLadder", "Request", "ServeSummary", "ServingEngine",
@@ -46,4 +56,6 @@ __all__ = [
     "copy_cache_block", "extract_serving_weights", "gpt_decode_step",
     "gpt_extend_step", "gpt_prefill_step",
     "EngineGauges", "RequestTrace", "ServeMetrics", "SnapshotTrigger",
+    "RequestJournal", "ServeRunResult", "ShedPolicy",
+    "SpeculationGovernor", "recover_engine", "run_serving",
 ]

@@ -47,13 +47,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.flags import flag_bool, flag_int, flag_str
+from ..analysis.flags import flag_bool, flag_float, flag_int, flag_str
 from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
                        PrefixMatch, init_cache)
 from .metrics import ServeMetrics
 from .model import (GPTServingWeights, ServingModelConfig,
                     copy_cache_block, gpt_decode_step,
                     gpt_extend_step, gpt_prefill_step)
+from .resilience import RequestJournal, ShedPolicy, SpeculationGovernor
 
 __all__ = ["Request", "BucketLadder", "ServingEngine", "ServeSummary",
            "default_cache_config"]
@@ -142,18 +143,34 @@ class BucketLadder:
 
 @dataclasses.dataclass
 class Request:
-    """One generation request and its accumulated results."""
+    """One generation request and its accumulated results.
+
+    ``deadline_ms`` bounds the request's whole wall (submit → last
+    token) relative to its submit instant: a queued request past its
+    deadline is expired with terminal ``deadline_exceeded``; a running
+    one is evicted with terminal ``deadline`` — both at tick
+    boundaries, AFTER the expiring tick's tokens were delivered (the
+    deadline-at-boundary semantics the tests pin).  ``None`` falls
+    back to the engine default (``APEX_TPU_SERVE_DEADLINE_MS``, 0 =
+    no deadline).  ``priority`` orders load shedding: under pool/queue
+    pressure the :class:`~.resilience.ShedPolicy` sheds lowest
+    priority, shortest progress first."""
 
     rid: Any
     prompt: List[int]
     max_new_tokens: int
     eos_token: Optional[int] = None
+    deadline_ms: Optional[float] = None
+    priority: int = 0
     # engine-owned:
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     token_latency_s: List[float] = dataclasses.field(
         default_factory=list)
     admitted_at_step: Optional[int] = None
     preempted: bool = False
+    submit_t: Optional[float] = None     # engine-clock submit instant
+    terminal: Optional[str] = None       # finished | preempted |
+    # deadline | deadline_exceeded | shed — set exactly once
 
     @property
     def done(self) -> bool:
@@ -209,6 +226,20 @@ class ServeSummary:
     shared_blocks_hw: int = 0
     cow_copies: int = 0
     prefill_chunks: int = 0
+    # ISSUE-13 serving resilience: requests expired past their
+    # deadline (queued OR running), requests shed under pool/queue
+    # pressure, how often the shed policy engaged, whether the
+    # speculation governor degraded the run, and how many requests
+    # entered through a journal replay (supervised crash recovery)
+    requests_deadline: int = 0
+    requests_shed: int = 0
+    shed_engagements: int = 0
+    spec_disabled: bool = False
+    replayed_requests: int = 0
+    # how many crash recoveries (engine.crash_reset) produced this
+    # summary — counted on the engine itself so the serve_done event
+    # carries the real value, not a post-hoc patch (0 = never crashed)
+    restarts: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -263,6 +294,11 @@ class ServingEngine:
                  draft_cfg: Optional[ServingModelConfig] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
+                 deadline_ms: Optional[float] = None,
+                 shed: Optional[ShedPolicy] = None,
+                 journal: Optional[RequestJournal] = None,
+                 escalation=None, fault=None,
+                 spec_governor="auto",
                  clock: Callable[[], float] = time.perf_counter):
         self.weights = weights
         self.model_cfg = model_cfg
@@ -287,6 +323,30 @@ class ServingEngine:
                 self.ladder, chunks=(self.prefill_chunk,))
         self.prefix_share = prefix_share if prefix_share is not None \
             else flag_bool("APEX_TPU_SERVE_PREFIX_SHARE")
+        # --- ISSUE-13 serving resilience ----------------------------
+        # default request deadline (0/None = none), hysteresis shed
+        # policy, crash-safe request journal, watchdog escalation
+        # (serve default: stall -> snapshot-then-drain), and the
+        # deterministic fault injector (reject_alloc / corrupt_journal
+        # need the engine's cooperation; crash/stall/signal fire from
+        # the driver's before_tick) — all host-side bookkeeping, so
+        # the zero-steady-state-recompile ladder contract is untouched
+        self.default_deadline_ms = deadline_ms if deadline_ms \
+            is not None else flag_float("APEX_TPU_SERVE_DEADLINE_MS")
+        self.shed = shed if shed is not None else ShedPolicy.from_flags()
+        self.journal = journal
+        self.escalation = escalation
+        self.fault = fault
+        self._esc_handled = False
+        self._drain_reason: Optional[str] = None
+        self.spec_disabled = False
+        self._deadline_count = 0
+        self._shed_count = 0
+        self._replayed = 0
+        self.restarts = 0
+        # set on the first submit carrying a deadline: the per-tick
+        # enforcement scan is skipped entirely while no request has one
+        self._deadlines_active = False
         if self.speculate_k > 0 and draft_weights is None:
             raise ValueError(
                 "speculate_k > 0 needs a draft model: pass "
@@ -312,6 +372,13 @@ class ServingEngine:
                 kv_dtype=cache_cfg.kv_dtype,
                 model_dtype=draft_cfg.dtype)
             self.draft_cache = init_cache(self.draft_cache_cfg)
+        # degraded mode for the fast path: sustained verify mismatch
+        # auto-disables speculation (alarm + gauge, never a crash)
+        if spec_governor == "auto":
+            self.spec_governor = SpeculationGovernor() \
+                if self.speculate_k > 0 else None
+        else:
+            self.spec_governor = spec_governor
         # request-lifecycle + gauge telemetry (serving/metrics.py):
         # pure host bookkeeping through the monitor sinks — no device
         # traffic, so the one-fetch-per-tick budget is untouched.
@@ -439,6 +506,13 @@ class ServingEngine:
             self._event("serve_compile", value=round(
                 (self._clock() - t0) * 1e3, 2), what=label,
                 bucket=str(key))
+            # compilation is progress: feed the stall heartbeat so a
+            # multi-second AOT warmup cannot trip the watchdog (and,
+            # under the serve escalation policy, drain the serve)
+            # before the first tick ever runs
+            wd = getattr(self.monitor, "watchdog", None)
+            if wd is not None:
+                wd.observe_step(self.steps)
         return ex
 
     def _decode_fn(self, bb: int, pb: int):
@@ -557,8 +631,70 @@ class ServingEngine:
                 request, "max_seq",
                 f"request {request.rid!r}: {worst} tokens exceed the "
                 f"model's max_seq {self.model_cfg.max_seq}")
+        if request.deadline_ms is None and self.default_deadline_ms \
+                and self.default_deadline_ms > 0:
+            request.deadline_ms = float(self.default_deadline_ms)
+        if request.deadline_ms:
+            self._deadlines_active = True
+        request.submit_t = self._clock()
         self.queue.append(request)
         self.metrics.on_submit(request, self.steps)
+        if self.journal is not None:
+            self.journal.record_submit(request, self.steps)
+
+    def resubmit(self, request: Request) -> None:
+        """Re-enter a journal-replayed request (crash recovery) WITHOUT
+        a second ``request_submitted`` lifecycle event: the chain the
+        pre-crash submit opened stays open, its admission/first-token
+        stamps are reset for the fresh incarnation, and the terminal
+        event still fires exactly once — so ``trace_check --serve``'s
+        N submitted ⇒ N terminal holds across the crash.  A replay in
+        a fresh process (no open chain for the rid) opens one."""
+        if request.deadline_ms:
+            self._deadlines_active = True
+        tr = self.metrics.reopen(str(request.rid))
+        if tr is not None:
+            # deadline stays anchored at the ORIGINAL submit: crash
+            # downtime counts against the request's SLO, not for it
+            request.submit_t = tr.submit_t
+        else:
+            request.submit_t = self._clock()
+            self.metrics.on_submit(request, self.steps)
+        self.queue.append(request)
+        self._replayed += 1
+        self._event("request_replayed", rid=str(request.rid),
+                    prompt_len=len(request.prompt),
+                    max_new_tokens=request.max_new_tokens)
+
+    def crash_reset(self) -> Dict[str, int]:
+        """Discard the tick loop's request bookkeeping the way a crash
+        does, keeping what the supervisor owns: the device cache and
+        the prefix-share index.  Every in-flight request's blocks are
+        freed — registered prompt pages park in the idle LRU, still
+        warm for the journal replay's readmission — and the open
+        lifecycle chains stay open (the replayed incarnations close
+        them).  Returns the lost-state counts for the replay event."""
+        lost = {"active": len(self.active),
+                "prefilling": len(self.prefilling),
+                "queued": len(self.queue)}
+        self.restarts += 1
+        for rid in list(self.active):
+            self.manager.free(rid)
+        for rid in list(self.prefilling):
+            self.manager.free(rid)
+        self.active.clear()
+        self.prefilling.clear()
+        self.queue.clear()
+        self._drain_reason = None
+        # re-arm escalation for the recovered attempt (the training
+        # loop's per-attempt escalation.reset() discipline): a stall
+        # latched before the crash must not blind the next run, and a
+        # NEW alarm there must escalate again
+        self._esc_handled = False
+        if self.escalation is not None:
+            self.escalation.reset()
+        self._event("crash_reset", **lost)
+        return lost
 
     def _reserved_blocks(self) -> int:
         """Blocks the free pool already owes to in-flight requests
@@ -717,34 +853,243 @@ class ServingEngine:
                                         self._clock())
         return done
 
-    def _finish(self, req: Request) -> None:
-        self.manager.free(req.rid)
-        del self.active[req.rid]
+    def _terminate(self, req: Request, terminal: str, *,
+                   where: str = "queued") -> None:
+        """The ONE terminal transition: free owned blocks (``where`` in
+        active/prefilling; queued requests own none), move the request
+        into ``done``, bump the per-reason counter, emit the terminal
+        ``request_done`` lifecycle event, and journal it — every
+        terminal path (finished, drain-preempted, deadline, shed) goes
+        through here, so none can skip the accounting."""
+        req.terminal = terminal
+        if where == "active":
+            self.manager.free(req.rid)
+            del self.active[req.rid]
+        elif where == "prefilling":
+            self.manager.free(req.rid)
+            del self.prefilling[req.rid]
         self.done.append(req)
-        if req.preempted:
-            self._preempted_count += 1
-        else:
+        if terminal == "finished":
             self._done_count += 1
+        elif terminal == "preempted":
+            self._preempted_count += 1
+        elif terminal == "shed":
+            self._shed_count += 1
+        else:                       # deadline / deadline_exceeded
+            self._deadline_count += 1
         self._done_tokens += len(req.out_tokens)
         # terminal lifecycle event (request_done) with the full
         # queued/prefill/decode breakdown
         self.metrics.on_done(req, self.steps)
+        if self.journal is not None:
+            self.journal.record_terminal(req, self.steps)
+
+    def _finish(self, req: Request) -> None:
+        self._terminate(req, "preempted" if req.preempted
+                        else "finished", where="active")
 
     def _terminating(self) -> bool:
         return (self.autoresume is not None
                 and self.autoresume.termination_requested())
 
+    # --- deadlines, shedding, escalation (ISSUE-13) -------------------
+
+    def _past_deadline(self, req: Request, now: float) -> bool:
+        if req.deadline_ms is None or req.deadline_ms <= 0 \
+                or req.submit_t is None:
+            return False
+        return (now - req.submit_t) * 1e3 >= req.deadline_ms
+
+    def _expire_deadlines(self) -> None:
+        """Tick-boundary deadline enforcement.  Runs at the START of a
+        tick, so a deadline crossed during tick K's decode is noticed
+        at the K+1 boundary — AFTER tick K's tokens were delivered
+        (the deadline-at-boundary semantics the tests pin: expiry
+        exactly on a boundary never claws back a delivered token)."""
+        if not self._deadlines_active:
+            return
+        now = self._clock()
+        if self.queue:
+            keep: deque = deque()
+            while self.queue:
+                q = self.queue.popleft()
+                if self._past_deadline(q, now):
+                    self._event("deadline_exceeded", rid=str(q.rid),
+                                where="queued",
+                                deadline_ms=q.deadline_ms)
+                    self._terminate(q, "deadline_exceeded")
+                else:
+                    keep.append(q)
+            self.queue = keep
+        for rid in [r for r, q in list(self.active.items())
+                    if self._past_deadline(q, now)]:
+            q = self.active[rid]
+            self._event("deadline_exceeded", rid=str(rid),
+                        where="active", deadline_ms=q.deadline_ms,
+                        tokens=len(q.out_tokens))
+            self._terminate(q, "deadline", where="active")
+        for rid in [r for r, j in list(self.prefilling.items())
+                    if self._past_deadline(j.req, now)]:
+            q = self.prefilling[rid].req
+            self._event("deadline_exceeded", rid=str(rid),
+                        where="prefilling", deadline_ms=q.deadline_ms)
+            self._terminate(q, "deadline", where="prefilling")
+
+    def _load(self) -> Tuple[float, int]:
+        """(pool pressure, admission backlog) for the shed policy.
+        Pool pressure counts only what an allocation could NOT draw on
+        — idle shared pages are reclaimable, so they are headroom, not
+        pressure."""
+        usable = max(1, self.cache_cfg.usable_blocks)
+        frac = 1.0 - self.manager.available_blocks / usable
+        return frac, len(self.queue) + len(self.prefilling)
+
+    def _shed_victim(self, *, from_pool: bool):
+        """The next victim under pressure: lowest priority first, then
+        shortest progress.  Queue pressure sheds BACKLOG only — queued
+        work (zero sunk cost) before mid-prefill jobs, never a running
+        decode, which costs paid-for progress without moving the
+        backlog signal at all.  Pool pressure must shed block OWNERS —
+        mid-prefill jobs (no tokens yet) before running requests,
+        fewest generated tokens first."""
+        if not from_pool:
+            if self.queue:
+                # newest submission at equal priority: the latest
+                # arrival has waited least
+                victim = min(
+                    enumerate(self.queue),
+                    key=lambda iq: (iq[1].priority, -iq[0]))
+                del self.queue[victim[0]]
+                return "queued", victim[1]
+            if self.prefilling:
+                rid = min(self.prefilling,
+                          key=lambda r: (
+                              self.prefilling[r].req.priority,
+                              self.prefilling[r].written
+                              - self.prefilling[r].start))
+                return "prefilling", self.prefilling[rid].req
+            return None
+        # progress = prefill chunks written for a mid-prefill job,
+        # generated tokens for a running one — least paid-for work
+        # dies first
+        owners = [("prefilling", j.req, j.written - j.start)
+                  for j in self.prefilling.values()] \
+            + [("active", q, len(q.out_tokens))
+               for q in self.active.values()]
+        if not owners:
+            return None
+        where, req, _ = min(owners,
+                            key=lambda w: (w[1].priority, w[2]))
+        return where, req
+
+    def _apply_shedding(self) -> bool:
+        """Advance the shed policy's hysteresis state and, while
+        engaged, shed lowest-priority / shortest-progress work until
+        the load drops below the LOW-water marks.  Returns whether
+        shedding is engaged (the engine admits nothing while it is —
+        the no-flap half of the hysteresis contract)."""
+        if self.shed is None or not self.shed.enabled:
+            return False
+        pf, qd = self._load()
+        if not self.shed.update(pool_frac=pf, queue_depth=qd):
+            return False
+        while True:
+            pf, qd = self._load()
+            if not self.shed.over_low(pf, qd):
+                break
+            over_queue = self.shed.queue_hw > 0 \
+                and qd > self.shed.queue_lw
+            victim = self._shed_victim(from_pool=not over_queue)
+            if victim is None:
+                break
+            where, req = victim
+            self._event("request_shed", rid=str(req.rid), where=where,
+                        priority=req.priority,
+                        tokens=len(req.out_tokens),
+                        pool_frac=round(pf, 4), queue_depth=qd)
+            self._terminate(req, "shed", where=where)
+        # shedding may have dropped the load through the band already
+        pf, qd = self._load()
+        self.shed.update(pool_frac=pf, queue_depth=qd)
+        return self.shed.engaged
+
+    def _poll_escalation(self) -> None:
+        """Tick-boundary escalation poll: a watchdog alarm the serve
+        policy maps to ``snapshot_then_drain`` (the serve default for
+        ``stall`` — never ``ignore`` a wedged decode) dumps ONE
+        structured engine snapshot and latches a drain for the next
+        boundary; ``abort`` actions raise
+        :class:`~apex_tpu.resilience.EscalationAbort` for the
+        supervisor (:func:`~.resilience.run_serving`) to restart."""
+        if self.escalation is None or self._esc_handled:
+            return
+        esc = self.escalation.pending()
+        if esc is None:
+            return
+        self._esc_handled = True
+        from ..resilience import SNAPSHOT_THEN_DRAIN, EscalationAbort
+
+        if esc.action == SNAPSHOT_THEN_DRAIN:
+            if self.monitor is not None:
+                self.monitor.event(
+                    "serving", "engine_snapshot", step=self.steps,
+                    reason=f"escalation:{esc.alarm}",
+                    **self.snapshot_state())
+            self._event("escalation_drain", alarm=esc.alarm,
+                        action=esc.action)
+            self._drain_reason = f"escalation:{esc.alarm}"
+            return
+        raise EscalationAbort(esc.alarm, esc.action, step=self.steps)
+
+    def _drain(self, source: str) -> None:
+        """Stop serving NOW, accounting for every request: in-flight
+        generation abandoned cleanly (blocks freed), mid-prefill jobs
+        dropped (no first token — the whole post-admission wall reads
+        as prefill), queued-never-admitted requests closed with
+        queue-wait-only chains.  Every submitted request ends terminal
+        ``preempted`` — nothing vanishes.  A request that already
+        emitted its full token budget is evicted as ``finished``
+        first: completing during the very tick that latched the drain
+        must not read back as preemption."""
+        for rid in [r for r, q in self.active.items() if q.done]:
+            self._finish(self.active[rid])
+        for rid in list(self.active):
+            q = self.active[rid]
+            q.preempted = True
+            self._terminate(q, "preempted", where="active")
+        for rid in list(self.prefilling):
+            q = self.prefilling[rid].req
+            q.preempted = True
+            self._terminate(q, "preempted", where="prefilling")
+        while self.queue:
+            q = self.queue.popleft()
+            q.preempted = True
+            self._terminate(q, "preempted")
+        self._drain_reason = None
+        self._event("serve_preempt", source=source)
+
     # --- the engine tick ----------------------------------------------
 
     def step(self) -> int:
-        """One continuous-batching tick: evict finished, advance ONE
-        pending prefill chunk (chunked prefill interleaves admission
-        cost with decode — a long prompt never monopolizes a tick),
-        admit (unless draining), run one bucketed decode step —
-        speculative when ``speculate_k > 0`` — over every active
-        request.  Returns the number of tokens generated this tick."""
+        """One continuous-batching tick: poll the escalation policy,
+        enforce deadlines (boundary semantics: after the previous
+        tick's tokens were delivered), evict finished, apply the shed
+        policy, advance ONE pending prefill chunk (chunked prefill
+        interleaves admission cost with decode — a long prompt never
+        monopolizes a tick), admit (unless draining, shedding, or a
+        ``reject_alloc`` fault simulates pool exhaustion), run one
+        bucketed decode step — speculative when ``speculate_k > 0`` —
+        over every active request.  Returns the number of tokens
+        generated this tick."""
+        self._poll_escalation()
+        # finished requests leave BEFORE deadline enforcement: a
+        # request whose last token arrived within its deadline must
+        # end terminal "finished" even when the next boundary lands
+        # past the deadline
         for rid in [r for r, q in self.active.items() if q.done]:
             self._finish(self.active[rid])
+        self._expire_deadlines()
+        shedding = self._apply_shedding()
         advanced_prefill = False
         if self.prefilling:
             # FIFO: the oldest admission's next chunk, exactly one
@@ -753,7 +1098,17 @@ class ServingEngine:
             if self._prefill_step(self.prefilling[rid]):
                 del self.prefilling[rid]
             advanced_prefill = True
-        if not self._terminating():
+        admit = (not self._terminating()
+                 and self._drain_reason is None and not shedding)
+        if admit and self.queue and self.fault is not None \
+                and self.fault.reject_alloc(self.steps):
+            # simulated pool exhaustion: this tick admits nothing,
+            # exactly once per armed spec (the serve fault drill).
+            # Polled only when work is actually queued, so a spec
+            # landing on an idle tick defers to one it can affect.
+            self._event("alloc_rejected", injected=True)
+            admit = False
+        if admit:
             while (self.queue
                    and (len(self.active) + len(self.prefilling)
                         < self.ladder.max_batch)):
@@ -953,6 +1308,21 @@ class ServingEngine:
         self.spec_proposed += tick_proposed
         self.spec_accepted += tick_accepted
         self.metrics.gauges.on_spec(tick_proposed, tick_accepted)
+        if self.spec_governor is not None \
+                and self.spec_governor.observe(tick_proposed,
+                                               tick_accepted):
+            # degraded mode: sustained verify mismatch (a drifted or
+            # stalled draft) — turn speculation off for the rest of
+            # the run.  Alarm + gauge, never a crash; output identity
+            # is preserved (speculative greedy == greedy), so the only
+            # observable change is ITL returning to one token/tick.
+            self.spec_disabled = True
+            self.speculate_k = 0
+            if self.monitor is not None:
+                self.monitor.event(
+                    "alarm", "spec_disabled", step=self.steps,
+                    low_streak=self.spec_governor.window,
+                    min_accept=self.spec_governor.min_accept)
         # --- draft catch-up: on full acceptance the draft never wrote
         # position base + K (the target's verify did) — one masked
         # draft step fills it so next tick's proposals read real k/v
@@ -987,9 +1357,8 @@ class ServingEngine:
         registered cadence, snapshot-trigger poll, and the watchdog
         stall heartbeat — all host bookkeeping the engine already
         holds, after the tick's one device fetch."""
-        self.metrics.on_tick(
-            self.steps, batch=batch, batch_bucket=bb,
-            pages_bucket=pb,
+        levels = dict(
+            batch=batch, batch_bucket=bb, pages_bucket=pb,
             free_blocks=self.manager.free_blocks,
             used_blocks=self.manager.used_blocks,
             reserved_blocks=self._reserved_blocks(),
@@ -998,6 +1367,20 @@ class ServingEngine:
             queue_depth=len(self.queue),
             prefilling=len(self.prefilling),
             compiles=sum(self._compiles.values()))
+        if self.shed is not None and self.shed.enabled:
+            levels["shed_engaged"] = self.shed.engaged
+        if self.spec_disabled:
+            levels["spec_disabled"] = True
+        self.metrics.on_tick(self.steps, **levels)
+        if self.journal is not None and self.active:
+            # ONE aggregated progress record per tick (not one write
+            # per request — the journal flushes per line, and O(batch)
+            # syscalls per generated token would tax ITL): the replay
+            # ledger's observability record (replay correctness rides
+            # the submit/terminal records — greedy decode regenerates)
+            self.journal.record_progress(
+                {rid: len(q.out_tokens)
+                 for rid, q in self.active.items()}, self.steps)
         if self.snapshot is not None:
             self.snapshot.poll(self.steps, self.snapshot_state,
                                self.monitor)
@@ -1080,44 +1463,43 @@ class ServingEngine:
         one run's wall."""
         t0 = self._clock()
         drained = False
-        while self.queue or self.active or self.prefilling:
-            if self._terminating():
-                drained = True
-                for rid in list(self.active):
-                    q = self.active[rid]
-                    q.preempted = True
-                    self._finish(q)
-                for rid in list(self.prefilling):
-                    # admitted but still prefilling: blocks freed,
-                    # preempted into done — no first token, the whole
-                    # post-admission wall reads as prefill
-                    q = self.prefilling.pop(rid).req
-                    q.preempted = True
-                    self.manager.free(rid)
-                    self.done.append(q)
-                    self._preempted_count += 1
-                    self.metrics.on_done(q, self.steps)
-                while self.queue:
-                    # accepted but never admitted: no blocks to free,
-                    # but the drain still accounts for every request —
-                    # preempted, in ``done``, with a complete
-                    # lifecycle chain whose wall was all queue wait
-                    q = self.queue.popleft()
-                    q.preempted = True
-                    self.done.append(q)
-                    self._preempted_count += 1
-                    self.metrics.on_done(q, self.steps)
-                self._event("serve_preempt",
-                            source=self.autoresume.source)
-                break
-            if max_steps is not None and self.steps >= max_steps:
-                drained = True
-                break
-            if before_tick is not None:
-                before_tick(self.steps)
-            self.step()
-            if after_tick is not None:
-                after_tick(self.steps)
+        try:
+            while self.queue or self.active or self.prefilling:
+                if self._terminating() or self._drain_reason is not None:
+                    drained = True
+                    self._drain(self._drain_reason
+                                or (self.autoresume.source
+                                    if self.autoresume else "api"))
+                    break
+                if max_steps is not None and self.steps >= max_steps:
+                    drained = True
+                    break
+                if before_tick is not None:
+                    before_tick(self.steps)
+                self.step()
+                if after_tick is not None:
+                    after_tick(self.steps)
+        except KeyboardInterrupt:
+            # bare ^C with no AutoResume installed (the library-use
+            # case): drain like SIGTERM — blocks freed, every chain
+            # terminal, summary still returned — instead of unwinding
+            # through the tick loop with blocks allocated.  A second
+            # ^C during the drain propagates (the PR-3 double-signal
+            # convention: the second one means NOW).
+            drained = True
+            self._drain("KeyboardInterrupt")
+        # a drain request that became moot (everything finished in the
+        # same tick that latched it, or max_steps broke first) must
+        # not leak into a future run() on this engine and preempt a
+        # fresh batch at its first tick
+        self._drain_reason = None
+        if self._esc_handled:
+            # the handled episode ends with this run: consume the
+            # policy latch and re-arm, so a future run() on this
+            # engine escalates a NEW alarm instead of being deaf
+            self._esc_handled = False
+            if self.escalation is not None:
+                self.escalation.reset()
         self._run_wall_s += self._clock() - t0
         # a trailing partial gauge window (tick_every > 1) flushes so
         # the final engine state is always in the log
@@ -1159,7 +1541,14 @@ class ServingEngine:
             prefix_hit_tokens=self._prefix_hit_tokens,
             shared_blocks_hw=self.manager.shared_blocks_hw,
             cow_copies=self.manager.cow_copies,
-            prefill_chunks=self.prefill_chunks)
+            prefill_chunks=self.prefill_chunks,
+            requests_deadline=self._deadline_count,
+            requests_shed=self._shed_count,
+            shed_engagements=(self.shed.engagements
+                              if self.shed is not None else 0),
+            spec_disabled=self.spec_disabled,
+            replayed_requests=self._replayed,
+            restarts=self.restarts)
         self._event("serve_done", value=summary.tokens_per_sec,
                     **{k: v for k, v in summary.as_dict().items()
                        if k not in ("compiles", "tokens_per_sec")})

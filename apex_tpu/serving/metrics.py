@@ -90,6 +90,10 @@ class RequestTrace:
     done_wall: Optional[float] = None   # epoch seconds (Chrome anchor)
     new_tokens: int = 0
     preempted: bool = False
+    # terminal reason (ISSUE-13): finished | preempted | deadline |
+    # deadline_exceeded | shed — the lifecycle chains' new terminal
+    # paths all close through request_done, just with a reason
+    terminal: str = "finished"
 
     @property
     def admitted(self) -> bool:
@@ -156,6 +160,7 @@ class RequestTrace:
             else None,
             "new_tokens": self.new_tokens,
             "preempted": self.preempted,
+            "terminal": self.terminal,
             "tick": self.done_tick,
         }
 
@@ -189,6 +194,8 @@ class EngineGauges:
         self._warm_admitted = 0
         self._finished = 0
         self._preempted = 0
+        self._shed = 0
+        self._deadline = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._compiles_seen = 0
@@ -207,11 +214,23 @@ class EngineGauges:
         self._spec_proposed += int(proposed)
         self._spec_accepted += int(accepted)
 
-    def on_finish(self, preempted: bool) -> None:
-        if preempted:
-            self._preempted += 1
-        else:
+    def on_finish(self, terminal="finished", *,
+                  preempted: Optional[bool] = None) -> None:
+        """One terminal request this window.  ``terminal`` is the
+        reason string; the pre-ISSUE-13 signature (a bool, positional
+        or as the ``preempted`` keyword) still works."""
+        if preempted is not None:
+            terminal = "preempted" if preempted else "finished"
+        elif isinstance(terminal, bool):
+            terminal = "preempted" if terminal else "finished"
+        if terminal == "finished":
             self._finished += 1
+        elif terminal == "preempted":
+            self._preempted += 1
+        elif terminal == "shed":
+            self._shed += 1
+        else:                       # deadline / deadline_exceeded
+            self._deadline += 1
 
     def observe(self, tick: int, **levels) -> Optional[Dict[str, Any]]:
         """Record one engine tick's level gauges; returns the event
@@ -233,7 +252,8 @@ class EngineGauges:
         run's final evictions happen in a tick that decodes nothing,
         so the flush is how they reach the log."""
         if self._ticks == 0 and not (self._admitted or self._finished
-                                     or self._preempted
+                                     or self._preempted or self._shed
+                                     or self._deadline
                                      or self._spec_proposed):
             return None
         return self._roll()
@@ -250,6 +270,10 @@ class EngineGauges:
             new_compiles=compiles - self._compiles_seen,
             used_blocks_high_water=self.used_blocks_hw,
         )
+        if self._shed:
+            attrs["shed"] = self._shed
+        if self._deadline:
+            attrs["deadline_exceeded"] = self._deadline
         if self.shared_blocks_hw:
             attrs["shared_blocks_high_water"] = self.shared_blocks_hw
         if self._spec_proposed:
@@ -261,6 +285,7 @@ class EngineGauges:
         self._ticks = 0
         self._admitted = self._warm_admitted = 0
         self._finished = self._preempted = 0
+        self._shed = self._deadline = 0
         self._spec_proposed = self._spec_accepted = 0
         self.emitted += 1
         return attrs
@@ -376,9 +401,27 @@ class ServeMetrics:
                    queue_wait_ms=round(qw_ms, 3),
                    prefill_ms=round(prefill_ms, 3))
 
+    def reopen(self, rid: str) -> Optional[RequestTrace]:
+        """Reset an open chain's admission/first-token stamps for a
+        journal-replayed incarnation (crash recovery): queue wait runs
+        from the ORIGINAL submit through the crash downtime to the
+        fresh admission, prefill/decode measure the incarnation that
+        actually finishes — so the terminal parts still sum to the
+        rid's full wall.  Returns the trace, or None when no chain is
+        open (a fresh-process replay re-submits normally)."""
+        tr = self._open.get(str(rid))
+        if tr is None:
+            return None
+        tr.admit_t = None
+        tr.admit_tick = None
+        tr.first_token_t = None
+        return tr
+
     def on_done(self, request, tick: int) -> None:
-        """Terminal: finished or preempted (``request.preempted``) —
-        every submitted rid ends in exactly one of these."""
+        """Terminal — every submitted rid ends in exactly one of
+        these, whatever the reason: ``request.terminal`` names it
+        (finished / preempted / deadline / deadline_exceeded / shed;
+        absent falls back to the ``request.preempted`` flag)."""
         tr = self._open.pop(str(request.rid), None)
         if tr is None:
             tr = RequestTrace(rid=str(request.rid),
@@ -389,6 +432,8 @@ class ServeMetrics:
         tr.done_tick = tick
         tr.done_wall = self._wall_at(t)
         tr.new_tokens = len(request.out_tokens)
+        tr.terminal = getattr(request, "terminal", None) \
+            or ("preempted" if request.preempted else "finished")
         tr.preempted = bool(request.preempted)
         # the first latency sample is the prefill; the rest are decode
         # ticks — the per-request inter-token latencies
@@ -398,10 +443,11 @@ class ServeMetrics:
         if tps is not None:
             self._decode_tps.append(tps)
         self.completed.append(tr)
-        self.gauges.on_finish(tr.preempted)
+        self.gauges.on_finish(tr.terminal)
         attrs: Dict[str, Any] = {
             "rid": tr.rid, "new_tokens": tr.new_tokens,
             "preempted": tr.preempted,
+            "terminal": tr.terminal,
             "wall_ms": round(tr.wall_s * 1e3, 3),
             "queue_wait_ms": round(tr.queue_wait_s * 1e3, 3),
             "prefill_ms": round(tr.prefill_s * 1e3, 3),

@@ -1,0 +1,471 @@
+"""Serving-side resilience: deadlines/shedding policy, the request
+journal, and the supervised serve driver (ISSUE-13).
+
+PR 3 gave the *training* loop its fault-tolerance story (SIGTERM-safe
+checkpoints, torn-restore fallback, ``run_resumable`` bounded retry,
+deterministic fault injectors).  This module is the serving mirror —
+the pieces a single replica needs before a fleet router (ROADMAP item
+1) can load-balance over it, because a router can only fail over
+between engines that fail *predictably*:
+
+* :class:`ShedPolicy` — hysteresis load shedding: when the block pool
+  or the admission queue crosses a configured high-water mark the
+  engine stops admitting and sheds lowest-priority / shortest-progress
+  work first, and keeps shedding state latched until the load drops
+  below the low-water mark — so the engine cannot flap between admit
+  and shed around one threshold.  Every shed decision is a terminal
+  ``request_done`` lifecycle event (``terminal="shed"``), so
+  ``trace_check --serve`` still proves N submitted ⇒ N terminal.
+* :class:`RequestJournal` — crash-safe append-only JSONL (the
+  :class:`~apex_tpu.monitor.events.JsonlSink` machinery: one record
+  per line, flushed per line, torn trailing lines tolerated on load)
+  recording every request's submit / progress / terminal transitions.
+  :func:`RequestJournal.load` reconstructs the request ledger;
+  :func:`recover_engine` replays it — every non-terminal request is
+  re-submitted (no duplicate ``request_submitted`` event: the
+  lifecycle chain stays open across the crash), and with PR-12 prefix
+  sharing on, the crashed requests' prompt pages survive in the idle
+  LRU so the readmission hits warm (``prefix_hit_tokens`` grows —
+  the measured warm-readmit win).  Replaying a fully-terminal journal
+  is a no-op.
+* :func:`run_serving` — the supervised serve driver: the PR-3
+  bounded-backoff restart semantics (:func:`apex_tpu.resilience.
+  run_resumable` drives the attempts, so the ``attempt_start`` /
+  ``attempt_error`` / ``attempt_backoff`` event trail is identical)
+  around one :class:`~.engine.ServingEngine`.  A crashed engine loop
+  is recovered in-process: request bookkeeping is rebuilt from the
+  journal while the device cache — owned by the supervisor, not the
+  loop — survives, which is exactly why the warm readmit works.
+  Greedy decode is deterministic, so a replayed request regenerates
+  token-for-token what the uninterrupted run would have produced
+  (the CI crash leg proves the digests match).
+* :class:`SpeculationGovernor` — degraded mode for the PR-12 fast
+  path: a run of consecutive low-acceptance speculative ticks
+  (mismatching draft, stalled verify) auto-disables speculation for
+  the rest of the run — alarm + gauge, never a crash, and output
+  identity is preserved because speculative greedy == greedy.
+
+Deterministic serve faults (``crash@tick`` / ``stall@tick`` /
+``reject_alloc@tick`` / ``corrupt_journal@tick``) live in
+:mod:`apex_tpu.resilience.faults`; ``standalone_gpt --serve --fault``
+wires them.  Worked crash-replay walkthrough: docs/api/resilience.md.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..analysis.flags import flag_float, flag_int
+from ..monitor.events import Event, JsonlSink
+from ..utils.log_util import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["ShedPolicy", "RequestJournal", "SpeculationGovernor",
+           "ServeRunResult", "recover_engine", "run_serving"]
+
+
+# ---------------------------------------------------------------------------
+# Load shedding
+# ---------------------------------------------------------------------------
+
+class ShedPolicy:
+    """Hysteresis admission/shed control for one serving engine.
+
+    Two independent pressure signals, each with a high-water mark that
+    *engages* shedding and a low-water mark that *disengages* it:
+
+    * ``pool_hw`` — used-block fraction of the pool (0 disables);
+      ``pool_lw`` defaults to ``pool_hw - 0.15``.
+    * ``queue_hw`` — queued + mid-prefill request count (0 disables);
+      ``queue_lw`` defaults to ``queue_hw // 2``.
+
+    While engaged the engine admits nothing and sheds lowest-priority,
+    shortest-progress work first (queued requests before running
+    ones — zero sunk cost beats evicting paid-for decode) until both
+    signals are below their LOW-water marks.  The gap between the two
+    marks is the hysteresis band: load hovering exactly at the
+    high-water mark cannot flap admit/shed/admit, because disengaging
+    requires dropping all the way through the band
+    (tests/test_serving_resilience.py proves no-flap around the mark).
+    """
+
+    def __init__(self, *, pool_hw: float = 0.0,
+                 pool_lw: Optional[float] = None,
+                 queue_hw: int = 0,
+                 queue_lw: Optional[int] = None):
+        if pool_hw and not 0.0 < pool_hw <= 1.0:
+            raise ValueError(f"pool_hw {pool_hw} must be in (0, 1]")
+        self.pool_hw = float(pool_hw)
+        self.pool_lw = (max(0.0, self.pool_hw - 0.15)
+                        if pool_lw is None else float(pool_lw))
+        self.queue_hw = int(queue_hw)
+        self.queue_lw = (self.queue_hw // 2 if queue_lw is None
+                         else int(queue_lw))
+        if self.pool_hw and self.pool_lw >= self.pool_hw:
+            raise ValueError("pool_lw must sit below pool_hw "
+                             "(the hysteresis band)")
+        if self.queue_hw and self.queue_lw >= self.queue_hw:
+            raise ValueError("queue_lw must sit below queue_hw")
+        self.engaged = False
+        self.engagements = 0
+
+    @classmethod
+    def from_flags(cls) -> "ShedPolicy":
+        return cls(pool_hw=flag_float("APEX_TPU_SERVE_SHED_POOL_HW"),
+                   queue_hw=flag_int("APEX_TPU_SERVE_SHED_QUEUE_HW"))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.pool_hw or self.queue_hw)
+
+    def _over_high(self, pool_frac: float, queue_depth: int) -> bool:
+        return ((self.pool_hw > 0 and pool_frac >= self.pool_hw)
+                or (self.queue_hw > 0 and queue_depth > self.queue_hw))
+
+    def over_low(self, pool_frac: float, queue_depth: int) -> bool:
+        """Still above the LOW-water marks — while engaged, shedding
+        continues until this goes False."""
+        return ((self.pool_hw > 0 and pool_frac > self.pool_lw)
+                or (self.queue_hw > 0 and queue_depth > self.queue_lw))
+
+    def update(self, *, pool_frac: float, queue_depth: int) -> bool:
+        """Advance the hysteresis state with this tick's load; returns
+        whether shedding is engaged for the tick."""
+        if not self.enabled:
+            return False
+        if not self.engaged:
+            if self._over_high(pool_frac, queue_depth):
+                self.engaged = True
+                self.engagements += 1
+        elif not self.over_low(pool_frac, queue_depth):
+            self.engaged = False
+        return self.engaged
+
+
+# ---------------------------------------------------------------------------
+# Request journal
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class JournalState:
+    """One journal's reconstructed ledger (:meth:`RequestJournal.load`).
+
+    ``submitted`` maps rid -> the submit record's attrs (prompt,
+    budget, deadline, priority — everything needed to rebuild the
+    :class:`~.engine.Request`); ``progress`` the last journaled token
+    count; ``terminal`` rid -> the terminal record's attrs (reason +
+    the full output token list, so completed requests' digests are
+    reconstructable without re-running them)."""
+
+    submitted: Dict[str, Dict[str, Any]]
+    progress: Dict[str, int]
+    terminal: Dict[str, Dict[str, Any]]
+    malformed: int = 0
+
+    @property
+    def open_rids(self) -> List[str]:
+        """Submitted-but-not-terminal rids, in submit order — exactly
+        the set a replay must re-enter."""
+        return [rid for rid in self.submitted
+                if rid not in self.terminal]
+
+
+class RequestJournal:
+    """Crash-safe append-only request ledger for one serve.
+
+    Rides the monitor's :class:`~apex_tpu.monitor.events.JsonlSink`
+    (append-only, one record per line, flushed per line — a kill at
+    any instant loses at most one torn trailing line, which
+    :meth:`load` tolerates).  Records are ``kind="journal"`` events:
+
+    * ``submit`` — rid, prompt, max_new_tokens, eos/deadline/priority
+      (enough to rebuild the request), stamped with the engine tick;
+    * ``progress`` — ONE record per tick mapping each active rid to
+      its generated-token count (observability + post-mortem; replay
+      correctness does not depend on it — greedy decode regenerates);
+    * ``terminal`` — rid, terminal reason, and the full output token
+      list (the exactly-once ledger: a rid with a terminal record is
+      never replayed, and its tokens survive the crash);
+    * ``replay`` — one record per recovery naming the re-entered rids.
+    """
+
+    def __init__(self, path: str, *,
+                 wall_clock: Callable[[], float] = time.time):
+        self.path = path
+        self._wall = wall_clock
+        self._sink = JsonlSink(path)
+
+    def _record(self, name: str, tick: Optional[int] = None,
+                **attrs) -> None:
+        self._sink.emit(Event(time=self._wall(), step=tick,
+                              kind="journal", name=name, attrs=attrs))
+
+    def record_submit(self, request, tick: int) -> None:
+        self._record(
+            "submit", tick, rid=str(request.rid),
+            prompt=[int(t) for t in request.prompt],
+            max_new_tokens=int(request.max_new_tokens),
+            eos_token=request.eos_token,
+            deadline_ms=request.deadline_ms,
+            priority=int(request.priority))
+
+    def record_progress(self, progress: Dict[Any, int],
+                        tick: int) -> None:
+        """One aggregated record: ``{rid: generated-token count}`` for
+        every active request this tick."""
+        self._record("progress", tick,
+                     progress={str(rid): int(n)
+                               for rid, n in progress.items()})
+
+    def record_terminal(self, request, tick: int) -> None:
+        self._record(
+            "terminal", tick, rid=str(request.rid),
+            terminal=request.terminal or "finished",
+            tokens=[int(t) for t in request.out_tokens])
+
+    def record_replay(self, rids: List[str], tick: int) -> None:
+        self._record("replay", tick, rids=[str(r) for r in rids])
+
+    def close(self) -> None:
+        self._sink.close()
+
+    @staticmethod
+    def load(path: str) -> JournalState:
+        """Reconstruct the ledger from disk.  Torn trailing lines (a
+        truncate-style crash or the ``corrupt_journal`` injector) are
+        counted, not fatal.  Submit records are incarnation-aware: a
+        submit while the rid is open keeps the FIRST record (the
+        original request definition is the replay contract — recovery
+        never re-records submits), but a submit arriving AFTER the
+        rid's terminal record REOPENS it with the new definition — a
+        journal reused across serves (an append-only file outliving
+        one run) must not let a finished previous-run rid mask the
+        new run's live request."""
+        from ..monitor.summary import load_events
+
+        events, malformed = load_events(path)
+        state = JournalState(submitted={}, progress={}, terminal={},
+                             malformed=malformed)
+        for e in events:
+            if e.kind != "journal":
+                continue
+            if e.name == "submit":
+                rid = str(e.attrs.get("rid"))
+                if rid in state.terminal:
+                    del state.terminal[rid]
+                    state.submitted[rid] = dict(e.attrs)
+                else:
+                    state.submitted.setdefault(rid, dict(e.attrs))
+            elif e.name == "progress":
+                for rid, n in (e.attrs.get("progress") or {}).items():
+                    state.progress[str(rid)] = int(n)
+            elif e.name == "terminal":
+                state.terminal[str(e.attrs.get("rid"))] = dict(e.attrs)
+        return state
+
+
+# ---------------------------------------------------------------------------
+# Degraded mode: speculative-decode governor
+# ---------------------------------------------------------------------------
+
+class SpeculationGovernor:
+    """Auto-disable speculation after sustained verify mismatch.
+
+    Observes every speculative tick's (proposed, accepted) pair; when
+    ``window`` *consecutive* ticks each land below ``min_accept``
+    acceptance, :meth:`observe` returns True once and the engine turns
+    speculation off for the rest of the run (alarm + gauge, never a
+    crash — speculative greedy and plain greedy emit identical tokens,
+    so degrading is output-invisible).  A draft that has stalled into
+    garbage proposals and a verify path that rejects everything look
+    the same from here, which is the point: either way every tick is
+    paying K draft dispatches for nothing."""
+
+    def __init__(self, *, min_accept: float = 0.05, window: int = 4):
+        if not 0.0 <= min_accept <= 1.0:
+            raise ValueError(f"min_accept {min_accept} not in [0, 1]")
+        if window < 1:
+            raise ValueError(f"window {window} must be >= 1")
+        self.min_accept = float(min_accept)
+        self.window = int(window)
+        self.low_streak = 0
+        self.tripped = False
+
+    def observe(self, proposed: int, accepted: int) -> bool:
+        """Feed one speculative tick; True exactly once, on the tick
+        that trips the governor."""
+        if self.tripped or proposed <= 0:
+            return False
+        if accepted / proposed < self.min_accept:
+            self.low_streak += 1
+        else:
+            self.low_streak = 0
+        if self.low_streak >= self.window:
+            self.tripped = True
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Supervised recovery
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplayStats:
+    """What one journal replay did (:func:`recover_engine`).
+
+    The replayed requests are only *queued* by the recovery — their
+    (possibly warm) admissions happen inside the next ``run()``, so
+    the warm-readmit win is measured by the caller as the delta of
+    the engine's warm counters across that run
+    (:func:`run_serving` does this and reports it on
+    :class:`ServeRunResult`)."""
+
+    replayed: int = 0            # non-terminal rids re-entered
+    skipped_terminal: int = 0    # rids the ledger already closed
+    lost_active: int = 0         # in-flight state the crash destroyed
+    lost_queued: int = 0
+
+
+@dataclasses.dataclass
+class ServeRunResult:
+    """What :func:`run_serving` supervised end to end."""
+
+    summary: Any                 # the final attempt's ServeSummary
+    attempts: int                # 1 = no crash
+    restarts: int                # attempts - 1
+    replayed: int                # total re-entered requests
+    warm_readmits: int           # replayed admissions that hit warm
+    prefix_hit_tokens: int       # prefill tokens replay skipped
+
+
+def recover_engine(engine, journal: RequestJournal,
+                   monitor=None) -> ReplayStats:
+    """Rebuild a crashed engine loop's request state from its journal.
+
+    The supervisor owns the device cache and the prefix-share index;
+    the crash destroyed only the *loop's* request bookkeeping.  So:
+    :meth:`~.engine.ServingEngine.crash_reset` frees every in-flight
+    request's blocks (registered prompt pages park in the idle LRU —
+    still warm), then every non-terminal rid in the journal is
+    re-entered through :meth:`~.engine.ServingEngine.resubmit` (no
+    second ``request_submitted`` event — the lifecycle chain opened
+    before the crash stays open and closes exactly once).  With prefix
+    sharing on, the readmission maps the surviving pages instead of
+    re-prefilling them; the stats record the measured win.  Replaying
+    a fully-terminal journal is a no-op (idempotency test)."""
+    from .engine import Request
+
+    stats = ReplayStats()
+    lost = engine.crash_reset()
+    stats.lost_active = lost["active"] + lost["prefilling"]
+    stats.lost_queued = lost["queued"]
+    state = RequestJournal.load(journal.path)
+    open_rids = state.open_rids
+    for rid in open_rids:
+        a = state.submitted[rid]
+        req = Request(
+            rid=rid, prompt=[int(t) for t in a.get("prompt", [])],
+            max_new_tokens=int(a.get("max_new_tokens", 1)),
+            eos_token=a.get("eos_token"),
+            deadline_ms=a.get("deadline_ms"),
+            priority=int(a.get("priority", 0)))
+        engine.resubmit(req)
+        stats.replayed += 1
+    stats.skipped_terminal = len(state.terminal)
+    journal.record_replay(open_rids, engine.steps)
+    if monitor is not None:
+        monitor.event("serving", "journal_replay", step=engine.steps,
+                      value=stats.replayed, replayed=stats.replayed,
+                      skipped_terminal=stats.skipped_terminal,
+                      lost_active=stats.lost_active,
+                      lost_queued=stats.lost_queued,
+                      malformed_lines=state.malformed)
+    return stats
+
+
+def run_serving(engine, requests, *, journal: RequestJournal,
+                max_restarts: int = 3,
+                backoff_base: float = 0.05,
+                backoff_max: float = 5.0,
+                jitter: float = 0.25,
+                monitor=None, sink=None,
+                before_tick: Optional[Callable[[int], None]] = None,
+                after_tick: Optional[Callable[[int], None]] = None,
+                max_steps: Optional[int] = None,
+                sleep: Callable[[float], None] = time.sleep,
+                rng=None) -> ServeRunResult:
+    """Supervise one engine's serve with bounded-backoff restarts.
+
+    The serving twin of PR-3's :func:`~apex_tpu.resilience.
+    run_resumable` — and literally built on it, so the restart event
+    trail (``attempt_start`` / ``attempt_error`` / ``attempt_backoff``
+    / ``attempt_done`` / ``run_giveup``) is the same one training
+    post-mortems already read.  ``requests`` are submitted (each
+    journaled) BEFORE the retry loop, so a submit-time validation
+    error raises straight to the caller instead of being retried as
+    a crash; a crashed attempt is recovered via
+    :func:`recover_engine` — crash_reset + journal replay — and the
+    next attempt serves the replayed queue to completion.  The same
+    engine (and its device cache) is reused across attempts, which is
+    what makes replayed admissions hit the prefix index warm.
+
+    ``monitor`` receives the serving-side events (``journal_replay``);
+    ``sink`` the resilience attempt trail (pass the same monitor for
+    one unified log).  Exhausting ``max_restarts`` re-raises through
+    :class:`~apex_tpu.resilience.GiveUp` — a replica that cannot
+    recover must die loudly, not serve garbage."""
+    from ..resilience import run_resumable
+
+    # the engine must journal THROUGH the supervisor's journal, or a
+    # recovery would load an empty ledger and silently drop every
+    # in-flight request; wiring it here makes the common call shape
+    # (engine built without journal=) just work
+    if engine.journal is None:
+        engine.journal = journal
+    elif engine.journal is not journal:
+        raise ValueError(
+            "engine.journal and run_serving's journal differ — "
+            "recovery would replay a ledger the engine never wrote")
+    stats = {"replayed": 0, "restarts": 0,
+             "replay_warm0": None, "replay_hit0": None}
+    # submit BEFORE the retry loop: a submit-time validation error
+    # (ladder span, empty prompt, ...) is the caller's bug and must
+    # raise to them directly — retrying it as a crash would swallow
+    # the error and silently drop every request after the bad one
+    for r in requests:
+        engine.submit(r)
+
+    def attempt(k: int):
+        if k > 0:
+            stats["restarts"] = k
+            if stats["replay_warm0"] is None:
+                # warm-hit counters at the FIRST recovery: everything
+                # above this after the run is replay-earned
+                stats["replay_warm0"] = engine._warm_admissions
+                stats["replay_hit0"] = engine._prefix_hit_tokens
+            rs = recover_engine(engine, journal, monitor=monitor)
+            stats["replayed"] += rs.replayed
+        return engine.run(max_steps=max_steps,
+                          before_tick=before_tick,
+                          after_tick=after_tick)
+
+    summary = run_resumable(
+        attempt, max_restarts=max_restarts, backoff_base=backoff_base,
+        backoff_max=backoff_max, jitter=jitter,
+        sink=sink if sink is not None else monitor,
+        sleep=sleep, rng=rng,
+        autoresume=engine.autoresume)
+    warm0 = stats["replay_warm0"]
+    hit0 = stats["replay_hit0"]
+    return ServeRunResult(
+        summary=summary,
+        attempts=stats["restarts"] + 1,
+        restarts=stats["restarts"],
+        replayed=stats["replayed"],
+        warm_readmits=(engine._warm_admissions - warm0
+                       if warm0 is not None else 0),
+        prefix_hit_tokens=(engine._prefix_hit_tokens - hit0
+                           if hit0 is not None else 0))

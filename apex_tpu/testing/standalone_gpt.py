@@ -1137,6 +1137,13 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 prefill_chunk: Optional[int] = None,
                 prefix_share: Optional[bool] = None,
                 draft: str = "self",
+                deadline_ms: Optional[float] = None,
+                shed=None,
+                journal_path: Optional[str] = None,
+                supervise: bool = False,
+                max_restarts: int = 3,
+                escalation="auto",
+                backoff_base: float = 0.05,
                 return_engine: bool = False):
     """Continuous-batched serving smoke: a tiny GPT serves
     ``num_requests`` mixed-length prompts through the
@@ -1184,16 +1191,30 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     (SIGUSR1 + ``APEX_TPU_SERVE_SNAPSHOT_FILE``); pass an explicit
     :class:`~apex_tpu.serving.SnapshotTrigger` or None.
 
+    Serving resilience (ISSUE-13) rides the same smoke:
+    ``deadline_ms`` stamps a default request deadline (flag:
+    ``APEX_TPU_SERVE_DEADLINE_MS``), ``shed`` a
+    :class:`~apex_tpu.serving.ShedPolicy` (flags:
+    ``APEX_TPU_SERVE_SHED_*``), ``journal_path`` a crash-safe
+    :class:`~apex_tpu.serving.RequestJournal` (default:
+    ``APEX_TPU_SERVE_JOURNAL_DIR``/serve.journal.jsonl when that flag
+    is set), and ``supervise=True`` runs the engine under
+    :func:`~apex_tpu.serving.run_serving` — bounded-backoff restarts
+    with journal replay, so ``--fault crash@K`` recovers instead of
+    dying (requires a journal).  ``escalation="auto"`` installs the
+    serve watchdog policy (stall → snapshot-then-drain); pass an
+    :class:`~apex_tpu.resilience.EscalationPolicy` or None.
+
     Returns the :class:`~apex_tpu.serving.ServeSummary` (with
     ``return_engine=True``, ``(summary, engine)`` — how tests read
     per-request token streams)."""
     import numpy as np
 
-    from ..resilience import AutoResume, parse_fault
-    from ..serving import (BucketLadder, Request, ServingEngine,
-                           ServingModelConfig, SnapshotTrigger,
-                           default_cache_config,
-                           extract_serving_weights)
+    from ..resilience import AutoResume, parse_fault, serve_policy
+    from ..serving import (BucketLadder, Request, RequestJournal,
+                           ServingEngine, ServingModelConfig,
+                           SnapshotTrigger, default_cache_config,
+                           extract_serving_weights, run_serving)
 
     model = GPTModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
@@ -1213,6 +1234,7 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     if ladder is None:
         ladder = BucketLadder.from_flags()
     from ..analysis.flags import flag_int as _flag_int
+    from ..analysis.flags import flag_str as _flag_str
 
     spec_k = speculate_k if speculate_k is not None \
         else _flag_int("APEX_TPU_SERVE_SPECULATE_K")
@@ -1240,9 +1262,14 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         else:
             raise ValueError(f"draft {draft!r} not in "
                              f"('self', 'narrow')")
+    if escalation == "auto":
+        # serve watchdog policy: a stalled decode snapshots the live
+        # engine state then drains cleanly, instead of the training
+        # default's ignore (docs/api/resilience.md#serving-resilience)
+        escalation = serve_policy()
     monitor = make_smoke_monitor(
         jsonl, sink, tokens_per_step=None, flops_per_step=None,
-        stall_timeout=stall_timeout, escalation=None,
+        stall_timeout=stall_timeout, escalation=escalation,
         watchdog_trace_dir=(os.path.join(trace_dir, "stall")
                             if trace_dir else None),
         run_attrs={"driver": "standalone_gpt.serve_smoke",
@@ -1252,6 +1279,18 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                    "decode_attention": decode_attention})
     if isinstance(fault, str):
         fault = parse_fault(fault)
+    journal = None
+    if journal_path is None:
+        jdir = _flag_str("APEX_TPU_SERVE_JOURNAL_DIR")
+        if jdir:
+            os.makedirs(jdir, exist_ok=True)
+            journal_path = os.path.join(jdir, "serve.journal.jsonl")
+    if journal_path is not None:
+        journal = RequestJournal(journal_path)
+    if supervise and journal is None:
+        raise ValueError(
+            "supervise=True needs a journal (journal_path or "
+            "APEX_TPU_SERVE_JOURNAL_DIR): recovery replays it")
     own_autoresume = False
     if autoresume == "auto":
         autoresume = AutoResume(sink=monitor).install()
@@ -1271,7 +1310,10 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                            draft_weights=draft_weights,
                            draft_cfg=draft_cfg,
                            prefill_chunk=prefill_chunk,
-                           prefix_share=prefix_share)
+                           prefix_share=prefix_share,
+                           deadline_ms=deadline_ms, shed=shed,
+                           journal=journal, escalation=escalation,
+                           fault=fault)
     # mixed-length prompts, deterministic per seed; every request
     # fits the ladder span and the model's position table
     rng = np.random.RandomState(seed)
@@ -1281,7 +1323,13 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                for x in rng.randint(1, 10 ** 6, num_requests)]
     prompts = [[int(t) for t in rng.randint(0, vocab, n)]
                for n in lengths]
-    before = fault.before_step if fault is not None else None
+    before = None
+    if fault is not None:
+        # the serve-aware hook: crash/stall/signals like the training
+        # loop, plus corrupt_journal against the live journal (the
+        # reject_alloc kind fires inside the engine's admission path)
+        def before(tick, _f=fault):
+            _f.before_tick(tick, journal_path=journal_path)
     try:
         with contextlib.ExitStack() as stack:
             san = None
@@ -1294,15 +1342,32 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                     transfer_guard=None, recompile_budget=0,
                     warmup_steps=1))
             engine.warmup()
+            if escalation is not None:
+                # warmup is not serving: a single AOT compile can
+                # outlast a short stall timeout and latch the policy
+                # before the first tick ever runs — re-arm it at the
+                # traffic boundary (the same per-attempt reset
+                # discipline as _run_smoke_loop)
+                escalation.reset()
             # submit AFTER warmup so the reported queue-wait/TTFT
             # distributions measure serving, not AOT compile time
-            for i, p in enumerate(prompts):
-                engine.submit(Request(
-                    rid=f"req{i:03d}", prompt=p,
-                    max_new_tokens=max_new_tokens))
-            summary = engine.run(
-                before_tick=before,
-                after_tick=(lambda i: san.step()) if san else None)
+            requests = [Request(rid=f"req{i:03d}", prompt=p,
+                                max_new_tokens=max_new_tokens)
+                        for i, p in enumerate(prompts)]
+            after = (lambda i: san.step()) if san else None
+            if supervise:
+                res = run_serving(
+                    engine, requests, journal=journal,
+                    max_restarts=max_restarts,
+                    backoff_base=backoff_base,
+                    monitor=monitor, before_tick=before,
+                    after_tick=after)
+                summary = res.summary   # restarts set by run_serving
+            else:
+                for r in requests:
+                    engine.submit(r)
+                summary = engine.run(before_tick=before,
+                                     after_tick=after)
         if trace_dir is not None:
             # one Perfetto lane per request (queued/prefill/decode),
             # written through the PR-7 atomic Chrome writer so the
@@ -1322,11 +1387,15 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
             monitor.close()
         finally:
             try:
-                if own_snapshot and snapshot is not None:
-                    snapshot.close()
+                if journal is not None:
+                    journal.close()
             finally:
-                if own_autoresume:
-                    autoresume.uninstall()
+                try:
+                    if own_snapshot and snapshot is not None:
+                        snapshot.close()
+                finally:
+                    if own_autoresume:
+                        autoresume.uninstall()
     if return_engine:
         return summary, engine
     return summary
@@ -1435,9 +1504,53 @@ def _main(argv=None):
                         "sharing: warm prefixes map shared KV pages "
                         "instead of re-prefilling (default: "
                         "APEX_TPU_SERVE_PREFIX_SHARE)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="(--serve) default request deadline in ms "
+                        "(submit -> last token); queued requests past "
+                        "it expire terminal deadline_exceeded, "
+                        "running ones are evicted terminal deadline "
+                        "(default: APEX_TPU_SERVE_DEADLINE_MS)")
+    p.add_argument("--shed-pool-hw", type=float, default=None,
+                   help="(--serve) load-shedding high-water mark on "
+                        "pool pressure, fraction (default: "
+                        "APEX_TPU_SERVE_SHED_POOL_HW; 0 disables)")
+    p.add_argument("--shed-queue-hw", type=int, default=None,
+                   help="(--serve) load-shedding high-water mark on "
+                        "the admission backlog (default: "
+                        "APEX_TPU_SERVE_SHED_QUEUE_HW; 0 disables)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="(--serve) crash-safe request journal JSONL "
+                        "(submit/progress/terminal transitions; "
+                        "default: APEX_TPU_SERVE_JOURNAL_DIR/"
+                        "serve.journal.jsonl when that flag is set)")
+    p.add_argument("--supervise", action="store_true",
+                   help="(--serve) run the engine under the "
+                        "serving supervisor: bounded-backoff "
+                        "restarts, journal replay of every "
+                        "non-terminal request after a crash "
+                        "(requires --journal); --fault crash@K "
+                        "recovers instead of dying")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="(--serve --supervise) restart budget "
+                        "(default 3)")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     if args.serve:
+        shed = None
+        if args.shed_pool_hw is not None \
+                or args.shed_queue_hw is not None:
+            from ..analysis.flags import flag_float, flag_int
+            from ..serving import ShedPolicy
+
+            # each CLI mark overrides only ITSELF; the other keeps its
+            # APEX_TPU_SERVE_SHED_* default as the help text promises
+            shed = ShedPolicy(
+                pool_hw=(args.shed_pool_hw
+                         if args.shed_pool_hw is not None else
+                         flag_float("APEX_TPU_SERVE_SHED_POOL_HW")),
+                queue_hw=(args.shed_queue_hw
+                          if args.shed_queue_hw is not None else
+                          flag_int("APEX_TPU_SERVE_SHED_QUEUE_HW")))
         s, eng = serve_smoke(
             args.requests, jsonl=args.jsonl, sanitize=args.sanitize,
             max_new_tokens=args.new_tokens,
@@ -1448,6 +1561,9 @@ def _main(argv=None):
             trace_dir=args.trace, speculate_k=args.speculate_k,
             prefill_chunk=args.prefill_chunk,
             prefix_share=args.prefix_share, draft=args.draft,
+            deadline_ms=args.deadline_ms, shed=shed,
+            journal_path=args.journal, supervise=args.supervise,
+            max_restarts=args.max_restarts,
             return_engine=True)
         spec = "" if s.spec_accept_rate is None else (
             f" spec_accept_rate={s.spec_accept_rate}"
@@ -1455,10 +1571,22 @@ def _main(argv=None):
         share = "" if not (s.warm_prefix_admissions
                            or s.shared_blocks_hw) else (
             f" warm_admissions={s.warm_prefix_admissions}"
+            f" prefix_hit_tokens={s.prefix_hit_tokens}"
             f" shared_blocks_hw={s.shared_blocks_hw}"
             f" cow_copies={s.cow_copies}")
         chunks = f" prefill_chunks={s.prefill_chunks}" \
             if s.prefill_chunks else ""
+        resil = ""
+        if args.supervise or s.replayed_requests:
+            resil += (f" restarts={s.restarts}"
+                      f" replayed={s.replayed_requests}")
+        if s.requests_deadline:
+            resil += f" deadline={s.requests_deadline}"
+        if s.requests_shed:
+            resil += (f" shed={s.requests_shed}"
+                      f" shed_engagements={s.shed_engagements}")
+        if s.spec_disabled:
+            resil += " spec_disabled=1"
         print(f"SERVE_DONE requests={s.requests_done} "
               f"preempted={s.requests_preempted} "
               f"tokens={s.tokens_generated} "
@@ -1470,7 +1598,7 @@ def _main(argv=None):
               f"steps={s.decode_steps} "
               f"compiles={len(s.compiles)} "
               f"drained={int(s.drained)}"
-              f"{spec}{share}{chunks} "
+              f"{spec}{share}{chunks}{resil} "
               f"digest={eng.tokens_digest()}"
               + (f" jsonl={args.jsonl}" if args.jsonl else ""))
         return

@@ -698,3 +698,65 @@ class TestMultiTickPrefillLifecycle:
             snapshot=None, speculate_k=2, draft="self")
         assert summary.spec_accept_rate == 1.0
         assert check_serve_trace(str(jsonl)) == []
+
+
+class TestResilienceMetrics:
+    """ISSUE-13: terminal reasons on the lifecycle chain, shed/deadline
+    gauge counters, and the crash-replay chain reopen semantics."""
+
+    def test_terminal_reason_rides_request_done(self):
+        mon = StubMonitor()
+        m = ServeMetrics(monitor=mon, clock=FakeClock(), tick_every=1)
+        req = Request(rid="d", prompt=[1, 2], max_new_tokens=4)
+        req.terminal = "deadline_exceeded"
+        m.on_submit(req, 0)
+        m.on_done(req, 1)
+        done = mon.sink.by_name("request_done")[0].attrs
+        assert done["terminal"] == "deadline_exceeded"
+        assert done["preempted"] is False
+        # never admitted: the whole wall is queue wait, parts sum
+        assert done["queue_wait_ms"] == pytest.approx(done["wall_ms"])
+
+    def test_gauges_count_shed_and_deadline_windows(self):
+        g = EngineGauges(every=2)
+        g.on_finish("shed")
+        g.on_finish("shed")
+        g.on_finish("deadline")
+        g.on_finish("finished")
+        g.observe(1, batch=1, used_blocks=1, compiles=0)
+        out = g.observe(2, batch=1, used_blocks=1, compiles=0)
+        assert out["shed"] == 2
+        assert out["deadline_exceeded"] == 1
+        assert out["finished"] == 1
+        # counters reset per window; a clean window omits the keys
+        out2 = g.flush()
+        assert out2 is None or "shed" not in out2
+
+    def test_flush_carries_tickless_shed_window(self):
+        g = EngineGauges(every=4)
+        g.on_finish("shed")
+        tail = g.flush()
+        assert tail is not None and tail["shed"] == 1
+
+    def test_reopen_resets_incarnation_parts_sum(self):
+        # a crash-replayed rid: queue wait spans the crash downtime to
+        # the FRESH admission; prefill/decode measure the incarnation
+        # that finishes — parts still sum to the rid's full wall
+        clock = FakeClock()
+        m = ServeMetrics(monitor=StubMonitor(), clock=clock,
+                         tick_every=1)
+        req = Request(rid="r", prompt=[1, 2, 3], max_new_tokens=3)
+        m.on_submit(req, 0)                      # submit_t = 2
+        m.on_admit(req, 0, admit_t=clock(), prefill_s=1.0)
+        tr = m.reopen("r")
+        assert tr is not None
+        assert tr.admit_t is None and tr.first_token_t is None
+        assert tr.submit_t == 2.0                # original anchor
+        m.on_admit(req, 3, admit_t=clock(), prefill_s=0.5)
+        req.out_tokens = [7, 8]
+        req.token_latency_s = [0.5, 0.25]
+        m.on_done(req, 4)
+        done = m.completed[-1]
+        assert done.queue_wait_s + done.prefill_s + done.decode_s \
+            == pytest.approx(done.wall_s, abs=1e-9)
+        assert m.reopen("ghost") is None

@@ -1,0 +1,623 @@
+"""Serving resilience tests (ISSUE-13): request deadlines + load
+shedding, the journaled supervised recovery path, serve fault
+injection, and degraded modes.
+
+The pinned acceptance bars:
+
+* **deadline-at-boundary semantics** — a deadline expiring exactly on
+  a tick boundary evicts AFTER that tick's tokens were delivered
+  (fake clock: deadline / tick_ms tokens, not one fewer);
+* **shed hysteresis** — engaging at the high-water mark latches until
+  the load drops through the band to the LOW-water mark: load
+  hovering at the mark cannot flap admit/shed/admit;
+* **exactly-once across a crash** — the supervised crash-replay ends
+  with every submitted rid in exactly one terminal ``request_done``,
+  the replayed admissions hit the surviving prefix pages warm
+  (``prefix_hit_tokens`` > 0), and the output digest is
+  token-for-token the uninterrupted run's (greedy determinism);
+* **journal replay idempotency** — replaying a fully-terminal journal
+  re-enters nothing.
+"""
+import os
+import types
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor import JsonlSink, MemorySink, StepMonitor
+from apex_tpu.monitor.tracing import check_serve_trace
+from apex_tpu.resilience import (EscalationAbort, InjectedCrash,
+                                 corrupt_journal, parse_fault,
+                                 serve_policy)
+from apex_tpu.serving import (BucketLadder, Request, RequestJournal,
+                              ServingEngine, ServingModelConfig,
+                              ShedPolicy, SpeculationGovernor,
+                              default_cache_config,
+                              extract_serving_weights, recover_engine,
+                              run_serving)
+from apex_tpu.testing.standalone_gpt import GPTModel
+
+
+def _tiny_model(vocab=32, hidden=16, heads=2, layers=2, max_seq=64,
+                seed=0):
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(model, params, *, ladder, num_blocks=32, block_size=4,
+            **kw):
+    cfg = ServingModelConfig.from_model(
+        model, prefill_flash=False, decode_attention="reference")
+    weights = extract_serving_weights(params, cfg.num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
+                                     block_size=block_size)
+    return ServingEngine(weights, cfg, cache_cfg, ladder=ladder, **kw)
+
+
+PROMPTS = [[3, 7, 1, 2, 9], [11, 2, 9, 4, 5, 6], [6, 6, 2, 1, 9, 8],
+           [4, 1, 3, 3, 7]]
+LADDER = BucketLadder(batch=(2, 4), pages=(2, 4))
+
+
+def _requests(new_tokens=5, prompts=PROMPTS, deadline_ms=None,
+              priority=0):
+    return [Request(rid=f"r{i}", prompt=list(p),
+                    max_new_tokens=new_tokens, deadline_ms=deadline_ms,
+                    priority=priority)
+            for i, p in enumerate(prompts)]
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return _tiny_model()
+
+
+@pytest.fixture(scope="module")
+def baseline_tokens(tiny):
+    model, params = tiny
+    eng = _engine(model, params, ladder=LADDER)
+    for r in _requests():
+        eng.submit(r)
+    eng.run()
+    return {q.rid: list(q.out_tokens) for q in eng.done}
+
+
+# ---------------------------------------------------------------------------
+# shed policy (unit)
+# ---------------------------------------------------------------------------
+
+class TestShedPolicy:
+    def test_hysteresis_no_flap_around_high_water(self):
+        # engage at hw; load hovering in the band (lw, hw) must stay
+        # one engagement, and dropping through the band disengages —
+        # the no-flap contract
+        p = ShedPolicy(pool_hw=0.8, pool_lw=0.5)
+        assert p.update(pool_frac=0.8, queue_depth=0) is True
+        assert p.engagements == 1
+        assert p.update(pool_frac=0.7, queue_depth=0) is True
+        assert p.update(pool_frac=0.79, queue_depth=0) is True
+        assert p.engagements == 1          # hovering != re-engaging
+        assert p.update(pool_frac=0.5, queue_depth=0) is False
+        assert p.update(pool_frac=0.7, queue_depth=0) is False
+        # in-band load after disengaging does NOT re-engage
+        assert p.engagements == 1
+        assert p.update(pool_frac=0.85, queue_depth=0) is True
+        assert p.engagements == 2
+
+    def test_queue_trigger_and_defaults(self):
+        p = ShedPolicy(queue_hw=4)
+        assert p.queue_lw == 2
+        assert not p.update(pool_frac=0.0, queue_depth=4)
+        assert p.update(pool_frac=0.0, queue_depth=5)
+        assert p.update(pool_frac=0.0, queue_depth=3)   # in band
+        assert not p.update(pool_frac=0.0, queue_depth=2)
+
+    def test_disabled_policy_never_engages(self):
+        p = ShedPolicy()
+        assert not p.enabled
+        assert not p.update(pool_frac=1.0, queue_depth=10 ** 6)
+
+    def test_bad_bands_raise(self):
+        with pytest.raises(ValueError):
+            ShedPolicy(pool_hw=1.5)
+        with pytest.raises(ValueError):
+            ShedPolicy(pool_hw=0.5, pool_lw=0.6)
+        with pytest.raises(ValueError):
+            ShedPolicy(queue_hw=2, queue_lw=2)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+class TestDeadlines:
+    def test_deadline_exactly_on_boundary_keeps_the_tick(self, tiny):
+        # fake clock: each tick costs 10 ms; deadline 20 ms.  The
+        # boundary at t=20 must evict AFTER tick 2's token was
+        # delivered — exactly 2 decode-tick tokens + the prefill
+        # token, never one fewer
+        model, params = tiny
+        clock = FakeClock()
+        eng = _engine(model, params, ladder=BucketLadder(
+            batch=(1,), pages=(4,)), clock=clock)
+        req = Request(rid="dl", prompt=[3, 1, 2], max_new_tokens=10,
+                      deadline_ms=20.0)
+        eng.submit(req)
+        eng.run(after_tick=lambda i: clock.advance(0.010))
+        assert req.terminal == "deadline"
+        # prefill token at t=0, decode tokens at the t=10 and t=20
+        # boundaries; eviction at the t=20 boundary check
+        assert len(req.out_tokens) == 3
+        assert eng.manager.used_blocks == 0    # blocks freed
+
+    def test_queued_expiry_is_terminal_not_vanished(self, tiny):
+        model, params = tiny
+        sink = MemorySink()
+        mon = StepMonitor(sink, close_sink=False)
+        clock = FakeClock()
+        eng = _engine(model, params,
+                      ladder=BucketLadder(batch=(1,), pages=(4,)),
+                      clock=clock, monitor=mon)
+        a = Request(rid="a", prompt=[1, 2, 3], max_new_tokens=6)
+        b = Request(rid="b", prompt=[4, 5, 6], max_new_tokens=6,
+                    deadline_ms=15.0)
+        eng.submit(a)
+        eng.submit(b)                      # batch bucket 1: b queues
+        s = eng.run(after_tick=lambda i: clock.advance(0.010))
+        assert b.terminal == "deadline_exceeded"
+        assert not b.out_tokens            # never admitted
+        assert a.terminal == "finished"
+        assert s.requests_deadline == 1 and s.requests_done == 1
+        done = [e for e in sink.events if e.name == "request_done"]
+        assert {e.attrs["rid"]: e.attrs["terminal"] for e in done} \
+            == {"a": "finished", "b": "deadline_exceeded"}
+
+    def test_finished_within_deadline_beats_expiry(self, tiny):
+        # a request whose LAST token arrived within its deadline ends
+        # terminal "finished" even though the next boundary check runs
+        # past the deadline — eviction of done requests precedes
+        # deadline enforcement
+        model, params = tiny
+        clock = FakeClock()
+        eng = _engine(model, params, ladder=BucketLadder(
+            batch=(1,), pages=(4,)), clock=clock)
+        req = Request(rid="ok", prompt=[3, 1, 2], max_new_tokens=3,
+                      deadline_ms=25.0)
+        eng.submit(req)
+        s = eng.run(after_tick=lambda i: clock.advance(0.010))
+        # tokens at t=0 (prefill), 10, 20 — done at t=20 < 25; the
+        # t=30 boundary must finish it, not expire it
+        assert req.terminal == "finished"
+        assert s.requests_done == 1 and s.requests_deadline == 0
+
+    def test_engine_default_deadline_applies(self, tiny):
+        model, params = tiny
+        clock = FakeClock()
+        eng = _engine(model, params, ladder=LADDER, clock=clock,
+                      deadline_ms=25.0)
+        reqs = _requests(new_tokens=10)
+        for r in reqs:
+            eng.submit(r)
+        assert all(r.deadline_ms == 25.0 for r in reqs)
+        s = eng.run(after_tick=lambda i: clock.advance(0.010))
+        assert s.requests_deadline == len(reqs)
+        assert all(r.terminal == "deadline" for r in reqs)
+
+
+# ---------------------------------------------------------------------------
+# shedding through the engine
+# ---------------------------------------------------------------------------
+
+class TestEngineShedding:
+    def test_shed_accounts_every_request(self, tiny, tmp_path):
+        model, params = tiny
+        path = str(tmp_path / "shed.jsonl")
+        mon = StepMonitor(JsonlSink(path))
+        eng = _engine(model, params,
+                      ladder=BucketLadder(batch=(1,), pages=(4,)),
+                      monitor=mon,
+                      shed=ShedPolicy(queue_hw=2, queue_lw=1))
+        reqs = _requests(new_tokens=4)     # 4 requests, batch cap 1
+        for r in reqs:
+            eng.submit(r)
+        s = eng.run()
+        mon.close()
+        assert s.requests_shed > 0
+        assert s.shed_engagements == 1
+        assert s.requests_done + s.requests_shed == len(reqs)
+        assert all(r.terminal in ("finished", "shed") for r in reqs)
+        # lifecycle completeness holds on the shed terminal path
+        assert check_serve_trace(path) == []
+
+    def test_shed_prefers_lowest_priority(self, tiny):
+        model, params = tiny
+        eng = _engine(model, params,
+                      ladder=BucketLadder(batch=(1,), pages=(4,)),
+                      shed=ShedPolicy(queue_hw=2, queue_lw=1))
+        first = Request(rid="first", prompt=[7, 8], max_new_tokens=3)
+        hi = Request(rid="hi", prompt=[1, 2, 3], max_new_tokens=3,
+                     priority=5)
+        lo = Request(rid="lo", prompt=[4, 5, 6], max_new_tokens=3,
+                     priority=0)
+        eng.submit(first)
+        eng.submit(hi)
+        eng.submit(lo)                     # backlog 3 > hw 2 -> shed
+        eng.run()
+        # victims: lowest priority first, newest arrival first among
+        # equals — the priority-5 request survives on priority alone
+        assert lo.terminal == "shed"
+        assert first.terminal == "shed"
+        assert hi.terminal == "finished"
+
+
+# ---------------------------------------------------------------------------
+# journal + supervised recovery
+# ---------------------------------------------------------------------------
+
+def _journaled_engine(tiny, tmp_path, name, **kw):
+    model, params = tiny
+    journal = RequestJournal(str(tmp_path / f"{name}.jsonl"))
+    sink = MemorySink()
+    mon = StepMonitor(sink, close_sink=False)
+    eng = _engine(model, params, ladder=LADDER, monitor=mon,
+                  journal=journal, **kw)
+    return eng, journal, sink
+
+
+class TestJournalRecovery:
+    def test_crash_replay_exactly_once_warm_and_digest(
+            self, tiny, tmp_path, baseline_tokens):
+        eng, journal, sink = _journaled_engine(
+            tiny, tmp_path, "crash", prefix_share=True)
+        fault = parse_fault("crash@2")
+        res = run_serving(eng, _requests(), journal=journal,
+                          max_restarts=2,
+                          before_tick=fault.before_step,
+                          sleep=lambda _s: None)
+        assert res.restarts == 1
+        assert res.replayed > 0
+        # warm readmit: the crashed requests' prompt pages survived in
+        # the idle LRU, so the replayed admissions skipped prefill
+        assert res.warm_readmits > 0
+        assert res.prefix_hit_tokens > 0
+        # exactly-once terminal accounting across the crash
+        done = [e for e in sink.events if e.name == "request_done"]
+        submitted = [e for e in sink.events
+                     if e.name == "request_submitted"]
+        assert len(submitted) == len(PROMPTS)      # no double-submit
+        rids = [e.attrs["rid"] for e in done]
+        assert sorted(rids) == sorted(f"r{i}"
+                                      for i in range(len(PROMPTS)))
+        # greedy determinism: the recovered run's tokens are the
+        # uninterrupted run's, token for token
+        assert {q.rid: list(q.out_tokens) for q in eng.done} \
+            == baseline_tokens
+        assert res.summary.replayed_requests == res.replayed
+
+    def test_fully_terminal_journal_replay_is_noop(self, tiny,
+                                                   tmp_path):
+        eng, journal, _ = _journaled_engine(tiny, tmp_path, "noop")
+        for r in _requests():
+            eng.submit(r)
+        eng.run()
+        state = RequestJournal.load(journal.path)
+        assert state.open_rids == []
+        stats = recover_engine(eng, journal)
+        assert stats.replayed == 0
+        assert stats.skipped_terminal == len(PROMPTS)
+        assert not eng.queue and not eng.active
+
+    def test_journal_survives_truncate(self, tiny, tmp_path):
+        eng, journal, _ = _journaled_engine(tiny, tmp_path, "trunc")
+        for r in _requests():
+            eng.submit(r)
+        eng.run()
+        corrupt_journal(journal.path, mode="truncate")
+        state = RequestJournal.load(journal.path)
+        # the torn tail is counted, every complete line still parses,
+        # and the submit ledger survives
+        assert state.malformed <= 1
+        assert len(state.submitted) == len(PROMPTS)
+
+    def test_unfinalized_terminal_replays_at_least_once(self, tiny,
+                                                        tmp_path):
+        eng, journal, _ = _journaled_engine(tiny, tmp_path, "unfin")
+        for r in _requests():
+            eng.submit(r)
+        eng.run()
+        n_before = len(RequestJournal.load(journal.path).terminal)
+        corrupt_journal(journal.path, mode="unfinalize")
+        state = RequestJournal.load(journal.path)
+        assert len(state.terminal) == n_before - 1
+        assert len(state.open_rids) == 1   # looks in-flight -> replays
+        stats = recover_engine(eng, journal)
+        assert stats.replayed == 1
+
+    def test_reused_journal_reopens_resubmitted_rids(self, tiny,
+                                                     tmp_path):
+        # an append-only journal outliving one serve: the second
+        # serve's submits (same rids) land AFTER the first serve's
+        # terminal records and must REOPEN the rids — otherwise a
+        # crash in the second serve replays nothing and its requests
+        # vanish behind the previous run's ledger
+        eng, journal, sink = _journaled_engine(
+            tiny, tmp_path, "reuse", prefix_share=True)
+        for r in _requests():
+            eng.submit(r)
+        eng.run()                          # serve 1 completes
+        first_done = len(eng.done)
+        fault = parse_fault("crash@6")     # ticks continue counting
+        # (serve 1 ends around tick 4; tick 6 lands mid-serve-2)
+        res = run_serving(eng, _requests(), journal=journal,
+                          max_restarts=2,
+                          before_tick=fault.before_step,
+                          sleep=lambda _s: None)
+        assert res.restarts == 1
+        assert res.replayed == len(PROMPTS)
+        assert len(eng.done) == first_done + len(PROMPTS)
+
+    def test_giveup_after_budget(self, tiny, tmp_path):
+        from apex_tpu.resilience import GiveUp
+
+        eng, journal, _ = _journaled_engine(tiny, tmp_path, "giveup")
+        fault = parse_fault("crash@1,crash@2")
+
+        def always_crash(tick):
+            fault.before_step(tick)
+            if tick >= 3:
+                raise InjectedCrash("still broken")
+
+        with pytest.raises(GiveUp):
+            run_serving(eng, _requests(), journal=journal,
+                        max_restarts=1, before_tick=always_crash,
+                        sleep=lambda _s: None)
+
+
+# ---------------------------------------------------------------------------
+# serve fault injectors
+# ---------------------------------------------------------------------------
+
+class TestServeFaults:
+    def test_reject_alloc_skips_one_ticks_admissions(self, tiny):
+        model, params = tiny
+        sink = MemorySink()
+        mon = StepMonitor(sink, close_sink=False)
+        fault = parse_fault("reject_alloc@0")
+        eng = _engine(model, params, ladder=LADDER, monitor=mon,
+                      fault=fault)
+        for r in _requests(new_tokens=3):
+            eng.submit(r)
+        s = eng.run()
+        rejected = [e for e in sink.events
+                    if e.name == "alloc_rejected"]
+        assert len(rejected) == 1          # once-semantics
+        # the rejected tick admitted nothing: every admission lands
+        # AFTER the alloc_rejected event, and the serve still finishes
+        order = [e.name for e in sink.events
+                 if e.name in ("alloc_rejected", "request_admitted")]
+        assert order[0] == "alloc_rejected"
+        assert order.count("request_admitted") == len(PROMPTS)
+        assert s.requests_done == len(PROMPTS)
+
+    def test_corrupt_journal_spec_fires_once(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = RequestJournal(path)
+        j.record_submit(Request(rid="x", prompt=[1, 2],
+                                max_new_tokens=2), 0)
+        j.record_terminal(types.SimpleNamespace(
+            rid="x", terminal="finished", out_tokens=[5, 6]), 1)
+        fault = parse_fault("corrupt_journal@2:unfinalize")
+        fault.before_tick(1, journal_path=path)
+        assert len(RequestJournal.load(path).terminal) == 1
+        fault.before_tick(2, journal_path=path)
+        assert len(RequestJournal.load(path).terminal) == 0
+        # fired: a second pass over the same tick is a no-op
+        j.record_terminal(types.SimpleNamespace(
+            rid="x", terminal="finished", out_tokens=[5, 6]), 3)
+        fault.before_tick(2, journal_path=path)
+        assert len(RequestJournal.load(path).terminal) == 1
+        j.close()
+
+    def test_live_journal_appends_survive_unfinalize(self, tmp_path):
+        # the injector rewrites IN PLACE: the engine's append-mode
+        # sink must keep landing records in the same file afterwards
+        path = str(tmp_path / "live.jsonl")
+        j = RequestJournal(path)
+        j.record_submit(Request(rid="a", prompt=[1, 2],
+                                max_new_tokens=2), 0)
+        j.record_terminal(types.SimpleNamespace(
+            rid="a", terminal="finished", out_tokens=[9]), 1)
+        corrupt_journal(path, mode="unfinalize")
+        j.record_submit(Request(rid="b", prompt=[3, 4],
+                                max_new_tokens=2), 2)
+        j.close()
+        state = RequestJournal.load(path)
+        assert set(state.submitted) == {"a", "b"}
+        assert state.terminal == {}
+
+
+# ---------------------------------------------------------------------------
+# degraded modes
+# ---------------------------------------------------------------------------
+
+class TestDegradedModes:
+    def test_governor_trips_on_streak_only(self):
+        g = SpeculationGovernor(min_accept=0.5, window=3)
+        assert not g.observe(4, 0)
+        assert not g.observe(4, 0)
+        assert not g.observe(4, 4)         # streak broken
+        assert not g.observe(4, 0)
+        assert not g.observe(4, 0)
+        assert g.observe(4, 0)             # 3rd consecutive low tick
+        assert not g.observe(4, 0)         # trips exactly once
+
+    def test_spec_auto_disable_preserves_output(self, tiny,
+                                                baseline_tokens):
+        # a disagreeing narrow draft + a zero-tolerance governor: the
+        # first rejecting tick disables speculation mid-run; output
+        # stays token-identical (speculative greedy == greedy) and
+        # the alarm + summary flag record the degradation
+        model, params = tiny
+        dm, dp = _tiny_model(hidden=16, heads=2, layers=1, seed=7)
+        dcfg = ServingModelConfig.from_model(
+            dm, prefill_flash=False, decode_attention="reference")
+        dweights = extract_serving_weights(dp, 1)
+        sink = MemorySink()
+        mon = StepMonitor(sink, close_sink=False)
+        eng = _engine(model, params, ladder=LADDER, monitor=mon,
+                      speculate_k=2, draft_weights=dweights,
+                      draft_cfg=dcfg,
+                      spec_governor=SpeculationGovernor(
+                          min_accept=1.0, window=1))
+        for r in _requests():
+            eng.submit(r)
+        s = eng.run()
+        assert s.spec_disabled
+        assert eng.speculate_k == 0
+        assert [e for e in sink.events
+                if e.name == "spec_disabled"]
+        assert {q.rid: list(q.out_tokens) for q in eng.done} \
+            == baseline_tokens
+
+    def test_stall_escalation_snapshots_then_drains(self, tiny):
+        model, params = tiny
+        sink = MemorySink()
+        mon = StepMonitor(sink, close_sink=False)
+        policy = serve_policy()
+        eng = _engine(model, params, ladder=LADDER, monitor=mon,
+                      escalation=policy)
+        reqs = _requests(new_tokens=8)
+        for r in reqs:
+            eng.submit(r)
+        # latch a stall alarm the way the watchdog heartbeat would
+        policy.notify(types.SimpleNamespace(name="stall", step=0))
+        s = eng.run()
+        assert s.drained
+        assert s.requests_preempted == len(reqs)
+        snaps = [e for e in sink.events
+                 if e.name == "engine_snapshot"]
+        assert len(snaps) == 1             # fires exactly once
+        assert snaps[0].attrs["reason"] == "escalation:stall"
+        assert [e for e in sink.events
+                if e.name == "escalation_drain"]
+        assert eng.manager.used_blocks == 0
+
+    def test_abort_action_raises_for_the_supervisor(self, tiny):
+        model, params = tiny
+        policy = serve_policy({"stall": "abort"})
+        eng = _engine(model, params, ladder=LADDER, escalation=policy)
+        eng.submit(Request(rid="x", prompt=[1, 2, 3],
+                           max_new_tokens=4))
+        policy.notify(types.SimpleNamespace(name="stall", step=0))
+        with pytest.raises(EscalationAbort):
+            eng.run()
+
+
+# ---------------------------------------------------------------------------
+# KeyboardInterrupt drain
+# ---------------------------------------------------------------------------
+
+class TestKeyboardInterrupt:
+    def test_first_interrupt_drains_clean(self, tiny, tmp_path):
+        model, params = tiny
+        path = str(tmp_path / "kbd.jsonl")
+        mon = StepMonitor(JsonlSink(path))
+        eng = _engine(model, params, ladder=LADDER, monitor=mon)
+        reqs = _requests(new_tokens=8)
+        for r in reqs:
+            eng.submit(r)
+
+        def interrupt(tick):
+            if tick >= 2:
+                raise KeyboardInterrupt
+
+        s = eng.run(before_tick=interrupt)
+        mon.close()
+        # clean drain, not an unwind: blocks freed, every chain
+        # terminal, summary returned
+        assert s.drained
+        assert s.requests_preempted == len(reqs)
+        assert eng.manager.used_blocks == 0
+        assert check_serve_trace(path) == []
+
+    def test_drain_finishes_completed_requests(self, tiny):
+        # a request that emitted its full budget during the tick that
+        # latched the drain must end "finished", not "preempted" —
+        # its eviction was merely pending the next tick
+        model, params = tiny
+        eng = _engine(model, params,
+                      ladder=BucketLadder(batch=(1,), pages=(4,)))
+        req = Request(rid="d", prompt=[1, 2, 3], max_new_tokens=2)
+        eng.submit(req)
+
+        def boom(tick):
+            if tick >= 1:
+                raise KeyboardInterrupt
+
+        s = eng.run(before_tick=boom)
+        assert req.terminal == "finished"
+        assert s.requests_done == 1 and s.requests_preempted == 0
+
+    def test_moot_drain_does_not_leak_into_next_run(self, tiny):
+        # an escalation latched on the run's final tick (everything
+        # finished that same tick) becomes moot — a later run() on the
+        # same engine must serve fresh requests, not preempt them at
+        # its first boundary
+        model, params = tiny
+        policy = serve_policy()
+        eng = _engine(model, params,
+                      ladder=BucketLadder(batch=(1,), pages=(4,)),
+                      escalation=policy)
+        r1 = Request(rid="one", prompt=[1, 2, 3], max_new_tokens=2)
+        eng.submit(r1)
+        fired = []
+
+        def late(tick):
+            if r1.done and not fired:
+                policy.notify(types.SimpleNamespace(name="stall",
+                                                    step=tick))
+                fired.append(tick)
+
+        eng.run(after_tick=late)
+        assert r1.terminal == "finished"
+        r2 = Request(rid="two", prompt=[4, 5], max_new_tokens=2)
+        eng.submit(r2)
+        s = eng.run()
+        assert r2.terminal == "finished"
+        assert s.requests_done == 2 and s.requests_preempted == 0
+
+    def test_second_interrupt_forces_exit(self, tiny, monkeypatch):
+        model, params = tiny
+        eng = _engine(model, params, ladder=LADDER)
+        for r in _requests(new_tokens=8):
+            eng.submit(r)
+
+        def interrupt(tick):
+            raise KeyboardInterrupt
+
+        # a second ^C arriving during the drain must propagate — the
+        # PR-3 double-signal convention (second one means NOW)
+        monkeypatch.setattr(
+            eng.metrics, "on_done",
+            lambda *a, **k: (_ for _ in ()).throw(KeyboardInterrupt))
+        with pytest.raises(KeyboardInterrupt):
+            eng.run(before_tick=interrupt)

@@ -108,7 +108,19 @@
 #                            acceptance_rate > 0 and shared blocks,
 #                            and emit a tokens digest IDENTICAL to
 #                            the plain leg's (speculative greedy ==
-#                            greedy, token for token)
+#                            greedy, token for token); then the
+#                            ISSUE-13 resilience legs: a supervised
+#                            `--fault crash@3` serve must restart
+#                            once, journal-replay every non-terminal
+#                            request WARM (prefix_hit_tokens > 0),
+#                            keep N submitted => N terminal across
+#                            the crash, and reproduce the
+#                            uninterrupted run's tokens digest; and a
+#                            `--fault stall@2` serve under a short
+#                            watchdog timeout must fire the
+#                            snapshot-then-drain escalation exactly
+#                            once (one engine_snapshot, clean drain,
+#                            chains complete)
 #  12. SPMD sharding audit   — python -m apex_tpu.analysis
 #                            --check-sharding compiles every
 #                            plan-carrying multichip entry point under
@@ -299,6 +311,56 @@ SPEC_DIGEST="$(echo "$SERVE_OUT" | grep -o 'digest=[0-9a-f]*')"
 [ -n "$PLAIN_DIGEST" ] && [ "$SPEC_DIGEST" = "$PLAIN_DIGEST" ] \
     || { echo "[ci] FAIL: speculative output digest $SPEC_DIGEST != plain $PLAIN_DIGEST"; exit 1; }
 python tools/trace_check.py "$SERVE_DIR/spec.jsonl" --serve
+# leg 4 (ISSUE-13): supervised crash recovery — the engine loop dies
+# at tick 3 (--fault crash@3), the supervisor restarts it with the
+# PR-3 bounded-backoff semantics, and the journal replay re-enters
+# every non-terminal request WARM (the crashed requests' prompt pages
+# survive the crash in the prefix index's idle LRU).  Asserted: one
+# restart, a positive replay count, warm readmission
+# (prefix_hit_tokens > 0), every submitted request terminal exactly
+# once (trace_check --serve across the crash), and a tokens digest
+# IDENTICAL to the same trace served uninterrupted (greedy decode is
+# deterministic — recovery must not change a single token).
+REF_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
+    --requests 5 --new-tokens 6)"
+REF_DIGEST="$(echo "$REF_OUT" | grep -o 'digest=[0-9a-f]*')"
+SERVE_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
+    --requests 5 --new-tokens 6 --prefix-share --supervise \
+    --journal "$SERVE_DIR/crash.journal.jsonl" \
+    --jsonl "$SERVE_DIR/crash.jsonl" --fault crash@3)"
+echo "$SERVE_OUT"
+echo "$SERVE_OUT" | grep -q "restarts=1" \
+    || { echo "[ci] FAIL: supervised serve did not restart once"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "replayed=[1-9]" \
+    || { echo "[ci] FAIL: journal replay re-entered no requests"; exit 1; }
+echo "$SERVE_OUT" | grep -Eq "prefix_hit_tokens=[1-9]" \
+    || { echo "[ci] FAIL: replay readmission did not hit warm"; exit 1; }
+[ "$(grep -c '"name":"request_submitted"' "$SERVE_DIR/crash.jsonl")" = 5 ] \
+    || { echo "[ci] FAIL: crash leg expected 5 submits (no double-submit on replay)"; exit 1; }
+[ "$(grep -c '"name":"request_done"' "$SERVE_DIR/crash.jsonl")" = 5 ] \
+    || { echo "[ci] FAIL: crash leg expected exactly 5 terminal events"; exit 1; }
+CRASH_DIGEST="$(echo "$SERVE_OUT" | grep -o 'digest=[0-9a-f]*')"
+[ -n "$REF_DIGEST" ] && [ "$CRASH_DIGEST" = "$REF_DIGEST" ] \
+    || { echo "[ci] FAIL: recovered digest $CRASH_DIGEST != uninterrupted $REF_DIGEST"; exit 1; }
+python tools/trace_check.py "$SERVE_DIR/crash.jsonl" --serve
+# leg 5 (ISSUE-13): watchdog stall -> snapshot-then-drain — the
+# injected 1.5 s stall at tick 2 outlasts the 0.5 s watchdog timeout;
+# the serve escalation policy must dump exactly ONE engine_snapshot
+# (reason escalation:stall) and drain cleanly instead of ignoring the
+# wedged decode: every request terminal preempted, chains complete.
+SERVE_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
+    --requests 4 --new-tokens 24 --jsonl "$SERVE_DIR/stall.jsonl" \
+    --fault stall@2:1.5 --stall-timeout 0.5)"
+echo "$SERVE_OUT"
+echo "$SERVE_OUT" | grep -q "drained=1" \
+    || { echo "[ci] FAIL: stalled serve did not drain"; exit 1; }
+[ "$(grep -c '"name":"engine_snapshot"' "$SERVE_DIR/stall.jsonl")" = 1 ] \
+    || { echo "[ci] FAIL: expected exactly one escalation snapshot"; exit 1; }
+grep -q '"reason":"escalation:stall"' "$SERVE_DIR/stall.jsonl" \
+    || { echo "[ci] FAIL: snapshot not attributed to the stall escalation"; exit 1; }
+grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
+    || { echo "[ci] FAIL: no escalation_drain event"; exit 1; }
+python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
 echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
