@@ -403,6 +403,41 @@ register_flag(
     "exactly one snapshot per trigger).  The wedged-serve "
     "post-mortem hook (docs/api/serving.md).")
 register_flag(
+    "APEX_TPU_SERVE_REPLICAS", "int", 1,
+    "Fleet size for the multi-replica serving driver (standalone_gpt "
+    "--serve-fleet / docs/api/serving.md#fleet-serving): N "
+    "ServingEngine replicas behind the gauge-fed FleetRouter, each "
+    "with its own KV pool (and, with APEX_TPU_SERVE_TP, its own "
+    "device slice).  The --replicas CLI flag overrides.", lo=1,
+    hi=64)
+register_flag(
+    "APEX_TPU_SERVE_TP", "int", 0,
+    "Tensor-parallel decode width per serving replica "
+    "(serving/tp.py): T>=2 shards weights and the paged KV cache "
+    "along a MeshPlan `tensor` axis (head-sharded attention, "
+    "column/row-split MLP, 2 psums per layer — the audited "
+    "gpt_decode_step_tp topology), greedy output token-identical to "
+    "the single-chip engine.  0/1 keeps single-chip replicas.  The "
+    "--tp CLI flag overrides.", lo=0, hi=64)
+register_flag(
+    "APEX_TPU_SERVE_DISAGGREGATE", "bool", False,
+    "Disaggregated prefill/decode for the serving fleet: prefill-role "
+    "replicas run prompt admission only and stream finished KV blocks "
+    "(block table as the wire format, int8/bf16 storage preserved) "
+    "into decode replicas' paged pools, registered into the shared "
+    "prefix index so the decode-side admission is warm "
+    "(prefix_hit_tokens > 0).  Requires APEX_TPU_SERVE_PREFIX_SHARE "
+    "semantics on every replica (the fleet driver arms it).  The "
+    "--disaggregate CLI flag overrides.")
+register_flag(
+    "APEX_TPU_SERVE_ROUTER", "str", "gauges",
+    "FleetRouter submission policy: 'gauges' scores replicas by the "
+    "router_snapshot feed — sticky warm-prefix affinity first (chain-"
+    "key intersection with each replica's shared index), then pool "
+    "headroom net of in-flight reservations, then smallest backlog, "
+    "avoiding shed-engaged replicas; 'round_robin' ignores all "
+    "signals (the A/B control the bench row compares against).")
+register_flag(
     "APEX_TPU_SHARDING_MIN_BYTES", "int", 1024,
     "Size floor for the SPMD auditor's APX701 replication rule "
     "(docs/api/analysis.md): a plan-sharded tensor smaller than this "

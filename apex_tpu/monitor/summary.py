@@ -232,6 +232,35 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
                              if _terminal(e) == "finished")
         digest["preempted"] = sum(1 for e in done_events
                                   if _terminal(e) == "preempted")
+        # fleet runs (ISSUE-14): replica-stamped events aggregate to
+        # one per-replica reconciliation table — N submitted must
+        # equal N terminal per replica AND fleet-wide
+        replicas: Dict[str, Dict[str, int]] = {}
+        for e in srv:
+            rep = e.attrs.get("replica")
+            if rep is None or e.name not in ("request_submitted",
+                                             "request_done"):
+                continue
+            row = replicas.setdefault(str(rep),
+                                      {"submitted": 0, "terminal": 0})
+            row["submitted" if e.name == "request_submitted"
+                else "terminal"] += 1
+        if replicas:
+            digest["replicas"] = {k: replicas[k]
+                                  for k in sorted(replicas)}
+        fleet = [e for e in events if e.kind == "fleet"]
+        if fleet:
+            digest["fleet"] = {
+                "routed": sum(1 for e in fleet
+                              if e.name == "request_routed"),
+                "kv_handoffs": sum(1 for e in fleet
+                                   if e.name == "kv_handoff"),
+                "swaps": sum(1 for e in fleet
+                             if e.name == "swap_done"),
+                "replica_restarts": sum(1 for e in fleet
+                                        if e.name ==
+                                        "replica_restart"),
+            }
         # ISSUE-13 terminal paths: deadline expiry (queued OR
         # running) and load shedding — rendered so N submitted still
         # visibly reconciles against N terminal
@@ -473,6 +502,23 @@ def render(summary: dict) -> str:
                      + " ".join(f"{k}={v}"
                                 for k, v in sorted(rej.items())))
         lines.append(head)
+        reps = srv.get("replicas")
+        if reps:
+            lines.append(
+                "  fleet replicas: "
+                + "  ".join(
+                    f"{rid}: {row['submitted']} submitted / "
+                    f"{row['terminal']} terminal"
+                    + ("" if row["submitted"] == row["terminal"]
+                       else "  [MISMATCH]")
+                    for rid, row in reps.items()))
+        fleet = srv.get("fleet")
+        if fleet:
+            lines.append(
+                f"  fleet: {fleet['routed']} routed, "
+                f"{fleet['kv_handoffs']} KV handoff(s), "
+                f"{fleet['swaps']} rolling swap(s), "
+                f"{fleet['replica_restarts']} replica restart(s)")
         for r in srv.get("journal_replays", []):
             lines.append(f"  JOURNAL REPLAY @ tick {r.get('tick')}: "
                          f"{r.get('replayed')} request(s) re-entered, "
@@ -564,17 +610,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         chrome = argv[i + 1]
         argv = argv[:i] + argv[i + 2:]
-    if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print("usage: monitor_summary.py RUN.jsonl [--chrome OUT.json]",
-              file=sys.stderr)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: monitor_summary.py RUN.jsonl [MORE.jsonl ...] "
+              "[--chrome OUT.json]   (several per-replica fleet logs "
+              "merge into one summary)", file=sys.stderr)
         return 2
+    events, malformed = [], 0
     try:
-        events, malformed = load_events(argv[0])
+        for path in argv:
+            evs, bad = load_events(path)
+            events.extend(evs)
+            malformed += bad
     except OSError as e:
         print(f"monitor_summary: {e}", file=sys.stderr)
         return 1
     if not events:
-        print(f"monitor_summary: no events in {argv[0]}",
+        print(f"monitor_summary: no events in {' '.join(argv)}",
               file=sys.stderr)
         return 1
     print(render(summarize(events, malformed)))

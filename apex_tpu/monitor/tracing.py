@@ -454,11 +454,18 @@ def serve_chrome_trace(rows: List[dict]) -> dict:
     return _chrome_json(serve_lane_events(rows, pid=pid), pid=pid)
 
 
-def check_serve_trace(jsonl_path: str,
+def check_serve_trace(jsonl_path,
                       chrome_path: Optional[str] = None, *,
                       tolerance: float = 0.02) -> List[str]:
     """Validate a serve run's telemetry (``tools/trace_check.py
-    --serve``, ci.sh step 11).  Returns failure strings (empty =
+    --serve``, ci.sh step 11).  ``jsonl_path`` may be ONE path or a
+    sequence of per-replica paths (``trace_check --serve
+    serve-r0.jsonl serve-r1.jsonl ...`` — the ISSUE-14 fleet form):
+    events merge before checking, so *N submitted ⇒ N terminal* holds
+    across the whole fleet — a request routed to replica A and
+    journal-replayed there still closes exactly once fleet-wide, and
+    a rid appearing on two replicas' logs (a double submit the router
+    must never produce) fails.  Returns failure strings (empty =
     pass):
 
     * lifecycle completeness — every submitted rid ends in exactly one
@@ -482,11 +489,30 @@ def check_serve_trace(jsonl_path: str,
     from .summary import load_events
 
     failures: List[str] = []
-    events, malformed = load_events(jsonl_path)
-    if malformed:
-        failures.append(f"{malformed} malformed line(s) in "
-                        f"{jsonl_path}")
+    paths = ([jsonl_path] if isinstance(jsonl_path, (str, os.PathLike))
+             else list(jsonl_path))
+    events = []
+    for p in paths:
+        evs, malformed = load_events(p)
+        if malformed:
+            failures.append(f"{malformed} malformed line(s) in {p}")
+        events.extend(evs)
     srv = [e for e in events if e.kind == "serving"]
+    # fleet-mode sanity: one rid must live on exactly one replica —
+    # its submit and terminal must carry the same replica stamp
+    if len(paths) > 1:
+        homes: Dict[str, set] = {}
+        for e in srv:
+            if e.name in ("request_submitted", "request_done") \
+                    and e.attrs.get("replica") is not None:
+                homes.setdefault(str(e.attrs.get("rid")),
+                                 set()).add(str(e.attrs["replica"]))
+        for rid, reps in sorted(homes.items()):
+            if len(reps) > 1:
+                failures.append(
+                    f"rid {rid}: lifecycle events on "
+                    f"{len(reps)} replicas ({sorted(reps)}) — a "
+                    f"request must live on exactly one")
     submitted = [str(e.attrs.get("rid")) for e in srv
                  if e.name == "request_submitted"]
     terminal: Dict[str, int] = {}
@@ -1265,7 +1291,10 @@ def main(argv=None) -> int:
         prog="python -m apex_tpu.monitor.tracing",
         description="Validate a traced run's event log and Chrome "
                     "artifact (ci.sh trace smoke).")
-    ap.add_argument("jsonl", help="monitor JSONL from a --trace run")
+    ap.add_argument("jsonl", nargs="+",
+                    help="monitor JSONL from a --trace run; with "
+                         "--serve, several per-replica fleet logs "
+                         "merge into one aggregate check")
     ap.add_argument("--chrome", default=None,
                     help="Chrome trace artifact to validate")
     ap.add_argument("--check", action="store_true",
@@ -1292,7 +1321,10 @@ def main(argv=None) -> int:
         failures = check_serve_trace(args.jsonl, args.chrome,
                                      tolerance=args.tolerance)
     else:
-        failures = check_trace(args.jsonl, args.chrome,
+        if len(args.jsonl) > 1:
+            ap.error("multiple JSONL paths are the --serve fleet "
+                     "form; the waterfall check takes one run log")
+        failures = check_trace(args.jsonl[0], args.chrome,
                                tolerance=args.tolerance,
                                scan_k=args.scan_k, steps=args.steps)
     for f in failures:
@@ -1300,12 +1332,14 @@ def main(argv=None) -> int:
     if failures:
         return 1
     if args.serve:
-        print(f"[trace-check] OK: {args.jsonl} carries complete "
-              "request lifecycle chains"
+        label = args.jsonl[0] if len(args.jsonl) == 1 \
+            else f"{len(args.jsonl)} replica logs"
+        print(f"[trace-check] OK: {label} "
+              "carries complete request lifecycle chains"
               + (f"; {args.chrome} carries the per-request lanes"
                  if args.chrome else ""))
         return 0
-    print(f"[trace-check] OK: {args.jsonl} carries the canonical "
+    print(f"[trace-check] OK: {args.jsonl[0]} carries the canonical "
           "waterfall"
           + (f" ({-(-args.steps // args.scan_k)} K={args.scan_k} "
              "window(s))" if args.scan_k and args.steps else "")

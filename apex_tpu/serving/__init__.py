@@ -32,30 +32,39 @@ docs/api/serving.md walks the architecture.
 """
 from .engine import (BucketLadder, Request, ServeSummary,
                      ServingEngine, default_cache_config)
+from .fleet import FleetRouter, FleetSummary, Replica, transfer_prefix
 from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
                        KVCacheManager, PagedKVCache, PrefixMatch,
-                       init_cache, quantize_kv_rows, write_prefill_kv,
+                       init_cache, prefix_chain_keys,
+                       quantize_kv_rows, write_prefill_kv,
                        write_token_kv)
-from .metrics import (EngineGauges, RequestTrace, ServeMetrics,
-                      SnapshotTrigger)
+from .metrics import (EngineGauges, ReplicaMonitor, RequestTrace,
+                      ServeMetrics, SnapshotTrigger)
 from .model import (GPTServingWeights, LayerWeights,
                     ServingModelConfig, copy_cache_block,
-                    extract_serving_weights, gpt_decode_step,
-                    gpt_extend_step, gpt_prefill_step)
+                    extract_serving_weights, gather_cache_blocks,
+                    gpt_decode_step, gpt_extend_step,
+                    gpt_prefill_step, scatter_cache_blocks)
 from .resilience import (RequestJournal, ServeRunResult, ShedPolicy,
                          SpeculationGovernor, recover_engine,
                          run_serving)
+from .tp import SERVING_TP_AXIS, TPContext, serving_tp_plan
 
 __all__ = [
     "BucketLadder", "Request", "ServeSummary", "ServingEngine",
     "default_cache_config",
+    "FleetRouter", "FleetSummary", "Replica", "transfer_prefix",
     "DUMP_BLOCK", "CachePoolExhausted", "KVCacheConfig",
     "KVCacheManager", "PagedKVCache", "PrefixMatch", "init_cache",
-    "quantize_kv_rows", "write_prefill_kv", "write_token_kv",
+    "prefix_chain_keys", "quantize_kv_rows", "write_prefill_kv",
+    "write_token_kv",
     "GPTServingWeights", "LayerWeights", "ServingModelConfig",
-    "copy_cache_block", "extract_serving_weights", "gpt_decode_step",
-    "gpt_extend_step", "gpt_prefill_step",
-    "EngineGauges", "RequestTrace", "ServeMetrics", "SnapshotTrigger",
+    "copy_cache_block", "extract_serving_weights",
+    "gather_cache_blocks", "gpt_decode_step", "gpt_extend_step",
+    "gpt_prefill_step", "scatter_cache_blocks",
+    "EngineGauges", "ReplicaMonitor", "RequestTrace", "ServeMetrics",
+    "SnapshotTrigger",
     "RequestJournal", "ServeRunResult", "ShedPolicy",
     "SpeculationGovernor", "recover_engine", "run_serving",
+    "SERVING_TP_AXIS", "TPContext", "serving_tp_plan",
 ]

@@ -299,7 +299,47 @@ class ServingEngine:
                  journal: Optional[RequestJournal] = None,
                  escalation=None, fault=None,
                  spec_governor="auto",
+                 tp=None, replica_id: Optional[str] = None,
+                 device=None,
                  clock: Callable[[], float] = time.perf_counter):
+        # --- ISSUE-14 fleet hooks -----------------------------------
+        # ``tp`` is a serving.tp.TPContext: the engine swaps its jit
+        # builders for the shard_map-wrapped TP ones, commits weights
+        # and cache to the plan's shardings, and serves with the
+        # tp-axis-carrying model config — the continuous-batching loop
+        # is otherwise unchanged.  ``replica_id`` stamps every emitted
+        # event with a stable fleet identity (ReplicaMonitor).
+        # ``device`` pins a single-chip replica's weights and cache to
+        # one device, so N fleet replicas execute on N device streams
+        # CONCURRENTLY — without it every replica's arrays land on
+        # device 0 and the fleet serializes behind one stream (mutually
+        # exclusive with ``tp``, whose mesh already places the shards).
+        self.tp = tp
+        self.device = device
+        if tp is not None and device is not None:
+            raise ValueError("pass either tp (a TPContext owns its "
+                             "device slice) or device, not both")
+        self.replica_id = (str(replica_id) if replica_id is not None
+                           else None)
+        if self.replica_id is not None and monitor is not None:
+            from .metrics import ReplicaMonitor
+
+            if not isinstance(monitor, ReplicaMonitor):
+                monitor = ReplicaMonitor(monitor, self.replica_id)
+        if tp is not None:
+            if speculate_k or draft_weights is not None:
+                raise ValueError(
+                    "tensor-parallel serving does not compose with "
+                    "speculative decoding yet — run the draft on its "
+                    "own replica or drop one of the two")
+            if tp.cache_cfg != cache_cfg:
+                raise ValueError(
+                    "TPContext was built for a different cache "
+                    "config than the engine's")
+            model_cfg = tp.model_cfg       # tp_axis armed
+            weights = tp.shard_weights(weights)
+        elif device is not None:
+            weights = jax.device_put(weights, device)
         self.weights = weights
         self.model_cfg = model_cfg
         self.cache_cfg = cache_cfg
@@ -372,6 +412,11 @@ class ServingEngine:
                 kv_dtype=cache_cfg.kv_dtype,
                 model_dtype=draft_cfg.dtype)
             self.draft_cache = init_cache(self.draft_cache_cfg)
+            if device is not None:
+                self.draft_weights = jax.device_put(draft_weights,
+                                                    device)
+                self.draft_cache = jax.device_put(self.draft_cache,
+                                                  device)
         # degraded mode for the fast path: sustained verify mismatch
         # auto-disables speculation (alarm + gauge, never a crash)
         if spec_governor == "auto":
@@ -389,7 +434,7 @@ class ServingEngine:
         self.snapshot = snapshot
         self.manager = KVCacheManager(cache_cfg,
                                       prefix_sharing=self.prefix_share)
-        self.cache = init_cache(cache_cfg)
+        self.cache = self._fresh_cache()
         self.queue: deque = deque()
         self.active: Dict[Any, Request] = {}
         # admitted requests whose chunked prefill is still running:
@@ -430,7 +475,22 @@ class ServingEngine:
 
     # --- compiled-program cache ---------------------------------------
 
+    def _fresh_cache(self):
+        """A zeroed device cache — TP-sharded under a TPContext (the
+        head axis committed to the plan), pinned to the replica's
+        device when one was given, default placement otherwise.  Used
+        at construction and by :meth:`swap_weights` (new weights mean
+        every cached k/v row is stale)."""
+        if self.tp is not None:
+            return self.tp.init_cache()
+        cache = init_cache(self.cache_cfg)
+        if self.device is not None:
+            cache = jax.device_put(cache, self.device)
+        return cache
+
     def _jit_decode(self, draft: bool = False):
+        if self.tp is not None and not draft:
+            return self.tp.jit_decode(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -444,6 +504,8 @@ class ServingEngine:
         return step
 
     def _jit_prefill(self, draft: bool = False):
+        if self.tp is not None and not draft:
+            return self.tp.jit_prefill(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -455,6 +517,8 @@ class ServingEngine:
         return step
 
     def _jit_extend(self, draft: bool = False):
+        if self.tp is not None and not draft:
+            return self.tp.jit_extend(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -636,7 +700,13 @@ class ServingEngine:
             request.deadline_ms = float(self.default_deadline_ms)
         if request.deadline_ms:
             self._deadlines_active = True
-        request.submit_t = self._clock()
+        if request.submit_t is None:
+            # a PRE-anchored submit instant is respected: the fleet
+            # router stamps a disaggregated request when IT accepted
+            # the submission, so queue-wait/TTFT/deadline all count
+            # the prefill-replica probe and the KV handoff — the
+            # clock must not restart at the decode-side submit
+            request.submit_t = self._clock()
         self.queue.append(request)
         self.metrics.on_submit(request, self.steps)
         if self.journal is not None:
@@ -1406,6 +1476,78 @@ class ServingEngine:
                      f"{','.join(map(str, q.out_tokens))};".encode())
         return h.hexdigest()[:12]
 
+    def router_snapshot(self) -> Dict[str, Any]:
+        """The cheap per-replica struct a fleet router load-balances
+        on (ISSUE-14): pool headroom (free + reclaimable-idle blocks,
+        net reservations), backlog (queue depth + mid-prefill jobs +
+        running batch), shed state, the shared-prefix index's chain
+        keys for sticky warm routing, and the gauge layer's last-tick
+        view — all host bookkeeping the engine already holds, one
+        dict, no device traffic and no reaching into engine
+        internals."""
+        snap = {
+            "replica": self.replica_id,
+            "tick": self.steps,
+            "free_blocks": self.manager.free_blocks,
+            "available_blocks": self.manager.available_blocks,
+            "reserved_blocks": self._reserved_blocks(),
+            "pool_blocks": self.cache_cfg.usable_blocks,
+            "queue_depth": len(self.queue),
+            "active": len(self.active),
+            "prefilling": len(self.prefilling),
+            "shed_engaged": bool(self.shed.engaged
+                                 if self.shed is not None else False),
+            "warm_prefix_keys": self.manager.prefix_keys(),
+            "gauges": self.metrics.gauges.router_snapshot(),
+        }
+        return snap
+
+    def swap_weights(self, weights: GPTServingWeights, *,
+                     draft_weights=None) -> None:
+        """Replace the serving weights IN PLACE on an idle engine —
+        the per-replica half of the fleet's rolling swap.  The engine
+        must be fully drained (no active/queued/mid-prefill work):
+        the fleet router guarantees that by admit-stopping the replica
+        first.  Weights are ARGUMENTS of the compiled programs, not
+        closures, so every AOT-compiled ladder bucket survives the
+        swap untouched — zero recompiles, which the sanitized CI swap
+        leg asserts.  The KV pool and the shared-prefix index reset
+        (every cached k/v row was computed under the OLD weights;
+        serving it would silently mix models), so the first
+        post-swap admissions run cold by design."""
+        if self.active or self.prefilling or self.queue:
+            raise RuntimeError(
+                f"swap_weights on a busy engine ({len(self.active)} "
+                f"active, {len(self.prefilling)} prefilling, "
+                f"{len(self.queue)} queued) — drain first (the "
+                f"router's admit-stop → drain → swap sequence)")
+        jax.tree_util.tree_map(
+            lambda old, new: _check_swap_leaf(old, new), self.weights,
+            weights)
+        if self.tp is not None:
+            weights = self.tp.shard_weights(weights)
+        elif self.device is not None:
+            weights = jax.device_put(weights, self.device)
+        self.weights = weights
+        if draft_weights is not None:
+            if self.draft_weights is None:
+                raise ValueError("draft_weights swap on an engine "
+                                 "built without a draft")
+            if self.device is not None:
+                draft_weights = jax.device_put(draft_weights,
+                                               self.device)
+            self.draft_weights = draft_weights
+        self.manager = KVCacheManager(
+            self.cache_cfg, prefix_sharing=self.prefix_share)
+        self.cache = self._fresh_cache()
+        if self.draft_cache is not None:
+            self.draft_cache = init_cache(self.draft_cache_cfg)
+            if self.device is not None:
+                self.draft_cache = jax.device_put(self.draft_cache,
+                                                  self.device)
+        self._event("weights_swapped",
+                    compiles=sum(self._compiles.values()))
+
     def snapshot_state(self) -> Dict[str, Any]:
         """Live engine state as one JSON-able dict — what the
         on-demand :class:`~apex_tpu.serving.metrics.SnapshotTrigger`
@@ -1504,11 +1646,23 @@ class ServingEngine:
         # a trailing partial gauge window (tick_every > 1) flushes so
         # the final engine state is always in the log
         self.metrics.flush_gauges(self.steps)
+        summary = self.summary(drained=drained)
+        self._event("serve_done", value=summary.tokens_per_sec,
+                    **{k: v for k, v in summary.as_dict().items()
+                       if k not in ("compiles", "tokens_per_sec")})
+        return summary
+
+    def summary(self, *, drained: bool = False) -> ServeSummary:
+        """The engine's lifetime :class:`ServeSummary` from the
+        counters it already holds — what :meth:`run` returns (and
+        emits as ``serve_done``), exposed separately so a fleet can
+        collect per-replica summaries without forcing an idle
+        ``run()`` round per replica."""
         wall = max(self._run_wall_s, 1e-9)
         gen = self._done_tokens \
             + sum(len(q.out_tokens) for q in self.active.values())
         pct = self.metrics.percentiles()
-        summary = ServeSummary(
+        return ServeSummary(
             requests_done=self._done_count,
             requests_preempted=self._preempted_count,
             tokens_generated=gen,
@@ -1549,10 +1703,18 @@ class ServingEngine:
             spec_disabled=self.spec_disabled,
             replayed_requests=self._replayed,
             restarts=self.restarts)
-        self._event("serve_done", value=summary.tokens_per_sec,
-                    **{k: v for k, v in summary.as_dict().items()
-                       if k not in ("compiles", "tokens_per_sec")})
-        return summary
+
+
+def _check_swap_leaf(old, new) -> None:
+    """One weight leaf of a rolling swap: shape and dtype must match
+    the serving arrays exactly, or the cached executables would
+    retrace (shape change) or silently cast (dtype change)."""
+    if old.shape != new.shape or old.dtype != new.dtype:
+        raise ValueError(
+            f"swap_weights leaf mismatch: serving "
+            f"{old.shape}/{old.dtype} vs replacement "
+            f"{new.shape}/{new.dtype} — a swap must keep the "
+            f"compiled ladder valid (same geometry, same dtype)")
 
 
 def _round_ms(v: Optional[float]) -> Optional[float]:

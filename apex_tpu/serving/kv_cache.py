@@ -51,7 +51,7 @@ from ..ops.flash_decode import use_decode_head_packing
 __all__ = ["KVCacheConfig", "PagedKVCache", "KVCacheManager",
            "PrefixMatch", "CachePoolExhausted", "init_cache",
            "write_token_kv", "write_prefill_kv", "quantize_kv_rows",
-           "DUMP_BLOCK"]
+           "prefix_chain_keys", "DUMP_BLOCK"]
 
 # block 0: never allocated, pads every block table, absorbs inactive
 # rows' writes.  Reads of it are always masked to an exact 0 weight.
@@ -250,6 +250,34 @@ def write_prefill_kv(cache: PagedKVCache, config: KVCacheConfig,
     return PagedKVCache(k, v, k_scale, v_scale)
 
 
+def prefix_chain_keys(prompt: Sequence[int], block_size: int):
+    """(full-block chain keys, partial-tail key or None) for a prompt.
+    Key ``i`` commits to tokens ``[0, (i+1)*bs)`` — a chain, so
+    matching key ``i`` implies matching every earlier block too.  The
+    ONE hashing convention for the whole serving stack: the manager's
+    shared-prefix index, the fleet router's sticky-warm probe
+    (:meth:`KVCacheManager.prefix_keys` against a prompt's keys), and
+    the disaggregated KV handoff's registration all speak it — so a
+    key computed on one replica addresses the same content on any
+    other."""
+    bs = int(block_size)
+    h = hashlib.blake2b(b"apex-prefix", digest_size=16)
+    keys: List[bytes] = []
+    full = len(prompt) // bs
+    for i in range(full):
+        h.update(np.asarray(prompt[i * bs:(i + 1) * bs],
+                            np.int64).tobytes())
+        keys.append(h.digest())
+    pkey = None
+    tail = prompt[full * bs:]
+    if len(tail):
+        hp = h.copy()
+        hp.update(b"partial")
+        hp.update(np.asarray(tail, np.int64).tobytes())
+        pkey = hp.digest()
+    return keys, pkey
+
+
 class PrefixMatch(NamedTuple):
     """What :meth:`KVCacheManager.match_prefix` found for a prompt.
 
@@ -375,25 +403,9 @@ class KVCacheManager:
     # --- prefix index -------------------------------------------------
 
     def _chain_keys(self, prompt: Sequence[int]):
-        """(full-block chain keys, partial-tail key or None).  Key i
-        commits to tokens [0, (i+1)*bs) — a chain, so matching key i
-        implies matching every earlier block too."""
-        bs = self.config.block_size
-        h = hashlib.blake2b(b"apex-prefix", digest_size=16)
-        keys: List[bytes] = []
-        full = len(prompt) // bs
-        for i in range(full):
-            h.update(np.asarray(prompt[i * bs:(i + 1) * bs],
-                                np.int64).tobytes())
-            keys.append(h.digest())
-        pkey = None
-        tail = prompt[full * bs:]
-        if len(tail):
-            hp = h.copy()
-            hp.update(b"partial")
-            hp.update(np.asarray(tail, np.int64).tobytes())
-            pkey = hp.digest()
-        return keys, pkey
+        """(full-block chain keys, partial-tail key or None) — see
+        :func:`prefix_chain_keys`."""
+        return prefix_chain_keys(prompt, self.config.block_size)
 
     def match_prefix(self, prompt: Sequence[int]) -> PrefixMatch:
         """Longest warm prefix of ``prompt`` in the shared index.
@@ -460,6 +472,107 @@ class KVCacheManager:
         self.shared_blocks_hw = max(self.shared_blocks_hw,
                                     len(self._block_key))
         return new
+
+    def prefix_keys(self):
+        """The shared index's chain keys (bytes digests) as a LIVE
+        read-only set view — the cheap warm-prefix probe surface
+        :meth:`~apex_tpu.serving.engine.ServingEngine.router_snapshot`
+        exports.  A router hashes a candidate prompt ONCE
+        (:func:`prefix_chain_keys`) and membership-probes each
+        replica's view (O(1) per key, no index copy per poll — the
+        index can hold thousands of chains on a warm replica); it
+        must not mutate or retain the view across engine mutations."""
+        return self._index.keys()
+
+    def resident_prefix(self, prompt: Sequence[int]
+                        ) -> Optional[List[int]]:
+        """The block list holding ``prompt``'s ENTIRE k/v in this
+        pool's shared index (every full block plus the partial tail),
+        in page order — the export unit of the disaggregated KV
+        handoff — or None when any page is missing.  Unlike
+        :meth:`match_prefix` this includes the final token's page
+        unconditionally: an exporter ships content, it does not admit
+        a request."""
+        if not self.prefix_sharing or not len(prompt):
+            return None
+        keys, pkey = self._chain_keys(prompt)
+        blocks: List[int] = []
+        for key in keys + ([pkey] if pkey is not None else []):
+            blk = self._index.get(key)
+            if blk is None:
+                return None
+            blocks.append(blk)
+        return blocks
+
+    def register_external(self, prompt: Sequence[int],
+                          payload_pages: int) -> Optional[List[int]]:
+        """Claim pool blocks for an IMPORTED prompt's k/v (the decode
+        side of the disaggregated handoff) and index them as shared
+        with zero live mappings — parked in the idle LRU, exactly the
+        state a finished local request's prompt pages land in — so the
+        next admission of this prompt maps them warm.  Returns the
+        claimed block ids (in page order, the scatter destination), or
+        None when the prompt (or a block-content collision) is already
+        resident — the importer then skips the device scatter
+        entirely.  Raises :class:`CachePoolExhausted` when the pool
+        cannot cover ``payload_pages`` blocks."""
+        if not self.prefix_sharing:
+            raise ValueError(
+                "register_external needs prefix_sharing=True — "
+                "imported pages are addressed through the shared "
+                "index (the warm-admission machinery)")
+        keys, pkey = self._chain_keys(prompt)
+        entries = keys + ([pkey] if pkey is not None else [])
+        if len(entries) != int(payload_pages):
+            raise ValueError(
+                f"payload covers {payload_pages} page(s) but the "
+                f"prompt chains into {len(entries)} — block_size "
+                f"mismatch between the replicas?")
+        if all(k in self._index for k in entries):
+            return None                       # already resident
+        if payload_pages > self.available_blocks:
+            raise CachePoolExhausted(
+                f"import needs {payload_pages} block(s), pool has "
+                f"{self.available_blocks} available")
+        blocks: List[int] = []
+        fresh: List[int] = []
+        # resident owners this import reuses leave the idle LRU for
+        # the duration of the claim loop: _take_block reclaims LRU
+        # idle blocks when the free list is dry, and stealing a page
+        # that is already on this import's block list would both
+        # unregister its chain entry and alias two payload pages into
+        # one block (one silently lost)
+        shelved: List[int] = []
+        for key in entries:
+            owner = self._index.get(key)
+            if owner is not None and owner in self._idle:
+                del self._idle[owner]
+                shelved.append(owner)
+        try:
+            for key in entries:
+                owner = self._index.get(key)
+                if owner is not None:
+                    # chain prefix already cached here: reuse the
+                    # resident page (the scatter rewrites it with
+                    # identical bytes — content-addressed no-op)
+                    blocks.append(owner)
+                    continue
+                blk = self._take_block("import: pool drained "
+                                       "mid-claim")
+                self._index[key] = blk
+                self._block_key[blk] = key
+                self._refs[blk] = 0
+                blocks.append(blk)
+                fresh.append(blk)
+        finally:
+            for blk in shelved:
+                self._idle[blk] = None        # back in the LRU
+        for blk in fresh:
+            # parked idle only AFTER every claim, same hazard as above
+            self._idle[blk] = None            # cached, reclaimable
+        self.shared_blocks_hw = max(self.shared_blocks_hw,
+                                    len(self._block_key))
+        return blocks
 
     def _map_shared(self, rid, blk: int) -> None:
         self._refs[blk] = self._refs.get(blk, 0) + 1

@@ -56,7 +56,7 @@ from ..utils.log_util import get_logger
 logger = get_logger(__name__)
 
 __all__ = ["RequestTrace", "ServeMetrics", "EngineGauges",
-           "SnapshotTrigger"]
+           "ReplicaMonitor", "SnapshotTrigger"]
 
 # distribution samples kept per series (queue-wait / ttft / itl /
 # per-request decode tok/s) — same bound as the engine's per-token
@@ -246,6 +246,17 @@ class EngineGauges:
             return self._roll()
         return None
 
+    def router_snapshot(self) -> Dict[str, Any]:
+        """The last observed tick's level gauges plus the high-water
+        counters, WITHOUT advancing the cadence window — the cheap
+        read a fleet router polls between its own dispatch rounds
+        (:meth:`~apex_tpu.serving.engine.ServingEngine.
+        router_snapshot` composes this with the pool's live state)."""
+        snap = dict(self._last or {})
+        snap["used_blocks_high_water"] = self.used_blocks_hw
+        snap["shared_blocks_high_water"] = self.shared_blocks_hw
+        return snap
+
     def flush(self) -> Optional[Dict[str, Any]]:
         """Close a trailing partial window (None when nothing is
         pending).  A window may hold counters but zero ticks: the
@@ -289,6 +300,32 @@ class EngineGauges:
         self._spec_proposed = self._spec_accepted = 0
         self.emitted += 1
         return attrs
+
+
+class ReplicaMonitor:
+    """Monitor facade stamping ``replica=<id>`` on every event.
+
+    A fleet's replicas may share one JSONL sink or write one file
+    each; either way every event a replica's engine, metrics layer, or
+    supervisor emits must carry a stable replica id so the aggregation
+    side (``monitor_summary`` fleet digest, ``trace_check --serve``
+    over per-replica logs) can attribute chains without parsing rids.
+    Wraps anything with the ``StepMonitor.event`` signature; every
+    other attribute (``watchdog``, ``close``, sinks) passes through,
+    so the engine's heartbeat and teardown paths see the real
+    monitor.  An explicit ``replica=`` in an event's attrs wins — the
+    stamp is a default, not an override."""
+
+    def __init__(self, monitor, replica_id: str):
+        self._monitor = monitor
+        self.replica_id = str(replica_id)
+
+    def event(self, kind: str, name: str, value=None, **attrs) -> None:
+        attrs.setdefault("replica", self.replica_id)
+        self._monitor.event(kind, name, value=value, **attrs)
+
+    def __getattr__(self, name):
+        return getattr(self._monitor, name)
 
 
 class ServeMetrics:
@@ -345,7 +382,14 @@ class ServeMetrics:
                    rid=str(rid), reason=reason)
 
     def on_submit(self, request, tick: int) -> None:
-        t = self._clock()
+        # the engine stamps request.submit_t just before this hook
+        # (respecting a pre-anchored instant — the fleet router's
+        # disaggregated submissions); the lifecycle chain must share
+        # that anchor or queue-wait/TTFT would silently exclude the
+        # pre-engine wait
+        t = getattr(request, "submit_t", None)
+        if t is None:
+            t = self._clock()
         self._open[str(request.rid)] = RequestTrace(
             rid=str(request.rid), prompt_len=len(request.prompt),
             submit_t=t, submit_tick=tick)

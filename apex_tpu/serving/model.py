@@ -39,7 +39,8 @@ from .kv_cache import (KVCacheConfig, PagedKVCache, write_prefill_kv,
 
 __all__ = ["GPTServingWeights", "LayerWeights", "ServingModelConfig",
            "extract_serving_weights", "gpt_prefill_step",
-           "gpt_decode_step", "gpt_extend_step", "copy_cache_block"]
+           "gpt_decode_step", "gpt_extend_step", "copy_cache_block",
+           "gather_cache_blocks", "scatter_cache_blocks"]
 
 
 class LayerWeights(NamedTuple):
@@ -88,6 +89,14 @@ class ServingModelConfig:
     # 'reference' = the dense gather twin — the naive full-attention
     # baseline bench.py's serving section measures the kernel against
     decode_attention: str = "kernel"
+    # tensor-parallel axis name (serving/tp.py): when set, the step
+    # functions run PER-SHARD math — heads/ffn columns local, hidden
+    # residual global — and the two row-parallel linears (attention
+    # dense, MLP fc2) all-reduce their partial sums over this axis
+    # before the bias add (the Megatron forward, 2 psums per layer).
+    # None (single chip) elides the collectives entirely, so the same
+    # programs serve both topologies.
+    tp_axis: Optional[str] = None
 
     def __post_init__(self):
         if self.hidden_size % self.num_heads:
@@ -159,19 +168,33 @@ def extract_serving_weights(params,
 
 
 def _linear(x, kernel, bias, dtype):
-    """The ColumnParallelLinear/RowParallelLinear single-device math:
-    compute-dtype matmul, bias in compute dtype."""
+    """The ColumnParallelLinear single-device math: compute-dtype
+    matmul, bias in compute dtype."""
     y = x.astype(dtype) @ kernel.astype(dtype)
     return y + bias.astype(dtype)
 
 
+def _row_linear(x, kernel, bias, dtype, tp_axis):
+    """RowParallelLinear: with ``tp_axis`` set the kernel rows are a
+    contraction shard, so the partial product all-reduces over the
+    axis BEFORE the (replicated) bias adds exactly once; single-chip
+    (``tp_axis=None``) is plain ``_linear``."""
+    y = x.astype(dtype) @ kernel.astype(dtype)
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    return y + bias.astype(dtype)
+
+
 def _layer_tail(x, lw: LayerWeights, attn_out, cfg):
-    """residual + LN + MLP + residual — shared by prefill and decode."""
+    """residual + LN + MLP + residual — shared by prefill and decode.
+    fc1 is column-split under TP (local gelu), fc2 row-split (the
+    layer's second all-reduce)."""
     x = x + attn_out.astype(x.dtype)
     m_in = layer_norm(x, lw.ln2_w, lw.ln2_b,
                       cfg.layernorm_eps).astype(cfg.dtype)
     h1 = jax.nn.gelu(_linear(m_in, lw.fc1_k, lw.fc1_b, cfg.dtype))
-    mlp_out = _linear(h1, lw.fc2_k, lw.fc2_b, cfg.dtype)
+    mlp_out = _row_linear(h1, lw.fc2_k, lw.fc2_b, cfg.dtype,
+                          cfg.tp_axis)
     return x + mlp_out.astype(x.dtype)
 
 
@@ -211,7 +234,10 @@ def gpt_prefill_step(weights: GPTServingWeights,
     from ..ops.flash_attention import flash_attention, mha_reference
 
     s_pad = tokens.shape[0]
-    h, d = cfg.num_heads, cfg.head_dim
+    # head count comes from the CACHE config: under tensor parallelism
+    # (serving/tp.py) each shard owns cfg.num_heads / tp heads and its
+    # cache is sized to match — the math below is per-shard math
+    h, d = cache_cfg.num_heads, cache_cfg.head_dim
     scale = d ** -0.5
     x = _embed(weights, tokens[None, :],
                jnp.arange(s_pad, dtype=jnp.int32)[None, :], cfg)
@@ -227,7 +253,8 @@ def gpt_prefill_step(weights: GPTServingWeights,
         attn = flash_attention if cfg.prefill_flash else mha_reference
         ctx = attn(qt, kt, vt, scale=scale, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(1, s_pad, h * d)
-        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
+                               cfg.tp_axis)
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)[0]          # (s_pad, V)
     last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=0,
@@ -262,7 +289,7 @@ def gpt_decode_step(weights: GPTServingWeights,
     interleave — the continuous-batching determinism the serving
     tests prove.
     """
-    h, d = cfg.num_heads, cfg.head_dim
+    h, d = cache_cfg.num_heads, cache_cfg.head_dim   # per-shard heads
     b = tokens.shape[0]
     scale = d ** -0.5
     x = _embed(weights, tokens, positions, cfg)   # (b, H)
@@ -283,7 +310,8 @@ def gpt_decode_step(weights: GPTServingWeights,
                 q, kc, vc, block_tables, seq_lens, scale=scale,
                 k_scale=ks, v_scale=vs)
         ctx = ctx.reshape(b, h * d)
-        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
+                               cfg.tp_axis)
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)             # (b, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -322,7 +350,7 @@ def gpt_extend_step(weights: GPTServingWeights,
     One compile per (batch bucket, t bucket, pages bucket) — the
     chunk/verify dimensions the engine's warmup adds to the ladder
     product."""
-    h, d = cfg.num_heads, cfg.head_dim
+    h, d = cache_cfg.num_heads, cache_cfg.head_dim   # per-shard heads
     b, t = tokens.shape
     scale = d ** -0.5
     pos = seq_lens.astype(jnp.int32)[:, None] - t \
@@ -351,7 +379,8 @@ def gpt_extend_step(weights: GPTServingWeights,
                 q, kc, vc, block_tables, seq_lens, scale=scale,
                 k_scale=ks, v_scale=vs)
         ctx = ctx.reshape(b, t, h * d)
-        attn_out = _linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype)
+        attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
+                               cfg.tp_axis)
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)             # (b, t, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -374,3 +403,46 @@ def copy_cache_block(cache: PagedKVCache, src: jnp.ndarray,
         k_scale = k_scale.at[:, dst].set(k_scale[:, src])
         v_scale = v_scale.at[:, dst].set(v_scale[:, src])
     return PagedKVCache(k, v, k_scale, v_scale)
+
+
+def gather_cache_blocks(cache: PagedKVCache, blocks: jnp.ndarray):
+    """Pull ``blocks`` (n,) int32 out of the paged cache as one
+    contiguous payload — the EXPORT half of the disaggregated
+    prefill→decode KV handoff (serving/fleet.py).  Returns
+    ``(k, v, k_scale, v_scale)`` with ``k``/``v`` shaped
+    ``(L, n, hk, bs, dk)`` (the storage layout, bytes untouched — an
+    int8 cache ships int8 rows + their fp32 scales, a bf16 cache
+    ships bf16) and scales ``(L, n, h, bs)`` or None.  Traced code:
+    the fleet jits it with the block list as data, padded to a page
+    rung, so every export of a rung-sized span reuses one compiled
+    program (dump-page padding gathers harmless zeros the importer
+    drops)."""
+    blocks = jnp.asarray(blocks, jnp.int32)
+    k = jnp.take(cache.k, blocks, axis=1)
+    v = jnp.take(cache.v, blocks, axis=1)
+    ks = vs = None
+    if cache.k_scale is not None:
+        ks = jnp.take(cache.k_scale, blocks, axis=1)
+        vs = jnp.take(cache.v_scale, blocks, axis=1)
+    return k, v, ks, vs
+
+
+def scatter_cache_blocks(cache: PagedKVCache, k: jnp.ndarray,
+                         v: jnp.ndarray, k_scale, v_scale,
+                         blocks: jnp.ndarray) -> PagedKVCache:
+    """Write an exported payload into ``blocks`` of this cache — the
+    IMPORT half of the KV handoff.  Shapes/dtypes must match this
+    cache's storage layout exactly (the fleet validates the two
+    replicas' :class:`~.kv_cache.KVCacheConfig` geometry before any
+    transfer); the cache is donated by the jitted caller so the
+    scatter is an in-place page-span DMA.  Padding entries pointing at
+    the dump block overwrite only the dump page (never read
+    unmasked)."""
+    blocks = jnp.asarray(blocks, jnp.int32)
+    ck = cache.k.at[:, blocks].set(k)
+    cv = cache.v.at[:, blocks].set(v)
+    cks, cvs = cache.k_scale, cache.v_scale
+    if cks is not None:
+        cks = cks.at[:, blocks].set(k_scale)
+        cvs = cvs.at[:, blocks].set(v_scale)
+    return PagedKVCache(ck, cv, cks, cvs)

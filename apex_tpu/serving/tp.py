@@ -1,0 +1,303 @@
+"""Tensor-parallel serving: the decode/prefill/extend programs sharded
+along a MeshPlan ``tensor`` axis (ISSUE-14 tentpole, piece 1).
+
+The single-chip serving programs (:mod:`.model`) are already written
+as per-shard math with the collective points marked: head count and
+head dim come from the CACHE config, and the two row-parallel linears
+(attention dense, MLP fc2) go through ``_row_linear`` whose psum is
+elided when ``ServingModelConfig.tp_axis`` is None.  This module
+supplies the other half — the topology as *data*:
+
+* :func:`serving_tp_plan` — the :class:`~apex_tpu.mesh_plan.MeshPlan`
+  contract: one ``tensor``-kind axis; qkv/fc1 column-split (heads and
+  ffn columns local), dense/fc2 row-split, embeddings / layernorms /
+  biases-after-psum replicated; the paged KV cache sharded on its
+  head axis; and the collective budget — **2 psums per layer** (the
+  Megatron forward: one after the attention dense, one after fc2),
+  a CEILING the SPMD auditor holds the compiled artifact to.
+* :class:`TPContext` — binds a plan to a mesh and builds the
+  shard_map-wrapped, donation-preserving jitted step builders the
+  :class:`~.engine.ServingEngine` swaps in for its single-chip ones:
+  same argument signatures, same bucket ladder, same AOT warmup —
+  tensor parallelism is invisible to the continuous-batching loop.
+
+Everything per-request stays host-side and replicated (block tables,
+write slots, sampled tokens); only weights and cache shard.  Greedy
+argmax runs on the post-psum (replicated) logits, so every shard
+samples the same token and the engine's one fetch per tick is
+unchanged.  The audited entry (``gpt_decode_step_tp`` in
+:mod:`apex_tpu.testing.entry_points`) carries this plan, so
+APX701/703/705 guard the serving topology exactly as they guard
+training, and tests pin the TP engine's greedy output token-identical
+to the single-chip engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Sequence
+
+from ..mesh_plan import MeshPlan
+from .kv_cache import KVCacheConfig, init_cache
+from .model import (GPTServingWeights, ServingModelConfig,
+                    gpt_decode_step, gpt_extend_step, gpt_prefill_step)
+
+__all__ = ["SERVING_TP_AXIS", "TPContext", "serving_tp_plan",
+           "serving_weight_specs"]
+
+# the canonical serving tensor-axis name (MeshPlan kind "tensor")
+SERVING_TP_AXIS = "tensor"
+
+
+def serving_weight_specs(axis: str = SERVING_TP_AXIS):
+    """Path-pattern → :data:`~apex_tpu.mesh_plan.Spec` for
+    :class:`~.model.GPTServingWeights` leaves, as the SPMD auditor
+    names them under an ``in0`` prefix (``in0.layers[0].qkv_k``).
+
+    Column-parallel kernels shard their OUTPUT columns (qkv by head —
+    the ``(h, 3d)`` column layout groups a head's 3d columns
+    contiguously, so an even head split is an even column split; fc1
+    by ffn column) along with their biases; row-parallel kernels
+    (dense, fc2) shard their INPUT rows and keep the bias replicated
+    (added once, after the psum).  Embeddings and every layer norm
+    stay replicated — the residual stream is global hidden."""
+    return {
+        r"\.qkv_k$": (None, axis),
+        r"\.qkv_b$": (axis,),
+        r"\.dense_k$": (axis, None),
+        r"\.fc1_k$": (None, axis),
+        r"\.fc1_b$": (axis,),
+        r"\.fc2_k$": (axis, None),
+    }
+
+
+def serving_tp_plan(tp: int, num_layers: int, *,
+                    axis: str = SERVING_TP_AXIS,
+                    quantized: bool = False) -> MeshPlan:
+    """The TP serving topology contract for the audited decode entry:
+    weight specs under ``in0``, the paged cache's head axis (storage
+    axis 2 of ``(L, nb, hk, bs, dk)``) under ``in1`` and on the
+    returned-cache outputs (``out0``/``out1``; int8 caches add the
+    scale leaves), and the 2-psums-per-layer ceiling.  The runtime
+    (:class:`TPContext`) derives its shard_map in/out specs and jit
+    in_shardings from THIS object, so plan drift is an APX703
+    finding, not a silent reshard."""
+    specs = {}
+    for pat, spec in serving_weight_specs(axis).items():
+        specs[r"^in0.*" + pat] = spec
+    cache_spec = (None, None, axis)
+    if quantized:
+        specs[r"^in1\.(k|v)_scale$"] = cache_spec
+        specs[r"^in1\.(k|v)$"] = cache_spec
+        # flat output order of (PagedKVCache, tokens): k, v, k_scale,
+        # v_scale, next_tokens
+        specs[r"^out[0-3]$"] = cache_spec
+        specs[r"^out4$"] = ()
+    else:
+        specs[r"^in1\.(k|v)$"] = cache_spec
+        specs[r"^out[01]$"] = cache_spec
+        specs[r"^out2$"] = ()
+    return MeshPlan.build(
+        axes=((axis, int(tp), "tensor"),),
+        tensor_specs=specs,
+        collective_budget={"psum": 2 * int(num_layers)})
+
+
+def _keystr(path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(path)
+
+
+class TPContext:
+    """One tensor-parallel serving topology, bound to real devices.
+
+    Validates the geometry (heads, ffn columns, packed head pairs, and
+    int8 scale rows must all divide by ``tp``), builds the mesh from
+    ``devices`` (default: the first ``tp`` of ``jax.devices()`` — a
+    fleet places each replica's context on its own device slice), and
+    exposes exactly what the engine needs:
+
+    * :meth:`shard_weights` / :meth:`init_cache` — commit the global
+      arrays to their plan shardings once, so every step call runs
+      reshard-free;
+    * :meth:`jit_decode` / :meth:`jit_prefill` / :meth:`jit_extend` —
+      drop-in replacements for the engine's single-chip jit builders:
+      same signatures, cache donated, shard_map inside with in/out
+      specs derived from the plan.
+
+    ``model_cfg`` is the context's tp-axis-carrying config — the
+    engine serves with it so the step functions' psums are armed."""
+
+    def __init__(self, model_cfg: ServingModelConfig,
+                 cache_cfg: KVCacheConfig, tp: int, *,
+                 axis: str = SERVING_TP_AXIS,
+                 devices: Optional[Sequence[Any]] = None):
+        if tp < 2:
+            raise ValueError(f"tp {tp} must be >= 2 (tp=1 is the "
+                             f"single-chip engine, no context needed)")
+        if model_cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads {model_cfg.num_heads} not divisible by "
+                f"tp {tp}")
+        if (4 * model_cfg.hidden_size) % tp:
+            raise ValueError(
+                f"ffn width {4 * model_cfg.hidden_size} not divisible "
+                f"by tp {tp}")
+        if cache_cfg.num_heads != model_cfg.num_heads \
+                or cache_cfg.head_dim != model_cfg.head_dim:
+            raise ValueError(
+                "cache_cfg head geometry "
+                f"({cache_cfg.num_heads}x{cache_cfg.head_dim}) does "
+                f"not match the model "
+                f"({model_cfg.num_heads}x{model_cfg.head_dim})")
+        local = dataclasses.replace(
+            cache_cfg, num_heads=cache_cfg.num_heads // tp)
+        if local.packed != cache_cfg.packed:
+            raise ValueError(
+                f"tp {tp} breaks the d=64 head-pair packing: the "
+                f"global layout is packed={cache_cfg.packed} but a "
+                f"{local.num_heads}-head shard packs={local.packed} — "
+                f"choose tp so heads/tp stays even (or disable "
+                f"APEX_TPU_FLASH_PACK_D64)")
+        if cache_cfg.kv_shape[2] % tp:
+            raise ValueError(
+                f"cache head axis {cache_cfg.kv_shape[2]} not "
+                f"divisible by tp {tp}")
+        self.tp = int(tp)
+        self.axis = axis
+        self.cache_cfg = cache_cfg            # GLOBAL geometry
+        self.local_cache_cfg = local          # per-shard geometry
+        self.model_cfg = dataclasses.replace(model_cfg, tp_axis=axis)
+        self.plan = serving_tp_plan(tp, model_cfg.num_layers,
+                                    axis=axis,
+                                    quantized=cache_cfg.quantized)
+        self.mesh = self.plan.make_mesh(devices)
+
+    # --- spec trees -----------------------------------------------------
+
+    def _replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P()
+
+    def _spec_tree(self, tree, prefix: str):
+        """PartitionSpec pytree for ``tree`` from the plan's declared
+        specs under ``prefix`` — the ONE derivation both shard_map
+        in/out_specs and jit in/out_shardings use."""
+        import jax
+
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: self.plan.partition_spec(
+                prefix + _keystr(path)), tree)
+
+    def weight_specs(self, weights: GPTServingWeights):
+        return self._spec_tree(weights, "in0")
+
+    def cache_specs(self, cache=None):
+        """PartitionSpec pytree for the paged cache, derived from the
+        plan's ``in1`` patterns — the SAME object the auditor checks,
+        so a plan change cannot leave the runtime sharding with a
+        stale literal (the drift the design promises is impossible)."""
+        if cache is None:
+            cache = init_cache(self.cache_cfg)
+        return self._spec_tree(cache, "in1")
+
+    def _named(self, spec_tree):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            spec_tree, is_leaf=lambda s: isinstance(s, P))
+
+    # --- committed placement -------------------------------------------
+
+    def shard_weights(self, weights: GPTServingWeights
+                      ) -> GPTServingWeights:
+        """Commit the (global) weight arrays to their plan shardings —
+        done once at engine construction and once per weight swap, so
+        steps never pay a per-call reshard."""
+        import jax
+
+        return jax.device_put(weights,
+                              self._named(self.weight_specs(weights)))
+
+    def init_cache(self):
+        """A zeroed paged cache committed to the plan's head-axis
+        sharding (each shard holds its heads' pages for every block)."""
+        import jax
+
+        cache = init_cache(self.cache_cfg)
+        return jax.device_put(cache,
+                              self._named(self.cache_specs(cache)))
+
+    # --- jitted step builders (engine drop-ins) -------------------------
+
+    def _wrap(self, body, weights, n_data: int, cache_out_index=0):
+        """shard_map-wrapped jit: ``body(weights, cache, *data)`` with
+        weights/cache sharded per plan, the ``n_data`` trailing args
+        replicated, the cache output sharded, everything else
+        replicated (post-psum values are shard-invariant), and the
+        cache donated."""
+        import jax
+
+        from .._compat import shard_map
+
+        rep = self._replicated()
+        w_specs = self.weight_specs(weights)
+        c_specs = self.cache_specs()
+        in_specs = (w_specs, c_specs) + (rep,) * n_data
+        out_specs = (c_specs, rep)
+        in_sh = (self._named(w_specs), self._named(c_specs)) \
+            + (self._named(rep),) * n_data
+        out_sh = (self._named(c_specs), self._named(rep))
+        mesh = self.mesh
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           in_shardings=in_sh, out_shardings=out_sh)
+        def step(weights, cache, *data):
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs,
+                             check_vma=False)(weights, cache, *data)
+
+        return step
+
+    def jit_decode(self, weights: GPTServingWeights):
+        cfg, ccfg = self.model_cfg, self.local_cache_cfg
+
+        def body(weights, cache, tokens, positions, block_tables,
+                 seq_lens, write_blocks, write_offsets):
+            return gpt_decode_step(weights, cfg, ccfg, cache, tokens,
+                                   positions, block_tables, seq_lens,
+                                   write_blocks, write_offsets)
+
+        return self._wrap(body, weights, 6)
+
+    def jit_prefill(self, weights: GPTServingWeights):
+        cfg, ccfg = self.model_cfg, self.local_cache_cfg
+
+        def body(weights, cache, tokens, length, blocks):
+            return gpt_prefill_step(weights, cfg, ccfg, cache, tokens,
+                                    length, blocks)
+
+        return self._wrap(body, weights, 3)
+
+    def jit_extend(self, weights: GPTServingWeights):
+        cfg, ccfg = self.model_cfg, self.local_cache_cfg
+
+        def body(weights, cache, tokens, block_tables, seq_lens,
+                 write_blocks, write_offsets):
+            return gpt_extend_step(weights, cfg, ccfg, cache, tokens,
+                                   block_tables, seq_lens,
+                                   write_blocks, write_offsets)
+
+        return self._wrap(body, weights, 5)
+
+    def describe(self) -> str:
+        devs = ",".join(str(getattr(d, "id", d))
+                        for d in self.mesh.devices.flat)
+        return (f"tp={self.tp} axis={self.axis!r} devices=[{devs}] "
+                f"psum_budget={self.plan.budget().get('psum')}")

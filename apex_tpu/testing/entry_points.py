@@ -209,6 +209,50 @@ def _build_gpt_decode_step():
     return engine._jit_decode(), engine._decode_args(2, 2)
 
 
+def _build_gpt_decode_step_tp():
+    """The ISSUE-14 tensor-parallel serving decode step: the SAME
+    continuous-batching decode program as ``gpt_decode_step``, shard-
+    mapped over a 2-way MeshPlan ``tensor`` axis — heads and ffn
+    columns local, the paged KV cache sharded on its head axis, 2
+    psums per layer (attention dense + MLP fc2, the Megatron
+    forward).  The plan is the runtime's own
+    :func:`apex_tpu.serving.tp.serving_tp_plan`, so the SPMD auditor
+    (APX701/703/705) guards the serving topology exactly as it
+    guards training: a replicated cache shard or an extra all-reduce
+    is a CI failure here before it is a TPU bill.  APX601 proves the
+    sharded cache still donates end to end; APX604 that zero host
+    transfers compile in — the engine's one fetch per tick stays the
+    explicit (b,) next-token readout."""
+    import jax.numpy as jnp
+
+    from ..serving import (BucketLadder, ServingEngine,
+                           ServingModelConfig, TPContext,
+                           default_cache_config,
+                           extract_serving_weights)
+    from .standalone_gpt import make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
+    cfg = ServingModelConfig.from_model(setup.model)
+    weights = extract_serving_weights(setup.params, cfg.num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=8, block_size=4)
+    tp = TPContext(cfg, cache_cfg, 2)
+    engine = ServingEngine(weights, cfg, cache_cfg,
+                           ladder=BucketLadder(batch=(2,), pages=(2,)),
+                           tp=tp)
+    return engine._jit_decode(), engine._decode_args(2, 2)
+
+
+def _serving_tp_plan():
+    """gpt_decode_step_tp's contract = the serving stack's own
+    :func:`~apex_tpu.serving.tp.serving_tp_plan` (tp=2 over the
+    2-layer smoke GPT, bf16 cache): qkv/fc1 column-split, dense/fc2
+    row-split, cache head-axis sharded in AND out, 2 psums per
+    layer."""
+    from ..serving.tp import serving_tp_plan
+
+    return serving_tp_plan(2, num_layers=2, quantized=False)
+
+
 def _build_fused_pipeline_step():
     """The PR-4 persistent packed optimizer pipeline as its own entry:
     one full amp post-backward step (pack -> norm/finite sweep ->
@@ -642,6 +686,14 @@ register_entry_point(
     dead_args=(), min_devices=8, plan=_moe_ep8_plan,
     doc="top-2 GShard MoE train step over expert=8 — the layer's own "
         "mesh_plan supplies specs and the all_to_all budget")
+register_entry_point(
+    "gpt_decode_step_tp", _build_gpt_decode_step_tp, policy="O5",
+    dead_args=(1,), min_devices=2, plan=_serving_tp_plan,
+    doc="tensor-parallel serving decode step (tp=2): head-sharded "
+        "paged attention + column/row-split MLP under shard_map, "
+        "2 psums per layer, cache donated through the sharded carry "
+        "— the serving topology audited like training "
+        "(what --serve-fleet --tp runs per tick)")
 
 
 # ---------------------------------------------------------------------------

@@ -1401,6 +1401,207 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     return summary
 
 
+# ---------------------------------------------------------------------------
+# Fleet serving smoke — multi-replica acceptance path (ISSUE-14)
+# ---------------------------------------------------------------------------
+
+def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
+                tp: Optional[int] = None,
+                disaggregate: Optional[bool] = None,
+                policy: Optional[str] = None,
+                jsonl_dir: Optional[str] = None,
+                vocab: int = 64, hidden: int = 32, num_heads: int = 4,
+                num_layers: int = 2, max_seq: int = 64,
+                max_new_tokens: int = 4, seed: int = 0,
+                dtype=jnp.float32, decode_attention: str = "kernel",
+                num_blocks: Optional[int] = None,
+                block_size: Optional[int] = None,
+                kv_dtype: Optional[str] = None, ladder=None,
+                sanitize: bool = False, threads: bool = False,
+                swap: bool = False, swap_after: int = 2,
+                prefix_share: Optional[bool] = None,
+                journal_dir: Optional[str] = None, fault=None,
+                fault_replica: str = "r0", max_restarts: int = 3,
+                stall_timeout: float = 300.0,
+                return_router: bool = False):
+    """Multi-replica serving smoke: N :class:`~apex_tpu.serving.
+    ServingEngine` replicas behind the gauge-fed
+    :class:`~apex_tpu.serving.FleetRouter` (the ``--serve-fleet``
+    acceptance path, tools/ci.sh step 13).
+
+    ``replicas``/``tp``/``disaggregate``/``policy`` default to the
+    ``APEX_TPU_SERVE_REPLICAS``/``_TP``/``_DISAGGREGATE``/``_ROUTER``
+    flags.  Each replica gets its own engine, KV pool, device (the
+    i-th host device, or with ``tp`` its own ``tp``-device slice and
+    a :class:`~apex_tpu.serving.TPContext` — head-sharded attention,
+    2 psums/layer, greedy output token-identical to single-chip), its
+    own JSONL event log (``jsonl_dir/serve-<rid>.jsonl``,
+    replica-stamped events) and, with ``journal_dir``, its own crash
+    journal — ``fault="crash@K"`` on ``fault_replica`` then recovers
+    by crash_reset + replay while the other replicas keep serving.
+    ``disaggregate=True`` adds a prefill-role replica streaming
+    finished prompt KV into the decode replicas' pools (warm
+    admissions, ``prefix_hit_tokens > 0``).  ``swap=True`` performs
+    one rolling weight swap (to a freshly initialized model) after
+    ``swap_after`` fleet rounds — zero requests lost, zero new
+    compiles (the sanitized leg proves both).  ``threads=True`` runs
+    one thread per replica (the aggregate-tokens/s scaling mode);
+    the default stepped loop is deterministic and supports
+    disaggregation and the mid-serve swap.
+
+    Returns the :class:`~apex_tpu.serving.FleetSummary` (with
+    ``return_router=True``, ``(summary, router)``)."""
+    import numpy as np
+
+    from ..analysis.flags import (flag_bool, flag_int,
+                                  flag_str)
+    from ..resilience import parse_fault
+    from ..serving import (BucketLadder, FleetRouter, Replica, Request,
+                           RequestJournal, ServingEngine,
+                           ServingModelConfig, TPContext,
+                           default_cache_config,
+                           extract_serving_weights)
+
+    replicas = replicas if replicas is not None \
+        else flag_int("APEX_TPU_SERVE_REPLICAS")
+    tp = tp if tp is not None else flag_int("APEX_TPU_SERVE_TP")
+    disaggregate = disaggregate if disaggregate is not None \
+        else flag_bool("APEX_TPU_SERVE_DISAGGREGATE")
+    policy = policy if policy is not None \
+        else flag_str("APEX_TPU_SERVE_ROUTER")
+    if disaggregate:
+        prefix_share = True         # the handoff lands through the
+        # shared index; colocated replicas may still opt in
+    if disaggregate and threads:
+        raise ValueError("disaggregation needs the stepped fleet "
+                         "loop (threads=False)")
+
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=dtype)
+    key = jax.random.PRNGKey(seed)
+    probe = jnp.zeros((1, min(8, max_seq)), jnp.int32)
+    params = jax.jit(model.init)(key, probe)["params"]
+    cfg = ServingModelConfig.from_model(
+        model, decode_attention=decode_attention)
+    weights = extract_serving_weights(params, num_layers)
+    swap_weights = None
+    if swap:
+        # a REAL weight change (fresh init): the swap leg proves the
+        # fleet swaps models, not just that the plumbing runs
+        swap_params = jax.jit(model.init)(
+            jax.random.PRNGKey(seed + 101), probe)["params"]
+        swap_weights = extract_serving_weights(swap_params, num_layers)
+    if ladder is None:
+        ladder = BucketLadder.from_flags()
+    devices = jax.devices()
+    if isinstance(fault, str):
+        fault = parse_fault(fault)
+
+    def make_cache_cfg():
+        return default_cache_config(cfg, num_blocks=num_blocks,
+                                    block_size=block_size,
+                                    kv_dtype=kv_dtype)
+
+    monitors = []
+    members = []
+    total = replicas + (1 if disaggregate else 0)
+    if tp and tp > 1 and total * tp > len(devices):
+        raise ValueError(
+            f"{total} replica(s) x tp={tp} needs {total * tp} "
+            f"devices, host has {len(devices)}")
+
+    if jsonl_dir:
+        os.makedirs(jsonl_dir, exist_ok=True)
+
+    def make_member(idx: int, rid: str, role: str) -> Replica:
+        monitor = make_smoke_monitor(
+            (os.path.join(jsonl_dir, f"serve-{rid}.jsonl")
+             if jsonl_dir else None), None,
+            tokens_per_step=None, flops_per_step=None,
+            stall_timeout=stall_timeout,
+            run_attrs={"driver": "standalone_gpt.fleet_smoke",
+                       "replica": rid, "role": role,
+                       "replicas": replicas, "tp": tp or 0,
+                       "disaggregate": bool(disaggregate)})
+        monitors.append(monitor)
+        cache_cfg = make_cache_cfg()
+        tp_ctx = None
+        device = None
+        if tp and tp > 1:
+            tp_ctx = TPContext(cfg, cache_cfg, tp,
+                               devices=devices[idx * tp:
+                                               (idx + 1) * tp])
+        else:
+            device = devices[idx % len(devices)]
+        engine = ServingEngine(
+            weights, cfg, cache_cfg, ladder=ladder, monitor=monitor,
+            prefix_share=prefix_share, tp=tp_ctx, device=device,
+            replica_id=rid)
+        journal = None
+        if journal_dir:
+            os.makedirs(journal_dir, exist_ok=True)
+            journal = RequestJournal(
+                os.path.join(journal_dir, f"{rid}.journal.jsonl"))
+        return Replica(rid, engine, role=role, journal=journal,
+                       max_restarts=max_restarts,
+                       fault=(fault if rid == fault_replica
+                              else None))
+
+    for i in range(replicas):
+        members.append(make_member(i, f"r{i}", "serve"))
+    if disaggregate:
+        members.append(make_member(replicas, "pf0", "prefill"))
+    # the router gets replica 0's RAW monitor (pre-stamping): fleet-
+    # scope events (request_routed, kv_handoff, fleet_done) carry
+    # their own explicit replica attrs and must not inherit a bogus
+    # replica="r0" default
+    router = FleetRouter(members, policy=policy, monitor=monitors[0])
+
+    # deterministic mixed-length prompts with shared-prefix pairs (so
+    # sticky routing and the prefix machinery have something to bite)
+    rng = np.random.RandomState(seed)
+    span = ladder.max_pages * make_cache_cfg().block_size
+    max_prompt = max(1, min(max_seq, span) - max_new_tokens)
+    prompts = []
+    for i in range(num_requests):
+        n = 1 + (int(rng.randint(1, 10 ** 6)) % max_prompt)
+        prompts.append([int(t) for t in rng.randint(0, vocab, n)])
+    requests = [Request(rid=f"req{i:03d}", prompt=p,
+                        max_new_tokens=max_new_tokens)
+                for i, p in enumerate(prompts)]
+
+    try:
+        with contextlib.ExitStack() as stack:
+            san = None
+            if sanitize:
+                from ..analysis import sanitize as sanitize_ctx
+
+                san = stack.enter_context(sanitize_ctx(
+                    transfer_guard=None, recompile_budget=0,
+                    warmup_steps=1))
+            for m in members:
+                with m.device_scope():
+                    m.engine.warmup()
+            if threads:
+                summary = router.serve_threaded(requests)
+            else:
+                after = (lambda i: san.step()) if san else None
+                summary = router.serve(
+                    requests,
+                    swap_after=(swap_after if swap else None),
+                    swap_weights=swap_weights,
+                    before_round=after)
+    finally:
+        for m in monitors:
+            m.close()
+    if return_router:
+        return summary, router
+    return summary
+
+
 def add_resilience_cli(p) -> None:
     """The shared GPT/BERT smoke-driver resilience flags."""
     p.add_argument("--ckpt-dir", default=None,
@@ -1533,8 +1734,98 @@ def _main(argv=None):
     p.add_argument("--max-restarts", type=int, default=3,
                    help="(--serve --supervise) restart budget "
                         "(default 3)")
+    p.add_argument("--serve-fleet", action="store_true",
+                   help="multi-replica serving smoke: N engines "
+                        "behind the gauge-fed FleetRouter "
+                        "(apex_tpu.serving.fleet) — per-replica KV "
+                        "pools/devices/JSONL logs, sticky warm "
+                        "routing, optional TP decode, disaggregated "
+                        "prefill/decode, and a rolling weight swap; "
+                        "prints a FLEET_DONE row")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="(--serve-fleet) serve-role replica count "
+                        "(default: APEX_TPU_SERVE_REPLICAS)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="(--serve-fleet) tensor-parallel width per "
+                        "replica; each replica takes its own "
+                        "TP-device slice (default: "
+                        "APEX_TPU_SERVE_TP; 0 = single-chip)")
+    p.add_argument("--disaggregate", action="store_true",
+                   default=None,
+                   help="(--serve-fleet) add a prefill-role replica "
+                        "that streams finished prompt KV into the "
+                        "decode replicas' pools (warm admissions; "
+                        "default: APEX_TPU_SERVE_DISAGGREGATE)")
+    p.add_argument("--router-policy", default=None,
+                   choices=("gauges", "round_robin"),
+                   help="(--serve-fleet) submission policy "
+                        "(default: APEX_TPU_SERVE_ROUTER)")
+    p.add_argument("--swap", action="store_true",
+                   help="(--serve-fleet) perform one rolling weight "
+                        "swap (to a freshly initialized model) "
+                        "mid-serve — zero lost requests, zero new "
+                        "compiles")
+    p.add_argument("--fleet-threads", action="store_true",
+                   help="(--serve-fleet) one thread per replica "
+                        "(the aggregate tokens/s scaling mode); "
+                        "default is the deterministic stepped loop")
+    p.add_argument("--jsonl-dir", default=None, metavar="DIR",
+                   help="(--serve-fleet) per-replica event logs "
+                        "DIR/serve-<rid>.jsonl (replica-stamped; "
+                        "aggregate with trace_check --serve "
+                        "DIR/serve-*.jsonl)")
+    p.add_argument("--journal-dir", default=None, metavar="DIR",
+                   help="(--serve-fleet) per-replica crash journals "
+                        "DIR/<rid>.journal.jsonl; with --fault "
+                        "crash@K the faulted replica recovers by "
+                        "journal replay while the rest keep serving")
+    p.add_argument("--fleet-hidden", type=int, default=32,
+                   help="(--serve-fleet) model hidden size — the "
+                        "bench scaling legs use a compute-heavier "
+                        "shape than the CI smoke default")
+    p.add_argument("--fleet-layers", type=int, default=2,
+                   help="(--serve-fleet) model layer count")
+    p.add_argument("--fleet-vocab", type=int, default=64,
+                   help="(--serve-fleet) model vocab size")
     add_resilience_cli(p)
     args = p.parse_args(argv)
+    if args.serve_fleet:
+        s = fleet_smoke(
+            args.requests, replicas=args.replicas, tp=args.tp,
+            disaggregate=args.disaggregate,
+            policy=args.router_policy, jsonl_dir=args.jsonl_dir,
+            max_new_tokens=args.new_tokens,
+            max_seq=args.serve_max_seq,
+            hidden=args.fleet_hidden, num_layers=args.fleet_layers,
+            vocab=args.fleet_vocab,
+            decode_attention=("reference" if args.decode_reference
+                              else "kernel"),
+            sanitize=args.sanitize, threads=args.fleet_threads,
+            swap=args.swap, journal_dir=args.journal_dir,
+            fault=args.fault, max_restarts=args.max_restarts,
+            stall_timeout=args.stall_timeout)
+        print(f"FLEET_DONE replicas={s.replicas} "
+              f"prefill_replicas={s.prefill_replicas} "
+              f"policy={s.router_policy} "
+              f"submitted={s.requests_submitted} "
+              f"done={s.requests_done} "
+              f"preempted={s.requests_preempted} "
+              f"lost={s.lost_requests} "
+              f"tokens={s.tokens_generated} "
+              f"tokens_s={s.tokens_per_sec} "
+              f"sum_decode_tokens_s={s.sum_decode_tokens_per_sec} "
+              f"swaps={s.swaps} handoffs={s.handoffs} "
+              f"warm_admissions={s.warm_prefix_admissions} "
+              f"prefix_hit_tokens={s.prefix_hit_tokens} "
+              f"sticky_routes={s.sticky_routes} "
+              f"replayed={s.replayed_requests} "
+              f"restarts={s.restarts} "
+              f"ttft_p50_ms={s.ttft_p50_ms} "
+              f"ttft_p99_ms={s.ttft_p99_ms} "
+              f"threaded={int(s.threaded)}"
+              + (f" jsonl_dir={args.jsonl_dir}"
+                 if args.jsonl_dir else ""))
+        return
     if args.serve:
         shed = None
         if args.shed_pool_hw is not None \

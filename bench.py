@@ -1185,6 +1185,131 @@ def bench_serving():
     return out
 
 
+def bench_serving_fleet():
+    """The ISSUE-14 multi-replica serving fleet measured end to end —
+    every leg is one ``standalone_gpt --serve-fleet`` subprocess on
+    an 8-device host-platform mesh (its own process so each leg gets
+    the per-replica device placement the fleet needs regardless of
+    how THIS bench process initialized jax):
+
+    * ``scaling`` — aggregate tokens/s at 1/2/4 threaded replicas
+      under weak scaling (8 requests per replica), plus the
+      efficiency ratios vs linear — the ROADMAP item-1 exit bar is
+      ``scaling_efficiency_4r >= 0.8``;
+    * ``tp_decode`` — one replica decoding tensor-parallel over a
+      2-device slice (the audited ``gpt_decode_step_tp`` program):
+      tokens/s next to the single-chip row prices the 2-psum/layer
+      topology (on the CPU host mesh TP is a correctness/topology
+      row, not a speed win — the kernels are not bandwidth-bound
+      here);
+    * ``disaggregated`` — FULL-request TTFT p50/p99 (anchored at the
+      router's submit, so the prefill-probe wait and the KV handoff
+      are counted) vs the colocated fleet, plus the handoff volume
+      and the warm-hit token count.  On this single-core stepped
+      substrate the probe + handoff serialize with everything else,
+      so disaggregated TTFT is honestly WORSE than colocated — the
+      split's real win here is that decode-side admissions land warm
+      (prefill cost off the decode replica's tick path; the
+      ``prefix_hit_tokens`` column) and it becomes a latency win only
+      where prefill replicas run on their own hardware;
+    * ``rolling_swap`` — one mid-serve weight swap on a 2-replica
+      fleet: requests lost (MUST be 0) and swaps completed.
+
+    The fleet shape (hidden 256, 2 layers, batch-8 ladder) is pinned
+    compute-heavy enough that a replica's jitted tick dominates its
+    host bookkeeping — the regime where replica threads actually
+    overlap (and the regime a real accelerator serve is in)."""
+    import re
+    import subprocess
+
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count"
+                            "=8").strip()
+    env.update(JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+               APEX_TPU_SERVE_KV_BLOCK="16",
+               APEX_TPU_SERVE_BLOCKS="64",
+               APEX_TPU_SERVE_BATCH_BUCKETS="8",
+               APEX_TPU_SERVE_PAGE_BUCKETS="4")
+    base = [sys.executable, "-m",
+            "apex_tpu.testing.standalone_gpt", "--serve-fleet",
+            "--new-tokens", "24", "--serve-max-seq", "256",
+            "--fleet-hidden", "256", "--fleet-vocab", "256"]
+
+    def run_leg(extra):
+        proc = subprocess.run(base + extra, env=env,
+                              capture_output=True, text=True,
+                              timeout=900,
+                              cwd=os.path.dirname(
+                                  os.path.abspath(__file__)))
+        m = re.search(r"^FLEET_DONE (.+)$", proc.stdout, re.M)
+        if proc.returncode != 0 or m is None:
+            raise RuntimeError(
+                f"fleet leg {extra} failed (rc={proc.returncode}): "
+                f"{proc.stdout[-400:]} {proc.stderr[-400:]}")
+        row = {}
+        for kv in m.group(1).split():
+            k, _, v = kv.partition("=")
+            try:
+                row[k] = json.loads(v)
+            except (ValueError, json.JSONDecodeError):
+                row[k] = None if v == "None" else v
+        return row
+
+    scaling = []
+    tps = {}
+    for n in (1, 2, 4):
+        row = run_leg(["--replicas", str(n), "--requests",
+                       str(8 * n), "--fleet-threads"])
+        tps[n] = row["tokens_s"]
+        scaling.append({
+            "replicas": n, "requests": row["submitted"],
+            "tokens_per_sec": row["tokens_s"],
+            "lost_requests": row["lost"],
+            "sum_decode_tokens_per_sec":
+                row["sum_decode_tokens_s"]})
+    tp_row = run_leg(["--replicas", "1", "--tp", "2",
+                      "--requests", "8"])
+    colocated = run_leg(["--replicas", "1", "--requests", "8"])
+    disagg = run_leg(["--replicas", "1", "--disaggregate",
+                      "--requests", "8"])
+    swap_row = run_leg(["--replicas", "2", "--requests", "16",
+                        "--swap"])
+    out = {
+        "shape": {"hidden": 256, "layers": 2, "vocab": 256,
+                  "new_tokens": 24, "batch_bucket": 8,
+                  "mesh": "8-device host platform"},
+        "scaling": scaling,
+        "scaling_efficiency_2r": round(tps[2] / (2 * tps[1]), 3),
+        "scaling_efficiency_4r": round(tps[4] / (4 * tps[1]), 3),
+        "tp_decode": {
+            "tp": 2, "tokens_per_sec": tp_row["tokens_s"],
+            "single_chip_tokens_per_sec": tps[1],
+            "lost_requests": tp_row["lost"]},
+        "disaggregated": {
+            "ttft_p50_ms": disagg["ttft_p50_ms"],
+            "ttft_p99_ms": disagg["ttft_p99_ms"],
+            "ttft_p50_ms_colocated": colocated["ttft_p50_ms"],
+            "ttft_p99_ms_colocated": colocated["ttft_p99_ms"],
+            "handoffs": disagg["handoffs"],
+            "prefix_hit_tokens": disagg["prefix_hit_tokens"],
+            "warm_admissions": disagg["warm_admissions"]},
+        "rolling_swap": {
+            "swaps": swap_row["swaps"],
+            "lost_requests": swap_row["lost"],
+            "requests_done": swap_row["done"]},
+    }
+    print(f"[bench] serving_fleet: 1r {tps[1]} / 2r {tps[2]} / 4r "
+          f"{tps[4]} tok/s (eff {out['scaling_efficiency_4r']}x "
+          f"linear @4), tp2 {tp_row['tokens_s']} tok/s, disagg ttft "
+          f"p99 {disagg['ttft_p99_ms']} vs colocated "
+          f"{colocated['ttft_p99_ms']} ms, swap lost="
+          f"{swap_row['lost']}", file=sys.stderr)
+    return out
+
+
 def bench_collective():
     n_dev = jax.device_count()
     out = {"devices": n_dev}
@@ -1775,6 +1900,20 @@ def _compact_summary(full):
                 res.get("prefix_hit_tokens")
             ce["serve"]["replay_digest_ok"] = \
                 res.get("digest_matches_uninterrupted")
+    fl = ex.get("serving_fleet", {})
+    if isinstance(fl, dict) and fl.get("scaling"):
+        # ISSUE-14 fleet: aggregate tokens/s per replica count, the
+        # 4-replica scaling efficiency, TP decode, disagg TTFT, swap
+        ce["fleet"] = {
+            "tok_s": {str(r["replicas"]): r["tokens_per_sec"]
+                      for r in fl["scaling"]},
+            "eff_4r": fl.get("scaling_efficiency_4r"),
+            "tp2_tok_s": (fl.get("tp_decode") or {}).get(
+                "tokens_per_sec"),
+            "disagg_ttft_p99":
+                (fl.get("disaggregated") or {}).get("ttft_p99_ms"),
+            "swap_lost": (fl.get("rolling_swap") or {}).get(
+                "lost_requests")}
     col = ex.get("collective", {})
     if "hbm_read_gbps" in col:
         ce["hbm_gbps"] = col["hbm_read_gbps"]
@@ -1961,7 +2100,8 @@ class SectionBudget:
 # the per-section seconds in BENCH_EVENTS.jsonl from complete sweeps.
 SECTION_ESTIMATES_S = {
     "resnet50": 600, "optimizer_step": 600, "optimizer_pipeline": 600,
-    "scan_driver": 120, "serving": 420, "collective": 240,
+    "scan_driver": 120, "serving": 420, "serving_fleet": 480,
+    "collective": 240,
     "long_context": 900, "ring_flash": 360, "gpt2_345m": 600,
     "gpt2_345m_s2048": 480, "gpt2_345m_dropout": 480,
     "bert_large": 600, "zero_sharded_adam": 480,
@@ -2022,6 +2162,7 @@ def _run_section(extras, name, fn, writer, sink=None, budget=None,
 
 SECTION_NAMES = ("resnet50", "optimizer_step",
                  "optimizer_pipeline", "scan_driver", "serving",
+                 "serving_fleet",
                  "collective", "long_context", "ring_flash",
                  "gpt2_345m", "gpt2_345m_s2048", "gpt2_345m_dropout",
                  "bert_large", "zero_sharded_adam")
@@ -2150,6 +2291,7 @@ def main(argv=None):
                 ("optimizer_pipeline", bench_optimizer_pipeline),
                 ("scan_driver", bench_scan_driver),
                 ("serving", bench_serving),
+                ("serving_fleet", bench_serving_fleet),
                 ("collective", bench_collective),
                 ("long_context", bench_long_context),
                 ("ring_flash", bench_ring_flash),

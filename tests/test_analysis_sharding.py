@@ -572,7 +572,8 @@ class TestTopologyColumn:
         plans = graft.multichip_plans(8)
         assert set(plans) == {
             "gpt_3d", "interleaved_pp", "sequence_ring", "ulysses",
-            "expert_parallel", "tp_x_ep", "zero_adam", "resnet_dp"}
+            "expert_parallel", "tp_x_ep", "zero_adam", "resnet_dp",
+            "serving_tp"}
         for plan in plans.values():
             assert plan.axes  # every leg records real axes
         # kinds cover the full parallelism alphabet

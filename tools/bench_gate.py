@@ -86,7 +86,20 @@ LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms",
                              "serving_ttft_p99_ms",
                              "serving_itl_p99_ms",
                              "serving_warm_admission_ms",
-                             "serving_chunked_itl_p99_ms"})
+                             "serving_chunked_itl_p99_ms",
+                             "serving_fleet_disagg_ttft_p99_ms"})
+
+
+def _fleet_scaling_tps(full, replicas):
+    """Aggregate tokens/s of the ``replicas``-count fleet scaling
+    row, or None when the section (or that row) is absent."""
+    rows = _get(full, "extras", "serving_fleet", "scaling")
+    if not isinstance(rows, list):
+        return None
+    for row in rows:
+        if isinstance(row, dict) and row.get("replicas") == replicas:
+            return row.get("tokens_per_sec")
+    return None
 
 
 def headline_metrics(full):
@@ -151,6 +164,22 @@ def headline_metrics(full):
         "serving_chunked_itl_p99_ms": (
             _get(full, "extras", "serving", "chunked_prefill",
                  "itl_p99_ms_staggered_chunked"), "serving"),
+        # ISSUE-14 fleet rows: aggregate 4-replica throughput and its
+        # scaling efficiency vs linear gate upward (the ROADMAP
+        # item-1 exit bar is efficiency >= 0.8), TP-decode tokens/s
+        # guards the tensor-parallel serving path, and the
+        # disaggregated decode-side TTFT gates LOWER_IS_BETTER
+        "serving_fleet_tokens_per_sec_4r": (
+            _fleet_scaling_tps(full, 4), "serving_fleet"),
+        "serving_fleet_scaling_4r": (
+            _get(full, "extras", "serving_fleet",
+                 "scaling_efficiency_4r"), "serving_fleet"),
+        "serving_fleet_tp_tokens_per_sec": (
+            _get(full, "extras", "serving_fleet", "tp_decode",
+                 "tokens_per_sec"), "serving_fleet"),
+        "serving_fleet_disagg_ttft_p99_ms": (
+            _get(full, "extras", "serving_fleet", "disaggregated",
+                 "ttft_p99_ms"), "serving_fleet"),
     }
     lc = _get(full, "extras", "long_context") or {}
     if isinstance(lc, dict):
@@ -443,6 +472,50 @@ def self_test() -> int:
         "itl_p99_ms_staggered_chunked"] = 15.0
     r, _ = compare(improved, fast)
     assert r == [], r
+    # ISSUE-14 fleet legs: 4-replica aggregate tokens/s and scaling
+    # efficiency gate upward, TP decode tokens/s guards the TP path,
+    # disaggregated TTFT gates LOWER_IS_BETTER, a pre-fleet artifact
+    # rolls forward ungated, and a section-level skip row excuses all
+    flt = json.loads(json.dumps(srv))
+    flt["extras"]["serving_fleet"] = {
+        "scaling": [
+            {"replicas": 1, "tokens_per_sec": 200.0},
+            {"replicas": 4, "tokens_per_sec": 700.0}],
+        "scaling_efficiency_4r": 0.875,
+        "tp_decode": {"tokens_per_sec": 150.0},
+        "disaggregated": {"ttft_p99_ms": 80.0}}
+    r, _ = compare(json.loads(json.dumps(flt)), flt)
+    assert r == [], r
+    unscaled = json.loads(json.dumps(flt))
+    unscaled["extras"]["serving_fleet"]["scaling"][1][
+        "tokens_per_sec"] = 500.0                            # -29%
+    unscaled["extras"]["serving_fleet"][
+        "scaling_efficiency_4r"] = 0.625
+    r, _ = compare(unscaled, flt)
+    assert len(r) == 2 \
+        and any("serving_fleet_tokens_per_sec_4r" in x for x in r) \
+        and any("serving_fleet_scaling_4r" in x for x in r), r
+    slow_tp = json.loads(json.dumps(flt))
+    slow_tp["extras"]["serving_fleet"]["tp_decode"][
+        "tokens_per_sec"] = 100.0
+    r, _ = compare(slow_tp, flt)
+    assert len(r) == 1 \
+        and "serving_fleet_tp_tokens_per_sec" in r[0], r
+    slow_handoff = json.loads(json.dumps(flt))
+    slow_handoff["extras"]["serving_fleet"]["disaggregated"][
+        "ttft_p99_ms"] = 120.0                               # +50%
+    r, _ = compare(slow_handoff, flt)
+    assert len(r) == 1 \
+        and "serving_fleet_disagg_ttft_p99_ms" in r[0] \
+        and "lower is better" in r[0], r
+    pre_fleet = json.loads(json.dumps(srv))   # no serving_fleet at all
+    r, _ = compare(flt, pre_fleet)
+    assert r == [], r
+    fleet_skip = json.loads(json.dumps(flt))
+    fleet_skip["extras"]["serving_fleet"] = {"skipped": "budget"}
+    r, notes = compare(fleet_skip, flt)
+    assert r == [] and any("serving_fleet" in n and "skipped" in n
+                           for n in notes), (r, notes)
     # roll-forward: gating a fast-path fresh run against a committed
     # artifact WITHOUT the columns never fires
     r, _ = compare(slow_spec, srv)

@@ -135,28 +135,44 @@
 #                            MULTICHIP_TOPOLOGY.json must match the
 #                            canonical MeshPlan constructors
 #                            (docs/api/analysis.md)
+#  13. fleet serving smoke   — the ISSUE-14 multi-replica stack: a
+#                            sanitized 2-replica `--serve-fleet` run
+#                            with one mid-serve rolling weight swap
+#                            must lose ZERO requests (every submitted
+#                            rid terminal fleet-wide, trace_check
+#                            --serve over the per-replica logs) and
+#                            compile NOTHING after warmup (the swap
+#                            keeps the AOT ladder — sanitize proves
+#                            it); a disaggregated leg must hand
+#                            prefill KV off warm (handoffs > 0,
+#                            prefix_hit_tokens > 0 on the decode
+#                            replica); and a `--fault crash@2`
+#                            replica with a journal must recover by
+#                            replay (restarts>=1, replayed>0) while
+#                            the fleet still completes every request
+#                            (docs/api/serving.md#fleet-serving)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/12 default test tier"
+echo "[ci] 1/13 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/12 README drift guard"
+echo "[ci] 2/13 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/12 8-device multichip dryrun"
+echo "[ci] 3/13 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/12 monitor smoke"
+echo "[ci] 4/13 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/12 kill->resume smoke"
+echo "[ci] 5/13 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -176,16 +192,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/12 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/13 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/12 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/13 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/12 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/13 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -194,7 +210,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/12 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/13 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -215,7 +231,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/12 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/13 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -239,7 +255,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/12 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/13 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -363,7 +379,7 @@ grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
-echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
+echo "[ci] 12/13 SPMD sharding audit (--check-sharding) + topology drift"
 # Compile every plan-carrying multichip entry under its mesh on the
 # same 8-device host-platform trick the multichip tests use; fails on
 # APX701-703 findings, per-device-memory drift vs the committed
@@ -374,5 +390,61 @@ echo "[ci] 12/12 SPMD sharding audit (--check-sharding) + topology drift"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-sharding
 python __graft_entry__.py --plans 8
+
+echo "[ci] 13/13 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
+FLEET_DIR="$(mktemp -d -t apex_tpu_fleet.XXXXXX)"
+# leg 1: sanitized 2-replica fleet with ONE rolling weight swap
+# mid-serve — zero lost requests fleet-wide, zero compiles after
+# warmup (the swap keeps every AOT-compiled ladder bucket), and the
+# merged per-replica logs prove N submitted => N terminal
+FLEET_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet \
+    --replicas 2 --requests 8 --new-tokens 4 --swap --sanitize \
+    --jsonl-dir "$FLEET_DIR/swap")"
+echo "$FLEET_OUT"
+echo "$FLEET_OUT" | grep -q "swaps=2" \
+    || { echo "[ci] FAIL: rolling swap did not cover both replicas"; exit 1; }
+echo "$FLEET_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: rolling swap lost requests"; exit 1; }
+echo "$FLEET_OUT" | grep -q "done=8" \
+    || { echo "[ci] FAIL: fleet did not finish all 8 requests"; exit 1; }
+python tools/trace_check.py "$FLEET_DIR"/swap/serve-r0.jsonl \
+    "$FLEET_DIR"/swap/serve-r1.jsonl --serve
+python tools/monitor_summary.py "$FLEET_DIR"/swap/serve-r0.jsonl \
+    "$FLEET_DIR"/swap/serve-r1.jsonl
+# leg 2: disaggregated prefill/decode — a prefill-role replica runs
+# the prompts and streams finished KV blocks into the decode
+# replica's pool; every decode-side admission must land WARM
+FLEET_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet \
+    --replicas 1 --disaggregate --requests 5 --new-tokens 4 \
+    --jsonl-dir "$FLEET_DIR/disagg")"
+echo "$FLEET_OUT"
+echo "$FLEET_OUT" | grep -Eq "handoffs=[1-9]" \
+    || { echo "[ci] FAIL: no KV handoffs in the disaggregated leg"; exit 1; }
+echo "$FLEET_OUT" | grep -Eq "prefix_hit_tokens=[1-9]" \
+    || { echo "[ci] FAIL: disaggregated admissions did not land warm"; exit 1; }
+echo "$FLEET_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: disaggregated leg lost requests"; exit 1; }
+python tools/trace_check.py "$FLEET_DIR"/disagg/serve-*.jsonl --serve
+# leg 3: replica crash + journal replay — replica r0 crashes at tick
+# 2, recovers in place (crash_reset + replay of every non-terminal
+# rid), and the fleet still completes every submitted request
+FLEET_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet \
+    --replicas 2 --requests 8 --new-tokens 6 --fault crash@2 \
+    --journal-dir "$FLEET_DIR/journals" \
+    --jsonl-dir "$FLEET_DIR/crash")"
+echo "$FLEET_OUT"
+echo "$FLEET_OUT" | grep -Eq "restarts=[1-9]" \
+    || { echo "[ci] FAIL: crashed replica did not restart"; exit 1; }
+echo "$FLEET_OUT" | grep -Eq "replayed=[1-9]" \
+    || { echo "[ci] FAIL: journal replay re-entered no requests"; exit 1; }
+echo "$FLEET_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: crash leg lost requests"; exit 1; }
+echo "$FLEET_OUT" | grep -q "done=8" \
+    || { echo "[ci] FAIL: crash leg did not finish all 8 requests"; exit 1; }
+python tools/trace_check.py "$FLEET_DIR"/crash/serve-*.jsonl --serve
+rm -rf "$FLEET_DIR"
 
 echo "[ci] all green"

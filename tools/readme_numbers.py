@@ -141,6 +141,27 @@ def render(full: dict, artifact_name: str, topo: list = None) -> str:
         if sv.get("kernel_vs_naive") is not None:
             row("serving: paged kernel vs naive full-gather decode",
                 f"{sv['kernel_vs_naive']}x")
+    fl = ex.get("serving_fleet", {})
+    if isinstance(fl, dict) and fl.get("scaling"):
+        tps = {r.get("replicas"): r.get("tokens_per_sec")
+               for r in fl["scaling"] if isinstance(r, dict)}
+        if tps.get(1) is not None and tps.get(4) is not None:
+            row("serving fleet: aggregate tokens/s 1 -> 4 replicas "
+                "(8-device host mesh)",
+                f"{tps[1]} -> {tps[4]} tok/s "
+                f"({fl.get('scaling_efficiency_4r')}x linear)")
+        tpd = fl.get("tp_decode") or {}
+        if tpd.get("tokens_per_sec") is not None:
+            row("serving fleet: tensor-parallel decode (tp=2, "
+                "audited topology)",
+                f"{tpd['tokens_per_sec']} tok/s")
+        dg = fl.get("disaggregated") or {}
+        if dg.get("ttft_p99_ms") is not None \
+                and dg.get("ttft_p99_ms_colocated") is not None:
+            row("serving fleet: disaggregated full-request TTFT p99 "
+                "vs colocated (probe + KV handoff counted)",
+                f"{dg['ttft_p99_ms']} vs "
+                f"{dg['ttft_p99_ms_colocated']} ms")
     z = ex.get("zero_sharded_adam", {})
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
