@@ -28,6 +28,16 @@ generated from it):
   ``tools/sharding_baseline.json``.
 * :mod:`.sanitizer` — runtime ``sanitize()`` context: JAX transfer
   guard plus a per-step recompile budget driven by ``jax_log_compiles``.
+* :mod:`.concurrency` — host-concurrency auditor (APX801-805): lock
+  discipline via guard inference over ``with self._lock:`` regions,
+  lock-acquisition-order cycles aggregated cross-module, flag-only
+  signal handlers, blocking-under-lock, and thread-target jit
+  dispatch outside a device pin.
+* :mod:`.schedule` — the dynamic half: a seeded deterministic-
+  interleaving scheduler that steps the threaded serving fleet under
+  permuted thread orderings and asserts the terminal digest is
+  seed-invariant, with ``threading.excepthook`` capture so a
+  background-thread crash is a failure, not a vanished thread.
 
 CLI: ``python -m apex_tpu.analysis --check`` / ``--check-hlo`` /
 ``--check-sharding`` (self-hosted in tools/ci.sh steps 7, 8, and 12;
@@ -53,6 +63,12 @@ _LAZY = {
     "ShardingAudit": "sharding", "audit_sharding": "sharding",
     "run_sharding_check": "sharding",
     "write_sharding_baseline": "sharding",
+    "lint_concurrency_source": "concurrency",
+    "lint_concurrency_paths": "concurrency",
+    "run_concurrency_check": "concurrency",
+    "write_concurrency_baseline": "concurrency",
+    "DeterministicScheduler": "schedule",
+    "fleet_digest": "schedule", "schedule_sweep": "schedule",
 }
 
 __all__ = [

@@ -4,6 +4,7 @@
     python -m apex_tpu.analysis --check --paths a.py b.py   # changed-file
     python -m apex_tpu.analysis --check-hlo      # compiled-graph audit
     python -m apex_tpu.analysis --check-sharding # SPMD plan audit
+    python -m apex_tpu.analysis --check-concurrency  # APX8xx lock/signal audit
     python -m apex_tpu.analysis --update-baseline
     python -m apex_tpu.analysis --update-hlo-baseline
     python -m apex_tpu.analysis --update-sharding-baseline
@@ -88,6 +89,21 @@ def main(argv=None) -> int:
                          "against tools/sharding_baseline.json "
                          "(APX701-705; needs the 8-device "
                          "host-platform mesh)")
+    ap.add_argument("--check-concurrency", action="store_true",
+                    help="host-concurrency audit (APX801-805): lock "
+                         "discipline via guard inference, "
+                         "lock-acquisition-order cycles aggregated "
+                         "across modules, flag-only signal handlers, "
+                         "blocking calls under locks, and thread-"
+                         "target jit dispatch outside a device pin, "
+                         "against tools/concurrency_baseline.txt "
+                         "(committed empty; stale entries fail)")
+    ap.add_argument("--update-concurrency-baseline",
+                    action="store_true",
+                    help="rewrite tools/concurrency_baseline.txt to "
+                         "accept all current APX8xx findings (the "
+                         "repo commits it EMPTY: fix, don't "
+                         "baseline)")
     ap.add_argument("--update-sharding-baseline", action="store_true",
                     help="rewrite tools/sharding_baseline.json "
                          "(plans + per-device memory + censuses) from "
@@ -250,6 +266,39 @@ def main(argv=None) -> int:
               f"collective op(s) within budget, "
               f"{len(advisories)} advisory(ies), 0 unsuppressed "
               f"findings")
+        return 0
+
+    if args.check_concurrency or args.update_concurrency_baseline:
+        from .concurrency import (DEFAULT_BASELINE as CONC_BASELINE,
+                                  lint_concurrency_paths,
+                                  run_concurrency_check,
+                                  write_concurrency_baseline)
+
+        if args.update_concurrency_baseline:
+            findings, _ = lint_concurrency_paths(repo_root=args.root)
+            write_concurrency_baseline(findings, repo_root=args.root)
+            print(f"[analysis] concurrency baseline rewritten with "
+                  f"{len(set(f.key for f in findings))} entries")
+            return 0
+        unsuppressed, stale, regions = run_concurrency_check(
+            repo_root=args.root)
+        for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
+            if args.json:
+                print(json.dumps(dataclasses.asdict(f)))
+            else:
+                print(f.render())
+        for k in sorted(stale):
+            print(f"[analysis] stale concurrency baseline entry "
+                  f"(finding no longer fires — delete the line): {k}",
+                  file=sys.stderr)
+        if unsuppressed or stale:
+            print(f"[analysis] FAIL: {len(unsuppressed)} unsuppressed "
+                  f"concurrency finding(s), {len(stale)} stale "
+                  f"baseline entr(ies)", file=sys.stderr)
+            return 1
+        print(f"[analysis] concurrency clean: {regions} lock "
+              f"region(s) audited, 0 unsuppressed APX8xx findings "
+              f"(baseline {CONC_BASELINE} empty-current)")
         return 0
 
     if args.smoke:

@@ -1,0 +1,877 @@
+"""APX8xx host-concurrency auditor — lock discipline and signal safety.
+
+The analysis stack guards traced code (APX1xx), compiled graphs
+(APX6xx), and SPMD topology (APX7xx); what it never guarded is the
+layer that crashes **nondeterministically**: the host-side threading
+the serving fleet made real (one thread per replica, the watchdog
+heartbeat, SpanTracer per-thread buffers, SIGTERM/SIGINT/SIGUSR1
+handlers).  The repo's concurrency discipline — flag-only signal
+handlers, lock-guarded sinks, per-thread device pinning — was a
+convention stated in docstrings.  This module makes it a checked
+invariant, on the same machinery as the PR-5 linter (AST walk,
+structured :class:`~.linter.Finding` s, reasoned inline suppressions,
+a committed baseline with stale-entry-fails semantics, rule-registry
+docs generation).
+
+Rules (docs/api/analysis.md for the long-form table):
+
+==========  ================================================================
+APX801      shared mutable attribute accessed outside its guarding
+            lock.  Guard inference: an attribute of a lock-bearing
+            class that is *written* (outside ``__init__``) and
+            accessed at least once inside a ``with self._lock:``
+            region is lock-guarded; any access outside the lock is a
+            finding.  The lock attribute itself is the class's
+            declaration that its state is reached from more than one
+            thread.  Two more entry-point-driven forms: a
+            read-modify-write (``+=``) on a lock-bearing class's
+            attribute outside the lock (increments are never atomic),
+            and an attribute store inside a ``threading.Thread``
+            target function when the same attribute is also stored
+            elsewhere in the module (the shared-counter race the
+            threaded fleet loop shipped with).  A method named
+            ``*_locked`` is analyzed as if every class lock were held
+            — the sanctioned convention for helpers whose contract is
+            "caller holds the lock".
+APX802      lock-acquisition-order cycle: ``with A:`` lexically
+            nesting ``with B:`` (or ``B.acquire()``) records an
+            ordering edge A→B; edges aggregate across *every* scanned
+            module, and any cycle in the graph is a potential
+            deadlock, reported with each edge's provenance.
+APX803      signal handler doing more than flag-set / counter-
+            increment — the repo's stated "flag-only handler"
+            convention, enforced.  Allowed: attribute/name stores,
+            ``Event.set()``, dict ``.get``, chaining to the previous
+            handler (calling a saved callable, ``signal.signal`` +
+            ``os.kill`` re-raise), and calls into same-class methods
+            that are themselves flag-only.  Everything else —
+            telemetry emission, logging, lock acquisition, I/O — is a
+            finding: the handler runs between bytecodes of a thread
+            that may hold any lock in the process.
+APX804      blocking call while holding a lock: ``.join()`` /
+            ``sleep()`` / ``Event.wait()`` / sink ``.emit()`` /
+            monitor ``.event()`` / ``jax.device_get`` /
+            ``.block_until_ready()`` lexically inside a lock region,
+            including reached through a same-class method call (the
+            ``self._alarm()``-under-lock shape).  A lock whose
+            *purpose* is to serialize one file's writes (the
+            crash-safe JSONL sink) stays legal: plain ``.write`` /
+            ``.flush`` on an owned handle are not in the deny set.
+APX805      jit dispatch from a thread-entry function outside a
+            device-pinning context: ``jnp.*`` / ``jax.device_put`` /
+            ``jax.device_get`` / ``.block_until_ready()`` / calling a
+            name bound from ``jax.jit`` inside a
+            ``threading.Thread(target=...)`` function with no
+            enclosing ``with ...device_scope():`` /
+            ``jax.default_device(...)`` — the exact bug class the
+            threaded fleet found by hand when every replica's tick
+            staging transited device 0 and aggregate tokens/s stayed
+            flat.
+==========  ================================================================
+
+Suppression: the linter's inline form
+(``# apex-lint: disable=APX804 -- <reason>``) or the committed
+baseline ``tools/concurrency_baseline.txt`` (same
+``path:RULE:symbol  # reason`` format and the same stale-entry-fails
+semantics as ``tools/analysis_baseline.txt``; committed EMPTY — every
+finding at introduction was fixed).  CI runs
+``python -m apex_tpu.analysis --check-concurrency`` self-hosted.
+
+Import-light on purpose (stdlib ``ast`` only), like :mod:`.linter`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .linter import (Finding, _iter_py, _suppressions, load_baseline,
+                     write_baseline)
+
+__all__ = ["lint_concurrency_source", "lint_concurrency_paths",
+           "run_concurrency_check", "write_concurrency_baseline",
+           "DEFAULT_BASELINE", "LockEdge"]
+
+DEFAULT_BASELINE = "tools/concurrency_baseline.txt"
+
+#: constructors whose result is a mutual-exclusion object — assigning
+#: one to ``self.X`` (or a module-level name) declares a lock
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: with-context callables that pin device placement for the enclosed
+#: block (APX805's sanctioned shapes)
+_PIN_CONTEXTS = {"device_scope", "default_device"}
+
+#: call tails that block (or do unbounded work) — illegal while a lock
+#: is held.  ``.write``/``.flush``/``.close`` on an owned handle are
+#: deliberately absent: a lock whose purpose is to serialize one
+#: file's appends (JsonlSink) is the repo's stated sink discipline.
+_BLOCKING_TAILS = {"join", "sleep", "wait", "emit", "event",
+                   "device_get", "block_until_ready"}
+
+#: calls a flag-only signal handler may make (APX803): Event.set /
+#: is_set, dict .get, the chain-to-previous-handler idiom
+#: (``signal.signal`` + ``os.kill`` + calling the saved handler),
+#: and cheap pure conversions
+_HANDLER_ALLOWED_TAILS = {"set", "is_set", "get", "signal", "kill",
+                          "getpid", "Signals", "str", "int",
+                          "callable"}
+
+#: dotted jax calls that dispatch device work (APX805 signals beyond
+#: the ``jnp`` root and jitted names)
+_DISPATCH_TAILS = {"device_put", "device_get", "block_until_ready"}
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_lock_factory(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and _tail(value.func) in _LOCK_FACTORIES)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockEdge:
+    """One observed acquisition ordering: ``held`` was locked when
+    ``acquired`` was taken.  ``path``/``line`` is the inner
+    acquisition site (the provenance a cycle report prints)."""
+
+    held: str
+    acquired: str
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    store: bool          # Assign/AugAssign target vs plain read
+    aug: bool            # read-modify-write
+    locks: Tuple[str, ...]   # class-lock attrs held (lexically)
+    func: str
+    line: int
+    col: int
+
+
+class _ClassModel:
+    """Everything APX801/803/804 need about one class."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: Set[str] = set()
+        self.accesses: List[_Access] = []
+        # func name -> same-class methods it calls via self.X(...)
+        self.self_calls: Dict[str, Set[str]] = {}
+        # func name -> direct blocking-call sites (tail, line, col)
+        self.blocking: Dict[str, List[Tuple[str, int, int]]] = {}
+        # calls made while >=1 lock held:
+        # (held locks, callee node, enclosing func, receiver lock key)
+        self.locked_calls: List[Tuple[Tuple[str, ...], ast.Call,
+                                      str, Optional[str]]] = []
+        self.methods: Dict[str, ast.AST] = {}
+
+    def transitively_blocking(self) -> Dict[str, Tuple[str, int]]:
+        """func -> (blocking tail, line) for every method that
+        performs a blocking call directly or through same-class
+        calls — the interprocedural half of APX804."""
+        out: Dict[str, Tuple[str, int]] = {
+            f: (sites[0][0], sites[0][1])
+            for f, sites in self.blocking.items() if sites}
+        changed = True
+        while changed:
+            changed = False
+            for f, callees in self.self_calls.items():
+                if f in out:
+                    continue
+                for c in callees:
+                    if c in out:
+                        out[f] = out[c]
+                        changed = True
+                        break
+        return out
+
+
+def _module_lock_names(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to ``threading.Lock()``-class
+    constructors — the pre-scan that lets another module's
+    ``from .mod import LOCK`` resolve to the same qualified key."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and _is_lock_factory(
+                stmt.value):
+            out.update(t.id for t in stmt.targets
+                       if isinstance(t, ast.Name))
+    return out
+
+
+class _ModuleModel(ast.NodeVisitor):
+    """One file's concurrency facts, collected in a single walk."""
+
+    def __init__(self, path: str,
+                 locks_by_stem: Optional[Dict[str, Set[str]]] = None):
+        self.path = path
+        self._locks_by_stem = locks_by_stem or {}
+        # imported module-level locks: local alias -> qualified key
+        self._external: Dict[str, str] = {}
+        self.module_locks: Set[str] = set()
+        self.classes: List[_ClassModel] = []
+        self.edges: List[LockEdge] = []
+        # handler expr nodes registered via signal.signal(sig, X),
+        # paired with the class (if any) enclosing the registration
+        self.handlers: List[Tuple[ast.AST, Optional[_ClassModel]]] = []
+        # thread-target references: Name/Attribute nodes passed as
+        # Thread(target=...), paired with the enclosing class
+        self.thread_targets: List[Tuple[ast.AST,
+                                        Optional[_ClassModel]]] = []
+        # names bound (module scope or any function) from jax.jit(...)
+        self.jitted_names: Set[str] = set()
+        # every function def by name (module-wide; last wins) — used
+        # to resolve thread targets and handler Names
+        self.functions: Dict[str, ast.AST] = {}
+        self.n_lock_regions = 0
+        self._aug_targets: Set[int] = set()
+
+    # -- collection ----------------------------------------------------------
+
+    def build(self, tree: ast.Module) -> "_ModuleModel":
+        self.module_locks = _module_lock_names(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                stem = (node.module or "").split(".")[-1]
+                for alias in node.names:
+                    if alias.name in self._locks_by_stem.get(
+                            stem, ()):
+                        self._external[alias.asname or alias.name] = \
+                            f"{stem}.{alias.name}"
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            if isinstance(node, ast.Assign):
+                v = node.value
+                jit_like = (isinstance(v, ast.Call)
+                            and (_tail(v.func) == "jit"
+                                 or (_tail(v.func) == "partial"
+                                     and any(_tail(a) == "jit"
+                                             for a in v.args))))
+                if jit_like:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.jitted_names.add(t.id)
+            if isinstance(node, ast.Call):
+                self._scan_call(node)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+        # module-level lock regions (edges + locked calls live on a
+        # synthetic "module" class so APX802/804 cover them too)
+        mod_cls = _ClassModel(f"<module:{Path(self.path).stem}>")
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(stmt, mod_cls, stmt.name, ())
+        if mod_cls.locked_calls or mod_cls.blocking:
+            self.classes.append(mod_cls)
+        return self
+
+    def _scan_call(self, node: ast.Call) -> None:
+        """Thread targets and signal-handler registrations, wherever
+        they occur."""
+        if _tail(node.func) == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self.thread_targets.append((kw.value, None))
+        if (_tail(node.func) == "signal"
+                and _root(node.func) in ("signal", "_signal")
+                and len(node.args) >= 2):
+            self.handlers.append((node.args[1], None))
+
+    def _enclosing_fixups(self, tree_cls: ast.ClassDef,
+                          model: _ClassModel) -> None:
+        """Re-attribute thread targets / handlers registered inside
+        this class's methods to the class, so ``self.X`` references
+        resolve."""
+        inside = {id(n) for n in ast.walk(tree_cls)}
+        self.thread_targets = [
+            (ref, model if id(ref) in inside else cls)
+            for ref, cls in self.thread_targets]
+        self.handlers = [
+            (ref, model if id(ref) in inside else cls)
+            for ref, cls in self.handlers]
+
+    def _scan_class(self, cls: ast.ClassDef) -> None:
+        model = _ClassModel(cls.name)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_factory(
+                    node.value):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        model.locks.add(t.attr)
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[stmt.name] = stmt
+                self._walk(stmt, model, stmt.name, ())
+        self.classes.append(model)
+        self._enclosing_fixups(cls, model)
+
+    # -- the lexical region walk --------------------------------------------
+
+    def _lock_key(self, expr: ast.AST,
+                  model: _ClassModel) -> Optional[str]:
+        """Qualified name of a lock acquired by ``with expr:`` /
+        ``expr.acquire()`` — ``Class.attr`` for self locks,
+        ``<stem>.name`` for module-level ones."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in model.locks):
+            return f"{model.name}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return f"{Path(self.path).stem}.{expr.id}"
+            if expr.id in self._external:
+                return self._external[expr.id]
+        return None
+
+    def _walk(self, node: ast.AST, model: _ClassModel, func: str,
+              held: Tuple[str, ...]) -> None:
+        """Recursive lexical walk tracking held locks (node-dispatch:
+        every node is recorded exactly once).  Descending into a
+        nested function def resets ``held`` — a closure's body does
+        not run at definition time.  A method named ``*_locked`` is
+        walked as if every class lock were held: the sanctioned
+        naming convention for helpers whose contract is "caller holds
+        the lock" (the lexical analysis cannot see the caller's
+        ``with``)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            name = getattr(node, "name", func)
+            inner_held: Tuple[str, ...] = ()
+            if name.endswith("_locked") and model.locks:
+                inner_held = tuple(f"{model.name}.{lk}"
+                                   for lk in sorted(model.locks))
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, model, name, inner_held)
+            return
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                # the context exprs themselves evaluate under the
+                # OUTER lock set
+                self._walk(item.context_expr, model, func, held)
+                key = self._lock_key(item.context_expr, model)
+                if key is not None:
+                    acquired.append((key, item.context_expr))
+            for i, (key, expr) in enumerate(acquired):
+                for h in held + tuple(k for k, _ in acquired[:i]):
+                    self.edges.append(LockEdge(
+                        held=h, acquired=key, path=self.path,
+                        line=expr.lineno))
+            if acquired:
+                self.n_lock_regions += 1
+            new_held = held + tuple(k for k, _ in acquired)
+            for s in node.body:
+                self._walk(s, model, func, new_held)
+            return
+        self._record_node(node, model, func, held)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, model, func, held)
+
+    def _record_node(self, n: ast.AST, model: _ClassModel, func: str,
+                     held: Tuple[str, ...]) -> None:
+        """Record ONE node's concurrency facts (the walk visits every
+        node exactly once)."""
+        class_held = tuple(k for k in held
+                           if k.startswith(model.name + "."))
+        if (isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"):
+            aug = id(n) in self._aug_targets
+            model.accesses.append(_Access(
+                attr=n.attr,
+                store=aug or isinstance(n.ctx, (ast.Store, ast.Del)),
+                aug=aug, locks=class_held, func=func, line=n.lineno,
+                col=n.col_offset))
+        if isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Attribute):
+            self._aug_targets.add(id(n.target))
+        if isinstance(n, ast.Call):
+            tail = _tail(n.func)
+            receiver_lock = None
+            if isinstance(n.func, ast.Attribute):
+                receiver_lock = self._lock_key(n.func.value, model)
+            if tail == "acquire" and receiver_lock is not None:
+                for h in held:
+                    self.edges.append(LockEdge(
+                        held=h, acquired=receiver_lock,
+                        path=self.path, line=n.lineno))
+            str_join = (tail == "join"
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Constant))
+            if tail in _BLOCKING_TAILS and not str_join and not (
+                    tail == "wait" and receiver_lock is not None):
+                # exempt: str.join on a literal separator, and
+                # Condition.wait on the held condition (it releases
+                # the lock — the canonical CV idiom)
+                model.blocking.setdefault(func, []).append(
+                    (tail, n.lineno, n.col_offset))
+            if (isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self"):
+                model.self_calls.setdefault(func, set()).add(
+                    n.func.attr)
+            if held:
+                model.locked_calls.append((held, n, func,
+                                           receiver_lock))
+
+
+# ---------------------------------------------------------------------------
+# rule passes over a built model
+# ---------------------------------------------------------------------------
+
+_INIT_EXEMPT = {"__init__", "__post_init__", "__new__", "__enter__"}
+
+
+def _apx801_class(model: _ClassModel, emit) -> None:
+    if not model.locks:
+        return
+    written = {a.attr for a in model.accesses
+               if a.store and a.func not in _INIT_EXEMPT}
+    guarded = {a.attr for a in model.accesses
+               if a.locks and a.attr in written}
+    for a in model.accesses:
+        if a.func in _INIT_EXEMPT:
+            continue
+        if a.attr in guarded and not a.locks:
+            kind = "written" if a.store else "read"
+            emit(a.line, a.col, "APX801",
+                 f"{model.name}.{a.attr} is lock-guarded (accessed "
+                 f"under a '{model.name}' lock elsewhere) but {kind} "
+                 f"in '{a.func}' with no lock held — take the lock "
+                 f"or move the access inside an existing region",
+                 f"{model.name}.{a.attr}@{a.func}")
+        elif a.aug and not a.locks and a.attr not in guarded:
+            emit(a.line, a.col, "APX801",
+                 f"read-modify-write '{model.name}.{a.attr} += ...' "
+                 f"in '{a.func}' of a lock-bearing class outside any "
+                 f"lock — increments are not atomic across threads",
+                 f"{model.name}.{a.attr}@{a.func}+=")
+
+
+def _attr_store_targets(n: ast.AST) -> List[ast.Attribute]:
+    if isinstance(n, ast.Assign):
+        return [t for t in n.targets if isinstance(t, ast.Attribute)]
+    if isinstance(n, ast.AugAssign) and isinstance(n.target,
+                                                   ast.Attribute):
+        return [n.target]
+    return []
+
+
+def _apx801_thread_writes(mod: _ModuleModel, tree: ast.Module,
+                          emit) -> None:
+    """Attribute stores inside a thread-target function racing with
+    stores to the same attribute elsewhere in the module."""
+    targets = _resolve_thread_targets(mod)
+    if not targets:
+        return
+    target_ids = {id(n) for fn in targets for n in ast.walk(fn)}
+    outside_attrs = {t.attr for n in ast.walk(tree)
+                     if id(n) not in target_ids
+                     for t in _attr_store_targets(n)}
+    for fn in targets:
+        fname = getattr(fn, "name", "<lambda>")
+        for n in ast.walk(fn):
+            for t in _attr_store_targets(n):
+                if t.attr in outside_attrs \
+                        and not _under_lock_with(fn, n):
+                    emit(n.lineno, n.col_offset, "APX801",
+                         f"thread target '{fname}' stores attribute "
+                         f"'.{t.attr}' that is also stored outside "
+                         f"it — a cross-thread shared write with no "
+                         f"lock; collect per-thread results and "
+                         f"aggregate after join(), or guard both "
+                         f"sides",
+                         f"thread.{fname}.{t.attr}")
+
+
+def _under_lock_with(fn: ast.AST, node: ast.AST) -> bool:
+    """Is ``node`` lexically inside any ``with`` whose context looks
+    like a lock (named *lock*) within ``fn``?  Cheap containment probe
+    for the thread-write rule only."""
+    for w in ast.walk(fn):
+        if isinstance(w, ast.With):
+            looks_locked = any(
+                (t := _tail(i.context_expr)) and "lock" in t.lower()
+                for i in w.items)
+            if looks_locked and any(n is node
+                                    for n in ast.walk(w)):
+                return True
+    return False
+
+
+def _resolve_thread_targets(mod: _ModuleModel) -> List[ast.AST]:
+    out = []
+    for ref, cls in mod.thread_targets:
+        fn = None
+        if isinstance(ref, ast.Name):
+            fn = mod.functions.get(ref.id)
+        elif (isinstance(ref, ast.Attribute)
+              and isinstance(ref.value, ast.Name)
+              and ref.value.id == "self" and cls is not None):
+            fn = cls.methods.get(ref.attr)
+        elif isinstance(ref, ast.Lambda):
+            fn = ref
+        if fn is not None:
+            out.append(fn)
+    return out
+
+
+def _apx803(mod: _ModuleModel, emit) -> None:
+    for ref, cls in mod.handlers:
+        fn = None
+        if isinstance(ref, ast.Name):
+            fn = mod.functions.get(ref.id)
+        elif isinstance(ref, ast.Lambda):
+            fn = ref
+        elif (isinstance(ref, ast.Attribute)
+              and isinstance(ref.value, ast.Name)
+              and ref.value.id == "self" and cls is not None):
+            fn = cls.methods.get(ref.attr)
+        if fn is None:
+            continue  # restoring a saved handler / SIG_DFL: not ours
+        _check_handler(fn, cls, emit, seen=set())
+
+
+def _check_handler(fn: ast.AST, cls: Optional[_ClassModel],
+                   emit, seen: Set[str]) -> bool:
+    """Emit APX803 findings for non-flag-only operations; returns
+    True when the body is clean (used for same-class recursion)."""
+    name = getattr(fn, "name", "<lambda>")
+    if name in seen:
+        return True
+    seen.add(name)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    # bare-name calls are legal only for callables the handler itself
+    # bound (the saved-previous-handler chain idiom: `prev =
+    # self._prev.get(signum); prev(...)`) — a bare `print(...)` or
+    # `open(...)` is not a chain
+    local_names: Set[str] = set()
+    for top in body:
+        for n in ast.walk(top):
+            if isinstance(n, ast.Assign):
+                local_names.update(t.id for t in n.targets
+                                   if isinstance(t, ast.Name))
+    clean = True
+    for top in body:
+        for n in ast.walk(top):
+            if isinstance(n, ast.With):
+                clean = False
+                emit(n.lineno, n.col_offset, "APX803",
+                     f"signal handler '{name}' enters a context "
+                     f"manager — a handler interrupting the lock's "
+                     f"holder deadlocks; set a flag and act at the "
+                     f"next safe boundary",
+                     f"handler.{name}.with")
+            if not isinstance(n, ast.Call):
+                continue
+            tail = _tail(n.func)
+            if tail in _HANDLER_ALLOWED_TAILS:
+                continue
+            if isinstance(n.func, ast.Name) \
+                    and n.func.id in local_names:
+                # calling a saved previous handler (a callable the
+                # handler bound locally) — the chain idiom
+                continue
+            if (isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self" and cls is not None
+                    and n.func.attr in cls.methods):
+                sub = cls.methods[n.func.attr]
+                if _check_handler(sub, cls, _swallow, set(seen)):
+                    continue
+                clean = False
+                emit(n.lineno, n.col_offset, "APX803",
+                     f"signal handler '{name}' calls "
+                     f"self.{n.func.attr}() which is not flag-only",
+                     f"handler.{name}.{n.func.attr}")
+                continue
+            clean = False
+            emit(n.lineno, n.col_offset, "APX803",
+                 f"signal handler '{name}' calls "
+                 f"'{_dotted(n.func)}' — more than flag-set/"
+                 f"counter-increment (no telemetry, logging, locks, "
+                 f"or I/O from a handler; it runs between bytecodes "
+                 f"of a thread that may hold any lock)",
+                 f"handler.{name}.{tail or 'call'}")
+    return clean
+
+
+def _swallow(*_a, **_k) -> None:
+    """No-op emit used when probing whether a callee is flag-only."""
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)) or "?"
+
+
+def _apx804(model: _ClassModel, emit) -> None:
+    transitive = model.transitively_blocking()
+    for held, call, func, receiver_lock in model.locked_calls:
+        tail = _tail(call.func)
+        if tail == "wait" and receiver_lock in held:
+            continue  # Condition.wait on the held lock releases it
+        if (tail == "join" and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Constant)):
+            continue  # str.join on a literal separator
+        if tail in _BLOCKING_TAILS:
+            emit(call.lineno, call.col_offset, "APX804",
+                 f"blocking call '.{tail}()' in '{func}' while "
+                 f"holding {list(held)} — emit/join/sleep after "
+                 f"releasing the lock (collect under the lock, act "
+                 f"outside)",
+                 f"{model.name}.{func}.{tail}")
+        elif (isinstance(call.func, ast.Attribute)
+              and isinstance(call.func.value, ast.Name)
+              and call.func.value.id == "self"
+              and call.func.attr in transitive
+              and call.func.attr not in _INIT_EXEMPT):
+            btail, bline = transitive[call.func.attr]
+            emit(call.lineno, call.col_offset, "APX804",
+                 f"'{func}' calls self.{call.func.attr}() while "
+                 f"holding {list(held)}, which reaches blocking "
+                 f"'.{btail}()' (line {bline}) — restructure so the "
+                 f"blocking work happens outside the lock",
+                 f"{model.name}.{func}.{call.func.attr}")
+
+
+def _apx805(mod: _ModuleModel, emit) -> None:
+    for fn in _resolve_thread_targets(mod):
+        fname = getattr(fn, "name", "<lambda>")
+        _walk_dispatch(fn, fname, mod, emit, pinned=False)
+
+
+def _walk_dispatch(node: ast.AST, fname: str, mod: _ModuleModel,
+                   emit, pinned: bool) -> None:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.With):
+            now_pinned = pinned or any(
+                isinstance(i.context_expr, ast.Call)
+                and _tail(i.context_expr.func) in _PIN_CONTEXTS
+                for i in child.items)
+            for s in child.body:
+                _walk_dispatch(s, fname, mod, emit, now_pinned)
+            continue
+        if isinstance(child, ast.Call) and not pinned:
+            root = _root(child.func)
+            tail = _tail(child.func)
+            dispatches = (root == "jnp"
+                          or (root == "jax" and tail in _DISPATCH_TAILS)
+                          or tail == "block_until_ready"
+                          or (isinstance(child.func, ast.Name)
+                              and child.func.id in mod.jitted_names))
+            if dispatches:
+                emit(child.lineno, child.col_offset, "APX805",
+                     f"thread target '{fname}' dispatches device "
+                     f"work ('{_dotted(child.func)}') outside a "
+                     f"device-pinning context — off the main thread "
+                     f"this lands on the process default device "
+                     f"(device 0 serializes the fleet); wrap the "
+                     f"tick in 'with replica.device_scope():' or "
+                     f"'jax.default_device(dev)'",
+                     f"thread.{fname}.{tail or 'dispatch'}")
+        _walk_dispatch(child, fname, mod, emit, pinned)
+
+
+# ---------------------------------------------------------------------------
+# cycle detection over aggregated lock edges
+# ---------------------------------------------------------------------------
+
+def _find_cycles(edges: Sequence[LockEdge]
+                 ) -> List[List[LockEdge]]:
+    """Simple cycles in the acquisition-order graph, deduplicated by
+    canonical rotation.  Graphs here are tiny (a handful of locks), so
+    a DFS with an explicit path is plenty."""
+    adj: Dict[str, Dict[str, LockEdge]] = {}
+    for e in edges:
+        if e.held == e.acquired:
+            continue  # re-entrant self-acquire: RLock territory
+        adj.setdefault(e.held, {}).setdefault(e.acquired, e)
+    cycles: Dict[Tuple[str, ...], List[LockEdge]] = {}
+
+    def dfs(start: str, node: str, path: List[str],
+            trail: List[LockEdge]) -> None:
+        for nxt, edge in sorted(adj.get(node, {}).items()):
+            if nxt == start and trail:
+                cyc = trail + [edge]
+                names = [c.held for c in cyc]
+                k = min(range(len(names)), key=lambda i: names[i])
+                key = tuple(names[k:] + names[:k])
+                cycles.setdefault(key, cyc[k:] + cyc[:k])
+            elif nxt not in path:
+                dfs(start, nxt, path + [nxt], trail + [edge])
+
+    for n in sorted(adj):
+        dfs(n, n, [n], [])
+    return [cycles[k] for k in sorted(cycles)]
+
+
+def _cycle_findings(edges: Sequence[LockEdge],
+                    suppressed: Dict[str, Dict[int, Set[str]]]
+                    ) -> List[Finding]:
+    out = []
+    for cyc in _find_cycles(edges):
+        anchor = cyc[0]
+        order = " -> ".join([c.held for c in cyc] + [cyc[0].held])
+        prov = "; ".join(
+            f"{c.held} then {c.acquired} at {c.path}:{c.line}"
+            for c in cyc)
+        if "APX802" in suppressed.get(anchor.path, {}).get(
+                anchor.line, ()):
+            continue
+        out.append(Finding(
+            path=anchor.path, line=anchor.line, col=0, rule="APX802",
+            severity="error",
+            message=f"lock-acquisition-order cycle {order} — two "
+                    f"threads taking these locks in their observed "
+                    f"orders deadlock ({prov}); pick one global "
+                    f"order or release before acquiring",
+            symbol="cycle:" + "->".join(c.held for c in cyc)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def _analyze_source(source: str, path: str,
+                    locks_by_stem: Optional[Dict[str,
+                                                 Set[str]]] = None,
+                    tree: Optional[ast.Module] = None
+                    ) -> Tuple[List[Finding], List[LockEdge],
+                               Dict[int, Set[str]], int]:
+    try:
+        tree = ast.parse(source) if tree is None else tree
+    except SyntaxError as e:
+        return ([Finding(path=path, line=e.lineno or 0,
+                         col=e.offset or 0, rule="APX000",
+                         severity="error",
+                         message=f"syntax error: {e.msg}",
+                         symbol="syntax")], [], {}, 0)
+    # reasoned inline suppressions (the APX900 malformed-suppression
+    # finding stays the main linter's — one owner per rule)
+    suppressed, _ = _suppressions(source, path)
+    findings: List[Finding] = []
+
+    def emit(line: int, col: int, rule: str, message: str,
+             symbol: str) -> None:
+        if rule in suppressed.get(line, ()):
+            return
+        findings.append(Finding(path=path, line=line, col=col,
+                                rule=rule, severity="error",
+                                message=message, symbol=symbol))
+
+    mod = _ModuleModel(path, locks_by_stem).build(tree)
+    for cls in mod.classes:
+        _apx801_class(cls, emit)
+        _apx804(cls, emit)
+    _apx801_thread_writes(mod, tree, emit)
+    _apx803(mod, emit)
+    _apx805(mod, emit)
+    return findings, mod.edges, suppressed, mod.n_lock_regions
+
+
+def lint_concurrency_source(source: str, path: str) -> List[Finding]:
+    """Lint one file, including lock-order cycles visible within it."""
+    findings, edges, suppressed, _ = _analyze_source(source, path)
+    findings.extend(_cycle_findings(edges, {path: suppressed}))
+    return findings
+
+
+def lint_concurrency_paths(package_root: str = "apex_tpu", *,
+                           repo_root: str = "."
+                           ) -> Tuple[List[Finding], int]:
+    """Audit every ``.py`` under ``package_root``; lock-order edges
+    aggregate across files before cycle detection (a deadlock needs
+    no single file to show both orders).  Returns ``(findings,
+    lock_region_count)``."""
+    repo = Path(repo_root).resolve()
+    findings: List[Finding] = []
+    edges: List[LockEdge] = []
+    suppress_maps: Dict[str, Dict[int, Set[str]]] = {}
+    regions = 0
+    sources: List[Tuple[str, str, Optional[ast.Module]]] = []
+    locks_by_stem: Dict[str, Set[str]] = {}
+    for p in _iter_py(repo / package_root):
+        rel = p.relative_to(repo).as_posix()
+        text = p.read_text()
+        try:
+            tree: Optional[ast.Module] = ast.parse(text)
+        except SyntaxError:
+            tree = None  # the per-file pass reports APX000
+        sources.append((rel, text, tree))
+        if tree is not None:
+            names = _module_lock_names(tree)
+            if names:
+                locks_by_stem.setdefault(p.stem, set()).update(names)
+    for rel, text, tree in sources:
+        f, e, s, n = _analyze_source(text, rel, locks_by_stem,
+                                     tree=tree)
+        findings.extend(f)
+        edges.extend(e)
+        suppress_maps[rel] = s
+        regions += n
+    findings.extend(_cycle_findings(edges, suppress_maps))
+    return findings, regions
+
+
+def run_concurrency_check(package_root: str = "apex_tpu", *,
+                          baseline: str = DEFAULT_BASELINE,
+                          repo_root: str = "."
+                          ) -> Tuple[List[Finding], List[str], int]:
+    """(unsuppressed findings, stale baseline keys, lock regions) —
+    the ``--check-concurrency`` body, with the linter baseline's
+    semantics: a baseline entry whose finding no longer fires is
+    stale and fails until deleted (baselines only shrink)."""
+    findings, regions = lint_concurrency_paths(package_root,
+                                               repo_root=repo_root)
+    base = load_baseline(baseline, repo_root=repo_root)
+    live = {f.key for f in findings}
+    unsuppressed = [f for f in findings if f.key not in base]
+    stale = [k for k in base if k not in live]
+    return unsuppressed, stale, regions
+
+
+_CONC_BASELINE_HEADER = (
+    "# apex_tpu.analysis.concurrency baseline — APX8xx findings",
+    "# accepted with a reason.  New findings do NOT belong here:",
+    "# fix them or suppress inline with '# apex-lint: disable=...'.",
+    "# Committed EMPTY: every finding at introduction was fixed.",
+    "# Format: <path>:<rule>:<symbol>  # <reason>",
+)
+
+
+def write_concurrency_baseline(findings: Sequence[Finding],
+                               path: str = DEFAULT_BASELINE, *,
+                               repo_root: str = ".") -> None:
+    write_baseline(findings, path, repo_root=repo_root,
+                   header=_CONC_BASELINE_HEADER)

@@ -445,6 +445,15 @@ register_flag(
     "step count costs nothing, and the rule exists for param/state/"
     "activation buffers whose 1/N sharding IS the memory plan.", lo=0)
 register_flag(
+    "APEX_TPU_SCHED_SEEDS", "int", 5,
+    "Seed count for the deterministic-schedule fleet stress harness "
+    "(python -m apex_tpu.analysis.schedule, ci.sh step 14): each "
+    "seed serves the same request trace on the threaded fleet under "
+    "a different reproducible thread interleaving; the terminal "
+    "fleet digest must be identical across all of them, with zero "
+    "lost requests and zero uncaught background-thread exceptions.",
+    lo=1, hi=64)
+register_flag(
     "APEX_TPU_FULL", "bool", False,
     "CI switch: run the full (slow-inclusive) test tier in "
     "tools/ci.sh.")

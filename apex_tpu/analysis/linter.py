@@ -636,17 +636,24 @@ def load_baseline(path: str = DEFAULT_BASELINE, *,
     return out
 
 
+_BASELINE_HEADER = (
+    "# apex_tpu.analysis baseline — pre-existing findings accepted",
+    "# with a reason.  New findings do NOT belong here by default:",
+    "# fix them or suppress inline with '# apex-lint: disable=...'.",
+    "# Format: <path>:<rule>:<symbol>  # <reason>",
+)
+
+
 def write_baseline(findings: Sequence[Finding],
                    path: str = DEFAULT_BASELINE, *,
-                   repo_root: str = ".") -> None:
+                   repo_root: str = ".",
+                   header: Sequence[str] = _BASELINE_HEADER) -> None:
+    """Serialize a baseline file (one implementation — the
+    concurrency auditor delegates here with its own header/path), with
+    curated reasons for already-listed keys preserved."""
     p = Path(repo_root) / path
     existing = load_baseline(path, repo_root=repo_root)
-    lines = [
-        "# apex_tpu.analysis baseline — pre-existing findings accepted",
-        "# with a reason.  New findings do NOT belong here by default:",
-        "# fix them or suppress inline with '# apex-lint: disable=...'.",
-        "# Format: <path>:<rule>:<symbol>  # <reason>",
-    ]
+    lines = list(header)
     for key in sorted(set(fi.key for fi in findings)):
         reason = existing.get(key) or "accepted pre-existing finding"
         lines.append(f"{key}  # {reason}")

@@ -22,6 +22,10 @@ Layers:
   partitioner's actual output (propagated shardings, per-device
   memory, the collective schedule) against each entry's
   :class:`apex_tpu.mesh_plan.MeshPlan` contract.
+* ``concurrency`` — the host-concurrency auditor
+  (:mod:`.concurrency`): lock discipline, lock-order cycles,
+  signal-handler safety, blocking-under-lock, and off-main-thread
+  device dispatch over the threaded serving/monitor host layer.
 
 Import-light on purpose (stdlib only), like :mod:`.flags`.
 """
@@ -47,7 +51,8 @@ RULES: Dict[str, Rule] = {}
 
 
 def register_rule(id: str, layer: str, scope: str, doc: str) -> Rule:
-    if layer not in ("source", "kernel", "compiled", "sharding"):
+    if layer not in ("source", "kernel", "compiled", "sharding",
+                     "concurrency"):
         raise ValueError(f"unknown rule layer {layer!r}")
     if id in RULES:
         raise ValueError(f"duplicate rule registration: {id}")
@@ -172,6 +177,44 @@ register_rule(
     "executable (arguments+outputs+temps−aliased, per device) exceeds "
     "the committed tools/sharding_baseline.json row by >10% (shrinks "
     "fail too — refresh the baseline)")
+register_rule(
+    "APX801", "concurrency", "host threading",
+    "shared mutable attribute accessed outside its guarding lock: an "
+    "attribute of a lock-bearing class that is written and accessed "
+    "under `with self._lock:` elsewhere (guard inference) but "
+    "read/written lock-free; a `+=` read-modify-write outside the "
+    "lock; or an attribute store inside a `threading.Thread` target "
+    "racing a store to the same attribute elsewhere in the module")
+register_rule(
+    "APX802", "concurrency", "host threading (cross-module)",
+    "lock-acquisition-order cycle: `with A:` nesting `with B:` "
+    "records an A→B edge, edges aggregate across every scanned "
+    "module, and any cycle is a potential deadlock — reported with "
+    "each edge's file:line provenance")
+register_rule(
+    "APX803", "concurrency", "signal handlers",
+    "signal handler doing more than flag-set / counter-increment — "
+    "the flag-only-handler convention enforced: no telemetry, "
+    "logging, locks, or I/O from a handler (it runs between "
+    "bytecodes of a thread that may hold any lock); chaining to the "
+    "previous handler and calls into same-class flag-only methods "
+    "stay legal")
+register_rule(
+    "APX804", "concurrency", "host threading",
+    "blocking call while holding a lock: `.join()` / `sleep()` / "
+    "`Event.wait()` / sink `.emit()` / monitor `.event()` / "
+    "`jax.device_get` / `.block_until_ready()` inside a lock region, "
+    "including reached through a same-class method call — collect "
+    "under the lock, emit/block after releasing it "
+    "(`Condition.wait` on the held lock is exempt: it releases)")
+register_rule(
+    "APX805", "concurrency", "thread targets",
+    "jit dispatch from a `threading.Thread` target outside a "
+    "device-pinning context (`with replica.device_scope():` / "
+    "`jax.default_device(...)`): off the main thread the staging "
+    "lands on the process default device and every replica's tick "
+    "transits device 0's stream — aggregate fleet throughput stays "
+    "flat")
 register_rule(
     "APX900", "source", "everywhere",
     "suppression comment without a reason")

@@ -274,6 +274,114 @@ class WriterSink(Sink):
                                0 if event.step is None else event.step)
 
 
+class BackgroundThreadError(RuntimeError):
+    """A background thread died with an uncaught exception — surfaced
+    by :class:`ThreadExceptionCapture` instead of vanishing into
+    stderr."""
+
+
+class ThreadExceptionCapture:
+    """``threading.excepthook`` wiring: an uncaught exception in a
+    background thread becomes a terminal ``run_error`` monitor event
+    and a raisable failure, instead of a traceback on stderr and a
+    silently dead thread (the default — a crashed watchdog heartbeat
+    or fleet replica thread used to leave no machine-readable record
+    and fail no test).
+
+    ``target`` is anything with either the ``StepMonitor.event``
+    signature or the :class:`Sink` ``emit`` one (or ``None``: record
+    only — the conftest fixture reads ``failures`` at teardown).  The
+    hook appends one record per crash (a single list append — no
+    torn state to lock) and, with ``chain=True`` (the default),
+    chains to the previously installed hook so the stderr traceback
+    is not lost (``chain=False`` swallows it — for tests that crash
+    threads on purpose and assert on the capture).  ``raise_first()``
+    re-raises the first crash wrapped in
+    :class:`BackgroundThreadError`; call it after join/teardown so a
+    run whose main loop succeeded still fails when a thread it owned
+    died.
+    """
+
+    def __init__(self, target: Any = None, *, clock=time.time,
+                 chain: bool = True,
+                 attrs: Optional[Dict[str, Any]] = None):
+        self._target = target
+        self._clock = clock
+        self._chain = bool(chain)
+        # merged into every emitted run_error's attrs — e.g. the
+        # fleet driver stamps replica="fleet" so a crash logged
+        # through one replica's sink is not misattributed to it
+        self._attrs = dict(attrs or {})
+        self._prev = None
+        self._installed = False
+        self.failures: List[Dict[str, Any]] = []
+
+    def install(self) -> "ThreadExceptionCapture":
+        if self._installed:
+            return self
+        self._prev = threading.excepthook
+        threading.excepthook = self._hook
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        threading.excepthook = self._prev
+        self._prev = None
+        self._installed = False
+
+    def _hook(self, args) -> None:
+        record = {
+            "thread": getattr(args.thread, "name", None) or "?",
+            "error": getattr(args.exc_type, "__name__",
+                             str(args.exc_type)),
+            "message": str(args.exc_value)[:200],
+            "background": True,
+            "exception": args.exc_value,
+        }
+        self.failures.append(record)
+        try:
+            self._emit(record)
+        except Exception:  # apex-lint: disable=APX202 -- the hook runs on a dying thread; a sink failure here must not mask the original crash (recorded above)
+            pass
+        if self._chain:
+            prev = self._prev or threading.__excepthook__
+            prev(args)
+
+    def _emit(self, record: Dict[str, Any]) -> None:
+        t = self._target
+        if t is None:
+            return
+        attrs = {k: v for k, v in record.items() if k != "exception"}
+        attrs.update(self._attrs)
+        ev = getattr(t, "event", None)
+        if callable(ev):
+            ev("run", "run_error", **attrs)
+        else:
+            t.emit(Event(time=self._clock(), step=None, kind="run",
+                         name="run_error", attrs=attrs))
+
+    def raise_first(self) -> None:
+        """Raise :class:`BackgroundThreadError` for the first captured
+        crash (no-op when every thread exited clean)."""
+        if not self.failures:
+            return
+        rec = self.failures[0]
+        raise BackgroundThreadError(
+            f"background thread {rec['thread']!r} died: "
+            f"{rec['error']}: {rec['message']}"
+            + (f" (+{len(self.failures) - 1} more)"
+               if len(self.failures) > 1 else "")
+        ) from rec.get("exception")
+
+    def __enter__(self) -> "ThreadExceptionCapture":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
 class ScalarWriter:
     """The inverse adapter: an ``add_scalar``-style facade over a sink,
     so ``Timers.write(names, writer, iteration)``

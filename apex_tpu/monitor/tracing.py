@@ -195,7 +195,11 @@ class SpanTracer:
         buf, depth = self._thread_buf()
         depth[0] -= 1
         if len(buf) >= self._max_spans:
-            self._dropped += 1
+            # cold path (the buffer is already full): the shared drop
+            # counter takes the lock — += from concurrent threads
+            # loses counts (APX801)
+            with self._lock:
+                self._dropped += 1
             return
         th = threading.current_thread()
         buf.append(Span(
@@ -219,7 +223,8 @@ class SpanTracer:
         times into the same Chrome writer."""
         buf, _ = self._thread_buf()
         if len(buf) >= self._max_spans:
-            self._dropped += 1
+            with self._lock:
+                self._dropped += 1
             return
         th = threading.current_thread()
         buf.append(Span(name=name, t0=float(t0), dur=float(dur),
@@ -266,8 +271,10 @@ class SpanTracer:
         ``spans``, drains the tracer."""
         if spans is None:
             spans = self.drain()
+        with self._lock:
+            dropped = self._dropped
         return _chrome_json([s.chrome_event() for s in spans],
-                            pid=self._pid, dropped=self._dropped)
+                            pid=self._pid, dropped=dropped)
 
     def write_chrome_trace(self, path: str,
                            spans: Optional[List[Span]] = None) -> str:

@@ -59,9 +59,13 @@ class Watchdog:
         # Escalation hook: called with every emitted alarm Event (e.g.
         # apex_tpu.resilience.EscalationPolicy.notify turns alarms into
         # checkpoint-then-abort restarts).  May run on the heartbeat
-        # thread and under the watchdog lock — it must be cheap, must
-        # not call back into the watchdog, and must never raise (a
-        # raise is swallowed: telemetry cannot kill the run).
+        # thread but always OUTSIDE the watchdog lock (alarms are
+        # collected under the lock and emitted after it is released —
+        # sink I/O and hook work must not serialize the observers, and
+        # a hook taking its own lock must not nest inside ours); it
+        # must be cheap, must not call back into the watchdog, and
+        # must never raise (a raise is swallowed: telemetry cannot
+        # kill the run).
         self._on_alarm = on_alarm
         self.overflow_streak = int(overflow_streak)
         self.stall_timeout = float(stall_timeout)
@@ -77,6 +81,9 @@ class Watchdog:
         self._last_progress = clock()
         self._last_step: Optional[int] = None
         self._stall_fired = False
+        self._stall_seq = 0     # bumps when a stall fires: the trace
+        # liveness token (a recovery observed between the stall
+        # decision and the profiler start invalidates the start)
         self._nonfinite_fired = False
         self._overflow_count = 0
         self._overflow_fired = False
@@ -86,6 +93,13 @@ class Watchdog:
         self._stop_evt: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        # serializes the jax.profiler start/stop transitions only
+        # (never held around sink emission): the stall decision is
+        # made under _lock, emission happens outside it (APX804), so
+        # without this a recovery racing the stall could stop a trace
+        # before it started and leak the started one until the next
+        # episode
+        self._trace_lock = threading.Lock()
 
     # -- alarm emission ------------------------------------------------------
 
@@ -112,6 +126,13 @@ class Watchdog:
         this step); ``overflow`` is this step's amp skip flag (``None``
         = no scaler in play).
         """
+        # Episode state flips under the lock; alarm EMISSION (sink
+        # I/O, the escalation hook, the profiler trace teardown)
+        # happens after it is released, in the order the transitions
+        # fired — APX804: a blocking call under the watchdog lock
+        # would serialize the heartbeat thread behind the sink and
+        # nest the hook's own lock inside ours.
+        actions = []
         with self._lock:
             now = self._clock() if now is None else now
             self._last_progress = now
@@ -119,14 +140,15 @@ class Watchdog:
             if self._stall_fired:
                 # episode over: progress resumed
                 self._stall_fired = False
-                self._alarm("stall_recovered", step=step)
-                self._stop_trace()
+                actions.append(("stall_recovered", dict(step=step)))
+                actions.append(("stop_trace", None))
             if loss is not None:
                 if not _finite(loss):
                     if not self._nonfinite_fired:
                         self._nonfinite_fired = True
-                        self._alarm("nonfinite_loss", step=step,
-                                    loss=str(loss))
+                        actions.append(("nonfinite_loss",
+                                        dict(step=step,
+                                             loss=str(loss))))
                 else:
                     self._nonfinite_fired = False
             if overflow is not None:
@@ -137,17 +159,25 @@ class Watchdog:
                     if (self._overflow_count >= self.overflow_streak
                             and not self._overflow_fired):
                         self._overflow_fired = True
-                        self._alarm("overflow_streak", step=step,
-                                    value=self._overflow_count,
-                                    threshold=self.overflow_streak)
+                        actions.append(("overflow_streak",
+                                        dict(step=step,
+                                             value=self._overflow_count,
+                                             threshold=self.
+                                             overflow_streak)))
                 else:
                     self._overflow_count = 0
                     self._overflow_fired = False
+        for name, kw in actions:
+            if name == "stop_trace":
+                self._stop_trace()
+            else:
+                self._alarm(name, **kw)
 
     @property
     def overflow_count(self) -> int:
         """Current consecutive-overflow streak length."""
-        return self._overflow_count
+        with self._lock:
+            return self._overflow_count
 
     # -- stall check ---------------------------------------------------------
 
@@ -161,40 +191,70 @@ class Watchdog:
             if not stalled or self._stall_fired:
                 return False
             self._stall_fired = True
-            self._alarm("stall", value=now - self._last_progress,
-                        step=self._last_step,
-                        timeout_s=self.stall_timeout,
-                        last_step=self._last_step)
-            self._start_trace()
-            return True
+            self._stall_seq += 1
+            seq = self._stall_seq
+            value = now - self._last_progress
+            last_step = self._last_step
+        # emit + trace capture outside the lock (see observe_step);
+        # the _stall_fired latch above guarantees at most one thread
+        # reaches this per episode.  A recovery racing in between can
+        # reorder the stall/stall_recovered emissions (each carries
+        # its own wall time; the pair is always complete) — but the
+        # trace must not leak: _start_trace re-checks episode
+        # liveness (seq) under the trace lock.
+        self._alarm("stall", value=value, step=last_step,
+                    timeout_s=self.stall_timeout,
+                    last_step=last_step)
+        self._start_trace(seq)
+        return True
 
     # -- optional jax.profiler dump of the wedged step -----------------------
 
-    def _start_trace(self) -> None:
-        if not self.trace_dir or self._tracing:
+    def _start_trace(self, seq: int) -> None:
+        """Start the wedged-step profiler trace for stall episode
+        ``seq`` — a no-op when that episode already recovered (the
+        check_stall thread lost the race to observe_step): starting
+        then would leak an open trace until the NEXT recovery.  The
+        trace lock serializes the start/stop transitions; a
+        concurrent ``_stop_trace`` either runs first (liveness check
+        fails, nothing starts) or queues behind and stops what was
+        started."""
+        if not self.trace_dir:
             return
-        try:
-            import jax
+        started = False
+        with self._trace_lock:
+            with self._lock:
+                live = self._stall_fired and seq == self._stall_seq
+            if live and not self._tracing:
+                try:
+                    import jax
 
-            jax.profiler.start_trace(self.trace_dir)
-            self._tracing = True
-            self._alarm("stall_trace_started", trace_dir=self.trace_dir)
-        except Exception as e:  # telemetry must never kill the run
-            logger.warning("stall trace failed to start: %s",
-                           str(e)[:160])
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._tracing = True
+                    started = True
+                except Exception as e:  # telemetry must never kill
+                    logger.warning("stall trace failed to start: %s",
+                                   str(e)[:160])
+        if started:
+            self._alarm("stall_trace_started",
+                        trace_dir=self.trace_dir)
 
     def _stop_trace(self) -> None:
-        if not self._tracing:
-            return
-        try:
-            import jax
+        stopped = False
+        with self._trace_lock:
+            if self._tracing:
+                try:
+                    import jax
 
-            jax.profiler.stop_trace()
-            self._alarm("stall_trace_stopped", trace_dir=self.trace_dir)
-        except Exception as e:
-            logger.warning("stall trace failed to stop: %s",
-                           str(e)[:160])
-        self._tracing = False
+                    jax.profiler.stop_trace()
+                    stopped = True
+                except Exception as e:
+                    logger.warning("stall trace failed to stop: %s",
+                                   str(e)[:160])
+                self._tracing = False
+        if stopped:
+            self._alarm("stall_trace_stopped",
+                        trace_dir=self.trace_dir)
 
     # -- heartbeat thread ----------------------------------------------------
 

@@ -585,8 +585,8 @@ class FleetRouter:
     # --- threaded drive loop ---------------------------------------------
 
     def serve_threaded(self, requests: Sequence[Request], *,
-                       max_restarts: Optional[int] = None
-                       ) -> FleetSummary:
+                       max_restarts: Optional[int] = None,
+                       scheduler=None) -> FleetSummary:
         """One thread per serve replica, each running its engine's own
         ``run()`` (or the supervised :func:`~.resilience.run_serving`
         when the replica carries a journal).  Requests are routed
@@ -594,7 +594,17 @@ class FleetRouter:
         jitted steps release the GIL, so on a multi-core host the
         fleet's aggregate tokens/s scales with replica count (the
         bench's scaling row).  Disaggregation needs the stepped
-        loop's handoff sequencing and is rejected here."""
+        loop's handoff sequencing and is rejected here.
+
+        ``scheduler`` (an :class:`apex_tpu.analysis.schedule.
+        DeterministicScheduler`) gates every replica's tick boundary
+        through a seeded permuted hand-off, serializing the threads
+        in a reproducible interleaving — the race-hunting stress mode
+        (``python -m apex_tpu.analysis.schedule``).  Worker threads
+        write NO shared attributes: each deposits its supervised-run
+        stats in its own slot of ``results`` and the main thread
+        aggregates after ``join()`` (a cross-thread ``self.x += y``
+        is exactly the APX801 lost-update race)."""
         if self.prefill_replicas:
             raise ValueError("disaggregated prefill runs in the "
                              "stepped loop (serve()), not threads")
@@ -611,16 +621,40 @@ class FleetRouter:
                         replica=target.replica_id)
         self._planned = {}
         errors: List[BaseException] = []
+        # one slot per replica id, one writer each; read after join()
+        results: Dict[str, Tuple[int, int]] = {}
+        workers = [r for r in self.serve_replicas
+                   if shares[r.replica_id]]
+        if scheduler is not None:
+            for r in workers:
+                scheduler.expect(r.replica_id)
 
         def worker(r: Replica, share: List[Request]) -> None:
             try:
-                before = None
+                hooks = []
                 if r.fault is not None:
                     jp = r.journal.path if r.journal is not None \
                         else None
+                    hooks.append(lambda tick, _f=r.fault, _jp=jp:
+                                 _f.before_tick(tick,
+                                                journal_path=_jp))
+                if scheduler is not None:
+                    hooks.append(lambda tick, _rid=r.replica_id:
+                                 scheduler.gate(_rid))
+                before = None
+                if hooks:
+                    def before(tick, _hooks=tuple(hooks)):
+                        for h in _hooks:
+                            h(tick)
+                no_retry: tuple = ()
+                if scheduler is not None:
+                    # a starved schedule gate is the HARNESS failing,
+                    # not an engine crash: retrying it as one would
+                    # mask the starvation behind max_restarts journal
+                    # replays (each gating and starving again)
+                    from ..analysis.schedule import ScheduleTimeout
 
-                    def before(tick, _f=r.fault, _jp=jp):
-                        _f.before_tick(tick, journal_path=_jp)
+                    no_retry = (ScheduleTimeout,)
                 with r.device_scope():
                     if r.journal is not None:
                         res = run_serving(
@@ -629,9 +663,10 @@ class FleetRouter:
                                           if max_restarts is not None
                                           else r.max_restarts),
                             monitor=self.monitor,
-                            before_tick=before)
-                        r.restarts += res.restarts
-                        self.replayed += res.replayed
+                            before_tick=before,
+                            no_retry_on=no_retry)
+                        results[r.replica_id] = (res.restarts,
+                                                 res.replayed)
                     else:
                         for req in share:
                             r.engine.submit(req)
@@ -643,17 +678,25 @@ class FleetRouter:
                              r.replica_id, type(e).__name__,
                              str(e)[:160])
                 errors.append(e)
+            finally:
+                if scheduler is not None:
+                    scheduler.finish(r.replica_id)
 
         t0 = self._clock()
         threads = [threading.Thread(
             target=worker, args=(r, shares[r.replica_id]),
             name=f"replica-{r.replica_id}", daemon=True)
-            for r in self.serve_replicas if shares[r.replica_id]]
+            for r in workers]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
         wall = self._clock() - t0
+        for r in self.serve_replicas:
+            got = results.get(r.replica_id)
+            if got is not None:
+                r.restarts += got[0]
+                self.replayed += got[1]
         if errors:
             raise errors[0]
         return self._summary(wall, threaded=True)

@@ -396,7 +396,8 @@ def run_serving(engine, requests, *, journal: RequestJournal,
                 after_tick: Optional[Callable[[int], None]] = None,
                 max_steps: Optional[int] = None,
                 sleep: Callable[[float], None] = time.sleep,
-                rng=None) -> ServeRunResult:
+                rng=None,
+                no_retry_on: tuple = ()) -> ServeRunResult:
     """Supervise one engine's serve with bounded-backoff restarts.
 
     The serving twin of PR-3's :func:`~apex_tpu.resilience.
@@ -457,6 +458,7 @@ def run_serving(engine, requests, *, journal: RequestJournal,
         backoff_max=backoff_max, jitter=jitter,
         sink=sink if sink is not None else monitor,
         sleep=sleep, rng=rng,
+        no_retry_on=no_retry_on,
         autoresume=engine.autoresume)
     warm0 = stats["replay_warm0"]
     hit0 = stats["replay_hit0"]

@@ -1007,6 +1007,7 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
     shorter scan, but :func:`run_scan_windows` AOT-precompiles every
     window length before the first step, so both compiles land in the
     warmup bucket and the budget stays clean for any N)."""
+    from ..monitor.events import ThreadExceptionCapture
     from ..resilience import AutoResume, parse_fault
     from ..utils import CheckpointManager
 
@@ -1016,6 +1017,11 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
     own_autoresume = False
     loss_f = None
     done = 0
+    # threading.excepthook capture: a watchdog-heartbeat (or any
+    # other background) thread dying mid-run becomes a run_error
+    # event at crash time and a raised failure after teardown,
+    # instead of a stderr traceback and a silently dead thread
+    thread_cap = ThreadExceptionCapture(monitor).install()
     try:
         if escalation is not None:
             escalation.reset()  # a fresh attempt re-arms the policy —
@@ -1107,8 +1113,12 @@ def _run_smoke_loop(step_fn, params, amp_opt, amp_state, steps, monitor,
                     if mgr is not None:
                         mgr.close()  # pending async saves become durable
                 finally:
-                    if own_autoresume:
-                        autoresume.uninstall()
+                    try:
+                        if own_autoresume:
+                            autoresume.uninstall()
+                    finally:
+                        thread_cap.uninstall()
+    thread_cap.raise_first()
     if return_state:
         return loss_f, params, amp_state, done
     return loss_f
@@ -1330,6 +1340,9 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         # reject_alloc kind fires inside the engine's admission path)
         def before(tick, _f=fault):
             _f.before_tick(tick, journal_path=journal_path)
+    from ..monitor.events import ThreadExceptionCapture
+
+    thread_cap = ThreadExceptionCapture(monitor).install()
     try:
         with contextlib.ExitStack() as stack:
             san = None
@@ -1394,8 +1407,14 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                     if own_snapshot and snapshot is not None:
                         snapshot.close()
                 finally:
-                    if own_autoresume:
-                        autoresume.uninstall()
+                    try:
+                        if own_autoresume:
+                            autoresume.uninstall()
+                    finally:
+                        thread_cap.uninstall()
+    # a background thread (watchdog heartbeat) that died mid-serve
+    # fails the run after teardown instead of vanishing
+    thread_cap.raise_first()
     if return_engine:
         return summary, engine
     return summary
@@ -1423,7 +1442,7 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                 journal_dir: Optional[str] = None, fault=None,
                 fault_replica: str = "r0", max_restarts: int = 3,
                 stall_timeout: float = 300.0,
-                return_router: bool = False):
+                return_router: bool = False, scheduler=None):
     """Multi-replica serving smoke: N :class:`~apex_tpu.serving.
     ServingEngine` replicas behind the gauge-fed
     :class:`~apex_tpu.serving.FleetRouter` (the ``--serve-fleet``
@@ -1448,6 +1467,13 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     one thread per replica (the aggregate-tokens/s scaling mode);
     the default stepped loop is deterministic and supports
     disaggregation and the mid-serve swap.
+
+    ``scheduler`` (an :class:`apex_tpu.analysis.schedule.
+    DeterministicScheduler`) gates the threaded replicas' tick
+    boundaries in a seeded permuted order — the race-stress mode.
+    A background thread dying mid-serve (``threading.excepthook``)
+    is captured, emitted as a ``run_error`` event, and re-raised
+    after teardown instead of vanishing.
 
     Returns the :class:`~apex_tpu.serving.FleetSummary` (with
     ``return_router=True``, ``(summary, router)``)."""
@@ -1573,8 +1599,17 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                         max_new_tokens=max_new_tokens)
                 for i, p in enumerate(prompts)]
 
+    from ..monitor.events import ThreadExceptionCapture
+
+    # the crash event lands in replica 0's JSONL (the fleet-scope
+    # log); the explicit replica="fleet" attr keeps it from reading
+    # as an r0 failure — the record's `thread` names the real owner
+    thread_cap = ThreadExceptionCapture(
+        monitors[0] if monitors else None,
+        attrs={"replica": "fleet"})
     try:
         with contextlib.ExitStack() as stack:
+            stack.enter_context(thread_cap)
             san = None
             if sanitize:
                 from ..analysis import sanitize as sanitize_ctx
@@ -1586,7 +1621,8 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                 with m.device_scope():
                     m.engine.warmup()
             if threads:
-                summary = router.serve_threaded(requests)
+                summary = router.serve_threaded(requests,
+                                                scheduler=scheduler)
             else:
                 after = (lambda i: san.step()) if san else None
                 summary = router.serve(
@@ -1597,6 +1633,10 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     finally:
         for m in monitors:
             m.close()
+    # a background thread that died mid-serve (captured by the
+    # excepthook above, run_error already in the log) fails the run
+    # AFTER teardown — it must not vanish into stderr
+    thread_cap.raise_first()
     if return_router:
         return summary, router
     return summary

@@ -35,6 +35,27 @@ def _clean_parallel_state():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.fixture(autouse=True)
+def _background_thread_exceptions_fail():
+    """threading.excepthook capture (ISSUE-15): an uncaught exception
+    in ANY background thread a test spawns — a watchdog heartbeat, a
+    fleet replica worker, a test's own helper thread — fails the
+    owning test instead of printing to stderr and vanishing.  Library
+    code that catches its thread exceptions itself (the fleet worker,
+    the heartbeat's internal try) is unaffected; this net catches the
+    ones nobody caught."""
+    from apex_tpu.monitor.events import (BackgroundThreadError,
+                                         ThreadExceptionCapture)
+
+    cap = ThreadExceptionCapture().install()
+    yield cap
+    cap.uninstall()
+    try:
+        cap.raise_first()
+    except BackgroundThreadError as e:
+        pytest.fail(str(e), pytrace=False)
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

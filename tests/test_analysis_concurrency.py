@@ -1,0 +1,990 @@
+"""apex_tpu.analysis.concurrency (APX801-805) + the deterministic-
+schedule harness (ISSUE-15): per-rule fixtures at exact file:line
+(positive + clean negative each), suppression/baseline semantics, the
+repo self-check against the committed EMPTY baseline, seeded
+scheduler determinism, the 2-replica threaded-fleet seed-invariance
+sweep, and threading.excepthook capture."""
+import textwrap
+import threading
+import time
+
+import pytest
+
+from apex_tpu.analysis import concurrency
+from apex_tpu.analysis.concurrency import (lint_concurrency_paths,
+                                           lint_concurrency_source,
+                                           run_concurrency_check)
+from apex_tpu.analysis.schedule import (DeterministicScheduler,
+                                        ScheduleTimeout)
+from apex_tpu.monitor.events import (BackgroundThreadError, MemorySink,
+                                     ThreadExceptionCapture)
+
+
+def _lint(src, path="fixture.py"):
+    return lint_concurrency_source(textwrap.dedent(src), path)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# APX801 — lock discipline
+# ---------------------------------------------------------------------------
+
+class TestAPX801:
+    def test_guarded_attr_read_outside_lock(self):
+        fs = _lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n = self._n + 1
+
+                def peek(self):
+                    return self._n
+        """)
+        assert _rules(fs) == ["APX801"]
+        assert fs[0].line == 14
+        assert "Counter._n" in fs[0].message
+        assert "peek" in fs[0].message
+
+    def test_all_accesses_under_lock_is_clean(self):
+        fs = _lint("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n = self._n + 1
+
+                def peek(self):
+                    with self._lock:
+                        return self._n
+        """)
+        assert fs == []
+
+    def test_racy_increment_outside_lock(self):
+        # not guard-inferred (never touched under the lock) but a +=
+        # in a lock-bearing class is a lost-update race regardless
+        fs = _lint("""
+            import threading
+
+            class Tracer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._dropped = 0
+                    self._buf = []
+
+                def drop(self):
+                    self._dropped += 1
+
+                def drain(self):
+                    with self._lock:
+                        return list(self._buf)
+        """)
+        assert _rules(fs) == ["APX801"]
+        assert fs[0].line == 11
+        assert "+=" in fs[0].message or "read-modify-write" \
+            in fs[0].message
+
+    def test_config_attr_read_under_lock_not_inferred(self):
+        # an attr only WRITTEN in __init__ is config, not shared
+        # mutable state — reading it both under and outside the lock
+        # is clean (the Watchdog.stall_timeout shape)
+        fs = _lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.timeout = 5.0
+                    self._last = 0.0
+
+                def check(self, now):
+                    with self._lock:
+                        self._last = now
+                        return now - self._last > self.timeout
+
+                def describe(self):
+                    return self.timeout
+        """)
+        assert fs == []
+
+    def test_thread_target_shared_write(self):
+        fs = _lint("""
+            import threading
+
+            class Fleet:
+                def __init__(self):
+                    self.replayed = 0
+
+                def step(self):
+                    self.replayed += 1
+
+                def serve(self):
+                    def worker(r):
+                        r.replayed += 1
+                    ts = [threading.Thread(target=worker, args=(self,))
+                          for _ in range(2)]
+                    for t in ts:
+                        t.start()
+        """)
+        assert _rules(fs) == ["APX801"]
+        assert fs[0].line == 13
+        assert "worker" in fs[0].message
+        assert "aggregate" in fs[0].message
+
+    def test_thread_target_private_slot_is_clean(self):
+        # one writer per dict key, aggregated after join — the fixed
+        # fleet shape
+        fs = _lint("""
+            import threading
+
+            class Fleet:
+                def __init__(self):
+                    self.replayed = 0
+
+                def serve(self):
+                    results = {}
+
+                    def worker(rid):
+                        results[rid] = 1
+                    ts = [threading.Thread(target=worker, args=(i,))
+                          for i in range(2)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    self.replayed = sum(results.values())
+        """)
+        assert fs == []
+
+    def test_init_is_exempt(self):
+        fs = _lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = {}
+
+                def put(self, k, v):
+                    with self._lock:
+                        self._state = dict(self._state, **{k: v})
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# APX802 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+class TestAPX802:
+    CYCLE_SRC = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+
+    def test_cycle_detected_with_both_provenances(self):
+        fs = _lint(self.CYCLE_SRC)
+        assert _rules(fs) == ["APX802"]
+        f = fs[0]
+        assert "A._a" in f.message and "A._b" in f.message
+        # both acquisition sites printed (file:line provenance)
+        assert "fixture.py:11" in f.message
+        assert "fixture.py:16" in f.message
+        assert f.symbol.startswith("cycle:")
+
+    def test_consistent_order_is_clean(self):
+        fs = _lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert fs == []
+
+    def test_cross_module_cycle(self, tmp_path):
+        """The deadlock needs no single file to show both orders —
+        edges aggregate repo-wide before cycle detection."""
+        pkg = tmp_path / "apex_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "mod_x.py").write_text(textwrap.dedent("""
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._xl = threading.Lock()
+
+                def act(self, other):
+                    with self._xl:
+                        with other._yl:
+                            pass
+        """))
+        (pkg / "mod_y.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Y:
+                def __init__(self):
+                    self._yl = threading.Lock()
+
+                def act(self, other):
+                    with self._yl:
+                        with other._xl:
+                            pass
+        """))
+        # NB: each file alone has no cycle
+        for name in ("mod_x.py", "mod_y.py"):
+            assert lint_concurrency_source(
+                (pkg / name).read_text(), name) == []
+        fs, _ = lint_concurrency_paths(repo_root=str(tmp_path))
+        # the partner lock is an attribute of a foreign object; the
+        # per-class key can only see its OWN lock, so the cross-module
+        # form needs module-level locks to alias — use those instead
+        (pkg / "mod_x.py").write_text(textwrap.dedent("""
+            import threading
+
+            LX = threading.Lock()
+
+            def act():
+                from .mod_y import LY
+                with LX:
+                    with LY:
+                        pass
+        """))
+        (pkg / "mod_y.py").write_text(textwrap.dedent("""
+            import threading
+
+            LY = threading.Lock()
+
+            def act():
+                from .mod_x import LX
+                with LY:
+                    with LX:
+                        pass
+        """))
+        fs, _ = lint_concurrency_paths(repo_root=str(tmp_path))
+        assert [f.rule for f in fs] == ["APX802"]
+        assert "mod_x.LX" in fs[0].message
+        assert "mod_y.LY" in fs[0].message
+
+    def test_inline_suppression(self):
+        # the cycle finding anchors at the canonical first edge's
+        # acquisition site — the inner `with self._b:` in forward()
+        src = self.CYCLE_SRC.replace(
+            "with self._b:",
+            "with self._b:  "
+            "# apex-lint: disable=APX802 -- fixture says so", 1)
+        assert _lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# APX803 — flag-only signal handlers
+# ---------------------------------------------------------------------------
+
+class TestAPX803:
+    def test_emitting_handler_flagged(self):
+        fs = _lint("""
+            import signal
+
+            class R:
+                def __init__(self, sink):
+                    self._sink = sink
+                    signal.signal(signal.SIGTERM, self._handler)
+
+                def _handler(self, signum, frame):
+                    self._sink.emit({"name": "caught"})
+        """)
+        assert _rules(fs) == ["APX803"]
+        assert fs[0].line == 10
+        assert "emit" in fs[0].message
+
+    def test_flag_only_handler_with_chain_is_clean(self):
+        # the AutoResume shape: Event.set, dict .get, chain to the
+        # previous handler, SIG_DFL re-raise — all allowed
+        fs = _lint("""
+            import os
+            import signal
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._requested = threading.Event()
+                    self._prev = {}
+                    signal.signal(signal.SIGTERM, self._handler)
+
+                def _handler(self, signum, frame):
+                    if self._requested.is_set():
+                        prev = self._prev.get(signum)
+                        if callable(prev):
+                            prev(signum, frame)
+                        else:
+                            signal.signal(signum, signal.SIG_DFL)
+                            os.kill(os.getpid(), signum)
+                        return
+                    self._source = str(signum)
+                    self._requested.set()
+        """)
+        assert fs == []
+
+    def test_lambda_to_flag_only_method_is_clean(self):
+        # the CaptureTrigger shape: lambda -> self.request, which only
+        # sets a flag
+        fs = _lint("""
+            import signal
+
+            class T:
+                def __init__(self):
+                    self._pending = None
+                    signal.signal(
+                        signal.SIGUSR1,
+                        lambda *_: self.request("signal"))
+
+                def request(self, reason):
+                    if self._pending is None:
+                        self._pending = reason
+        """)
+        assert fs == []
+
+    def test_lambda_to_heavy_method_flagged(self):
+        fs = _lint("""
+            import signal
+
+            class T:
+                def __init__(self, logdir):
+                    self.logdir = logdir
+                    signal.signal(
+                        signal.SIGUSR1,
+                        lambda *_: self.dump())
+
+                def dump(self):
+                    with open(self.logdir) as f:
+                        return f.read()
+        """)
+        assert _rules(fs) == ["APX803"]
+        assert fs[0].line == 9
+        assert "dump" in fs[0].message
+
+    def test_bare_name_call_only_legal_for_local_chain(self):
+        # `prev(...)` after `prev = self._prev.get(...)` is the chain
+        # idiom; a bare `print(...)` is not
+        fs = _lint("""
+            import signal
+
+            def handler(signum, frame):
+                print("caught", signum)
+
+            signal.signal(signal.SIGTERM, handler)
+        """)
+        assert _rules(fs) == ["APX803"]
+        assert fs[0].line == 5
+
+    def test_handler_taking_lock_flagged(self):
+        fs = _lint("""
+            import signal
+            import threading
+
+            LOCK = threading.Lock()
+            FLAG = []
+
+            def handler(signum, frame):
+                with LOCK:
+                    FLAG.append(signum)
+
+            signal.signal(signal.SIGTERM, handler)
+        """)
+        assert "APX803" in _rules(fs)
+        with_finding = [f for f in fs if "context manager"
+                        in f.message]
+        assert with_finding and with_finding[0].line == 9
+
+
+# ---------------------------------------------------------------------------
+# APX804 — blocking under a lock
+# ---------------------------------------------------------------------------
+
+class TestAPX804:
+    def test_join_under_lock(self):
+        fs = _lint("""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._threads = []
+
+                def stop(self):
+                    with self._lock:
+                        for t in self._threads:
+                            t.join()
+        """)
+        assert _rules(fs) == ["APX804"]
+        assert fs[0].line == 12
+        assert ".join()" in fs[0].message
+
+    def test_emit_reached_through_self_method(self):
+        # the Watchdog shape at introduction: observe() -> _alarm()
+        # -> sink.emit, all under the state lock
+        fs = _lint("""
+            import threading
+
+            class W:
+                def __init__(self, sink):
+                    self._lock = threading.Lock()
+                    self._sink = sink
+                    self._fired = False
+
+                def _alarm(self, name):
+                    self._sink.emit(name)
+
+                def observe(self):
+                    with self._lock:
+                        if not self._fired:
+                            self._fired = True
+                            self._alarm("stall")
+        """)
+        rules = _rules(fs)
+        assert "APX804" in rules
+        f = [x for x in fs if x.rule == "APX804"][0]
+        assert f.line == 17
+        assert "_alarm" in f.message and "emit" in f.message
+
+    def test_collect_then_emit_outside_is_clean(self):
+        fs = _lint("""
+            import threading
+
+            class W:
+                def __init__(self, sink):
+                    self._lock = threading.Lock()
+                    self._sink = sink
+                    self._fired = False
+
+                def observe(self):
+                    alarms = []
+                    with self._lock:
+                        if not self._fired:
+                            self._fired = True
+                            alarms.append("stall")
+                    for a in alarms:
+                        self._sink.emit(a)
+        """)
+        assert fs == []
+
+    def test_jsonl_sink_write_under_own_lock_is_clean(self):
+        # the lock exists to serialize exactly this write — .write/
+        # .flush are not in the deny set
+        fs = _lint("""
+            import threading
+
+            class Sink:
+                def __init__(self, f):
+                    self._lock = threading.Lock()
+                    self._f = f
+
+                def emit(self, line):
+                    with self._lock:
+                        if self._f is None:
+                            return
+                        self._f.write(line)
+                        self._f.flush()
+
+                def close(self):
+                    with self._lock:
+                        self._f.close()
+                        self._f = None
+        """)
+        assert fs == []
+
+    def test_condition_wait_on_held_lock_is_clean(self):
+        # the canonical CV idiom: wait() RELEASES the held condition
+        fs = _lint("""
+            import threading
+
+            class Gate:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._open = False
+
+                def wait_open(self):
+                    with self._cv:
+                        while not self._open:
+                            self._cv.wait(1.0)
+        """)
+        assert fs == []
+
+    def test_str_join_under_lock_is_clean(self):
+        fs = _lint("""
+            import threading
+
+            LOCK = threading.Lock()
+
+            def render(parts):
+                with LOCK:
+                    return " ".join(parts)
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# APX805 — thread-target dispatch outside a device pin
+# ---------------------------------------------------------------------------
+
+class TestAPX805:
+    def test_unpinned_dispatch_flagged(self):
+        fs = _lint("""
+            import threading
+            import jax.numpy as jnp
+
+            def serve(engines):
+                def worker(e):
+                    x = jnp.asarray([1, 2, 3])
+                    e.step(x)
+                ts = [threading.Thread(target=worker, args=(e,))
+                      for e in engines]
+                for t in ts:
+                    t.start()
+        """)
+        assert _rules(fs) == ["APX805"]
+        assert fs[0].line == 7
+        assert "jnp.asarray" in fs[0].message
+        assert "device_scope" in fs[0].message
+
+    def test_pinned_dispatch_is_clean(self):
+        fs = _lint("""
+            import threading
+            import jax.numpy as jnp
+
+            def serve(replicas):
+                def worker(r):
+                    with r.device_scope():
+                        x = jnp.asarray([1, 2, 3])
+                        r.engine.step(x)
+                ts = [threading.Thread(target=worker, args=(r,))
+                      for r in replicas]
+                for t in ts:
+                    t.start()
+        """)
+        assert fs == []
+
+    def test_default_device_pin_is_clean(self):
+        fs = _lint("""
+            import threading
+            import jax
+            import jax.numpy as jnp
+
+            def serve(devs):
+                def worker(d):
+                    with jax.default_device(d):
+                        jnp.zeros((4,))
+                for d in devs:
+                    threading.Thread(target=worker, args=(d,)).start()
+        """)
+        assert fs == []
+
+    def test_jitted_name_call_flagged(self):
+        fs = _lint("""
+            import threading
+            import jax
+
+            _step = jax.jit(lambda x: x * 2)
+
+            def drive(xs):
+                def worker(x):
+                    return _step(x)
+                threading.Thread(target=worker, args=(xs,)).start()
+        """)
+        assert _rules(fs) == ["APX805"]
+        assert "_step" in fs[0].message
+
+    def test_non_dispatch_thread_is_clean(self):
+        # the watchdog-heartbeat shape: pure host work off-thread
+        fs = _lint("""
+            import threading
+
+            class W:
+                def check(self):
+                    return True
+
+                def start(self):
+                    def beat():
+                        while True:
+                            self.check()
+                    threading.Thread(target=beat, daemon=True).start()
+        """)
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions, baseline, repo self-check
+# ---------------------------------------------------------------------------
+
+class TestSuppressionAndBaseline:
+    POSITIVE = """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._threads = []
+
+            def stop(self):
+                with self._lock:
+                    for t in self._threads:
+                        t.join()  # apex-lint: disable=APX804 -- fixture justification
+    """
+
+    def test_inline_suppression_honored(self):
+        assert _lint(self.POSITIVE) == []
+
+    def test_reasonless_suppression_not_honored(self):
+        src = self.POSITIVE.replace(" -- fixture justification", "")
+        # the reasonless comment does not suppress (APX900 itself is
+        # the main linter's finding — one owner per rule)
+        assert _rules(_lint(src)) == ["APX804"]
+
+    def test_baseline_and_staleness(self, tmp_path):
+        pkg = tmp_path / "apex_tpu"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "pool.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._threads = []
+
+                def stop(self):
+                    with self._lock:
+                        for t in self._threads:
+                            t.join()
+        """))
+        tools = tmp_path / "tools"
+        tools.mkdir()
+        findings, _ = lint_concurrency_paths(repo_root=str(tmp_path))
+        assert [f.rule for f in findings] == ["APX804"]
+        # baselined: check goes green
+        concurrency.write_concurrency_baseline(
+            findings, repo_root=str(tmp_path))
+        unsup, stale, _ = run_concurrency_check(
+            repo_root=str(tmp_path))
+        assert unsup == [] and stale == []
+        # fix the code: the baseline entry is now STALE and fails
+        (pkg / "pool.py").write_text(textwrap.dedent("""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._threads = []
+
+                def stop(self):
+                    with self._lock:
+                        threads = list(self._threads)
+                    for t in threads:
+                        t.join()
+        """))
+        unsup, stale, _ = run_concurrency_check(
+            repo_root=str(tmp_path))
+        assert unsup == []
+        assert len(stale) == 1 and "APX804" in stale[0]
+
+    def test_repo_self_check_clean_and_baseline_empty(self):
+        """The committed baseline is EMPTY and current: every APX8xx
+        finding the auditor surfaced at introduction was fixed, not
+        baselined (ISSUE-15 acceptance)."""
+        from apex_tpu.analysis.linter import load_baseline
+
+        unsup, stale, regions = run_concurrency_check(repo_root=".")
+        assert unsup == [], "\n".join(f.render() for f in unsup)
+        assert stale == []
+        assert regions > 0, "the repo has lock regions to audit"
+        assert load_baseline(concurrency.DEFAULT_BASELINE,
+                             repo_root=".") == {}
+
+    def test_rules_registered_and_documented(self):
+        from apex_tpu.analysis.rules import RULES, render_rule_table
+
+        table = render_rule_table()
+        for rid in ("APX801", "APX802", "APX803", "APX804", "APX805"):
+            assert rid in RULES
+            assert RULES[rid].layer == "concurrency"
+            assert f"`{rid}`" in table
+
+
+# ---------------------------------------------------------------------------
+# the deterministic scheduler
+# ---------------------------------------------------------------------------
+
+class TestDeterministicScheduler:
+    def _drive(self, seed, rounds=4, names=("a", "b", "c")):
+        sched = DeterministicScheduler(seed, timeout=30.0)
+        for n in names:
+            sched.expect(n)
+        done = []
+
+        def worker(name):
+            for _ in range(rounds):
+                sched.gate(name)
+                done.append(name)
+            sched.finish(name)
+
+        ts = [threading.Thread(target=worker, args=(n,))
+              for n in names]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return sched.grants, done
+
+    def test_same_seed_same_order(self):
+        g1, d1 = self._drive(7)
+        g2, d2 = self._drive(7)
+        assert g1 == g2
+        assert d1 == d2
+
+    def test_seeds_permute_the_order(self):
+        orders = {tuple(self._drive(s)[0]) for s in range(6)}
+        assert len(orders) > 1, "six seeds never changed the order"
+
+    def test_serialized_execution(self):
+        """Every executed tick consumed one grant, in grant order
+        (trailing grants picked for a thread that then finished
+        without another tick are legal and unconsumed)."""
+        grants, done = self._drive(3, rounds=3, names=("x", "y"))
+        assert done.count("x") == 3 and done.count("y") == 3
+        it = iter(grants)
+        assert all(any(d == g for g in it) for d in done), \
+            f"done {done} is not a subsequence of grants {grants}"
+
+    def test_finish_hands_grant_on(self):
+        sched = DeterministicScheduler(0, timeout=10.0)
+        sched.expect("a")
+        sched.expect("b")
+        out = []
+
+        def short():
+            sched.gate("a")
+            out.append("a")
+            sched.finish("a")
+
+        def long():
+            for _ in range(3):
+                sched.gate("b")
+                out.append("b")
+            sched.finish("b")
+
+        ts = [threading.Thread(target=short),
+              threading.Thread(target=long)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert out.count("a") == 1 and out.count("b") == 3
+
+    def test_starved_gate_times_out(self):
+        sched = DeterministicScheduler(0, timeout=0.2)
+        sched.expect("a")
+        sched.expect("b")   # never shows up, may hold the grant
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(5):
+                    sched.gate("a")
+            except ScheduleTimeout as e:
+                errs.append(e)
+            finally:
+                sched.finish("a")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(10.0)
+        assert errs, "gate should starve waiting for the absent 'b'"
+
+
+# ---------------------------------------------------------------------------
+# watchdog stall-trace liveness (the emit-outside-lock fix must not
+# leak a profiler trace when recovery races the stall emission)
+# ---------------------------------------------------------------------------
+
+class TestWatchdogTraceLiveness:
+    def _watchdog(self, monkeypatch, tmp_path):
+        import jax
+
+        from apex_tpu.monitor.watchdog import Watchdog
+
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: calls.append(("start", d)))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append(("stop",)))
+        sink = MemorySink()
+        clk = {"t": 0.0}
+        wd = Watchdog(sink, stall_timeout=1.0,
+                      clock=lambda: clk["t"],
+                      trace_dir=str(tmp_path))
+        return wd, sink, clk, calls
+
+    def test_stall_starts_and_recovery_stops(self, monkeypatch,
+                                             tmp_path):
+        wd, sink, clk, calls = self._watchdog(monkeypatch, tmp_path)
+        clk["t"] = 2.0
+        assert wd.check_stall() is True
+        assert calls == [("start", str(tmp_path))]
+        wd.observe_step(1)                      # recovery
+        assert calls[-1] == ("stop",)
+        names = [e.name for e in sink.by_kind("alarm")]
+        assert names == ["stall", "stall_trace_started",
+                         "stall_recovered", "stall_trace_stopped"]
+
+    def test_stale_episode_start_is_refused(self, monkeypatch,
+                                            tmp_path):
+        """The lost race: recovery lands between the stall decision
+        and the profiler start — the start must be refused (the old
+        code leaked an open trace until the NEXT recovery)."""
+        wd, sink, clk, calls = self._watchdog(monkeypatch, tmp_path)
+        clk["t"] = 2.0
+        assert wd.check_stall() is True
+        wd.observe_step(1)                      # episode over
+        calls.clear()
+        # replay the stale start the preempted check_stall thread
+        # would issue for the already-recovered episode
+        wd._start_trace(wd._stall_seq)
+        assert calls == [], "stale-episode start must be a no-op"
+        assert not wd._tracing
+
+
+# ---------------------------------------------------------------------------
+# threading.excepthook capture
+# ---------------------------------------------------------------------------
+
+class TestThreadExceptionCapture:
+    def test_capture_emits_and_raises(self):
+        sink = MemorySink()
+        # chain=False: the crash is intentional — it must not also
+        # reach the conftest capture (which fails the owning test)
+        cap = ThreadExceptionCapture(sink, chain=False).install()
+        try:
+            t = threading.Thread(
+                target=lambda: (_ for _ in ()).throw(
+                    ValueError("boom")),
+                name="doomed")
+            t.start()
+            t.join()
+        finally:
+            cap.uninstall()
+        assert len(cap.failures) == 1
+        rec = cap.failures[0]
+        assert rec["thread"] == "doomed"
+        assert rec["error"] == "ValueError"
+        evs = sink.by_name("run_error")
+        assert len(evs) == 1
+        assert evs[0].attrs["background"] is True
+        assert evs[0].attrs["thread"] == "doomed"
+        with pytest.raises(BackgroundThreadError, match="doomed"):
+            cap.raise_first()
+
+    def test_monitor_style_target(self):
+        class FakeMonitor:
+            def __init__(self):
+                self.calls = []
+
+            def event(self, kind, name, value=None, **attrs):
+                self.calls.append((kind, name, attrs))
+
+        mon = FakeMonitor()
+        cap = ThreadExceptionCapture(mon, chain=False).install()
+        try:
+            t = threading.Thread(
+                target=lambda: (_ for _ in ()).throw(
+                    RuntimeError("x")))
+            t.start()
+            t.join()
+        finally:
+            cap.uninstall()
+        assert mon.calls and mon.calls[0][:2] == ("run", "run_error")
+
+    def test_no_failures_is_noop(self):
+        cap = ThreadExceptionCapture().install()
+        try:
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()
+        finally:
+            cap.uninstall()
+        assert cap.failures == []
+        cap.raise_first()   # no-op
+
+    def test_uninstall_restores_previous_hook(self):
+        prev = threading.excepthook
+        cap = ThreadExceptionCapture().install()
+        assert threading.excepthook == cap._hook
+        cap.uninstall()
+        assert threading.excepthook is prev
+
+
+# ---------------------------------------------------------------------------
+# the seeded fleet sweep (the acceptance bar: digest seed-invariance)
+# ---------------------------------------------------------------------------
+
+class TestScheduleSweep:
+    def test_two_replica_fleet_digest_is_seed_invariant(self):
+        """The ISSUE-15 dynamic acceptance: the threaded 2-replica
+        fleet serves the same trace under permuted interleavings and
+        the terminal digest never moves (CI's step-14 leg runs >= 5
+        seeds; the tier-1 test keeps three for wall-clock)."""
+        from apex_tpu.analysis.schedule import schedule_sweep
+
+        report = schedule_sweep(
+            range(3), replicas=2, num_requests=4, new_tokens=3,
+            timeout=60.0)
+        assert report.failures() == []
+        assert report.invariant
+        digests = set(report.digests.values())
+        assert len(digests) == 1 and "" not in digests
+        for r in report.runs:
+            assert r.lost == 0
+            assert r.requests_done == 4
+            assert r.thread_failures == []
+            assert r.grants > 0
+        # the interleavings genuinely differed: grant SEQUENCES are
+        # seed-dependent even when counts collide
+        assert len({r.grants for r in report.runs}) >= 1
