@@ -24,7 +24,8 @@ from .cast import (
     tree_cast,
 )
 from .mixed_precision import AmpOptimizer, AmpState, StepInfo, initialize
-from .policy import O0, O1, O2, O3, O4, O5, Policy, get_policy, opt_levels
+from .policy import (O0, O1, O2, O3, O4, O5, Q8, Policy, get_policy,
+                     opt_levels)
 from .scaler import ScalerState, all_finite, scale_loss, unscale
 
 __all__ = [
@@ -32,7 +33,7 @@ __all__ = [
     "promote_function", "lists",
     "AmpOptimizer", "AmpState", "StepInfo", "initialize",
     "Policy", "get_policy", "opt_levels",
-    "O0", "O1", "O2", "O3", "O4", "O5",
+    "O0", "O1", "O2", "O3", "O4", "O5", "Q8",
     "ScalerState", "scaler", "scale_loss", "unscale", "all_finite",
     "cast_params", "cast_inputs", "cast_outputs", "convert_network",
     "master_copy", "restore_dtypes", "tree_cast",

@@ -19,7 +19,15 @@ O2     fp16         —             yes         yes      dynamic
 O3     fp16         —             no          no       1.0
 O4     —            bf16 lists    yes         no       1.0
 O5     bf16         —             yes         yes      1.0
+Q8     bf16         —             yes         yes      1.0
 =====  ===========  ============  ==========  =======  ===========
+
+Q8 extends the ladder below O5 for **serving**: same bf16 activation
+casting, loss scale pinned 1.0, plus ``quantize_weights="int8"`` —
+matmul weights stored as per-output-channel symmetric int8 and run
+through :func:`apex_tpu.ops.quant_matmul.quant_matmul`.  Training
+under Q8 is O5 (quantization is a deployment transform applied to the
+extracted serving weights, never differentiated through).
 """
 from __future__ import annotations
 
@@ -58,6 +66,11 @@ class Policy:
     # Cast model outputs to this dtype (``cast_model_outputs``,
     # frontend.py initialize kwarg).
     cast_model_outputs: Optional[DTypeLike] = None
+    # Weight-only quantization for serving matmuls: None, or "int8"
+    # (per-output-channel symmetric, apex_tpu.ops.quant_matmul).
+    # Fork-added below the reference's ladder — a storage/compute
+    # format for extracted serving weights, not a training cast.
+    quantize_weights: Optional[str] = None
 
     def __post_init__(self):
         # Consistency validation in the spirit of Properties' setters
@@ -74,6 +87,11 @@ class Policy:
             raise ValueError(
                 "master_weights=True requires a low-precision "
                 "cast_model_type."
+            )
+        if self.quantize_weights not in (None, "int8"):
+            raise ValueError(
+                f"quantize_weights {self.quantize_weights!r} not in "
+                f"(None, 'int8')"
             )
 
     # -- derived views ------------------------------------------------------
@@ -116,8 +134,15 @@ O4 = Policy(opt_level="O4", cast_ops=True, cast_ops_type=jnp.bfloat16,
             keep_batchnorm_fp32=None, master_weights=False, loss_scale=1.0)
 O5 = Policy(opt_level="O5", cast_model_type=jnp.bfloat16,
             keep_batchnorm_fp32=True, master_weights=True, loss_scale=1.0)
+# Q8: O5's casting discipline plus int8 weight-only serving matmuls —
+# the tier BELOW O5 on the ladder (less weight precision, same
+# activation precision, loss scale still pinned: bf16 range rules).
+Q8 = Policy(opt_level="Q8", cast_model_type=jnp.bfloat16,
+            keep_batchnorm_fp32=True, master_weights=True,
+            loss_scale=1.0, quantize_weights="int8")
 
-opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3, "O4": O4, "O5": O5}
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3, "O4": O4, "O5": O5,
+              "Q8": Q8}
 
 
 def get_policy(opt_level: Union[str, Policy] = "O5", **overrides) -> Policy:

@@ -33,6 +33,14 @@ Rules (registered in :mod:`.rules`, table in docs/api/analysis.md):
 * **APX604 host transfer** — callback/infeed/outfeed ops compiled into
   the graph (``pure_callback`` / ``io_callback`` / ``debug_callback``):
   a host round-trip every step.
+* **APX606 dequantized weight residency** — the Q8 analogue of
+  APX602: a ``convert_element_type`` int8 → f32/bf16 of a
+  weight-sized tensor inside a Q8-policy entry whose provenance is
+  not the quant kernel family (``ops/quant_matmul.py``, where dequant
+  is tile-local in VMEM) or the int8-KV decode kernels.  An
+  HLO-visible dense copy of an int8 operand means the graph
+  materializes the fp32 weights it was quantized to avoid — the
+  bandwidth win is silently forfeited.
 * **APX605 peak-live-memory estimate** — buffer liveness over the
   lowered jaxpr (inputs+consts live at entry, equation outputs
   allocated in order, buffers freed after their last use, call-like
@@ -72,6 +80,16 @@ HOST_TRANSFER_PRIMS = {"pure_callback", "io_callback",
                        "debug_callback", "infeed", "outfeed"}
 # Low-precision source dtypes for the promotion rule.
 _LOWP = ("bfloat16", "float16")
+
+# APX606: modules whose int8 -> float converts are the POINT — the
+# quant matmul family dequantizes tile-locally (its registered twin is
+# the sanctioned XLA fallback on CPU lowerings), and the paged decode
+# kernels dequantize int8 KV rows the same way.  Everywhere else a
+# weight-sized int8 -> f32/bf16 convert is a materialized dequant.
+Q8_DEQUANT_REGIONS = ("apex_tpu/ops/quant_matmul.py",
+                      "apex_tpu/ops/flash_decode.py")
+# ...and converts below this are scale vectors / scalars, not weights.
+_DEQUANT_MIN_BYTES = 1024
 
 # APX601 ignores buffers below this: donating a scalar loss-scale
 # saves nothing, and matching tiny scalars by (shape, dtype) is pure
@@ -326,10 +344,14 @@ def _audit_one(name: str, ep, repo_root: Path) -> EntryAudit:
     # --- collective census + promotions + host transfers ------------------
     collectives: List[CollectiveOp] = []
     allow = tuple(ep.allow_upcast)
-    if ep.policy in ("O4", "O5"):
+    if ep.policy in ("O4", "O5", "Q8"):
         from ..testing.entry_points import POLICY_FP32_REGIONS
 
         allow = allow + POLICY_FP32_REGIONS
+    # APX606's allow list is deliberately NOT the fp32-region list:
+    # those sanction ACTIVATION upcasts (softmax, layer-norm stats);
+    # an int8 WEIGHT dequant is only ever legal inside the kernels
+    q8_allow = tuple(ep.allow_upcast) + Q8_DEQUANT_REGIONS
     for eqn, mult in _iter_eqns(closed.jaxpr):
         prim = eqn.primitive.name
         if prim in COLLECTIVE_PRIMS:
@@ -341,7 +363,7 @@ def _audit_one(name: str, ep, repo_root: Path) -> EntryAudit:
                 kind=prim, elements=nelems, bytes=nbytes * mult,
                 count=mult, path=path, line=line, function=func))
         elif prim == "convert_element_type" \
-                and ep.policy in ("O4", "O5"):
+                and ep.policy in ("O4", "O5", "Q8"):
             src = getattr(eqn.invars[0].aval, "dtype", None)
             dst = eqn.params.get("new_dtype")
             if src is not None and str(src) in _LOWP \
@@ -358,6 +380,26 @@ def _audit_one(name: str, ep, repo_root: Path) -> EntryAudit:
                                 f"entry registry or keep the math in "
                                 f"{src})",
                         symbol=f"{name}.{func}.{src}"))
+            if ep.policy == "Q8" and src is not None \
+                    and str(src) == "int8" \
+                    and str(dst) in ("float32", "bfloat16") \
+                    and _aval_bytes(eqn.outvars[0].aval) \
+                    >= _DEQUANT_MIN_BYTES:
+                path, line, func = _provenance(eqn, repo_root)
+                if not any(a in path for a in q8_allow):
+                    findings.append(Finding(
+                        path=path, line=line, col=0, rule="APX606",
+                        severity="error",
+                        message=f"[{name}] dequantized int8 weight "
+                                f"resident: int8->{dst} of "
+                                f"{_aval_bytes(eqn.outvars[0].aval)} "
+                                f"bytes in '{func}' escapes the "
+                                f"kernel into the compiled graph — "
+                                f"Q8's contract is tile-local dequant "
+                                f"(ops/quant_matmul.py); a dense "
+                                f"float copy forfeits the bandwidth "
+                                f"win quantization bought",
+                        symbol=f"{name}.{func}.int8"))
         elif prim in HOST_TRANSFER_PRIMS:
             path, line, func = _provenance(eqn, repo_root)
             findings.append(Finding(

@@ -95,6 +95,13 @@ KERNEL_TWINS: Dict[Tuple[str, str], TwinSpec] = {
     ("fused_pipeline.py", "_norm_finite_pallas"): _spec(
         "grad_norm_finite", "_norm_finite_jnp",
         "apex_tpu/ops/fused_pipeline.py", "tests/test_fused_pipeline.py"),
+    # int8 weight-only matmul (ISSUE-16 Q8 tier): GEMV decode path and
+    # tiled prefill path, both specified by the scale-after-matmul
+    # fp32 reference (also the XLA fallback off TPU)
+    **{("quant_matmul.py", fn): _spec(
+        "quant_matmul", "quant_matmul_reference",
+        "apex_tpu/ops/quant_matmul.py", "tests/test_quant_matmul.py")
+       for fn in ("_quant_gemv", "_quant_tiled")},
 }
 
 

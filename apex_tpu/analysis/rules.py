@@ -147,6 +147,13 @@ register_rule(
     "lowered jaxpr exceeds the committed baseline by >10% (shrinks "
     "fail too — refresh the baseline)")
 register_rule(
+    "APX606", "compiled", "entry points (Q8 policy)",
+    "dequantized weight residency: a `convert_element_type` int8 → "
+    "f32/bf16 of a weight-sized tensor whose provenance is outside "
+    "the quant kernel family (`ops/quant_matmul.py` dequantizes "
+    "tile-locally in VMEM) — the compiled graph materializes the "
+    "dense float weights int8 storage was meant to avoid")
+register_rule(
     "APX701", "sharding", "planned entry points",
     "unintended full replication: a tensor above the "
     "`APEX_TPU_SHARDING_MIN_BYTES` floor whose MeshPlan spec shards it "

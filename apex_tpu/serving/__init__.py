@@ -41,10 +41,12 @@ from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
 from .metrics import (EngineGauges, ReplicaMonitor, RequestTrace,
                       ServeMetrics, SnapshotTrigger)
 from .model import (GPTServingWeights, LayerWeights,
+                    QuantGPTServingWeights, QuantLayerWeights,
                     ServingModelConfig, copy_cache_block,
                     extract_serving_weights, gather_cache_blocks,
                     gpt_decode_step, gpt_extend_step,
-                    gpt_prefill_step, scatter_cache_blocks)
+                    gpt_prefill_step, gpt_sequence_logits,
+                    quantize_weights, scatter_cache_blocks)
 from .resilience import (RequestJournal, ServeRunResult, ShedPolicy,
                          SpeculationGovernor, recover_engine,
                          run_serving)
@@ -58,10 +60,12 @@ __all__ = [
     "KVCacheManager", "PagedKVCache", "PrefixMatch", "init_cache",
     "prefix_chain_keys", "quantize_kv_rows", "write_prefill_kv",
     "write_token_kv",
-    "GPTServingWeights", "LayerWeights", "ServingModelConfig",
+    "GPTServingWeights", "LayerWeights", "QuantGPTServingWeights",
+    "QuantLayerWeights", "ServingModelConfig",
     "copy_cache_block", "extract_serving_weights",
     "gather_cache_blocks", "gpt_decode_step", "gpt_extend_step",
-    "gpt_prefill_step", "scatter_cache_blocks",
+    "gpt_prefill_step", "gpt_sequence_logits", "quantize_weights",
+    "scatter_cache_blocks",
     "EngineGauges", "ReplicaMonitor", "RequestTrace", "ServeMetrics",
     "SnapshotTrigger",
     "RequestJournal", "ServeRunResult", "ShedPolicy",

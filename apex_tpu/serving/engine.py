@@ -51,6 +51,7 @@ from ..analysis.flags import flag_bool, flag_float, flag_int, flag_str
 from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
                        PrefixMatch, init_cache)
 from .metrics import ServeMetrics
+from ..ops.quant_matmul import is_quantized_weights
 from .model import (GPTServingWeights, ServingModelConfig,
                     copy_cache_block, gpt_decode_step,
                     gpt_extend_step, gpt_prefill_step)
@@ -336,6 +337,12 @@ class ServingEngine:
                 raise ValueError(
                     "TPContext was built for a different cache "
                     "config than the engine's")
+            # int8 weights need the plan's scale-row specs armed; a
+            # context built for the other weight format rebinds here
+            # so callers never hand-sync the flag
+            if tp.weight_quantized != is_quantized_weights(weights):
+                self.tp = tp = tp.rebind(
+                    weight_quantized=is_quantized_weights(weights))
             model_cfg = tp.model_cfg       # tp_axis armed
             weights = tp.shard_weights(weights)
         elif device is not None:
@@ -1514,17 +1521,49 @@ class ServingEngine:
         leg asserts.  The KV pool and the shared-prefix index reset
         (every cached k/v row was computed under the OLD weights;
         serving it would silently mix models), so the first
-        post-swap admissions run cold by design."""
+        post-swap admissions run cold by design.
+
+        A **requantization swap** (bf16 ``GPTServingWeights`` ↔ int8
+        :class:`~apex_tpu.ops.quant_matmul.QuantGPTServingWeights`)
+        changes the weight pytree's structure, so the cached target
+        executables cannot survive; the engine drops them and re-runs
+        the AOT warmup inside the drained swap window instead — every
+        retrace is charged to the swap, and the steady state after the
+        replica rejoins is still zero-recompile (the fleet rollout
+        test asserts the compile counter is flat from rejoin on)."""
         if self.active or self.prefilling or self.queue:
             raise RuntimeError(
                 f"swap_weights on a busy engine ({len(self.active)} "
                 f"active, {len(self.prefilling)} prefilling, "
                 f"{len(self.queue)} queued) — drain first (the "
                 f"router's admit-stop → drain → swap sequence)")
-        jax.tree_util.tree_map(
-            lambda old, new: _check_swap_leaf(old, new), self.weights,
-            weights)
+        requantized = (jax.tree_util.tree_structure(self.weights)
+                       != jax.tree_util.tree_structure(weights))
+        if not requantized:
+            jax.tree_util.tree_map(
+                lambda old, new: _check_swap_leaf(old, new),
+                self.weights, weights)
+        else:
+            if is_quantized_weights(weights) \
+                    == is_quantized_weights(self.weights) \
+                    or len(self.weights.layers) != len(weights.layers):
+                raise ValueError(
+                    "swap_weights pytree mismatch that is not a "
+                    "bf16<->int8 requantization — a swap must keep "
+                    "the model geometry (same layer count, same "
+                    "embedding shapes)")
+            # the unquantized leaves still obey the strict leaf rule
+            for old, new in ((self.weights.wte, weights.wte),
+                             (self.weights.wpe, weights.wpe),
+                             (self.weights.lnf_w, weights.lnf_w)):
+                _check_swap_leaf(old, new)
+            self._decode_exec.clear()
+            self._prefill_exec.clear()
+            self._extend_exec.clear()
         if self.tp is not None:
+            if requantized:
+                self.tp = self.tp.rebind(
+                    weight_quantized=is_quantized_weights(weights))
             weights = self.tp.shard_weights(weights)
         elif self.device is not None:
             weights = jax.device_put(weights, self.device)
@@ -1545,7 +1584,11 @@ class ServingEngine:
             if self.device is not None:
                 self.draft_cache = jax.device_put(self.draft_cache,
                                                   self.device)
-        self._event("weights_swapped",
+        if requantized:
+            # restore the AOT ladder while still drained: the rebuild
+            # is part of the swap's cost, not the steady state's
+            self.warmup()
+        self._event("weights_swapped", requantized=requantized,
                     compiles=sum(self._compiles.values()))
 
     def snapshot_state(self) -> Dict[str, Any]:

@@ -34,12 +34,17 @@ from ..ops.flash_decode import (flash_decode, flash_decode_multi,
                                 paged_attention_multi_reference,
                                 paged_attention_reference)
 from ..ops.layer_norm import layer_norm
+from ..ops.quant_matmul import (QuantGPTServingWeights,
+                                QuantLayerWeights, quant_matmul,
+                                quantize_weights)
 from .kv_cache import (KVCacheConfig, PagedKVCache, write_prefill_kv,
                        write_token_kv)
 
 __all__ = ["GPTServingWeights", "LayerWeights", "ServingModelConfig",
-           "extract_serving_weights", "gpt_prefill_step",
-           "gpt_decode_step", "gpt_extend_step", "copy_cache_block",
+           "QuantGPTServingWeights", "QuantLayerWeights",
+           "quantize_weights", "extract_serving_weights",
+           "gpt_prefill_step", "gpt_decode_step", "gpt_extend_step",
+           "gpt_sequence_logits", "copy_cache_block",
            "gather_cache_blocks", "scatter_cache_blocks"]
 
 
@@ -167,19 +172,32 @@ def extract_serving_weights(params,
         lnf_b=tr["final_layernorm"]["bias"])
 
 
-def _linear(x, kernel, bias, dtype):
+def _matmul(x, kernel, dtype, scale):
+    """The one matmul both linears share.  ``scale`` is None for a
+    dense float kernel (compute-dtype matmul) or the per-output-channel
+    fp32 scales of an int8 kernel (Q8: fp32-accumulated
+    :func:`~apex_tpu.ops.quant_matmul.quant_matmul`, scale applied
+    after the contraction, result cast back to compute dtype — the
+    fp32 weight tensor never materializes, APX606's invariant)."""
+    if scale is not None:
+        return quant_matmul(x, kernel, scale, out_dtype=dtype)
+    return x.astype(dtype) @ kernel.astype(dtype)
+
+
+def _linear(x, kernel, bias, dtype, scale=None):
     """The ColumnParallelLinear single-device math: compute-dtype
     matmul, bias in compute dtype."""
-    y = x.astype(dtype) @ kernel.astype(dtype)
-    return y + bias.astype(dtype)
+    return _matmul(x, kernel, dtype, scale) + bias.astype(dtype)
 
 
-def _row_linear(x, kernel, bias, dtype, tp_axis):
+def _row_linear(x, kernel, bias, dtype, tp_axis, scale=None):
     """RowParallelLinear: with ``tp_axis`` set the kernel rows are a
     contraction shard, so the partial product all-reduces over the
     axis BEFORE the (replicated) bias adds exactly once; single-chip
-    (``tp_axis=None``) is plain ``_linear``."""
-    y = x.astype(dtype) @ kernel.astype(dtype)
+    (``tp_axis=None``) is plain ``_linear``.  Per-channel scales
+    commute with the shard sum (each shard's partial covers every
+    output channel), so Q8 scales apply pre-psum."""
+    y = _matmul(x, kernel, dtype, scale)
     if tp_axis is not None:
         y = jax.lax.psum(y, tp_axis)
     return y + bias.astype(dtype)
@@ -192,9 +210,10 @@ def _layer_tail(x, lw: LayerWeights, attn_out, cfg):
     x = x + attn_out.astype(x.dtype)
     m_in = layer_norm(x, lw.ln2_w, lw.ln2_b,
                       cfg.layernorm_eps).astype(cfg.dtype)
-    h1 = jax.nn.gelu(_linear(m_in, lw.fc1_k, lw.fc1_b, cfg.dtype))
+    h1 = jax.nn.gelu(_linear(m_in, lw.fc1_k, lw.fc1_b, cfg.dtype,
+                             getattr(lw, "fc1_s", None)))
     mlp_out = _row_linear(h1, lw.fc2_k, lw.fc2_b, cfg.dtype,
-                          cfg.tp_axis)
+                          cfg.tp_axis, getattr(lw, "fc2_s", None))
     return x + mlp_out.astype(x.dtype)
 
 
@@ -244,7 +263,8 @@ def gpt_prefill_step(weights: GPTServingWeights,
     for i, lw in enumerate(weights.layers):
         a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
                           cfg.layernorm_eps).astype(cfg.dtype)
-        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype,
+                      getattr(lw, "qkv_s", None))
         qkv = qkv.reshape(1, s_pad, h, 3 * d)
         q, k, v = jnp.split(qkv, 3, axis=-1)      # (1, s, h, d)
         cache = write_prefill_kv(cache, cache_cfg, i, k[0], v[0],
@@ -254,7 +274,8 @@ def gpt_prefill_step(weights: GPTServingWeights,
         ctx = attn(qt, kt, vt, scale=scale, causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(1, s_pad, h * d)
         attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
-                               cfg.tp_axis)
+                               cfg.tp_axis,
+                               getattr(lw, "dense_s", None))
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)[0]          # (s_pad, V)
     last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=0,
@@ -296,7 +317,8 @@ def gpt_decode_step(weights: GPTServingWeights,
     for i, lw in enumerate(weights.layers):
         a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
                           cfg.layernorm_eps).astype(cfg.dtype)
-        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype,
+                      getattr(lw, "qkv_s", None))
         qkv = qkv.reshape(b, h, 3 * d)
         q, k, v = jnp.split(qkv, 3, axis=-1)       # (b, h, d)
         cache = write_token_kv(cache, cache_cfg, i, k, v,
@@ -311,7 +333,8 @@ def gpt_decode_step(weights: GPTServingWeights,
                 k_scale=ks, v_scale=vs)
         ctx = ctx.reshape(b, h * d)
         attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
-                               cfg.tp_axis)
+                               cfg.tp_axis,
+                               getattr(lw, "dense_s", None))
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)             # (b, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -363,7 +386,8 @@ def gpt_extend_step(weights: GPTServingWeights,
     for i, lw in enumerate(weights.layers):
         a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
                           cfg.layernorm_eps).astype(cfg.dtype)
-        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype,
+                      getattr(lw, "qkv_s", None))
         qkv = qkv.reshape(b, t, h, 3 * d)
         q, k, v = jnp.split(qkv, 3, axis=-1)       # (b, t, h, d)
         cache = write_token_kv(cache, cache_cfg, i,
@@ -380,11 +404,47 @@ def gpt_extend_step(weights: GPTServingWeights,
                 k_scale=ks, v_scale=vs)
         ctx = ctx.reshape(b, t, h * d)
         attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
-                               cfg.tp_axis)
+                               cfg.tp_axis,
+                               getattr(lw, "dense_s", None))
         x = _layer_tail(x, lw, attn_out, cfg)
     logits = _lm_head(x, weights, cfg)             # (b, t, V)
     next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return cache, next_tokens
+
+
+def gpt_sequence_logits(weights, cfg: ServingModelConfig,
+                        tokens: jnp.ndarray) -> jnp.ndarray:
+    """Whole-sequence teacher-forced logits ``(b, s, V)`` — no KV
+    cache, no paging: the training-forward view of the SAME serving
+    math (same ``_linear``/``_row_linear`` dispatch, so Q8 weights run
+    the quantized matmuls here too).  This is the oracle behind the
+    bench's perplexity-delta row and the Q8-vs-O5 divergence tests;
+    single-chip only (head counts come from ``cfg``, not a sharded
+    cache config)."""
+    from ..ops.flash_attention import flash_attention, mha_reference
+
+    b, s = tokens.shape
+    h, d = cfg.num_heads, cfg.head_dim
+    scale = d ** -0.5
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                           (b, s))
+    x = _embed(weights, tokens, pos, cfg)
+    for lw in weights.layers:
+        a_in = layer_norm(x, lw.ln1_w, lw.ln1_b,
+                          cfg.layernorm_eps).astype(cfg.dtype)
+        qkv = _linear(a_in, lw.qkv_k, lw.qkv_b, cfg.dtype,
+                      getattr(lw, "qkv_s", None))
+        qkv = qkv.reshape(b, s, h, 3 * d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        attn = flash_attention if cfg.prefill_flash else mha_reference
+        ctx = attn(qt, kt, vt, scale=scale, causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        attn_out = _row_linear(ctx, lw.dense_k, lw.dense_b, cfg.dtype,
+                               cfg.tp_axis,
+                               getattr(lw, "dense_s", None))
+        x = _layer_tail(x, lw, attn_out, cfg)
+    return _lm_head(x, weights, cfg)
 
 
 def copy_cache_block(cache: PagedKVCache, src: jnp.ndarray,

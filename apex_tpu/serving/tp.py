@@ -49,7 +49,8 @@ __all__ = ["SERVING_TP_AXIS", "TPContext", "serving_tp_plan",
 SERVING_TP_AXIS = "tensor"
 
 
-def serving_weight_specs(axis: str = SERVING_TP_AXIS):
+def serving_weight_specs(axis: str = SERVING_TP_AXIS, *,
+                         weight_quantized: bool = False):
     """Path-pattern → :data:`~apex_tpu.mesh_plan.Spec` for
     :class:`~.model.GPTServingWeights` leaves, as the SPMD auditor
     names them under an ``in0`` prefix (``in0.layers[0].qkv_k``).
@@ -60,8 +61,18 @@ def serving_weight_specs(axis: str = SERVING_TP_AXIS):
     by ffn column) along with their biases; row-parallel kernels
     (dense, fc2) shard their INPUT rows and keep the bias replicated
     (added once, after the psum).  Embeddings and every layer norm
-    stay replicated — the residual stream is global hidden."""
-    return {
+    stay replicated — the residual stream is global hidden.
+
+    ``weight_quantized`` (Q8 int8 weights,
+    :class:`~apex_tpu.ops.quant_matmul.QuantGPTServingWeights`) adds
+    the per-output-channel scale rows: a column-split kernel's scales
+    split with its columns (``qkv_s``/``fc1_s``), while a row-split
+    kernel's scales index GLOBAL output channels — applied to the
+    pre-psum partial, which covers every channel on every shard — so
+    ``dense_s``/``fc2_s`` stay replicated like the post-psum biases.
+    The patterns are gated so a bf16 plan never declares a spec that
+    matches no tensor (APX703)."""
+    specs = {
         r"\.qkv_k$": (None, axis),
         r"\.qkv_b$": (axis,),
         r"\.dense_k$": (axis, None),
@@ -69,11 +80,16 @@ def serving_weight_specs(axis: str = SERVING_TP_AXIS):
         r"\.fc1_b$": (axis,),
         r"\.fc2_k$": (axis, None),
     }
+    if weight_quantized:
+        specs[r"\.qkv_s$"] = (axis,)
+        specs[r"\.fc1_s$"] = (axis,)
+    return specs
 
 
 def serving_tp_plan(tp: int, num_layers: int, *,
                     axis: str = SERVING_TP_AXIS,
-                    quantized: bool = False) -> MeshPlan:
+                    quantized: bool = False,
+                    weight_quantized: bool = False) -> MeshPlan:
     """The TP serving topology contract for the audited decode entry:
     weight specs under ``in0``, the paged cache's head axis (storage
     axis 2 of ``(L, nb, hk, bs, dk)``) under ``in1`` and on the
@@ -83,7 +99,8 @@ def serving_tp_plan(tp: int, num_layers: int, *,
     in_shardings from THIS object, so plan drift is an APX703
     finding, not a silent reshard."""
     specs = {}
-    for pat, spec in serving_weight_specs(axis).items():
+    for pat, spec in serving_weight_specs(
+            axis, weight_quantized=weight_quantized).items():
         specs[r"^in0.*" + pat] = spec
     cache_spec = (None, None, axis)
     if quantized:
@@ -132,7 +149,8 @@ class TPContext:
     def __init__(self, model_cfg: ServingModelConfig,
                  cache_cfg: KVCacheConfig, tp: int, *,
                  axis: str = SERVING_TP_AXIS,
-                 devices: Optional[Sequence[Any]] = None):
+                 devices: Optional[Sequence[Any]] = None,
+                 weight_quantized: bool = False):
         if tp < 2:
             raise ValueError(f"tp {tp} must be >= 2 (tp=1 is the "
                              f"single-chip engine, no context needed)")
@@ -168,11 +186,25 @@ class TPContext:
         self.axis = axis
         self.cache_cfg = cache_cfg            # GLOBAL geometry
         self.local_cache_cfg = local          # per-shard geometry
+        self.weight_quantized = bool(weight_quantized)
         self.model_cfg = dataclasses.replace(model_cfg, tp_axis=axis)
         self.plan = serving_tp_plan(tp, model_cfg.num_layers,
                                     axis=axis,
-                                    quantized=cache_cfg.quantized)
+                                    quantized=cache_cfg.quantized,
+                                    weight_quantized=weight_quantized)
         self.mesh = self.plan.make_mesh(devices)
+
+    def rebind(self, *, weight_quantized: bool) -> "TPContext":
+        """The same topology re-planned for the other weight format —
+        the engine's requantization swap calls this so the bf16→int8
+        rollout reuses the context's devices and geometry while the
+        plan gains (or drops) the int8 scale-row specs."""
+        if bool(weight_quantized) == self.weight_quantized:
+            return self
+        return TPContext(
+            self.model_cfg, self.cache_cfg, self.tp, axis=self.axis,
+            devices=list(self.mesh.devices.flat),
+            weight_quantized=weight_quantized)
 
     # --- spec trees -----------------------------------------------------
 

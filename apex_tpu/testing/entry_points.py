@@ -61,6 +61,10 @@ POLICY_FP32_REGIONS = (
     # scales are the decode path's sanctioned fp32 regions
     "apex_tpu/serving/",
     "apex_tpu/ops/flash_decode.py",
+    # Q8: fp32 accumulation is the quantized matmul's contract (the
+    # activation upcast feeding the int8 contraction) — APX606, not
+    # APX602, polices what may leave this module
+    "apex_tpu/ops/quant_matmul.py",
 )
 
 
@@ -203,6 +207,36 @@ def _build_gpt_decode_step():
     setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
     cfg = ServingModelConfig.from_model(setup.model)
     weights = extract_serving_weights(setup.params, cfg.num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=8, block_size=4)
+    engine = ServingEngine(weights, cfg, cache_cfg,
+                           ladder=BucketLadder(batch=(2,), pages=(2,)))
+    return engine._jit_decode(), engine._decode_args(2, 2)
+
+
+def _build_gpt_decode_step_q8():
+    """The ISSUE-16 Q8 serving tier: the SAME continuous-batching
+    decode step as ``gpt_decode_step`` with the weight pytree
+    quantized to per-output-channel int8
+    (:func:`apex_tpu.ops.quant_matmul.quantize_weights`).  Built at
+    the Q8 policy surface so the compiled-graph audit holds the
+    quantized hot path to BOTH precision contracts: APX602 (no
+    unsanctioned bf16→f32 activation upcasts, same as O5) and APX606
+    (no weight-sized int8→float convert outside the quant kernel
+    family — the dequant must stay tile-local, never an HLO-visible
+    fp32 weight resident).  Donation and host-transfer guarantees are
+    unchanged from the bf16 entry."""
+    import jax.numpy as jnp
+
+    from ..ops.quant_matmul import quantize_weights
+    from ..serving import (BucketLadder, ServingEngine,
+                           ServingModelConfig, default_cache_config,
+                           extract_serving_weights)
+    from .standalone_gpt import make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
+    cfg = ServingModelConfig.from_model(setup.model)
+    weights = quantize_weights(
+        extract_serving_weights(setup.params, cfg.num_layers))
     cache_cfg = default_cache_config(cfg, num_blocks=8, block_size=4)
     engine = ServingEngine(weights, cfg, cache_cfg,
                            ladder=BucketLadder(batch=(2,), pages=(2,)))
@@ -353,6 +387,13 @@ register_entry_point(
         "one (batch=2, pages=2) bucket) — the cache carry donated, "
         "zero compiled-in host transfers; what standalone_gpt "
         "--serve runs per tick")
+register_entry_point(
+    "gpt_decode_step_q8", _build_gpt_decode_step_q8, policy="Q8",
+    dead_args=(1,),
+    doc="Q8 serving decode step: int8 weight-only matmuls "
+        "(ops/quant_matmul) on the same bucketed decode program — "
+        "the APX606 dequant-residency audit surface (what "
+        "standalone_gpt --serve --policy Q8 runs per tick)")
 register_entry_point(
     "fused_pipeline_step", _build_fused_pipeline_step, policy="O5",
     dead_args=(0, 1, 2),

@@ -1133,6 +1133,7 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 num_heads: int = 4, num_layers: int = 2,
                 max_seq: int = 64, max_new_tokens: int = 6,
                 seed: int = 0, dtype=jnp.float32,
+                policy: Optional[str] = None,
                 decode_attention: str = "kernel",
                 prefill_flash: bool = True,
                 num_blocks: Optional[int] = None,
@@ -1215,6 +1216,12 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     serve watchdog policy (stall → snapshot-then-drain); pass an
     :class:`~apex_tpu.resilience.EscalationPolicy` or None.
 
+    ``policy`` selects an amp serving tier (ISSUE-16): ``"O5"`` casts
+    the model to bf16; ``"Q8"`` additionally quantizes every matmul
+    weight to per-channel int8 (:func:`apex_tpu.ops.quant_matmul.
+    quantize_weights`), so the serve exercises the quantized decode
+    path end to end — the ``--policy Q8`` CI smoke.
+
     Returns the :class:`~apex_tpu.serving.ServeSummary` (with
     ``return_engine=True``, ``(summary, engine)`` — how tests read
     per-request token streams)."""
@@ -1226,6 +1233,12 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                            SnapshotTrigger, default_cache_config,
                            extract_serving_weights, run_serving)
 
+    pol = None
+    if policy is not None:
+        from ..amp import get_policy
+        pol = get_policy(policy)
+        if pol.cast_model_type is not None:
+            dtype = pol.cast_model_type
     model = GPTModel(
         vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
         num_attention_heads=num_heads, max_sequence_length=max_seq,
@@ -1238,6 +1251,9 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         model, prefill_flash=prefill_flash,
         decode_attention=decode_attention)
     weights = extract_serving_weights(params, num_layers)
+    if pol is not None and pol.quantize_weights == "int8":
+        from ..ops.quant_matmul import quantize_weights as _quantize_w
+        weights = _quantize_w(weights)
     cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
                                      block_size=block_size,
                                      kv_dtype=kv_dtype)
@@ -1286,7 +1302,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                    "requests": num_requests, "max_seq": max_seq,
                    "kv_dtype": cache_cfg.kv_dtype,
                    "block_size": cache_cfg.block_size,
-                   "decode_attention": decode_attention})
+                   "decode_attention": decode_attention,
+                   "policy": policy or "none"})
     if isinstance(fault, str):
         fault = parse_fault(fault)
     journal = None
@@ -1718,6 +1735,12 @@ def _main(argv=None):
     p.add_argument("--decode-reference", action="store_true",
                    help="(--serve) dense full-gather decode instead "
                         "of the paged kernel (the naive baseline)")
+    p.add_argument("--policy", default=None, choices=("O5", "Q8"),
+                   help="(--serve) amp serving tier: O5 casts the "
+                        "model to bf16; Q8 additionally quantizes "
+                        "every matmul weight to per-channel int8 "
+                        "(weight-only, fp32 accumulation) — the "
+                        "quantized decode smoke")
     p.add_argument("--speculate-k", type=int, default=None,
                    metavar="K",
                    help="(--serve) speculative decoding: a draft "
@@ -1885,7 +1908,7 @@ def _main(argv=None):
         s, eng = serve_smoke(
             args.requests, jsonl=args.jsonl, sanitize=args.sanitize,
             max_new_tokens=args.new_tokens,
-            max_seq=args.serve_max_seq,
+            max_seq=args.serve_max_seq, policy=args.policy,
             decode_attention=("reference" if args.decode_reference
                               else "kernel"),
             stall_timeout=args.stall_timeout, fault=args.fault,

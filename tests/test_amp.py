@@ -30,6 +30,13 @@ def test_opt_level_table():
     assert amp.O4.cast_ops_type == jnp.bfloat16 and amp.O4.loss_scale == 1.0
     assert amp.O5.cast_model_type == jnp.bfloat16 and amp.O5.master_weights
     assert amp.O5.loss_scale == 1.0
+    # Q8 rides below O5: same bf16 activation story, int8 weights,
+    # loss_scale pinned (serving-only tier — no scaled backward)
+    assert "Q8" in amp.opt_levels
+    assert amp.Q8.quantize_weights == "int8"
+    assert amp.Q8.cast_model_type == jnp.bfloat16
+    assert amp.Q8.loss_scale == 1.0 and amp.Q8.master_weights
+    assert amp.O5.quantize_weights is None
 
 
 def test_policy_overrides_and_validation():
@@ -39,6 +46,8 @@ def test_policy_overrides_and_validation():
         amp.get_policy("O7")
     with pytest.raises(ValueError):
         amp.Policy(cast_ops=True, cast_model_type=jnp.bfloat16)
+    with pytest.raises(ValueError, match="quantize_weights"):
+        amp.Policy(quantize_weights="int4")
 
 
 def test_convert_network_keeps_bn_fp32():

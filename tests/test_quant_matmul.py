@@ -1,0 +1,178 @@
+"""int8 weight-only matmul: kernel-vs-twin parity (APX401/402 surface)
+and the quantizer's degenerate-row discipline (ISSUE-16)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.quant_matmul import (QuantGPTServingWeights,
+                                       SCALE_FLOOR, dequantize_weight,
+                                       is_quantized_weights,
+                                       quant_matmul,
+                                       quant_matmul_reference,
+                                       quantize_weight,
+                                       quantize_weights, self_check)
+
+
+def _qw(key, k, n, scale=1.0):
+    w = jax.random.normal(key, (k, n), jnp.float32) * scale
+    return (w,) + quantize_weight(w)
+
+
+# --- kernel vs twin -------------------------------------------------------
+
+@pytest.mark.parametrize("batch", [1, 4, 8])
+def test_gemv_parity(batch):
+    """The decode fast path (M <= 8) against the jnp twin."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    _, wq, sc = _qw(kw, 128, 384)
+    x = jax.random.normal(kx, (batch, 128), jnp.float32)
+    got = quant_matmul(x, wq, sc, backend="pallas")
+    want = quant_matmul_reference(x, wq, sc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("k,n", [(96, 160), (100, 130), (192, 72)])
+def test_odd_dims_parity(k, n):
+    """Odd K/N zero-pad to kernel tiles; padded columns slice off."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(1))
+    _, wq, sc = _qw(kw, k, n)
+    x = jax.random.normal(kx, (4, k), jnp.float32)
+    got = quant_matmul(x, wq, sc, backend="pallas")
+    want = quant_matmul_reference(x, wq, sc)
+    assert got.shape == (4, n)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tiled_parity():
+    """The prefill path (M > 8, M-tiled grid)."""
+    kx, kw = jax.random.split(jax.random.PRNGKey(2))
+    _, wq, sc = _qw(kw, 128, 256)
+    x = jax.random.normal(kx, (200, 128), jnp.float32)
+    got = quant_matmul(x, wq, sc, backend="pallas")
+    want = quant_matmul_reference(x, wq, sc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_saturating_amax_inputs():
+    """Columns driven to exactly +/-amax hit the +/-127 codes — no
+    wraparound, kernel and twin agree bit-for-bit."""
+    k, n = 64, 128
+    w = np.zeros((k, n), np.float32)
+    w[0, :] = np.linspace(-3.0, 3.0, n)     # the amax row per column
+    w[1, :] = -w[0, :]
+    wq, sc = quantize_weight(jnp.asarray(w))
+    assert int(jnp.max(wq)) == 127 and int(jnp.min(wq)) == -127
+    x = jnp.ones((8, k), jnp.float32) * 5.0
+    got = quant_matmul(x, wq, sc, backend="pallas")
+    want = quant_matmul_reference(x, wq, sc)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_leading_dims_and_out_dtype():
+    kx, kw = jax.random.split(jax.random.PRNGKey(3))
+    _, wq, sc = _qw(kw, 128, 128)
+    x = jax.random.normal(kx, (2, 3, 128), jnp.bfloat16)
+    got = quant_matmul(x, wq, sc, backend="pallas")
+    assert got.shape == (2, 3, 128) and got.dtype == jnp.bfloat16
+    want = quant_matmul_reference(x, wq, sc)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_backend_dispatch_and_validation():
+    _, wq, sc = _qw(jax.random.PRNGKey(4), 64, 64)
+    x = jnp.ones((2, 64))
+    # default backend off-TPU is the twin (XLA fallback)
+    np.testing.assert_allclose(
+        np.asarray(quant_matmul(x, wq, sc)),
+        np.asarray(quant_matmul_reference(x, wq, sc)))
+    with pytest.raises(ValueError, match="backend"):
+        quant_matmul(x, wq, sc, backend="cuda")
+    with pytest.raises(ValueError, match="int8"):
+        quant_matmul(x, wq.astype(jnp.int32), sc)
+    with pytest.raises(ValueError, match="mismatch"):
+        quant_matmul(jnp.ones((2, 63)), wq, sc)
+    with pytest.raises(ValueError, match="mismatch"):
+        quant_matmul(x, wq, sc[:-1])
+
+
+def test_self_check_runs():
+    self_check()
+
+
+# --- quantizer ------------------------------------------------------------
+
+def test_quantize_round_trip_error_bound():
+    w, wq, sc = _qw(jax.random.PRNGKey(5), 128, 96, scale=2.0)
+    deq = dequantize_weight(wq, sc)
+    # symmetric int8: worst-case error is half a quantization step
+    step = np.asarray(sc)[None, :]
+    assert np.all(np.abs(np.asarray(deq - w)) <= step * 0.5 + 1e-7)
+
+
+def test_all_zero_channel_round_trips_exactly():
+    """The degenerate-row regression (ISSUE-16 satellite): an all-zero
+    output channel must round-trip to exactly 0.0 — scale floored at
+    SCALE_FLOOR, never a 0/0 NaN on either side."""
+    w = np.zeros((64, 8), np.float32)
+    w[:, 3] = 1.0                       # one live channel
+    wq, sc = quantize_weight(jnp.asarray(w))
+    assert np.all(np.isfinite(np.asarray(sc)))
+    assert float(jnp.min(sc)) == pytest.approx(SCALE_FLOOR / 127.0)
+    deq = np.asarray(dequantize_weight(wq, sc))
+    assert np.all(deq[:, :3] == 0.0) and np.all(deq[:, 4:] == 0.0)
+    np.testing.assert_allclose(deq[:, 3], w[:, 3])
+    for backend in ("pallas", "xla"):
+        out = quant_matmul(jnp.ones((2, 64)), wq, sc, backend=backend)
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert np.all(np.asarray(out)[:, :3] == 0.0)
+
+
+def test_quantize_weight_validates_rank():
+    with pytest.raises(ValueError, match="expects"):
+        quantize_weight(jnp.ones((4, 4, 4)))
+
+
+# --- the GPT pytree conversion -------------------------------------------
+
+def test_quantize_weights_pytree():
+    from apex_tpu.serving.model import (GPTServingWeights, LayerWeights)
+
+    h, f, v, s = 32, 128, 64, 16
+    key = jax.random.PRNGKey(6)
+    ks = jax.random.split(key, 8)
+    lw = LayerWeights(
+        ln1_w=jnp.ones((h,)), ln1_b=jnp.zeros((h,)),
+        qkv_k=jax.random.normal(ks[0], (h, 3 * h)),
+        qkv_b=jnp.zeros((3 * h,)),
+        dense_k=jax.random.normal(ks[1], (h, h)),
+        dense_b=jnp.zeros((h,)),
+        ln2_w=jnp.ones((h,)), ln2_b=jnp.zeros((h,)),
+        fc1_k=jax.random.normal(ks[2], (h, f)),
+        fc1_b=jnp.zeros((f,)),
+        fc2_k=jax.random.normal(ks[3], (f, h)),
+        fc2_b=jnp.zeros((h,)))
+    w = GPTServingWeights(
+        wte=jax.random.normal(ks[4], (v, h)),
+        wpe=jax.random.normal(ks[5], (s, h)),
+        layers=(lw, lw), lnf_w=jnp.ones((h,)), lnf_b=jnp.zeros((h,)))
+    qw = quantize_weights(w)
+    assert isinstance(qw, QuantGPTServingWeights)
+    assert not is_quantized_weights(w) and is_quantized_weights(qw)
+    assert len(qw.layers) == 2
+    ql = qw.layers[0]
+    assert ql.qkv_k.dtype == jnp.int8 and ql.qkv_s.shape == (3 * h,)
+    assert ql.fc2_k.dtype == jnp.int8 and ql.fc2_s.shape == (h,)
+    # embeddings / norms / biases ride through untouched
+    assert qw.wte is w.wte and ql.ln1_w is lw.ln1_w
+    assert ql.qkv_b is lw.qkv_b
+    # dequantized kernels approximate the originals
+    np.testing.assert_allclose(
+        np.asarray(dequantize_weight(ql.dense_k, ql.dense_s)),
+        np.asarray(lw.dense_k), atol=float(jnp.max(ql.dense_s)) * 0.51)

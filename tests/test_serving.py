@@ -424,6 +424,21 @@ class TestCacheWrites:
         np.testing.assert_allclose(back, np.asarray(x), atol=np.max(
             np.abs(np.asarray(x))) / 127.0 * 1.01)
 
+    def test_quantize_weight_zero_channel_roundtrip(self):
+        # the weight-quantizer analogue of the KV scale floor
+        # (ISSUE-16): an all-zero output channel must round-trip to
+        # exactly 0.0 with a finite floored scale, never 0/0 = NaN
+        from apex_tpu.ops.quant_matmul import (dequantize_weight,
+                                               quantize_weight)
+        w = jnp.zeros((16, 4), jnp.float32).at[:, 1].set(2.0)
+        wq, sc = quantize_weight(w)
+        assert np.all(np.isfinite(np.asarray(sc))) and np.all(
+            np.asarray(sc) > 0.0)
+        deq = np.asarray(dequantize_weight(wq, sc))
+        assert np.all(deq[:, 0] == 0.0)
+        assert np.all(deq[:, 2:] == 0.0)
+        np.testing.assert_allclose(deq[:, 1], 2.0)
+
 
 # ---------------------------------------------------------------------------
 # bucket ladder
@@ -539,6 +554,62 @@ class TestServingModelParity:
             with pytest.raises(ValueError, match="max_new_tokens"):
                 eng.submit(Request(rid="z", prompt=[1, 2, 3],
                                    max_new_tokens=bad))
+
+
+# Committed divergence bound for the Q8 tier (ISSUE-16): int8
+# weight-only quantization may flip at most this fraction of greedy
+# tokens vs the float engine on the smoke GPT (measured 0/24 across
+# seeds; the bound leaves quantization-noise headroom, it is not a
+# target).
+Q8_GREEDY_DIVERGENCE_BOUND = 0.10
+
+
+class TestQ8Serving:
+    def test_q8_greedy_tracks_float_within_committed_bound(self):
+        from apex_tpu.ops.quant_matmul import quantize_weights
+        model, params = _tiny_model(vocab=64, hidden=64, heads=2)
+        cfg = ServingModelConfig.from_model(
+            model, prefill_flash=False, decode_attention="reference")
+        weights = extract_serving_weights(params, cfg.num_layers)
+        cache_cfg = default_cache_config(cfg, num_blocks=16,
+                                         block_size=4)
+        lad = BucketLadder(batch=(2,), pages=(3,))
+        prompts = [[3, 7, 1], [11, 2, 9, 4, 5], [1, 2], [6, 6, 6, 6]]
+        new = 6
+        outs = {}
+        for tag, w in (("float", weights),
+                       ("q8", quantize_weights(weights))):
+            eng = ServingEngine(w, cfg, cache_cfg, ladder=lad)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(rid=f"r{i}", prompt=p,
+                                   max_new_tokens=new))
+            eng.run()
+            assert len(eng.done) == len(prompts)
+            outs[tag] = {q.rid: q.out_tokens for q in eng.done}
+        total = sum(len(v) for v in outs["float"].values())
+        diverged = sum(a != b for rid in outs["float"]
+                       for a, b in zip(outs["float"][rid],
+                                       outs["q8"][rid]))
+        assert diverged / total <= Q8_GREEDY_DIVERGENCE_BOUND, (
+            diverged, total)
+
+    def test_q8_swap_back_and_forth(self):
+        # bf16<->int8 requantization swaps both directions; the
+        # second direction restores the original treedef bitwise path
+        from apex_tpu.ops.quant_matmul import (is_quantized_weights,
+                                               quantize_weights)
+        model, params = _tiny_model()
+        lad = BucketLadder(batch=(2,), pages=(3,))
+        eng = _engine(model, params, ladder=lad)
+        weights = eng.weights
+        eng.swap_weights(quantize_weights(weights))
+        assert is_quantized_weights(eng.weights)
+        eng.swap_weights(weights)
+        assert not is_quantized_weights(eng.weights)
+        eng.submit(Request(rid="r", prompt=[3, 1, 4],
+                           max_new_tokens=3))
+        eng.run()
+        assert len(eng.done) == 1
 
 
 class TestContinuousBatching:

@@ -446,6 +446,35 @@ class TestFleetServe:
         for r in router.serve_replicas:
             assert all(v == 1 for v in r.engine._compiles.values())
 
+    def test_rolling_requant_swap_bf16_to_int8(self, smoke_weights):
+        # the Q8 rollout path (ISSUE-16): a rolling swap hands each
+        # replica an int8-quantized pytree; the treedef changes, so the
+        # recompile is charged to the drained swap window — steady
+        # state afterwards must stay zero-recompile, with zero lost
+        # requests during the roll
+        from apex_tpu.ops.quant_matmul import (is_quantized_weights,
+                                               quantize_weights)
+        cfg, weights, _ = smoke_weights
+        qweights = quantize_weights(weights)
+        mk = lambda: make_engine(cfg, weights, warm=True)
+        router = FleetRouter([Replica("r0", mk()),
+                              Replica("r1", mk())])
+        reqs = make_requests(6, seed=41, max_new=6)
+        s = router.serve(reqs, swap_after=2, swap_weights=qweights)
+        assert s.swaps == 2
+        assert s.lost_requests == 0
+        assert s.requests_done == 6
+        for r in router.serve_replicas:
+            assert is_quantized_weights(r.engine.weights)
+        # steady state after the swap: more traffic, no new compiles
+        before = {r.replica_id: dict(r.engine._compiles)
+                  for r in router.serve_replicas}
+        more = router.serve(make_requests(4, seed=43))
+        assert more.lost_requests == 0
+        assert more.requests_done - s.requests_done == 4
+        for r in router.serve_replicas:
+            assert dict(r.engine._compiles) == before[r.replica_id]
+
     def test_swap_requires_idle(self, smoke_weights):
         cfg, weights, weights2 = smoke_weights
         e = make_engine(cfg, weights)

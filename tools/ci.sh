@@ -170,28 +170,39 @@
 #                            requests and zero uncaught background-
 #                            thread exceptions
 #                            (docs/api/analysis.md)
+#  15. Q8 quantized serving  — the ISSUE-16 int8 weight-only tier:
+#                            ops/quant_matmul.self_check() runs the
+#                            interpret-mode parity sweep (GEMV +
+#                            tiled paths vs the jnp twin, the
+#                            all-zero-channel round-trip), then a
+#                            sanitized `--serve --policy Q8` smoke
+#                            must decode through int8 weights with
+#                            the SAME AOT bucket ladder — one compile
+#                            per bucket, zero post-warmup recompiles,
+#                            tokens/s > 0 (docs/api/serving.md
+#                            #weight-quantization)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/14 default test tier"
+echo "[ci] 1/15 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/14 README drift guard"
+echo "[ci] 2/15 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/14 8-device multichip dryrun"
+echo "[ci] 3/15 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/14 monitor smoke"
+echo "[ci] 4/15 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/14 kill->resume smoke"
+echo "[ci] 5/15 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -211,16 +222,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/14 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/15 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/14 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/15 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/14 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/15 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -229,7 +240,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/14 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/15 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -250,7 +261,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/14 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/15 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -274,7 +285,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/14 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/15 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -398,7 +409,7 @@ grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
-echo "[ci] 12/14 SPMD sharding audit (--check-sharding) + topology drift"
+echo "[ci] 12/15 SPMD sharding audit (--check-sharding) + topology drift"
 # Compile every plan-carrying multichip entry under its mesh on the
 # same 8-device host-platform trick the multichip tests use; fails on
 # APX701-703 findings, per-device-memory drift vs the committed
@@ -410,7 +421,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-sharding
 python __graft_entry__.py --plans 8
 
-echo "[ci] 13/14 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
+echo "[ci] 13/15 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
 FLEET_DIR="$(mktemp -d -t apex_tpu_fleet.XXXXXX)"
 # leg 1: sanitized 2-replica fleet with ONE rolling weight swap
 # mid-serve — zero lost requests fleet-wide, zero compiles after
@@ -466,7 +477,7 @@ echo "$FLEET_OUT" | grep -q "done=8" \
 python tools/trace_check.py "$FLEET_DIR"/crash/serve-*.jsonl --serve
 rm -rf "$FLEET_DIR"
 
-echo "[ci] 14/14 host-concurrency audit (--check-concurrency) + schedule stress"
+echo "[ci] 14/15 host-concurrency audit (--check-concurrency) + schedule stress"
 # static half: APX801-805 over the whole package against the
 # committed EMPTY baseline (a stale entry fails like the linter's)
 python -m apex_tpu.analysis --check-concurrency
@@ -476,5 +487,26 @@ python -m apex_tpu.analysis --check-concurrency
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis.schedule --seeds 5 --replicas 2 \
     --requests 6 --new-tokens 4
+
+echo "[ci] 15/15 Q8 quantized serving smoke (int8 weight-only decode)"
+# kernel half: the quant matmul's interpret-mode parity sweep — GEMV
+# and tiled paths vs the jnp twin, plus the zero-channel round-trip
+python -c "from apex_tpu.ops import quant_matmul; \
+quant_matmul.self_check()"
+# serve half: a sanitized --policy Q8 serve — weights quantized to
+# per-channel int8 before the engine builds, the same pinned ladder
+# AOT-compiles (1 decode bucket + 1 prefill = 2 programs), and the
+# post-warmup recompile budget stays ZERO with tokens flowing
+Q8_OUT="$(APEX_TPU_SERVE_BATCH_BUCKETS=2 \
+    APEX_TPU_SERVE_PAGE_BUCKETS=2 \
+    python -m apex_tpu.testing.standalone_gpt --serve --requests 3 \
+    --new-tokens 3 --policy Q8 --sanitize)"
+echo "$Q8_OUT"
+echo "$Q8_OUT" | grep -q "requests=3 " \
+    || { echo "[ci] FAIL: Q8 serve did not finish all 3 requests"; exit 1; }
+echo "$Q8_OUT" | grep -q "compiles=2 " \
+    || { echo "[ci] FAIL: Q8 serve broke the one-compile-per-bucket ladder"; exit 1; }
+echo "$Q8_OUT" | grep -Eq "tokens_s=[1-9]" \
+    || { echo "[ci] FAIL: Q8 serve reported zero tokens/s"; exit 1; }
 
 echo "[ci] all green"

@@ -141,6 +141,25 @@ def render(full: dict, artifact_name: str, topo: list = None) -> str:
         if sv.get("kernel_vs_naive") is not None:
             row("serving: paged kernel vs naive full-gather decode",
                 f"{sv['kernel_vs_naive']}x")
+    # ISSUE-16 Q8 tier: the int8 weight-only policy's committed rows —
+    # weight-stream shrink, decode tokens/s, and the numerics price.
+    # Lives outside the decode gate: the committed artifact carries
+    # the policies row even while the TPU-tier decode rows are skipped.
+    pol = sv.get("policies") if isinstance(sv, dict) else None
+    if isinstance(pol, dict) and isinstance(pol.get("Q8"), dict):
+        q8 = pol["Q8"]
+        if q8.get("weight_bytes_vs_o5") is not None:
+            row("serving: Q8 int8 weight-only tier — resident weight "
+                "stream vs bf16 O5 (the HBM-bound decode lever)",
+                f"{q8['weight_bytes_vs_o5']}x smaller")
+        if q8.get("decode_tokens_per_sec") is not None:
+            row("serving: Q8 decode throughput, host substrate "
+                "(see artifact note)",
+                f"{q8['decode_tokens_per_sec']} tok/s "
+                f"({q8.get('vs_o5')}x vs O5)")
+        if q8.get("perplexity_delta") is not None:
+            row("serving: Q8 teacher-forced perplexity delta vs the "
+                "same bf16 model", f"{q8['perplexity_delta']:+g}")
     fl = ex.get("serving_fleet", {})
     if isinstance(fl, dict) and fl.get("scaling"):
         tps = {r.get("replicas"): r.get("tokens_per_sec")
