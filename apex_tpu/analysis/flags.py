@@ -438,6 +438,38 @@ register_flag(
     "avoiding shed-engaged replicas; 'round_robin' ignores all "
     "signals (the A/B control the bench row compares against).")
 register_flag(
+    "APEX_TPU_METRICS_PORT", "int", 0,
+    "Live metrics plane (monitor/export.py): >0 starts the stdlib "
+    "MetricsServer daemon thread on this port for the --serve / "
+    "--serve-fleet drivers, exposing /metrics (Prometheus text "
+    "exposition fed from the existing gauge/metrics structures — no "
+    "second bookkeeping path), /healthz (drain/shed/escalation/"
+    "SLO-burn aware, 503 while draining) and /varz (the same "
+    "engine.snapshot_state() JSON as the SIGUSR1 trigger).  0 "
+    "disables.  The --metrics-port CLI flag overrides; port 0 with "
+    "the CLI flag picks an ephemeral port (printed in the "
+    "metrics_server_started event).", lo=0, hi=65535)
+register_flag(
+    "APEX_TPU_SLO_TTFT_P99_MS", "float", 0.0,
+    "Serving SLO: time-to-first-token p99 objective in milliseconds "
+    "for ALL priority classes (serving/metrics.SLOTracker).  >0 arms "
+    "dual-window burn-rate tracking — an slo_burn alarm fires "
+    "(once per episode, through the watchdog escalation machinery) "
+    "when both the fast and slow rolling windows burn error budget "
+    "at >= the trip threshold.  0 disables the dimension.", lo=0.0)
+register_flag(
+    "APEX_TPU_SLO_ITL_P99_MS", "float", 0.0,
+    "Serving SLO: inter-token-latency p99 objective in milliseconds, "
+    "same burn-rate semantics as APEX_TPU_SLO_TTFT_P99_MS.  0 "
+    "disables the dimension.", lo=0.0)
+register_flag(
+    "APEX_TPU_SLO_AVAILABILITY", "float", 0.0,
+    "Serving SLO: availability target as a fraction (e.g. 0.999) — a "
+    "request counts against it when it terminates shed or "
+    "deadline_exceeded (preemptions are resumed work, not failures). "
+    "Error budget is 1-target; burn-rate semantics as the latency "
+    "objectives.  0 disables the dimension.", lo=0.0, hi=1.0)
+register_flag(
     "APEX_TPU_SHARDING_MIN_BYTES", "int", 1024,
     "Size floor for the SPMD auditor's APX701 replication rule "
     "(docs/api/analysis.md): a plan-sharded tensor smaller than this "

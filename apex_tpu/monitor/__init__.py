@@ -36,11 +36,28 @@ bench.py ONE structured emission path, in three layers:
    deferred metrics (zero per-step host transfers), and
    :class:`CaptureTrigger` on-demand profiling windows.
 
+5. **Export** (:mod:`.export`) — the live half (ISSUE-17): an
+   OpenMetrics :class:`MetricsRegistry` rendered in Prometheus text
+   exposition format, the lock-free :class:`MetricsExporter`
+   publish/scrape hand-off, the :class:`MetricsServer`
+   (``/metrics`` + ``/healthz`` + ``/varz`` on a stdlib daemon
+   thread), the :class:`FleetAggregator` trend rings, and
+   :func:`registry_from_serve_events` proving the JSONL stays the
+   complete source of truth.
+
 When to reach for what: ``monitor`` = run health over time; ``pyprof`` =
 where device time went; ``Timers`` = phase wall times (and they export
 into the monitor log via ``Timers.events``).  Full story with the JSONL
 schema: docs/api/observability.md.
 """
+from .export import (
+    FleetAggregator,
+    MetricsExporter,
+    MetricsRegistry,
+    MetricsServer,
+    PublishedState,
+    registry_from_serve_events,
+)
 from .events import (
     KINDS,
     SCHEMA_VERSION,
@@ -80,4 +97,7 @@ __all__ = [
     "StepWaterfall", "TraceSession", "CaptureTrigger",
     "DeviceMetricsBuffer", "DeferredTelemetry",
     "chrome_trace_from_events", "write_chrome_trace",
+    "MetricsRegistry", "MetricsExporter", "MetricsServer",
+    "PublishedState", "FleetAggregator",
+    "registry_from_serve_events",
 ]

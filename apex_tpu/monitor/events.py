@@ -71,9 +71,23 @@ SCHEMA_VERSION = 1
 #:               free+reserved blocks / queue depth / admissions+
 #:               evictions+preemptions this window — the fleet-router
 #:               feed, cadence ``APEX_TPU_SERVE_TICK_EVERY``)
+#:   ``fleet_tick`` per-router-round fleet aggregation
+#:               (:class:`apex_tpu.monitor.export.FleetAggregator`:
+#:               summed queue depth / free-blocks-net / backlog, token
+#:               and compile deltas over MEASURED per-replica engine
+#:               ticks — the ``ticks`` attr is the rate denominator,
+#:               never the nominal cadence — plus slope/EWMA trends)
+#:   ``slo``      SLO bookkeeping from :mod:`apex_tpu.serving.metrics`
+#:               (``slo_objectives`` — the objective definitions every
+#:               ``slo_burn`` alarm must trace back to — and
+#:               ``slo_recovered`` episode-clear records; the burn
+#:               itself is kind ``alarm`` name ``slo_burn``, routed
+#:               through the watchdog so escalation hooks see it)
+#:   ``metrics``  exporter lifecycle (``metrics_server_started`` /
+#:               ``metrics_server_stopped`` — trace_check pairs them)
 KINDS = ("run", "metric", "scale", "alarm", "timer", "span", "attr",
          "trace", "section", "resilience", "telemetry", "serving",
-         "serve_tick")
+         "serve_tick", "fleet_tick", "slo", "metrics")
 
 
 def _jsonable(v: Any) -> Any:

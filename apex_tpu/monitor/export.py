@@ -1,0 +1,649 @@
+"""Live metrics plane: OpenMetrics export of the serving telemetry.
+
+Everything the stack measures today terminates in the append-only
+JSONL — legible only *after* the run, through ``monitor_summary``.
+ROADMAP item 3 (router state over RPC, autoscaling from queue-depth /
+pool trends, per-class SLO gating) needs the same signals live.  This
+module is that plane's generic half — no serving imports, so the
+monitor layer stays below :mod:`apex_tpu.serving`:
+
+* :class:`MetricsRegistry` — counter / gauge / histogram families
+  with label sets, rendered in the Prometheus text exposition format
+  (version 0.0.4: ``# HELP`` / ``# TYPE`` headers, sorted label
+  pairs, cumulative ``le`` histogram buckets with ``+Inf``).  The
+  registry is an *adapter target*: the serving side builds one per
+  publish from bookkeeping it already holds
+  (``EngineGauges.router_snapshot()``, :class:`~apex_tpu.serving.
+  metrics.ServeMetrics` distributions, watchdog episode counters) —
+  no second bookkeeping path, and the one-fetch-per-tick device
+  budget is untouched.
+* :class:`MetricsExporter` — the lock-free hand-off between the
+  engine tick and the scrape side: the publisher swaps ONE immutable
+  :class:`PublishedState` reference per tick (a single attribute
+  store, atomic under the GIL — no lock anywhere on the tick path),
+  and every scrape renders from whatever reference it loaded,
+  stamping how stale that snapshot is.  A scrape can therefore never
+  block an engine tick, by construction.
+* :class:`MetricsServer` — a stdlib ``http.server`` daemon thread
+  exposing ``/metrics`` (exposition text), ``/healthz``
+  (drain/shed/escalation/SLO-aware status, 200/503), and ``/varz``
+  (the ``engine.snapshot_state()`` JSON — the same payload the
+  SIGUSR1 :class:`~apex_tpu.serving.metrics.SnapshotTrigger` dumps).
+  Handlers only read the exporter's published state; they never call
+  into the engine.  Lifecycle events
+  (``metrics_server_started`` / ``metrics_server_stopped``) pair up
+  in the JSONL (``trace_check --serve`` asserts it).
+* :class:`FleetAggregator` — merges N per-replica
+  ``router_snapshot()`` dicts into fleet-level series held in
+  bounded host rings (queue depth, free blocks net of reservations,
+  backlog, tokens/tick, compile deltas) with windowed trends (least-
+  squares slope + EWMA per series) — the autoscaling signal feed,
+  emitted as one ``fleet_tick`` event per router round.  Rate math
+  divides by the *measured* engine-tick delta stamped on the event
+  (``ticks``), never by a nominal cadence.
+* :func:`registry_from_serve_events` — rebuilds the exporter's
+  counter/gauge state from a serve JSONL, proving the log stays the
+  complete source of truth (property-tested in
+  tests/test_monitor_export.py).
+
+Worked example + healthz semantics table: docs/api/observability.md.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Iterable, List, Optional, \
+    Sequence, Tuple
+
+from ..utils.log_util import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["MetricsRegistry", "MetricsExporter", "MetricsServer",
+           "PublishedState", "FleetAggregator",
+           "registry_from_serve_events"]
+
+# metric-name prefix every serving series uses (the exposition
+# convention: one namespace per exporter)
+NAMESPACE = "apex_tpu"
+
+# default histogram bucket bounds (milliseconds) for latency series
+DEFAULT_MS_BUCKETS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting: integral floats print as
+    integers (``3`` not ``3.0``) so goldens stay stable."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _render_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+class _Family:
+    """One metric family: a name, a TYPE, and its labeled samples."""
+
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = _label_key(labels)
+        self._values[key] = self._values.get(key, 0.0) + float(value)
+
+    def set(self, value: float, **labels) -> None:
+        """Store an absolute value.  Legal on counters too: the
+        serving adapters *mirror* cumulative counters the engine
+        already keeps, they do not re-count."""
+        self._values[_label_key(labels)] = float(value)
+
+    def get(self, **labels) -> Optional[float]:
+        return self._values.get(_label_key(labels))
+
+    def samples(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        return dict(self._values)
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key in sorted(self._values):
+            lines.append(f"{self.name}{_render_labels(key)} "
+                         f"{_fmt(self._values[key])}")
+        return lines
+
+
+class _Histogram(_Family):
+    """Cumulative-bucket histogram family (``le`` + ``+Inf``, plus
+    ``_sum`` / ``_count``), the exposition-format shape scrapers
+    expect for latency series."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
+        super().__init__(name, "histogram", help_text)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # label key -> [per-bucket counts..., +Inf count]
+        self._counts: Dict[Tuple[Tuple[str, str], ...], List[int]] = {}
+        self._sums: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        counts = self._counts.setdefault(
+            key, [0] * (len(self.buckets) + 1))
+        v = float(value)
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+        self._sums[key] = self._sums.get(key, 0.0) + v
+
+    def samples(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        return {key: float(sum(counts))
+                for key, counts in self._counts.items()}
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key in sorted(self._counts):
+            counts = self._counts[key]
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += counts[i]
+                bkey = key + (("le", _fmt(b)),)
+                lines.append(f"{self.name}_bucket"
+                             f"{_render_labels(bkey)} {cum}")
+            cum += counts[-1]
+            ikey = key + (("le", "+Inf"),)
+            lines.append(f"{self.name}_bucket{_render_labels(ikey)} "
+                         f"{cum}")
+            lines.append(f"{self.name}_sum{_render_labels(key)} "
+                         f"{_fmt(self._sums.get(key, 0.0))}")
+            lines.append(f"{self.name}_count{_render_labels(key)} "
+                         f"{cum}")
+        return lines
+
+
+class MetricsRegistry:
+    """A set of metric families rendered as one exposition document.
+
+    Registration is idempotent by name (re-registering returns the
+    existing family; a kind mismatch raises — one name, one TYPE, as
+    the format requires).  The serving adapters build a FRESH registry
+    per publish from state the engine already holds, then hand it to
+    :meth:`MetricsExporter.publish` — after the swap nobody mutates
+    it, which is what makes the scrape side lock-free."""
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+
+    def _register(self, name: str, kind: str, help_text: str,
+                  factory: Callable[[], _Family]) -> _Family:
+        fam = self._families.get(name)
+        if fam is not None:
+            if fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{fam.kind}, not {kind}")
+            return fam
+        fam = factory()
+        self._families[name] = fam
+        return fam
+
+    def counter(self, name: str, help_text: str) -> _Family:
+        return self._register(name, "counter", help_text,
+                              lambda: _Family(name, "counter",
+                                              help_text))
+
+    def gauge(self, name: str, help_text: str) -> _Family:
+        return self._register(name, "gauge", help_text,
+                              lambda: _Family(name, "gauge",
+                                              help_text))
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS
+                  ) -> _Histogram:
+        return self._register(
+            name, "histogram", help_text,
+            lambda: _Histogram(name, help_text, buckets))
+
+    def families(self) -> List[_Family]:
+        return [self._families[n] for n in sorted(self._families)]
+
+    def samples(self) -> Dict[str,
+                              Dict[Tuple[Tuple[str, str], ...], float]]:
+        """``{family name: {label key: value}}`` — the comparable
+        state the reconstruction property test diffs (histograms
+        collapse to their total observation count)."""
+        return {name: fam.samples()
+                for name, fam in sorted(self._families.items())}
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for fam in self.families():
+            lines.extend(fam.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class PublishedState:
+    """One immutable publish: the rendered exposition text plus the
+    health and varz payloads, all frozen at the same engine tick.
+    The exporter swaps a reference to one of these per tick; scrape
+    handlers read whichever reference they loaded — torn reads are
+    impossible because nothing here mutates after construction."""
+
+    __slots__ = ("wall", "tick", "text", "health", "varz", "seq")
+
+    def __init__(self, wall: float, tick: Optional[int], text: str,
+                 health: Dict[str, Any], varz: Dict[str, Any],
+                 seq: int):
+        self.wall = wall
+        self.tick = tick
+        self.text = text
+        self.health = health
+        self.varz = varz
+        self.seq = seq
+
+
+class MetricsExporter:
+    """Lock-free publish/scrape hand-off (single writer: the engine
+    or router tick; any number of readers: the HTTP handler threads).
+
+    ``publish`` renders the registry ON the publishing side (host
+    string work, no device traffic) and stores one
+    :class:`PublishedState`; ``render``/``healthz``/``varz`` serve
+    from the last stored state and stamp its staleness — the scrape
+    path does no work proportional to the serve and can never stall
+    a tick."""
+
+    def __init__(self, *, wall_clock: Callable[[], float] = time.time):
+        self._wall = wall_clock
+        self._state: Optional[PublishedState] = None
+        self.publishes = 0
+
+    def publish(self, registry: MetricsRegistry, *,
+                tick: Optional[int] = None,
+                health: Optional[Dict[str, Any]] = None,
+                varz: Optional[Dict[str, Any]] = None) -> None:
+        seq = self.publishes + 1
+        state = PublishedState(self._wall(), tick, registry.render(),
+                               dict(health or {"ok": True,
+                                               "status": "ok"}),
+                               dict(varz or {}), seq)
+        # the swap: one attribute store, atomic under the GIL — the
+        # whole synchronization story (no lock to rank for APX802,
+        # nothing blocking to hold for APX804)
+        self._state = state
+        self.publishes = seq
+
+    @property
+    def state(self) -> Optional[PublishedState]:
+        return self._state
+
+    def staleness_s(self, state: Optional[PublishedState] = None
+                    ) -> float:
+        st = state if state is not None else self._state
+        if st is None:
+            return 0.0
+        return max(0.0, self._wall() - st.wall)
+
+    def render(self) -> str:
+        st = self._state
+        stale = self.staleness_s(st)
+        tail = [
+            "# HELP apex_tpu_exporter_staleness_seconds Seconds since"
+            " the serving side last published a snapshot.",
+            "# TYPE apex_tpu_exporter_staleness_seconds gauge",
+            f"apex_tpu_exporter_staleness_seconds {stale:.6f}",
+            "# HELP apex_tpu_exporter_publishes_total Snapshot"
+            " publishes since exporter start.",
+            "# TYPE apex_tpu_exporter_publishes_total counter",
+            f"apex_tpu_exporter_publishes_total "
+            f"{st.seq if st is not None else 0}",
+        ]
+        body = st.text if st is not None else ""
+        return body + "\n".join(tail) + "\n"
+
+    def healthz(self) -> Tuple[bool, Dict[str, Any]]:
+        st = self._state
+        if st is None:
+            return True, {"ok": True, "status": "starting",
+                          "staleness_s": 0.0}
+        payload = dict(st.health)
+        payload["staleness_s"] = round(self.staleness_s(st), 6)
+        payload.setdefault("tick", st.tick)
+        return bool(payload.get("ok", True)), payload
+
+    def varz(self) -> Dict[str, Any]:
+        st = self._state
+        return dict(st.varz) if st is not None else {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Scrape handler: every route serves from the exporter's last
+    published state — it never calls into the engine."""
+
+    # set by MetricsServer when the handler class is specialized
+    exporter: MetricsExporter = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        st = self.exporter.state
+        self.send_header("X-Apex-Staleness-Seconds",
+                         f"{self.exporter.staleness_s(st):.6f}")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._reply(200, self.exporter.render().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            ok, payload = self.exporter.healthz()
+            self._reply(200 if ok else 503,
+                        (json.dumps(payload, sort_keys=True)
+                         + "\n").encode(), "application/json")
+        elif path == "/varz":
+            self._reply(200, (json.dumps(self.exporter.varz(),
+                                         sort_keys=True, default=str)
+                              + "\n").encode(), "application/json")
+        else:
+            self._reply(404, b'{"error": "not found"}\n',
+                        "application/json")
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        # scrape chatter must not pollute the driver's stdout (the CI
+        # smoke greps it); route through the module logger at debug
+        logger.debug("metrics http: " + fmt, *args)
+
+
+class MetricsServer:
+    """The ``/metrics`` + ``/healthz`` + ``/varz`` daemon.
+
+    One stdlib :class:`ThreadingHTTPServer` on a daemon thread; per-
+    request handler threads are stdlib-managed daemons too.  Handlers
+    read only the exporter's published state, so no new lock is
+    introduced anywhere (the APX801–805 auditor stays empty-baseline)
+    and a slow scraper can never back-pressure the serve.  ``port=0``
+    binds an ephemeral port (tests); :attr:`port` reports the real
+    one after :meth:`start`.  Start/stop emit paired
+    ``metrics_server_started`` / ``metrics_server_stopped`` events
+    through the monitor so the JSONL records the exporter's uptime
+    window (``trace_check --serve`` pairs them up)."""
+
+    def __init__(self, exporter: MetricsExporter, *, port: int = 0,
+                 host: str = "127.0.0.1", monitor=None):
+        self.exporter = exporter
+        self.host = host
+        self._requested_port = int(port)
+        self.monitor = monitor
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return int(self._server.server_address[1])
+        return self._requested_port
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _event(self, name: str, **attrs) -> None:
+        if self.monitor is not None:
+            self.monitor.event("metrics", name, **attrs)
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self.port
+        handler = type("_BoundHandler", (_Handler,),
+                       {"exporter": self.exporter})
+        self._server = ThreadingHTTPServer(
+            (self.host, self._requested_port), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="apex_tpu-metrics-server", daemon=True)
+        self._thread.start()
+        self._event("metrics_server_started", port=self.port,
+                    host=self.host)
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is None:
+            return
+        port = self.port
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None
+        self._event("metrics_server_stopped", port=port)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level aggregation + trends
+# ---------------------------------------------------------------------------
+
+def _slope(points: Iterable[Tuple[float, float]]) -> float:
+    """Least-squares slope of value over tick — the trend an
+    autoscaler thresholds on.  0.0 until two distinct ticks exist."""
+    pts = list(points)
+    if len(pts) < 2:
+        return 0.0
+    n = float(len(pts))
+    sx = sum(p[0] for p in pts)
+    sy = sum(p[1] for p in pts)
+    sxx = sum(p[0] * p[0] for p in pts)
+    sxy = sum(p[0] * p[1] for p in pts)
+    denom = n * sxx - sx * sx
+    if denom <= 0.0:
+        return 0.0
+    return (n * sxy - sx * sy) / denom
+
+
+class FleetAggregator:
+    """Merge N per-replica ``router_snapshot()`` dicts into fleet
+    series with windowed trends — ROADMAP item 3's autoscaling feed.
+
+    Bounded host rings (``deque(maxlen=window)``) per series; one
+    :meth:`observe` per router round computes the fleet sums, the
+    per-series least-squares slope over the ring, and an EWMA.  Rate
+    series (tokens, compiles) are deltas of the cumulative per-
+    replica counters divided by the MEASURED engine-tick delta since
+    the previous observe (stamped as ``ticks`` on the ``fleet_tick``
+    event) — never by a nominal cadence, so a short trailing window
+    or a swap-drain gap cannot skew the rate.  Single-writer (the
+    router's drive loop); readers consume the emitted event or the
+    exporter's published snapshot — no locks."""
+
+    SERIES = ("queue_depth", "free_blocks_net", "backlog",
+              "tokens_per_tick", "compiles_per_tick")
+
+    def __init__(self, *, window: int = 64, ewma_alpha: float = 0.25):
+        self.window = max(2, int(window))
+        self.ewma_alpha = float(ewma_alpha)
+        self._rings: Dict[str, deque] = {
+            s: deque(maxlen=self.window) for s in self.SERIES}
+        self._ewma: Dict[str, float] = {}
+        # per-replica cumulative marks for delta series
+        self._prev_tokens: Dict[str, int] = {}
+        self._prev_compiles: Dict[str, int] = {}
+        self._prev_ticks: Dict[str, int] = {}
+        self.observations = 0
+
+    def _delta(self, marks: Dict[str, int], rid: str,
+               value: int) -> int:
+        prev = marks.get(rid)
+        marks[rid] = value
+        if prev is None or value < prev:   # fresh replica / reset
+            return 0
+        return value - prev
+
+    def observe(self, tick: int,
+                snapshots: Dict[str, Dict[str, Any]]
+                ) -> Dict[str, Any]:
+        """Fold one round of per-replica snapshots; returns the
+        ``fleet_tick`` event attrs (fleet levels + flattened
+        ``slope_*`` / ``ewma_*`` trend keys + the true ``ticks``
+        denominator)."""
+        queue_depth = 0
+        free_net = 0
+        backlog = 0
+        tokens_d = 0
+        compiles_d = 0
+        ticks_d = 0
+        for rid, snap in sorted(snapshots.items()):
+            queue_depth += int(snap.get("queue_depth", 0))
+            free_net += (int(snap.get("available_blocks",
+                                      snap.get("free_blocks", 0)))
+                         - int(snap.get("reserved_blocks", 0)))
+            backlog += (int(snap.get("queue_depth", 0))
+                        + int(snap.get("prefilling", 0))
+                        + int(snap.get("active", 0)))
+            tokens_d += self._delta(
+                self._prev_tokens, rid,
+                int(snap.get("tokens_generated", 0)))
+            compiles_d += self._delta(
+                self._prev_compiles, rid,
+                int(snap.get("compiles", 0)))
+            ticks_d += self._delta(self._prev_ticks, rid,
+                                   int(snap.get("tick", 0)))
+        ticks = max(1, ticks_d)
+        levels = {
+            "queue_depth": float(queue_depth),
+            "free_blocks_net": float(free_net),
+            "backlog": float(backlog),
+            "tokens_per_tick": tokens_d / ticks,
+            "compiles_per_tick": compiles_d / ticks,
+        }
+        attrs: Dict[str, Any] = {
+            "replicas": len(snapshots),
+            "ticks": ticks_d,
+            "queue_depth": queue_depth,
+            "free_blocks_net": free_net,
+            "backlog": backlog,
+            "new_tokens": tokens_d,
+            "new_compiles": compiles_d,
+        }
+        for name, v in levels.items():
+            ring = self._rings[name]
+            ring.append((float(tick), v))
+            prev = self._ewma.get(name)
+            self._ewma[name] = v if prev is None else (
+                self.ewma_alpha * v + (1.0 - self.ewma_alpha) * prev)
+            attrs[f"slope_{name}"] = round(_slope(ring), 6)
+            attrs[f"ewma_{name}"] = round(self._ewma[name], 6)
+        self.observations += 1
+        return attrs
+
+    def trends(self) -> Dict[str, Dict[str, float]]:
+        """Current ``{series: {slope, ewma, n}}`` view (the exporter
+        gauge source)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name in self.SERIES:
+            ring = self._rings[name]
+            out[name] = {
+                "slope": round(_slope(ring), 6),
+                "ewma": round(self._ewma.get(name, 0.0), 6),
+                "n": float(len(ring)),
+            }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# JSONL -> exporter-state reconstruction (source-of-truth proof)
+# ---------------------------------------------------------------------------
+
+def registry_from_serve_events(events: Sequence[Any],
+                               ) -> MetricsRegistry:
+    """Rebuild the exporter's counter/gauge state from a serve JSONL.
+
+    The exporter is a VIEW over the event log, never a second ledger:
+    every counter it publishes is recomputable from the ``serving`` /
+    ``serve_tick`` / ``alarm`` events alone.  This function is that
+    recomputation — the property test runs a serve with both paths
+    live and asserts the sample dicts match exactly.  ``events`` are
+    :class:`~apex_tpu.monitor.events.Event` objects (or anything with
+    ``kind`` / ``name`` / ``step`` / ``attrs``), e.g. from
+    :func:`~apex_tpu.monitor.summary.load_events`."""
+    reg = MetricsRegistry()
+    requests = reg.counter(
+        "apex_tpu_serve_requests_total",
+        "Terminal requests by terminal reason.")
+    tokens = reg.counter(
+        "apex_tpu_serve_tokens_total",
+        "Generated tokens over terminal requests.")
+    rejected = reg.counter(
+        "apex_tpu_serve_rejected_total",
+        "Submits the engine refused, by reason.")
+    burns = reg.counter(
+        "apex_tpu_slo_burn_episodes_total",
+        "SLO burn-rate episodes by priority class and dimension.")
+    last_tick: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        attrs = getattr(e, "attrs", None) or {}
+        replica = attrs.get("replica")
+        lbl = {"replica": replica} if replica is not None else {}
+        if e.kind == "serving" and e.name == "request_done":
+            requests.inc(1.0, terminal=attrs.get("terminal",
+                                                 "finished"), **lbl)
+            tokens.inc(float(attrs.get("new_tokens", 0)), **lbl)
+        elif e.kind == "serving" and e.name == "request_rejected":
+            rejected.inc(1.0, reason=attrs.get("reason", "unknown"),
+                         **lbl)
+        elif e.kind == "alarm" and e.name == "slo_burn":
+            burns.inc(
+                1.0,
+                priority_class=attrs.get("priority_class", "*"),
+                dimension=attrs.get("dimension", "unknown"))
+        elif e.kind == "serve_tick":
+            key = replica if replica is not None else ""
+            last_tick[key] = dict(attrs, _step=e.step)
+    for key, attrs in sorted(last_tick.items()):
+        lbl = {"replica": key} if key else {}
+        g = reg.gauge("apex_tpu_serve_queue_depth",
+                      "Admission queue depth at the last tick.")
+        g.set(float(attrs.get("queue_depth", 0)), **lbl)
+        g = reg.gauge("apex_tpu_serve_free_blocks",
+                      "Free KV pool blocks at the last tick.")
+        g.set(float(attrs.get("free_blocks", 0)), **lbl)
+        g = reg.gauge("apex_tpu_serve_pool_blocks",
+                      "Usable KV pool blocks.")
+        g.set(float(attrs.get("pool_blocks", 0)), **lbl)
+        g = reg.gauge("apex_tpu_serve_tick",
+                      "Engine tick of the last gauge window.")
+        g.set(float(attrs.get("last_tick", attrs.get("_step") or 0)),
+              **lbl)
+        c = reg.counter("apex_tpu_serve_compiles_total",
+                        "Cumulative compiled-program count.")
+        c.set(float(attrs.get("compiles", 0)), **lbl)
+    return reg

@@ -352,6 +352,45 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
                 {"tick": e.step, "reason": e.attrs.get("reason"),
                  "active": e.attrs.get("active"),
                  "queued": e.attrs.get("queued")} for e in snaps]
+        # ISSUE-17 live metrics plane: SLO burn-rate digest (the
+        # slo_burn alarms already render in the alarm table; this
+        # reconciles them against the objective definitions and the
+        # recovery records), fleet aggregation rounds, and the
+        # exporter lifecycle pair
+        slo_events = [e for e in events if e.kind == "slo"]
+        burns = [e for e in events
+                 if e.kind == "alarm" and e.name == "slo_burn"]
+        if slo_events or burns:
+            slo: Dict[str, object] = {}
+            defs = [e for e in slo_events
+                    if e.name == "slo_objectives"]
+            if defs:
+                slo["objectives"] = dict(defs[-1].attrs)
+            slo["burn_episodes"] = len(burns)
+            slo["recoveries"] = sum(1 for e in slo_events
+                                    if e.name == "slo_recovered")
+            if burns:
+                slo["burns"] = [
+                    {"tick": e.step,
+                     "class": e.attrs.get("priority_class"),
+                     "dimension": e.attrs.get("dimension"),
+                     "burn_fast": e.attrs.get("burn_fast"),
+                     "burn_slow": e.attrs.get("burn_slow")}
+                    for e in burns]
+            digest["slo"] = slo
+        fticks = [e for e in events if e.kind == "fleet_tick"]
+        if fticks:
+            digest["fleet_ticks"] = len(fticks)
+        mev = [e for e in events if e.kind == "metrics"]
+        if mev:
+            digest["metrics_server"] = {
+                "started": sum(1 for e in mev
+                               if e.name ==
+                               "metrics_server_started"),
+                "stopped": sum(1 for e in mev
+                               if e.name ==
+                               "metrics_server_stopped"),
+            }
         out["serving"] = digest
 
     # bench/driver sections ----------------------------------------------
@@ -553,6 +592,36 @@ def render(summary: dict) -> str:
                          f"[{s.get('reason')}]: "
                          f"{s.get('active')} active, "
                          f"{s.get('queued')} queued")
+        slo = srv.get("slo")
+        if slo:
+            lines.append(
+                f"  SLO: {slo.get('burn_episodes', 0)} burn "
+                f"episode(s), {slo.get('recoveries', 0)} "
+                f"recovery(ies)")
+            objs = (slo.get("objectives") or {}).get("objectives")
+            if objs:
+                for o in objs:
+                    parts = [f"{k}={v}" for k, v in sorted(o.items())
+                             if k != "priority_class" and v]
+                    lines.append(
+                        f"    objective [{o.get('priority_class')}]: "
+                        + " ".join(parts))
+            for b in slo.get("burns", []):
+                lines.append(
+                    f"    BURN @ tick {b.get('tick')} "
+                    f"[{b.get('class')}/{b.get('dimension')}]: "
+                    f"fast {_fmt(b.get('burn_fast'), 2)}x / "
+                    f"slow {_fmt(b.get('burn_slow'), 2)}x budget")
+        if srv.get("fleet_ticks"):
+            lines.append(f"  fleet aggregation: "
+                         f"{srv['fleet_ticks']} fleet_tick round(s)")
+        ms = srv.get("metrics_server")
+        if ms:
+            lines.append(
+                f"  metrics server: {ms['started']} started / "
+                f"{ms['stopped']} stopped"
+                + ("" if ms["started"] == ms["stopped"]
+                   else "  [UNPAIRED]"))
 
     caps = summary.get("captures")
     if caps:

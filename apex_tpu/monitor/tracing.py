@@ -490,6 +490,11 @@ def check_serve_trace(jsonl_path,
       to the rid's ``wall_ms`` within ``tolerance``;
     * engine gauges — a run that decoded must carry ``serve_tick``
       events;
+    * the live metrics plane (ISSUE-17) — every ``slo_burn`` alarm
+      traces back to an ``slo_objectives`` definition event,
+      ``fleet_tick`` steps are monotone non-decreasing per log, and
+      ``metrics_server_started`` / ``metrics_server_stopped`` pair
+      up (every started server was torn down, and vice versa);
     * the Chrome artifact (when given) parses and carries one lane per
       terminal rid with the canonical queued/prefill/decode phases.
     """
@@ -503,8 +508,40 @@ def check_serve_trace(jsonl_path,
         evs, malformed = load_events(p)
         if malformed:
             failures.append(f"{malformed} malformed line(s) in {p}")
+        # fleet aggregation rounds must advance in emission order
+        # WITHIN each log (merged logs interleave legitimately)
+        last_ft = None
+        for e in evs:
+            if e.kind == "fleet_tick":
+                if last_ft is not None and e.step is not None \
+                        and e.step < last_ft:
+                    failures.append(
+                        f"{p}: fleet_tick step went backwards "
+                        f"({last_ft} -> {e.step})")
+                if e.step is not None:
+                    last_ft = e.step
         events.extend(evs)
     srv = [e for e in events if e.kind == "serving"]
+    # ISSUE-17: every slo_burn alarm must trace back to an objective
+    # definition event, and the exporter lifecycle must pair up
+    burns = [e for e in events
+             if e.kind == "alarm" and e.name == "slo_burn"]
+    slo_defs = [e for e in events
+                if e.kind == "slo" and e.name == "slo_objectives"]
+    if burns and not slo_defs:
+        failures.append(
+            f"{len(burns)} slo_burn alarm(s) with no slo_objectives "
+            f"definition event — burns must be attributable to a "
+            f"declared objective")
+    started = sum(1 for e in events if e.kind == "metrics"
+                  and e.name == "metrics_server_started")
+    stopped = sum(1 for e in events if e.kind == "metrics"
+                  and e.name == "metrics_server_stopped")
+    if started != stopped:
+        failures.append(
+            f"metrics_server_started ({started}) != "
+            f"metrics_server_stopped ({stopped}) — every metrics "
+            f"server must be torn down")
     # fleet-mode sanity: one rid must live on exactly one replica —
     # its submit and terminal must carry the same replica stamp
     if len(paths) > 1:

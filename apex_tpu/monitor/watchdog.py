@@ -113,6 +113,28 @@ class Watchdog:
             except Exception as e:
                 logger.warning("on_alarm hook failed: %s", str(e)[:160])
 
+    def alarm(self, name: str, value=None, step=None, **attrs) -> None:
+        """Emit one externally-judged alarm through the watchdog's
+        sink AND its escalation hook — the route the serving SLO
+        layer uses for ``slo_burn`` events, so objective breaches hit
+        the same once-per-episode alarm machinery as stalls and
+        overflow streaks (the CALLER owns the episode latch; the
+        watchdog stays a pass-through).  Never call this while
+        holding a lock: emission does sink I/O and runs the hook
+        (the APX804 discipline every internal alarm path already
+        follows)."""
+        self._alarm(name, value=value, step=step, **attrs)
+
+    def alarm_counts(self) -> dict:
+        """Fired-episode counters for the metrics exporter (read
+        under the lock — the heartbeat thread writes them)."""
+        with self._lock:
+            return {
+                "stall": self._stall_seq,
+                "nonfinite_loss": 1 if self._nonfinite_fired else 0,
+                "overflow_streak": 1 if self._overflow_fired else 0,
+            }
+
     # -- observations (call on every completed step) -------------------------
 
     def observe_step(self, step: Optional[int] = None,

@@ -16,9 +16,12 @@ The serving counterpart of the training pipeline (ROADMAP item 1):
   prefill interleaved with decode ticks.
 * :mod:`.metrics` — per-request lifecycle telemetry (queue wait /
   TTFT / ITL distributions, Perfetto request lanes), per-tick engine
-  gauges (``serve_tick``), and the on-demand engine snapshot
+  gauges (``serve_tick``), the on-demand engine snapshot
   (:class:`ServeMetrics`, :class:`EngineGauges`,
-  :class:`SnapshotTrigger`).
+  :class:`SnapshotTrigger`), and per-priority-class SLOs with
+  multi-window burn-rate alerting (ISSUE-17: :class:`SLObjective`,
+  :class:`SLOTracker` — ``slo_burn`` episodes through the watchdog
+  alarm machinery, surfaced in ``/healthz`` and ``SERVE_DONE``).
 * :mod:`.resilience` — serving fault-tolerance (ISSUE-13): request
   deadlines + hysteresis load shedding (:class:`ShedPolicy`), the
   crash-safe :class:`RequestJournal` with supervised
@@ -39,7 +42,8 @@ from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
                        quantize_kv_rows, write_prefill_kv,
                        write_token_kv)
 from .metrics import (EngineGauges, ReplicaMonitor, RequestTrace,
-                      ServeMetrics, SnapshotTrigger)
+                      ServeMetrics, SLObjective, SLOTracker,
+                      SnapshotTrigger)
 from .model import (GPTServingWeights, LayerWeights,
                     QuantGPTServingWeights, QuantLayerWeights,
                     ServingModelConfig, copy_cache_block,
@@ -67,7 +71,7 @@ __all__ = [
     "gpt_prefill_step", "gpt_sequence_logits", "quantize_weights",
     "scatter_cache_blocks",
     "EngineGauges", "ReplicaMonitor", "RequestTrace", "ServeMetrics",
-    "SnapshotTrigger",
+    "SLObjective", "SLOTracker", "SnapshotTrigger",
     "RequestJournal", "ServeRunResult", "ShedPolicy",
     "SpeculationGovernor", "recover_engine", "run_serving",
     "SERVING_TP_AXIS", "TPContext", "serving_tp_plan",

@@ -48,14 +48,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.flags import flag_bool, flag_float, flag_int, flag_str
+from ..monitor.export import MetricsRegistry
+from ..utils.log_util import get_logger
 from .kv_cache import (DUMP_BLOCK, KVCacheConfig, KVCacheManager,
                        PrefixMatch, init_cache)
-from .metrics import ServeMetrics
+from .metrics import ServeMetrics, SLOTracker
 from ..ops.quant_matmul import is_quantized_weights
 from .model import (GPTServingWeights, ServingModelConfig,
                     copy_cache_block, gpt_decode_step,
                     gpt_extend_step, gpt_prefill_step)
 from .resilience import RequestJournal, ShedPolicy, SpeculationGovernor
+
+logger = get_logger(__name__)
 
 __all__ = ["Request", "BucketLadder", "ServingEngine", "ServeSummary",
            "default_cache_config"]
@@ -241,6 +245,13 @@ class ServeSummary:
     # summary — counted on the engine itself so the serve_done event
     # carries the real value, not a post-hoc patch (0 = never crashed)
     restarts: int = 0
+    # ISSUE-17 live metrics plane: SLO burn-rate episodes this engine
+    # tripped (and recovered from), plus the class/dimension pairs
+    # still burning when the summary was taken — the SERVE_DONE
+    # surface of the SLOTracker (None objectives => all zeros)
+    slo_burn_episodes: int = 0
+    slo_recoveries: int = 0
+    slo_burning: List[str] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -302,6 +313,7 @@ class ServingEngine:
                  spec_governor="auto",
                  tp=None, replica_id: Optional[str] = None,
                  device=None,
+                 slo="auto", exporter=None,
                  clock: Callable[[], float] = time.perf_counter):
         # --- ISSUE-14 fleet hooks -----------------------------------
         # ``tp`` is a serving.tp.TPContext: the engine swaps its jit
@@ -431,13 +443,26 @@ class ServingEngine:
                 if self.speculate_k > 0 else None
         else:
             self.spec_governor = spec_governor
+        # --- ISSUE-17 live metrics plane ----------------------------
+        # ``slo`` is an SLOTracker ("auto" builds one from the
+        # APEX_TPU_SLO_* flags; None when every dimension is off) fed
+        # by the metrics layer's lifecycle hooks and evaluated once
+        # per tick; burn transitions route through the watchdog's
+        # alarm machinery.  ``exporter`` is a monitor.export.
+        # MetricsExporter receiving one lock-free published snapshot
+        # per tick (registry + /healthz + /varz payloads) — all host
+        # bookkeeping the engine already holds, no device traffic.
+        self.slo = SLOTracker.from_flags() if slo == "auto" else slo
+        self.exporter = exporter
+        self._slo_defined = False
         # request-lifecycle + gauge telemetry (serving/metrics.py):
         # pure host bookkeeping through the monitor sinks — no device
         # traffic, so the one-fetch-per-tick budget is untouched.
         # ``snapshot`` is an optional metrics.SnapshotTrigger polled
         # at every tick boundary (the --serve driver wires SIGUSR1).
         self.metrics = ServeMetrics(monitor=monitor, clock=clock,
-                                    tick_every=tick_every)
+                                    tick_every=tick_every,
+                                    slo=self.slo)
         self.snapshot = snapshot
         self.manager = KVCacheManager(cache_cfg,
                                       prefix_sharing=self.prefix_share)
@@ -1468,6 +1493,174 @@ class ServingEngine:
         wd = getattr(self.monitor, "watchdog", None)
         if wd is not None:
             wd.observe_step(self.steps)
+        # ISSUE-17: SLO burn evaluation, then one lock-free exporter
+        # publish — SLO first so the published /healthz already
+        # reflects an episode that opened this tick
+        if self.slo is not None:
+            self._poll_slo()
+        if self.exporter is not None:
+            self._publish_exporter()
+
+    def _poll_slo(self) -> None:
+        """Per-tick SLO boundary: lazily emit the objective-
+        definition event (guaranteed to precede any burn — the
+        pairing ``trace_check --serve`` asserts), then forward the
+        tracker's episode transitions: ``burn`` through the
+        watchdog's alarm machinery (sink + escalation hook, once per
+        episode — the tracker latches), ``recovered`` as a plain
+        ``slo`` event."""
+        if not self._slo_defined:
+            self._slo_defined = True
+            if self.monitor is not None:
+                self.monitor.event("slo", "slo_objectives",
+                                   step=self.steps,
+                                   **self.slo.objectives_attrs())
+        wd = getattr(self.monitor, "watchdog", None)
+        for tr in self.slo.evaluate(self.steps):
+            action = tr.pop("action")
+            if action == "burn":
+                if wd is not None:
+                    wd.alarm("slo_burn", value=tr["burn_fast"],
+                             step=self.steps, **tr)
+                elif self.monitor is not None:
+                    self.monitor.event("alarm", "slo_burn",
+                                       value=tr["burn_fast"],
+                                       step=self.steps, **tr)
+            elif self.monitor is not None:
+                self.monitor.event("slo", "slo_recovered",
+                                   value=tr["burn_fast"],
+                                   step=self.steps, **tr)
+
+    def health_state(self, *, drained: bool = False) -> Dict[str, Any]:
+        """The /healthz payload: ``ok`` is False while the engine is
+        draining (SIGTERM / escalation / API), after an escalation
+        was handled, or while any SLO episode burns.  Shedding is
+        DEGRADED-but-serving — reported, still 200 (the healthz
+        semantics table in docs/api/observability.md)."""
+        draining = bool(drained or self._drain_reason is not None
+                        or self._terminating())
+        shed = bool(self.shed.engaged) if (
+            self.shed is not None and self.shed.enabled) else False
+        burning = list(self.slo.burning) if self.slo is not None \
+            else []
+        ok = not (draining or self._esc_handled or burning)
+        status = ("draining" if draining
+                  else "escalated" if self._esc_handled
+                  else "slo_burning" if burning
+                  else "shedding" if shed else "ok")
+        return {
+            "ok": ok, "status": status, "tick": self.steps,
+            "replica": self.replica_id,
+            "draining": draining, "shed_engaged": shed,
+            "escalated": self._esc_handled,
+            "slo_burning": burning,
+            "active": len(self.active), "queued": len(self.queue),
+        }
+
+    def export_registry(self,
+                        registry: Optional[MetricsRegistry] = None
+                        ) -> MetricsRegistry:
+        """Adapter shim: fill a :class:`~apex_tpu.monitor.export.
+        MetricsRegistry` from bookkeeping the engine already holds —
+        the gauge layer's last-tick levels
+        (``EngineGauges.router_snapshot()``, no cadence advance), the
+        metrics layer's lifetime terminal/rejection tallies, cached
+        latency quantiles, SLO episode counters, and the watchdog's
+        fired-alarm counts.  No second bookkeeping path and no device
+        fetch; counters mirror (``set``) the cumulative values, they
+        never re-count.  A fleet passes a shared ``registry`` so N
+        replicas land in one exposition document under their
+        ``replica`` labels."""
+        reg = registry if registry is not None else MetricsRegistry()
+        lbl = ({"replica": self.replica_id}
+               if self.replica_id is not None else {})
+        m = self.metrics
+        c = reg.counter("apex_tpu_serve_requests_total",
+                        "Terminal requests by terminal reason.")
+        for terminal, n in sorted(m.terminals.items()):
+            c.set(n, terminal=terminal, **lbl)
+        gen = self._done_tokens \
+            + sum(len(q.out_tokens) for q in self.active.values())
+        reg.counter("apex_tpu_serve_tokens_total",
+                    "Generated tokens over terminal requests."
+                    ).set(self._done_tokens, **lbl)
+        reg.gauge("apex_tpu_serve_tokens_live",
+                  "Generated tokens including in-flight requests."
+                  ).set(gen, **lbl)
+        reg.counter("apex_tpu_serve_prefill_tokens_total",
+                    "Prompt tokens prefilled."
+                    ).set(self.prefill_tokens, **lbl)
+        rej = reg.counter("apex_tpu_serve_rejected_total",
+                          "Submits the engine refused, by reason.")
+        for reason, n in sorted(m.rejected.items()):
+            rej.set(n, reason=reason, **lbl)
+        snap = m.gauges.router_snapshot()
+        for key, help_text in (
+                ("queue_depth", "Admission queue depth at the last "
+                                "tick."),
+                ("free_blocks", "Free KV pool blocks at the last "
+                                "tick."),
+                ("used_blocks", "Used KV pool blocks at the last "
+                                "tick."),
+                ("reserved_blocks", "Blocks reserved by admitted "
+                                    "requests at the last tick."),
+                ("pool_blocks", "Usable KV pool blocks."),
+                ("prefilling", "Requests mid-chunked-prefill at the "
+                               "last tick."),
+                ("batch", "Decode batch at the last tick."),
+                ("used_blocks_high_water", "Used-block high water."),
+                ("last_tick", "Engine tick of the last gauge "
+                              "window.")):
+            if key in snap:
+                name = ("apex_tpu_serve_tick" if key == "last_tick"
+                        else f"apex_tpu_serve_{key}")
+                reg.gauge(name, help_text).set(
+                    float(snap[key] or 0), **lbl)
+        reg.counter("apex_tpu_serve_compiles_total",
+                    "Cumulative compiled-program count."
+                    ).set(sum(self._compiles.values()), **lbl)
+        reg.gauge("apex_tpu_serve_shed_engaged",
+                  "1 while the hysteresis shed policy is engaged."
+                  ).set(1.0 if (self.shed is not None
+                                and self.shed.engaged) else 0.0,
+                        **lbl)
+        pct = m.percentiles_cached()
+        q = reg.gauge("apex_tpu_serve_latency_ms",
+                      "Serving latency quantiles over the bounded "
+                      "sample windows.")
+        for series in ("queue_wait", "ttft", "itl"):
+            for quant in ("p50", "p99"):
+                v = pct.get(f"{series}_{quant}_ms")
+                if v is not None:
+                    q.set(v, series=series, quantile=quant, **lbl)
+        if self.slo is not None:
+            reg.counter("apex_tpu_slo_burn_episodes_total",
+                        "SLO burn-rate episodes tripped."
+                        ).set(self.slo.episodes, **lbl)
+            reg.gauge("apex_tpu_slo_burning",
+                      "Currently-burning SLO episodes."
+                      ).set(len(self.slo.burning), **lbl)
+        wd = getattr(self.monitor, "watchdog", None)
+        if wd is not None and hasattr(wd, "alarm_counts"):
+            a = reg.counter("apex_tpu_alarm_episodes_total",
+                            "Watchdog alarm episodes fired, by "
+                            "class.")
+            for name, n in sorted(wd.alarm_counts().items()):
+                a.set(n, alarm=name, **lbl)
+        return reg
+
+    def _publish_exporter(self, *, drained: bool = False) -> None:
+        """One lock-free exporter publish: registry + health + varz,
+        all frozen at this tick.  Telemetry must never kill the
+        serve."""
+        try:
+            self.exporter.publish(
+                self.export_registry(), tick=self.steps,
+                health=self.health_state(drained=drained),
+                varz=self.snapshot_state())
+        except Exception as e:
+            logger.warning("exporter publish failed: %s",
+                           str(e)[:160])
 
     def tokens_digest(self) -> str:
         """Deterministic digest of every request's output token
@@ -1506,6 +1699,13 @@ class ServingEngine:
                                  if self.shed is not None else False),
             "warm_prefix_keys": self.manager.prefix_keys(),
             "gauges": self.metrics.gauges.router_snapshot(),
+            # cumulative counters the FleetAggregator differentiates
+            # into rate series (tokens/tick, compile deltas) against
+            # the measured tick delta — same host bookkeeping, one
+            # dict, still no device traffic
+            "tokens_generated": self._done_tokens
+            + sum(len(q.out_tokens) for q in self.active.values()),
+            "compiles": sum(self._compiles.values()),
         }
         return snap
 
@@ -1689,6 +1889,11 @@ class ServingEngine:
         # a trailing partial gauge window (tick_every > 1) flushes so
         # the final engine state is always in the log
         self.metrics.flush_gauges(self.steps)
+        # final exporter publish: terminal counters complete, and the
+        # published /healthz keeps reporting the drain until the
+        # server stops (the CI flip probe reads this window)
+        if self.exporter is not None:
+            self._publish_exporter(drained=drained)
         summary = self.summary(drained=drained)
         self._event("serve_done", value=summary.tokens_per_sec,
                     **{k: v for k, v in summary.as_dict().items()
@@ -1745,7 +1950,13 @@ class ServingEngine:
                               if self.shed is not None else 0),
             spec_disabled=self.spec_disabled,
             replayed_requests=self._replayed,
-            restarts=self.restarts)
+            restarts=self.restarts,
+            slo_burn_episodes=(self.slo.episodes
+                               if self.slo is not None else 0),
+            slo_recoveries=(self.slo.recoveries
+                            if self.slo is not None else 0),
+            slo_burning=(list(self.slo.burning)
+                         if self.slo is not None else []))
 
 
 def _check_swap_leaf(old, new) -> None:

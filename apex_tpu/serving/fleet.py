@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.flags import flag_str
+from ..monitor.export import FleetAggregator, MetricsRegistry
 from ..utils.log_util import get_logger
 from .engine import Request, ServeSummary, ServingEngine
 from .kv_cache import DUMP_BLOCK, prefix_chain_keys
@@ -269,6 +270,8 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence[Replica], *,
                  policy: Optional[str] = None, monitor=None,
+                 aggregator: Optional[FleetAggregator] = None,
+                 exporter=None,
                  clock: Callable[[], float] = time.perf_counter):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -304,6 +307,17 @@ class FleetRouter:
                         f"the handoff lands through the shared "
                         f"index")
         self.monitor = monitor
+        # ISSUE-17 live metrics plane: the aggregator folds every
+        # round's per-replica router_snapshot()s into fleet series
+        # with trend windows (queue depth, free blocks net, backlog,
+        # tokens/tick, compile deltas) and emits one ``fleet_tick``
+        # event per router round; an attached exporter additionally
+        # gets one published snapshot per round — per-replica series
+        # under ``replica`` labels plus the fleet aggregates, all on
+        # the router's single drive thread (no locks)
+        self.aggregator = aggregator if aggregator is not None \
+            else FleetAggregator()
+        self.exporter = exporter
         self._clock = clock
         self._rr = 0
         self._pending: deque = deque()
@@ -326,6 +340,106 @@ class FleetRouter:
     def _event(self, name: str, value=None, **attrs) -> None:
         if self.monitor is not None:
             self.monitor.event("fleet", name, value=value, **attrs)
+
+    # --- live metrics plane (ISSUE-17) ----------------------------------
+
+    def fleet_tick(self, round_idx: int) -> Dict[str, Any]:
+        """One aggregation round: gather every replica's
+        ``router_snapshot()`` (the same cheap host struct routing
+        already reads), fold it through the :class:`~apex_tpu.
+        monitor.export.FleetAggregator`, emit ONE ``fleet_tick``
+        event (step = router round, ``ticks`` = the measured engine-
+        tick delta this window — the true rate denominator), and
+        publish to the attached exporter.  Called once per stepped-
+        loop round; the threaded drive calls it once after the join
+        (its workers own their engines' ticks — aggregating from the
+        drive thread only is the APX801 discipline)."""
+        snapshots = {r.replica_id: r.engine.router_snapshot()
+                     for r in self.replicas}
+        attrs = self.aggregator.observe(round_idx, snapshots)
+        if self.monitor is not None:
+            self.monitor.event("fleet_tick", "fleet_tick",
+                               value=attrs.get("queue_depth"),
+                               step=round_idx, **attrs)
+        if self.exporter is not None:
+            try:
+                self.exporter.publish(
+                    self.fleet_registry(snapshots), tick=round_idx,
+                    health=self.fleet_health(),
+                    varz=self.fleet_varz())
+            except Exception as e:  # telemetry must never kill serve
+                logger.warning("fleet exporter publish failed: %s",
+                               str(e)[:160])
+        return attrs
+
+    def fleet_registry(self,
+                       snapshots: Optional[Dict[str, Dict[str, Any]]]
+                       = None) -> "MetricsRegistry":
+        """One exposition document for the whole fleet: every
+        replica's engine series under its ``replica`` label plus the
+        fleet-aggregate gauges and trend series."""
+        reg = MetricsRegistry()
+        for r in self.replicas:
+            r.engine.export_registry(reg)
+        if snapshots is None:
+            snapshots = {r.replica_id: r.engine.router_snapshot()
+                         for r in self.replicas}
+        qd = sum(int(s.get("queue_depth", 0))
+                 for s in snapshots.values())
+        free_net = sum(int(s.get("available_blocks", 0))
+                       - int(s.get("reserved_blocks", 0))
+                       for s in snapshots.values())
+        backlog = sum(int(s.get("queue_depth", 0))
+                      + int(s.get("prefilling", 0))
+                      + int(s.get("active", 0))
+                      for s in snapshots.values())
+        reg.gauge("apex_tpu_fleet_replicas",
+                  "Serve-role replicas in the fleet."
+                  ).set(len(self.serve_replicas))
+        reg.gauge("apex_tpu_fleet_queue_depth",
+                  "Fleet-wide admission queue depth.").set(qd)
+        reg.gauge("apex_tpu_fleet_free_blocks_net",
+                  "Fleet free+idle KV blocks net of reservations."
+                  ).set(free_net)
+        reg.gauge("apex_tpu_fleet_backlog",
+                  "Fleet queued + prefilling + active requests."
+                  ).set(backlog)
+        c = reg.counter("apex_tpu_fleet_requests_routed_total",
+                        "Requests the router submitted.")
+        c.set(self.submitted)
+        reg.counter("apex_tpu_fleet_kv_handoffs_total",
+                    "Disaggregated prefill->decode KV handoffs."
+                    ).set(self.handoffs)
+        reg.counter("apex_tpu_fleet_swaps_total",
+                    "Rolling weight swaps completed."
+                    ).set(self.swaps)
+        trend = reg.gauge("apex_tpu_fleet_trend",
+                          "Windowed trend per fleet series "
+                          "(least-squares slope / EWMA).")
+        for series, t in self.aggregator.trends().items():
+            trend.set(t["slope"], series=series, stat="slope")
+            trend.set(t["ewma"], series=series, stat="ewma")
+        return reg
+
+    def fleet_health(self) -> Dict[str, Any]:
+        """Fleet /healthz: ok iff every serve replica is ok; the
+        worst replica's status wins the headline."""
+        order = ("draining", "escalated", "slo_burning", "shedding",
+                 "ok")
+        per = {r.replica_id: r.engine.health_state()
+               for r in self.replicas}
+        ok = all(h["ok"] for h in per.values())
+        worst = min((h["status"] for h in per.values()),
+                    key=lambda s: order.index(s)
+                    if s in order else 0, default="ok")
+        return {"ok": ok, "status": worst,
+                "replicas": {rid: h["status"]
+                             for rid, h in sorted(per.items())}}
+
+    def fleet_varz(self) -> Dict[str, Any]:
+        return {rid: snap for rid, snap in sorted(
+            (r.replica_id, r.engine.snapshot_state())
+            for r in self.replicas)}
 
     # --- routing --------------------------------------------------------
 
@@ -577,6 +691,12 @@ class FleetRouter:
             if before_round is not None:
                 before_round(rounds)
             tick_all()
+            # one fleet_tick per router round, after the replicas
+            # ticked: the aggregation window's ``ticks`` stamp counts
+            # the engine ticks that actually elapsed (swap drains
+            # advance engines without advancing rounds — the measured
+            # delta, not the nominal cadence, is the rate denominator)
+            self.fleet_tick(rounds)
             rounds += 1
             if max_rounds is not None and rounds >= max_rounds:
                 break
@@ -699,6 +819,13 @@ class FleetRouter:
                 self.replayed += got[1]
         if errors:
             raise errors[0]
+        # threaded mode has no router rounds — the workers owned
+        # their engines' ticks.  One terminal aggregation round from
+        # the drive thread (after the join: workers write no shared
+        # state, the APX801 discipline) records the fleet's final
+        # series and publishes the exporter's end state.
+        self.fleet_tick(max((r.engine.steps for r in self.replicas),
+                            default=0))
         return self._summary(wall, threaded=True)
 
     # --- aggregation ------------------------------------------------------

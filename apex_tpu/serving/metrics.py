@@ -48,7 +48,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
-from ..analysis.flags import flag_int, flag_str
+from ..analysis.flags import flag_float, flag_int, flag_str
 from ..monitor.summary import _pct
 from ..monitor.tracing import serve_chrome_trace
 from ..utils.log_util import get_logger
@@ -56,7 +56,8 @@ from ..utils.log_util import get_logger
 logger = get_logger(__name__)
 
 __all__ = ["RequestTrace", "ServeMetrics", "EngineGauges",
-           "ReplicaMonitor", "SnapshotTrigger"]
+           "ReplicaMonitor", "SnapshotTrigger", "SLObjective",
+           "SLOTracker"]
 
 # distribution samples kept per series (queue-wait / ttft / itl /
 # per-request decode tok/s) — same bound as the engine's per-token
@@ -328,6 +329,232 @@ class ReplicaMonitor:
         return getattr(self._monitor, name)
 
 
+# ---------------------------------------------------------------------------
+# Per-priority-class SLOs with multi-window burn-rate alerting
+# ---------------------------------------------------------------------------
+
+# a p99 latency objective budgets 1% violations by definition
+_P99_BUDGET = 0.01
+# terminals the availability objective counts as bad: the engine
+# failed the request (shed under pressure, or past its deadline).
+# preempted is NOT bad — a clean drain is operator-initiated.
+_UNAVAILABLE_TERMINALS = ("shed", "deadline", "deadline_exceeded")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLObjective:
+    """One priority class's declarative objectives (0 disables a
+    dimension).  ``priority_class`` is ``"p<priority>"`` matching
+    :class:`~apex_tpu.serving.engine.Request.priority`, or ``"*"``
+    for one class-agnostic objective over all traffic (what the
+    ``APEX_TPU_SLO_*`` flags build).  ``availability`` is the target
+    good fraction (e.g. 0.99): a request is *bad* when its terminal
+    is shed / deadline / deadline_exceeded — the non-shed/non-
+    deadline fraction must stay above the target."""
+
+    priority_class: str = "*"
+    ttft_p99_ms: float = 0.0
+    itl_p99_ms: float = 0.0
+    availability: float = 0.0
+
+    def matches(self, cls: str) -> bool:
+        return self.priority_class in ("*", cls)
+
+    def dimensions(self):
+        """``(dimension, threshold, error budget)`` triples for the
+        enabled dimensions."""
+        if self.ttft_p99_ms > 0:
+            yield "ttft", self.ttft_p99_ms, _P99_BUDGET
+        if self.itl_p99_ms > 0:
+            yield "itl", self.itl_p99_ms, _P99_BUDGET
+        if self.availability > 0:
+            yield ("availability", self.availability,
+                   max(1e-9, 1.0 - self.availability))
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class SLOTracker:
+    """Multi-window burn-rate alerting over declarative objectives.
+
+    The SRE recipe, tick-denominated: each enabled (objective,
+    dimension) pair keeps a bounded deque of ``(tick, bad)`` samples;
+    :meth:`evaluate` computes the burn rate — bad fraction over the
+    error budget — over a fast window (~1 min equivalent in engine
+    ticks) and a slow window (~1 hr equivalent) and trips when BOTH
+    exceed ``burn_threshold`` (a fast blip alone or a long-decayed
+    stain alone never pages).  Episodes latch: one ``burn``
+    transition when the condition first holds, one ``recovered`` when
+    the fast window drops back under — the watchdog's once-per-
+    episode discipline, enforced here so the alarm machinery stays a
+    pass-through.  Everything is driven by the engine tick (injected,
+    fake-clock tests in tests/test_serving_slo.py) and touched only
+    from the engine thread — no locks.
+
+    Feeds: :class:`ServeMetrics` records TTFT/ITL samples and
+    terminal availability per priority class; the engine calls
+    :meth:`evaluate` once per tick from its telemetry boundary and
+    routes ``burn`` transitions through the watchdog
+    (:meth:`~apex_tpu.monitor.watchdog.Watchdog.alarm`) so the
+    escalation hook sees them like any other alarm."""
+
+    def __init__(self, objectives: "List[SLObjective]", *,
+                 fast_window: int = 64, slow_window: int = 1024,
+                 burn_threshold: float = 2.0):
+        self.objectives = [o for o in objectives
+                           if any(True for _ in o.dimensions())]
+        self.fast_window = max(1, int(fast_window))
+        self.slow_window = max(self.fast_window, int(slow_window))
+        self.burn_threshold = float(burn_threshold)
+        # (objective idx, dimension) -> deque[(tick, bad)]
+        self._samples: Dict[tuple, deque] = {}
+        # latched episodes: key -> attrs of the burn that opened it
+        self._burning: Dict[tuple, Dict[str, Any]] = {}
+        self.episodes = 0
+        self.recoveries = 0
+
+    @classmethod
+    def from_flags(cls) -> "Optional[SLOTracker]":
+        """One class-agnostic objective from the ``APEX_TPU_SLO_*``
+        flags; None when every dimension is disabled (the default —
+        no tracker, no per-tick evaluation cost)."""
+        obj = SLObjective(
+            priority_class="*",
+            ttft_p99_ms=flag_float("APEX_TPU_SLO_TTFT_P99_MS"),
+            itl_p99_ms=flag_float("APEX_TPU_SLO_ITL_P99_MS"),
+            availability=flag_float("APEX_TPU_SLO_AVAILABILITY"))
+        if not any(True for _ in obj.dimensions()):
+            return None
+        return cls([obj])
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.objectives)
+
+    # -- sample feeds (called by ServeMetrics) ---------------------------
+
+    def _record(self, dimension: str, cls_name: str, bad: bool,
+                tick: int) -> None:
+        for i, obj in enumerate(self.objectives):
+            if not obj.matches(cls_name):
+                continue
+            if not any(d == dimension for d, _, _ in
+                       obj.dimensions()):
+                continue
+            dq = self._samples.setdefault((i, dimension), deque())
+            dq.append((int(tick), 1 if bad else 0))
+
+    def record_ttft(self, cls_name: str, ttft_ms: float,
+                    tick: int) -> None:
+        for i, obj in enumerate(self.objectives):
+            if obj.matches(cls_name) and obj.ttft_p99_ms > 0:
+                dq = self._samples.setdefault((i, "ttft"), deque())
+                dq.append((int(tick),
+                           1 if ttft_ms > obj.ttft_p99_ms else 0))
+
+    def record_itl(self, cls_name: str, itl_ms: float,
+                   tick: int) -> None:
+        for i, obj in enumerate(self.objectives):
+            if obj.matches(cls_name) and obj.itl_p99_ms > 0:
+                dq = self._samples.setdefault((i, "itl"), deque())
+                dq.append((int(tick),
+                           1 if itl_ms > obj.itl_p99_ms else 0))
+
+    def record_terminal(self, cls_name: str, terminal: str,
+                        tick: int) -> None:
+        bad = terminal in _UNAVAILABLE_TERMINALS
+        self._record("availability", cls_name, bad, tick)
+
+    # -- evaluation ------------------------------------------------------
+
+    def _burn(self, dq: deque, tick: int, window: int,
+              budget: float) -> "tuple":
+        lo = tick - window
+        n = bad = 0
+        for t, b in dq:
+            if t > lo:
+                n += 1
+                bad += b
+        if n == 0:
+            return 0.0, 0, 0
+        return (bad / n) / budget, n, bad
+
+    def evaluate(self, tick: int) -> "List[Dict[str, Any]]":
+        """Advance to ``tick``: evict samples past the slow window,
+        recompute every pair's dual-window burn, and return the
+        episode TRANSITIONS (``action`` = ``burn`` | ``recovered``)
+        — at most one of each per pair per episode, the once-per-
+        episode contract the engine forwards to the alarm path."""
+        transitions: List[Dict[str, Any]] = []
+        for i, obj in enumerate(self.objectives):
+            for dimension, threshold, budget in obj.dimensions():
+                key = (i, dimension)
+                dq = self._samples.get(key)
+                if dq is None:
+                    continue
+                lo = tick - self.slow_window
+                while dq and dq[0][0] <= lo:
+                    dq.popleft()
+                burn_slow, n_slow, bad_slow = self._burn(
+                    dq, tick, self.slow_window, budget)
+                burn_fast, n_fast, bad_fast = self._burn(
+                    dq, tick, self.fast_window, budget)
+                attrs = {
+                    "priority_class": obj.priority_class,
+                    "dimension": dimension,
+                    "objective": threshold,
+                    "budget": budget,
+                    "burn_threshold": self.burn_threshold,
+                    "burn_fast": round(burn_fast, 4),
+                    "burn_slow": round(burn_slow, 4),
+                    "bad_fast": bad_fast, "n_fast": n_fast,
+                    "bad_slow": bad_slow, "n_slow": n_slow,
+                }
+                tripping = (n_fast > 0
+                            and burn_fast >= self.burn_threshold
+                            and burn_slow >= self.burn_threshold)
+                if tripping and key not in self._burning:
+                    self._burning[key] = attrs
+                    self.episodes += 1
+                    transitions.append(dict(attrs, action="burn"))
+                elif key in self._burning and not tripping \
+                        and burn_fast < self.burn_threshold:
+                    del self._burning[key]
+                    self.recoveries += 1
+                    transitions.append(dict(attrs,
+                                            action="recovered"))
+        return transitions
+
+    # -- surfaces --------------------------------------------------------
+
+    @property
+    def burning(self) -> "List[str]":
+        """Active episodes as ``class/dimension`` strings (the
+        /healthz payload)."""
+        return sorted(
+            f"{self.objectives[i].priority_class}/{dim}"
+            for i, dim in self._burning)
+
+    def objectives_attrs(self) -> Dict[str, Any]:
+        """The objective-definition event payload (``kind="slo"``,
+        ``name="slo_objectives"``) — the schema every ``slo_burn``
+        must pair with (``trace_check --serve`` asserts it)."""
+        return {
+            "fast_window": self.fast_window,
+            "slow_window": self.slow_window,
+            "burn_threshold": self.burn_threshold,
+            "objectives": [o.as_dict() for o in self.objectives],
+        }
+
+    def summary_attrs(self) -> Dict[str, Any]:
+        return {
+            "slo_burn_episodes": self.episodes,
+            "slo_recoveries": self.recoveries,
+            "slo_burning": self.burning,
+        }
+
+
 class ServeMetrics:
     """The engine's request-lifecycle + gauge telemetry layer.
 
@@ -346,7 +573,8 @@ class ServeMetrics:
                  wall_clock: Callable[[], float] = time.time,
                  tick_every: Optional[int] = None,
                  window: int = _SAMPLE_WINDOW,
-                 trace_window: int = _TRACE_WINDOW):
+                 trace_window: int = _TRACE_WINDOW,
+                 slo: Optional[SLOTracker] = None):
         self._monitor = monitor
         self._clock = clock
         self._perf0 = clock()
@@ -354,13 +582,33 @@ class ServeMetrics:
         self.gauges = EngineGauges(
             tick_every if tick_every is not None
             else flag_int("APEX_TPU_SERVE_TICK_EVERY"))
+        # optional SLO layer: the lifecycle hooks below feed it
+        # per-class samples; the engine evaluates it per tick
+        self.slo = slo
         self._open: Dict[str, RequestTrace] = {}
         self.completed: deque = deque(maxlen=trace_window)
         self.rejected: Dict[str, int] = {}
+        # lifetime terminal counts by reason — the exporter's
+        # requests_total counter source (same on_done hook, no second
+        # bookkeeping path)
+        self.terminals: Dict[str, int] = {}
         self._queue_wait_ms: deque = deque(maxlen=window)
         self._ttft_ms: deque = deque(maxlen=window)
         self._itl_ms: deque = deque(maxlen=window)
         self._decode_tps: deque = deque(maxlen=window)
+        # percentile cache: recomputed only when a series grew (the
+        # per-tick exporter publish must not re-sort idle windows);
+        # the mark is a monotone append count, not lengths — a
+        # saturated bounded deque keeps its length while its contents
+        # roll
+        self._pct_cache: Optional[Dict[str, Optional[float]]] = None
+        self._pct_appends = 0
+        self._pct_mark = -1
+
+    @staticmethod
+    def priority_class(request) -> str:
+        """The SLO bucket a request belongs to: ``p<priority>``."""
+        return f"p{int(getattr(request, 'priority', 0) or 0)}"
 
     # -- emission ------------------------------------------------------------
 
@@ -417,6 +665,7 @@ class ServeMetrics:
         tr.admit_tick = tick
         qw_ms = tr.queue_wait_s * 1e3
         self._queue_wait_ms.append(qw_ms)
+        self._pct_appends += 1
         self.gauges.on_admit(warm=bool(attrs.get("warm_tokens")))
         self._emit("serving", "request_admitted",
                    value=(None if prefill_s is None
@@ -439,6 +688,10 @@ class ServeMetrics:
         ttft_ms = tr.ttft_s * 1e3
         prefill_ms = tr.prefill_s * 1e3
         self._ttft_ms.append(ttft_ms)
+        self._pct_appends += 1
+        if self.slo is not None:
+            self.slo.record_ttft(self.priority_class(request),
+                                 ttft_ms, tick)
         self._emit("serving", "request_first_token",
                    value=round(ttft_ms, 3), tick=tick, rid=tr.rid,
                    ttft_ms=round(ttft_ms, 3),
@@ -481,13 +734,22 @@ class ServeMetrics:
         tr.preempted = bool(request.preempted)
         # the first latency sample is the prefill; the rest are decode
         # ticks — the per-request inter-token latencies
+        cls_name = self.priority_class(request)
         for itl in getattr(request, "token_latency_s", [])[1:]:
-            self._itl_ms.append(itl * 1e3)
+            itl_ms = itl * 1e3
+            self._itl_ms.append(itl_ms)
+            self._pct_appends += 1
+            if self.slo is not None:
+                self.slo.record_itl(cls_name, itl_ms, tick)
         tps = tr.decode_tokens_per_sec
         if tps is not None:
             self._decode_tps.append(tps)
         self.completed.append(tr)
         self.gauges.on_finish(tr.terminal)
+        self.terminals[tr.terminal] = \
+            self.terminals.get(tr.terminal, 0) + 1
+        if self.slo is not None:
+            self.slo.record_terminal(cls_name, tr.terminal, tick)
         attrs: Dict[str, Any] = {
             "rid": tr.rid, "new_tokens": tr.new_tokens,
             "preempted": tr.preempted,
@@ -538,6 +800,17 @@ class ServeMetrics:
                 out[f"{name}_p{q}_ms"] = (None if v is None
                                           else round(v, 3))
         return out
+
+    def percentiles_cached(self) -> Dict[str, Optional[float]]:
+        """:meth:`percentiles`, recomputed only when a series grew —
+        the per-tick exporter publish calls this so idle decode ticks
+        never re-sort the sample windows (latency quantiles cost
+        amortizes per completed request, not per tick)."""
+        if self._pct_cache is None \
+                or self._pct_appends != self._pct_mark:
+            self._pct_cache = self.percentiles()
+            self._pct_mark = self._pct_appends
+        return self._pct_cache
 
     def distributions(self) -> Dict[str, Dict[str, float]]:
         """Full p50/p90/p99 digest for every series (the bench row /

@@ -23,6 +23,7 @@ import contextlib
 import functools
 import os
 import signal
+import time
 from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
@@ -1155,6 +1156,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 max_restarts: int = 3,
                 escalation="auto",
                 backoff_base: float = 0.05,
+                metrics_port: Optional[int] = None,
+                metrics_linger: float = 0.0,
                 return_engine: bool = False):
     """Continuous-batched serving smoke: a tiny GPT serves
     ``num_requests`` mixed-length prompts through the
@@ -1221,6 +1224,19 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     weight to per-channel int8 (:func:`apex_tpu.ops.quant_matmul.
     quantize_weights`), so the serve exercises the quantized decode
     path end to end — the ``--policy Q8`` CI smoke.
+
+    The live metrics plane (ISSUE-17) arms with ``metrics_port``
+    (flag: ``APEX_TPU_METRICS_PORT``; an explicit ``0`` picks an
+    ephemeral port): a :class:`~apex_tpu.monitor.MetricsServer`
+    daemon thread serves ``/metrics`` (Prometheus text exposition),
+    ``/healthz`` (503 while draining; SLO-burn / shed / escalation
+    aware) and ``/varz`` (the SIGUSR1 snapshot payload) from
+    lock-free per-tick publishes — scrapes never touch the engine.
+    SLO objectives come from the ``APEX_TPU_SLO_*`` flags
+    (``ServingEngine(slo="auto")``).  ``metrics_linger`` keeps the
+    server up that many seconds after the drain so an external probe
+    (tools/metrics_probe.py, ci.sh step 16) can observe the
+    ``/healthz`` flip before teardown.
 
     Returns the :class:`~apex_tpu.serving.ServeSummary` (with
     ``return_engine=True``, ``(summary, engine)`` — how tests read
@@ -1304,6 +1320,19 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                    "block_size": cache_cfg.block_size,
                    "decode_attention": decode_attention,
                    "policy": policy or "none"})
+    if metrics_port is None:
+        _fp = _flag_int("APEX_TPU_METRICS_PORT")
+        metrics_port = _fp if _fp > 0 else None
+    exporter = metrics_server = None
+    if metrics_port is not None:
+        from ..monitor.export import MetricsExporter, MetricsServer
+
+        exporter = MetricsExporter()
+        metrics_server = MetricsServer(exporter, port=metrics_port,
+                                       monitor=monitor)
+        metrics_server.start()
+        print(f"METRICS http://127.0.0.1:{metrics_server.port}"
+              f"/metrics", flush=True)
     if isinstance(fault, str):
         fault = parse_fault(fault)
     journal = None
@@ -1340,7 +1369,7 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                            prefix_share=prefix_share,
                            deadline_ms=deadline_ms, shed=shed,
                            journal=journal, escalation=escalation,
-                           fault=fault)
+                           fault=fault, exporter=exporter)
     # mixed-length prompts, deterministic per seed; every request
     # fits the ladder span and the model's position table
     rng = np.random.RandomState(seed)
@@ -1414,21 +1443,30 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         raise
     finally:
         try:
-            monitor.close()
+            if metrics_server is not None:
+                # linger so an external probe can see the drained
+                # /healthz (the run() tail published it with
+                # draining=True) before the server goes away
+                if metrics_linger > 0:
+                    time.sleep(metrics_linger)
+                metrics_server.stop()
         finally:
             try:
-                if journal is not None:
-                    journal.close()
+                monitor.close()
             finally:
                 try:
-                    if own_snapshot and snapshot is not None:
-                        snapshot.close()
+                    if journal is not None:
+                        journal.close()
                 finally:
                     try:
-                        if own_autoresume:
-                            autoresume.uninstall()
+                        if own_snapshot and snapshot is not None:
+                            snapshot.close()
                     finally:
-                        thread_cap.uninstall()
+                        try:
+                            if own_autoresume:
+                                autoresume.uninstall()
+                        finally:
+                            thread_cap.uninstall()
     # a background thread (watchdog heartbeat) that died mid-serve
     # fails the run after teardown instead of vanishing
     thread_cap.raise_first()
@@ -1459,6 +1497,8 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                 journal_dir: Optional[str] = None, fault=None,
                 fault_replica: str = "r0", max_restarts: int = 3,
                 stall_timeout: float = 300.0,
+                metrics_port: Optional[int] = None,
+                metrics_linger: float = 0.0,
                 return_router: bool = False, scheduler=None):
     """Multi-replica serving smoke: N :class:`~apex_tpu.serving.
     ServingEngine` replicas behind the gauge-fed
@@ -1484,6 +1524,15 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     one thread per replica (the aggregate-tokens/s scaling mode);
     the default stepped loop is deterministic and supports
     disaggregation and the mid-serve swap.
+
+    ``metrics_port`` (flag: ``APEX_TPU_METRICS_PORT``; explicit
+    ``0`` = ephemeral) starts ONE :class:`~apex_tpu.monitor.
+    MetricsServer` for the whole fleet: ``/metrics`` carries every
+    replica's series under ``replica`` labels plus the
+    ``apex_tpu_fleet_*`` aggregates and trend gauges (ISSUE-17),
+    ``/healthz`` is ok only when every replica is, ``/varz`` maps
+    replica id → snapshot.  ``metrics_linger`` holds the server up
+    after the serve for external probes.
 
     ``scheduler`` (an :class:`apex_tpu.analysis.schedule.
     DeterministicScheduler`) gates the threaded replicas' tick
@@ -1597,11 +1646,25 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
         members.append(make_member(i, f"r{i}", "serve"))
     if disaggregate:
         members.append(make_member(replicas, "pf0", "prefill"))
+    if metrics_port is None:
+        _fp = flag_int("APEX_TPU_METRICS_PORT")
+        metrics_port = _fp if _fp > 0 else None
+    exporter = metrics_server = None
+    if metrics_port is not None:
+        from ..monitor.export import MetricsExporter, MetricsServer
+
+        exporter = MetricsExporter()
+        metrics_server = MetricsServer(exporter, port=metrics_port,
+                                       monitor=monitors[0])
+        metrics_server.start()
+        print(f"METRICS http://127.0.0.1:{metrics_server.port}"
+              f"/metrics", flush=True)
     # the router gets replica 0's RAW monitor (pre-stamping): fleet-
     # scope events (request_routed, kv_handoff, fleet_done) carry
     # their own explicit replica attrs and must not inherit a bogus
     # replica="r0" default
-    router = FleetRouter(members, policy=policy, monitor=monitors[0])
+    router = FleetRouter(members, policy=policy, monitor=monitors[0],
+                         exporter=exporter)
 
     # deterministic mixed-length prompts with shared-prefix pairs (so
     # sticky routing and the prefix machinery have something to bite)
@@ -1648,8 +1711,14 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                     swap_weights=swap_weights,
                     before_round=after)
     finally:
-        for m in monitors:
-            m.close()
+        try:
+            if metrics_server is not None:
+                if metrics_linger > 0:
+                    time.sleep(metrics_linger)
+                metrics_server.stop()
+        finally:
+            for m in monitors:
+                m.close()
     # a background thread that died mid-serve (captured by the
     # excepthook above, run_error already in the log) fails the run
     # AFTER teardown — it must not vanish into stderr
@@ -1850,6 +1919,21 @@ def _main(argv=None):
                    help="(--serve-fleet) model layer count")
     p.add_argument("--fleet-vocab", type=int, default=64,
                    help="(--serve-fleet) model vocab size")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="(--serve / --serve-fleet) start the live "
+                        "metrics plane on this port: /metrics "
+                        "(Prometheus text exposition), /healthz "
+                        "(drain/shed/SLO aware), /varz (engine "
+                        "snapshot JSON).  0 = ephemeral port "
+                        "(printed as a METRICS line); default: "
+                        "APEX_TPU_METRICS_PORT (0 there = off)")
+    p.add_argument("--metrics-linger", type=float, default=0.0,
+                   metavar="SEC",
+                   help="(--metrics-port) keep the metrics server "
+                        "up SEC seconds after the drain so an "
+                        "external probe can observe the drained "
+                        "/healthz before teardown")
     add_resilience_cli(p)
     args = p.parse_args(argv)
     if args.serve_fleet:
@@ -1866,7 +1950,9 @@ def _main(argv=None):
             sanitize=args.sanitize, threads=args.fleet_threads,
             swap=args.swap, journal_dir=args.journal_dir,
             fault=args.fault, max_restarts=args.max_restarts,
-            stall_timeout=args.stall_timeout)
+            stall_timeout=args.stall_timeout,
+            metrics_port=args.metrics_port,
+            metrics_linger=args.metrics_linger)
         print(f"FLEET_DONE replicas={s.replicas} "
               f"prefill_replicas={s.prefill_replicas} "
               f"policy={s.router_policy} "
@@ -1918,6 +2004,8 @@ def _main(argv=None):
             deadline_ms=args.deadline_ms, shed=shed,
             journal_path=args.journal, supervise=args.supervise,
             max_restarts=args.max_restarts,
+            metrics_port=args.metrics_port,
+            metrics_linger=args.metrics_linger,
             return_engine=True)
         spec = "" if s.spec_accept_rate is None else (
             f" spec_accept_rate={s.spec_accept_rate}"
@@ -1941,6 +2029,10 @@ def _main(argv=None):
                       f" shed_engagements={s.shed_engagements}")
         if s.spec_disabled:
             resil += " spec_disabled=1"
+        if s.slo_burn_episodes or s.slo_burning:
+            resil += (f" slo_burns={s.slo_burn_episodes}"
+                      f" slo_recoveries={s.slo_recoveries}"
+                      f" slo_burning={','.join(s.slo_burning) or '-'}")
         print(f"SERVE_DONE requests={s.requests_done} "
               f"preempted={s.requests_preempted} "
               f"tokens={s.tokens_generated} "

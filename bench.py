@@ -1445,6 +1445,144 @@ def bench_serving_fleet():
     return out
 
 
+def bench_serving_metrics():
+    """The ISSUE-17 live metrics plane priced: the identical trace
+    served with the exporter OFF vs ON — on with a live
+    :class:`~apex_tpu.monitor.MetricsServer` being scraped by a
+    concurrent client thread the whole serve, so the committed
+    overhead covers the full pipeline (per-tick registry build +
+    exposition render + lock-free publish + HTTP traffic), not an
+    idle exporter.  Two headline metrics, both bench_gate-gated:
+
+    * ``overhead_pct`` — decode tokens/s cost of exporter-on vs off
+      (best-of-N fresh-engine rounds per leg, the policy_leg noise
+      discipline; acceptance: <= 2%);
+    * ``scrape_p99_ms`` — client-observed /metrics latency p99 while
+      the engine decodes, the stall-freedom proof in number form
+      (handlers serve a published immutable snapshot and never touch
+      the engine)."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from apex_tpu.monitor.export import MetricsExporter, MetricsServer
+    from apex_tpu.serving import (BucketLadder, KVCacheConfig, Request,
+                                  ServingEngine, ServingModelConfig,
+                                  extract_serving_weights)
+    from apex_tpu.testing.standalone_gpt import GPTModel
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1" \
+        or jax.default_backend() != "tpu"
+    if smoke:
+        vocab, hidden, heads, layers = 256, 128, 2, 2
+        block, blocks, requests, new_tokens = 16, 48, 6, 16
+        rounds = 3
+    else:
+        vocab, hidden, heads, layers = 8192, 1024, 16, 4
+        block, blocks, requests, new_tokens = 128, 192, 16, 64
+        rounds = 3
+    ladder = BucketLadder(batch=(8,), pages=(4,))
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=512,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.bfloat16 if not smoke else jnp.float32)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    weights = extract_serving_weights(params, layers)
+    cfg = ServingModelConfig.from_model(model,
+                                        decode_attention="kernel")
+    cache_cfg = KVCacheConfig(
+        num_layers=layers, num_heads=heads, head_dim=hidden // heads,
+        num_blocks=blocks, block_size=block,
+        model_dtype=model.dtype)
+    rng = np.random.RandomState(17)
+    max_prompt = max(1, ladder.max_pages * block - new_tokens)
+    prompts = [[int(t) for t in rng.randint(0, vocab,
+                                            1 + i % max_prompt)]
+               for i in rng.randint(1, max_prompt, requests)]
+
+    def round_leg(exporter):
+        eng = ServingEngine(weights, cfg, cache_cfg, ladder=ladder,
+                            tick_every=1, exporter=exporter)
+        eng.warmup()
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=f"m{i:03d}", prompt=list(p),
+                               max_new_tokens=new_tokens))
+        return eng.run()
+
+    def leg(with_exporter):
+        scrape_ms = []
+        best = None
+        for _ in range(rounds):
+            exporter = server = None
+            stop = None
+            scraper = None
+            if with_exporter:
+                exporter = MetricsExporter()
+                server = MetricsServer(exporter, port=0)
+                server.start()
+                url = server.url("/metrics")
+                stop = threading.Event()
+
+                def scrape_loop():
+                    while not stop.is_set():
+                        t0 = time.perf_counter()
+                        try:
+                            urllib.request.urlopen(
+                                url, timeout=5.0).read()
+                            scrape_ms.append(
+                                (time.perf_counter() - t0) * 1e3)
+                        except Exception:
+                            pass
+                        stop.wait(0.005)
+
+                scraper = threading.Thread(
+                    target=scrape_loop,
+                    name="bench-metrics-scraper", daemon=True)
+                scraper.start()
+            try:
+                s = round_leg(exporter)
+            finally:
+                if with_exporter:
+                    stop.set()
+                    scraper.join(timeout=10.0)
+                    server.stop()
+            if best is None or s.decode_tokens_per_sec \
+                    > best.decode_tokens_per_sec:
+                best = s
+        return best, scrape_ms
+
+    s_off, _ = leg(False)
+    s_on, scrape_ms = leg(True)
+    overhead_pct = round(
+        100.0 * (1.0 - s_on.decode_tokens_per_sec
+                 / max(s_off.decode_tokens_per_sec, 1e-9)), 2)
+    scrape_p99 = round(float(np.percentile(scrape_ms, 99.0)), 3) \
+        if scrape_ms else None
+    out = {
+        "config": {"hidden": hidden, "heads": heads, "layers": layers,
+                   "block_size": block, "requests": requests,
+                   "new_tokens": new_tokens, "rounds": rounds,
+                   "tick_every": 1,
+                   "tier": "smoke" if smoke else "full"},
+        "exporter_off_tokens_per_sec": s_off.decode_tokens_per_sec,
+        "exporter_on_tokens_per_sec": s_on.decode_tokens_per_sec,
+        "overhead_pct": overhead_pct,
+        "scrapes": len(scrape_ms),
+        "scrape_p50_ms": round(float(np.percentile(scrape_ms, 50.0)),
+                               3) if scrape_ms else None,
+        "scrape_p99_ms": scrape_p99,
+    }
+    print(f"[bench] serving_metrics: exporter off "
+          f"{s_off.decode_tokens_per_sec} vs on "
+          f"{s_on.decode_tokens_per_sec} decode tok/s "
+          f"({overhead_pct}% overhead), {len(scrape_ms)} scrapes "
+          f"p99 {scrape_p99} ms", file=sys.stderr)
+    return out
+
+
 def bench_collective():
     n_dev = jax.device_count()
     out = {"devices": n_dev}
@@ -2047,6 +2185,12 @@ def _compact_summary(full):
             "weight_bytes_vs_o5")
         ce["serve"]["q8_ppl_d"] = pol["Q8"].get(
             "perplexity_delta")
+    sm = ex.get("serving_metrics", {})
+    if isinstance(sm, dict) and sm.get("overhead_pct") is not None:
+        # ISSUE-17: the exporter's decode-throughput price and the
+        # scrape latency a live /metrics client observes mid-serve
+        ce["metrics"] = {"ovh_pct": sm["overhead_pct"],
+                         "scrape_p99_ms": sm.get("scrape_p99_ms")}
     fl = ex.get("serving_fleet", {})
     if isinstance(fl, dict) and fl.get("scaling"):
         # ISSUE-14 fleet: aggregate tokens/s per replica count, the
@@ -2248,6 +2392,7 @@ class SectionBudget:
 SECTION_ESTIMATES_S = {
     "resnet50": 600, "optimizer_step": 600, "optimizer_pipeline": 600,
     "scan_driver": 120, "serving": 420, "serving_fleet": 480,
+    "serving_metrics": 240,
     "collective": 240,
     "long_context": 900, "ring_flash": 360, "gpt2_345m": 600,
     "gpt2_345m_s2048": 480, "gpt2_345m_dropout": 480,
@@ -2309,7 +2454,7 @@ def _run_section(extras, name, fn, writer, sink=None, budget=None,
 
 SECTION_NAMES = ("resnet50", "optimizer_step",
                  "optimizer_pipeline", "scan_driver", "serving",
-                 "serving_fleet",
+                 "serving_fleet", "serving_metrics",
                  "collective", "long_context", "ring_flash",
                  "gpt2_345m", "gpt2_345m_s2048", "gpt2_345m_dropout",
                  "bert_large", "zero_sharded_adam")
@@ -2448,6 +2593,7 @@ def main(argv=None):
                 ("scan_driver", bench_scan_driver),
                 ("serving", bench_serving),
                 ("serving_fleet", bench_serving_fleet),
+                ("serving_metrics", bench_serving_metrics),
                 ("collective", bench_collective),
                 ("long_context", bench_long_context),
                 ("ring_flash", bench_ring_flash),

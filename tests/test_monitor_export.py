@@ -1,0 +1,378 @@
+"""Live metrics plane tests (ISSUE-17): the exposition golden for
+counter/gauge/histogram rendering, the lock-free exporter publish /
+staleness semantics, the MetricsServer's three endpoints live over
+HTTP (including the healthz 503 flip and a scrape racing the serve),
+the FleetAggregator's measured-tick rate math and trend rings, and
+the JSONL -> exporter reconstruction property proving the event log
+stays the complete source of truth.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor import Event, MemorySink, load_events
+from apex_tpu.monitor.export import (FleetAggregator, MetricsExporter,
+                                     MetricsRegistry, MetricsServer,
+                                     registry_from_serve_events)
+from apex_tpu.serving import (BucketLadder, Request, ServingEngine,
+                              ServingModelConfig,
+                              default_cache_config,
+                              extract_serving_weights)
+from apex_tpu.testing.standalone_gpt import GPTModel
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+class StubMonitor:
+    def __init__(self):
+        self.sink = MemorySink()
+        self.watchdog = None
+
+    def event(self, kind, name, value=None, step=None, **attrs):
+        self.sink.emit(Event(time=float(step or 0), step=step,
+                             kind=kind, name=name, value=value,
+                             attrs=attrs))
+
+
+def _tiny_model(vocab=32, hidden=16, heads=2, layers=2, max_seq=32):
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_attention_heads=heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(monitor=None, exporter=None, *, ladder=None,
+            num_blocks=16, block_size=4, slo=None):
+    model, params = _tiny_model()
+    cfg = ServingModelConfig.from_model(
+        model, prefill_flash=False, decode_attention="reference")
+    weights = extract_serving_weights(params, cfg.num_layers)
+    cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
+                                     block_size=block_size)
+    return ServingEngine(weights, cfg, cache_cfg,
+                         ladder=ladder or BucketLadder(batch=(2, 4),
+                                                       pages=(3,)),
+                         monitor=monitor, exporter=exporter, slo=slo)
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.getcode(), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# registry + exposition format
+# ---------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_gauge_golden_exposition(self):
+        reg = MetricsRegistry()
+        c = reg.counter("apex_tpu_requests_total", "Requests seen.")
+        c.inc(2.0, terminal="finished")
+        c.inc(1.0, terminal="shed")
+        g = reg.gauge("apex_tpu_queue_depth", "Queue depth.")
+        g.set(3.0)
+        # families sort by name, labels sort within a family, and
+        # integral floats print as integers — the golden every
+        # scraper-compat claim rests on
+        assert reg.render() == (
+            "# HELP apex_tpu_queue_depth Queue depth.\n"
+            "# TYPE apex_tpu_queue_depth gauge\n"
+            "apex_tpu_queue_depth 3\n"
+            "# HELP apex_tpu_requests_total Requests seen.\n"
+            "# TYPE apex_tpu_requests_total counter\n"
+            'apex_tpu_requests_total{terminal="finished"} 2\n'
+            'apex_tpu_requests_total{terminal="shed"} 1\n')
+
+    def test_label_escaping_and_float_values(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("apex_tpu_g", "h")
+        g.set(1.5, reason='a"b\\c\nd')
+        out = reg.render()
+        assert 'reason="a\\"b\\\\c\\nd"' in out
+        assert out.rstrip().endswith("1.5")
+
+    def test_registration_idempotent_kind_mismatch_raises(self):
+        reg = MetricsRegistry()
+        a = reg.counter("apex_tpu_x_total", "h")
+        assert reg.counter("apex_tpu_x_total", "h") is a
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("apex_tpu_x_total", "h")
+
+    def test_histogram_cumulative_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("apex_tpu_lat_ms", "Latency.",
+                          buckets=(1.0, 5.0, 10.0))
+        for v in (0.5, 0.7, 3.0, 7.0, 100.0):
+            h.observe(v)
+        lines = reg.render().splitlines()
+        samples = [ln for ln in lines if not ln.startswith("#")]
+        # le buckets are CUMULATIVE and +Inf equals _count
+        assert samples == [
+            'apex_tpu_lat_ms_bucket{le="1"} 2',
+            'apex_tpu_lat_ms_bucket{le="5"} 3',
+            'apex_tpu_lat_ms_bucket{le="10"} 4',
+            'apex_tpu_lat_ms_bucket{le="+Inf"} 5',
+            "apex_tpu_lat_ms_sum 111.2",
+            "apex_tpu_lat_ms_count 5",
+        ]
+        # samples() collapses to the observation count (the shape the
+        # reconstruction property diffs)
+        assert h.samples() == {(): 5.0}
+
+
+# ---------------------------------------------------------------------------
+# exporter publish / staleness
+# ---------------------------------------------------------------------------
+
+class TestMetricsExporter:
+    def test_publish_swaps_state_and_stamps_staleness(self):
+        t = [100.0]
+        exp = MetricsExporter(wall_clock=lambda: t[0])
+        # before the first publish: healthy "starting", empty varz,
+        # a render that still carries the meta families
+        ok, payload = exp.healthz()
+        assert ok and payload["status"] == "starting"
+        assert exp.varz() == {}
+        assert "apex_tpu_exporter_publishes_total 0" in exp.render()
+        reg = MetricsRegistry()
+        reg.gauge("apex_tpu_g", "h").set(7)
+        exp.publish(reg, tick=3, health={"ok": True, "status": "ok"},
+                    varz={"tick": 3})
+        t[0] = 102.5
+        out = exp.render()
+        assert "apex_tpu_g 7" in out
+        assert "apex_tpu_exporter_publishes_total 1" in out
+        assert "apex_tpu_exporter_staleness_seconds 2.5" in out
+        ok, payload = exp.healthz()
+        assert ok and payload["staleness_s"] == pytest.approx(2.5)
+        assert payload["tick"] == 3
+        assert exp.varz() == {"tick": 3}
+
+    def test_unhealthy_publish_flips_healthz(self):
+        exp = MetricsExporter(wall_clock=lambda: 0.0)
+        exp.publish(MetricsRegistry(), tick=9,
+                    health={"ok": False, "status": "draining",
+                            "draining": True})
+        ok, payload = exp.healthz()
+        assert not ok
+        assert payload["status"] == "draining" and payload["draining"]
+
+    def test_scrape_reads_frozen_reference(self):
+        # the lock-free contract: a scrape renders from the reference
+        # it loaded; a publish AFTER the load must not tear it
+        exp = MetricsExporter(wall_clock=lambda: 0.0)
+        reg = MetricsRegistry()
+        reg.gauge("apex_tpu_g", "h").set(1)
+        exp.publish(reg, tick=1)
+        st = exp.state
+        reg2 = MetricsRegistry()
+        reg2.gauge("apex_tpu_g", "h").set(2)
+        exp.publish(reg2, tick=2)
+        assert "apex_tpu_g 1" in st.text          # frozen snapshot
+        assert "apex_tpu_g 2" in exp.state.text   # the new reference
+
+
+# ---------------------------------------------------------------------------
+# HTTP server (live endpoints)
+# ---------------------------------------------------------------------------
+
+class TestMetricsServer:
+    def test_endpoints_live_and_lifecycle_events_pair(self):
+        mon = StubMonitor()
+        exp = MetricsExporter()
+        reg = MetricsRegistry()
+        reg.gauge("apex_tpu_serve_queue_depth", "h").set(4)
+        exp.publish(reg, tick=2,
+                    health={"ok": True, "status": "ok"},
+                    varz={"tick": 2, "active": 1})
+        srv = MetricsServer(exp, port=0, monitor=mon)
+        try:
+            port = srv.start()
+            assert port > 0 and srv.port == port
+            code, body = _get(srv.url("/metrics"))
+            assert code == 200
+            assert "apex_tpu_serve_queue_depth 4" in body
+            assert "apex_tpu_exporter_staleness_seconds" in body
+            code, body = _get(srv.url("/healthz"))
+            assert code == 200
+            assert json.loads(body)["status"] == "ok"
+            code, body = _get(srv.url("/varz"))
+            assert code == 200 and json.loads(body)["active"] == 1
+            code, _ = _get(srv.url("/nope"))
+            assert code == 404
+            # an unhealthy publish flips /healthz to 503 on the very
+            # next scrape — no handler restart involved
+            exp.publish(reg, tick=3,
+                        health={"ok": False, "status": "draining",
+                                "draining": True})
+            code, body = _get(srv.url("/healthz"))
+            assert code == 503
+            assert json.loads(body)["draining"] is True
+        finally:
+            srv.stop()
+        # the port is closed after stop
+        with pytest.raises(OSError):
+            urllib.request.urlopen(srv.url("/healthz"), timeout=0.5)
+        names = [e.name for e in mon.sink.by_kind("metrics")]
+        assert names == ["metrics_server_started",
+                         "metrics_server_stopped"]
+        started = mon.sink.by_name("metrics_server_started")[0]
+        assert started.attrs["port"] == port
+
+    def test_scrape_races_the_serve(self):
+        # a scrape mid-run sees a consistent, recent snapshot — the
+        # lock-free swap means the handler can never block the tick
+        mon = StubMonitor()
+        exp = MetricsExporter()
+        eng = _engine(monitor=mon, exporter=exp)
+        srv = MetricsServer(exp, port=0, monitor=mon)
+        srv.start()
+        seen = []
+
+        def scrape(tick):
+            if tick == 1:
+                code, body = _get(srv.url("/metrics"))
+                hcode, hbody = _get(srv.url("/healthz"))
+                seen.append((code, body, hcode, hbody))
+        try:
+            for i in range(3):
+                eng.submit(Request(rid=f"r{i}", prompt=[3 + i, 7],
+                                   max_new_tokens=4))
+            eng.run(after_tick=scrape)
+        finally:
+            srv.stop()
+        assert len(seen) == 1
+        code, body, hcode, hbody = seen[0]
+        assert code == 200 and hcode == 200
+        assert "apex_tpu_serve_tick " in body
+        payload = json.loads(hbody)
+        assert payload["status"] == "ok"
+        assert payload["staleness_s"] < 60.0
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation + trends
+# ---------------------------------------------------------------------------
+
+class TestFleetAggregator:
+    def _snap(self, tick, tokens, queue=2, avail=10, reserved=3,
+              active=1, prefilling=0, compiles=1):
+        return {"tick": tick, "tokens_generated": tokens,
+                "queue_depth": queue, "available_blocks": avail,
+                "reserved_blocks": reserved, "active": active,
+                "prefilling": prefilling, "compiles": compiles}
+
+    def test_rates_use_measured_tick_deltas(self):
+        agg = FleetAggregator(window=8)
+        # first observe: no previous marks, so every delta is 0
+        a0 = agg.observe(0, {"r0": self._snap(10, 100),
+                             "r1": self._snap(10, 100)})
+        assert a0["ticks"] == 0 and a0["new_tokens"] == 0
+        # r0 advanced 4 engine ticks, r1 only 2 (a swap-drain gap):
+        # the denominator is the MEASURED sum, never rounds * nominal
+        a1 = agg.observe(1, {"r0": self._snap(14, 120),
+                             "r1": self._snap(12, 106)})
+        assert a1["ticks"] == 6
+        assert a1["new_tokens"] == 26
+        assert a1["replicas"] == 2
+        assert a1["queue_depth"] == 4
+        # free blocks are NET of reservations: 2 * (10 - 3)
+        assert a1["free_blocks_net"] == 14
+        # backlog = queued + prefilling + active across the fleet
+        assert a1["backlog"] == 2 * (2 + 1)
+        assert a1["ewma_tokens_per_tick"] > 0
+
+    def test_replica_reset_never_goes_negative(self):
+        agg = FleetAggregator()
+        agg.observe(0, {"r0": self._snap(50, 500)})
+        # a rolling weight swap restarted r0: cumulative counters
+        # reset below the marks — the delta clamps to 0, not -500
+        a = agg.observe(1, {"r0": self._snap(2, 10)})
+        assert a["new_tokens"] == 0 and a["ticks"] == 0
+
+    def test_trend_slope_and_ring_bound(self):
+        agg = FleetAggregator(window=4)
+        for t in range(10):
+            agg.observe(t, {"r0": self._snap(t + 1, 0,
+                                             queue=2 * t)})
+        trends = agg.trends()
+        assert set(trends) == set(FleetAggregator.SERIES)
+        qd = trends["queue_depth"]
+        # queue depth grows by 2/round; the bounded ring holds the
+        # last 4 points and the least-squares slope reads the growth
+        assert qd["n"] == 4
+        assert qd["slope"] == pytest.approx(2.0)
+        assert agg.observations == 10
+
+
+# ---------------------------------------------------------------------------
+# JSONL -> exporter reconstruction (source-of-truth property)
+# ---------------------------------------------------------------------------
+
+class TestReconstructionProperty:
+    # the families registry_from_serve_events rebuilds; the live
+    # export_registry must agree sample-for-sample on every one
+    SHARED = ("apex_tpu_serve_requests_total",
+              "apex_tpu_serve_tokens_total",
+              "apex_tpu_serve_rejected_total",
+              "apex_tpu_serve_queue_depth",
+              "apex_tpu_serve_free_blocks",
+              "apex_tpu_serve_pool_blocks",
+              "apex_tpu_serve_tick",
+              "apex_tpu_serve_compiles_total")
+
+    def test_rebuilt_registry_matches_live_export(self, tmp_path):
+        mon = StubMonitor()
+        eng = _engine(monitor=mon)
+        for i in range(3):
+            eng.submit(Request(rid=f"r{i}", prompt=[3 + i, 7, 5],
+                               max_new_tokens=3))
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid="bad", prompt=[1],
+                               max_new_tokens=0))
+        eng.run()
+        live = eng.export_registry().samples()
+        rebuilt = registry_from_serve_events(
+            list(mon.sink.events)).samples()
+        for fam in self.SHARED:
+            assert rebuilt.get(fam) == live.get(fam), fam
+
+    def test_property_survives_the_jsonl_round_trip(self, tmp_path):
+        # same property through an actual file: serialize, load_events,
+        # rebuild — proving the on-disk log is sufficient
+        from apex_tpu.monitor import JsonlSink
+
+        jsonl = tmp_path / "serve.jsonl"
+        sink = JsonlSink(str(jsonl))
+        mon = StubMonitor()
+        mon.sink = sink
+        eng = _engine(monitor=mon)
+        for i in range(2):
+            eng.submit(Request(rid=f"r{i}", prompt=[2, 4 + i],
+                               max_new_tokens=3))
+        eng.run()
+        sink.close()
+        events, malformed = load_events(str(jsonl))
+        assert malformed == 0
+        rebuilt = registry_from_serve_events(events).samples()
+        live = eng.export_registry().samples()
+        for fam in self.SHARED:
+            assert rebuilt.get(fam) == live.get(fam), fam
+        # and the rebuilt registry renders as a valid document
+        text = registry_from_serve_events(events).render()
+        assert "# TYPE apex_tpu_serve_requests_total counter" in text

@@ -735,3 +735,90 @@ class TestTracedSmokeLoop:
         assert len(rows) == 3
         for e in rows:
             assert e.attrs["wall_device_ratio"] >= 0.0
+
+
+class TestServeCheckerMetricsPlane:
+    """ISSUE-17 extensions of ``check_serve_trace``: fleet_tick
+    monotonicity per log, slo_burn attribution to a declared
+    objective, and metrics-server lifecycle pairing."""
+
+    def _write(self, path, events):
+        from apex_tpu.monitor import JsonlSink
+
+        sink = JsonlSink(str(path))
+        for e in events:
+            sink.emit(e)
+        sink.close()
+
+    def _ev(self, kind, name, step=0, **attrs):
+        return Event(time=float(step), step=step, kind=kind,
+                     name=name, value=None, attrs=attrs)
+
+    def _chain(self):
+        """A minimal complete lifecycle chain (the checker refuses a
+        log with no serve traffic): one drain-preempted rid whose
+        whole wall was queue wait."""
+        return [
+            self._ev("serving", "request_submitted", step=0, rid="r0",
+                     prompt_len=2),
+            self._ev("serving", "request_done", step=1, rid="r0",
+                     preempted=True, terminal="preempted",
+                     wall_ms=5.0, queue_wait_ms=5.0, prefill_ms=0.0,
+                     decode_ms=0.0, new_tokens=0),
+        ]
+
+    def test_clean_metrics_plane_log_passes(self, tmp_path):
+        from apex_tpu.monitor.tracing import check_serve_trace
+
+        p = tmp_path / "fleet.jsonl"
+        self._write(p, self._chain() + [
+            self._ev("metrics", "metrics_server_started", port=1234),
+            self._ev("fleet_tick", "fleet_gauges", step=1, ticks=2),
+            self._ev("fleet_tick", "fleet_gauges", step=3, ticks=4),
+            self._ev("slo", "slo_objectives", step=1, objectives=[]),
+            self._ev("alarm", "slo_burn", step=3, dimension="ttft"),
+            self._ev("metrics", "metrics_server_stopped", port=1234),
+        ])
+        assert check_serve_trace(str(p)) == []
+
+    def test_fleet_tick_regression_fails_per_log(self, tmp_path):
+        from apex_tpu.monitor.tracing import check_serve_trace
+
+        p = tmp_path / "fleet.jsonl"
+        self._write(p, self._chain() + [
+            self._ev("fleet_tick", "fleet_gauges", step=5, ticks=2),
+            self._ev("fleet_tick", "fleet_gauges", step=2, ticks=1),
+        ])
+        fails = check_serve_trace(str(p))
+        assert any("fleet_tick step went backwards (5 -> 2)" in f
+                   for f in fails), fails
+        # merged MULTI-log interleaving is legitimate: each log is
+        # monotone on its own, so the pair passes
+        a = tmp_path / "r0.jsonl"
+        b = tmp_path / "r1.jsonl"
+        self._write(a, self._chain()
+                    + [self._ev("fleet_tick", "fleet_gauges", step=5)])
+        self._write(b, [self._ev("fleet_tick", "fleet_gauges", step=2)])
+        assert check_serve_trace([str(a), str(b)]) == []
+
+    def test_burn_without_objectives_fails(self, tmp_path):
+        from apex_tpu.monitor.tracing import check_serve_trace
+
+        p = tmp_path / "serve.jsonl"
+        self._write(p, [
+            self._ev("alarm", "slo_burn", step=3, dimension="ttft"),
+        ])
+        fails = check_serve_trace(str(p))
+        assert any("slo_objectives" in f for f in fails), fails
+
+    def test_unpaired_metrics_server_fails(self, tmp_path):
+        from apex_tpu.monitor.tracing import check_serve_trace
+
+        p = tmp_path / "serve.jsonl"
+        self._write(p, [
+            self._ev("metrics", "metrics_server_started", port=1),
+        ])
+        fails = check_serve_trace(str(p))
+        assert any("metrics_server_started (1) != "
+                   "metrics_server_stopped (0)" in f
+                   for f in fails), fails

@@ -87,7 +87,15 @@ LOWER_IS_BETTER = frozenset({"serving_p99_latency_ms",
                              "serving_itl_p99_ms",
                              "serving_warm_admission_ms",
                              "serving_chunked_itl_p99_ms",
-                             "serving_fleet_disagg_ttft_p99_ms"})
+                             "serving_fleet_disagg_ttft_p99_ms",
+                             "serving_metrics_scrape_p99_ms"})
+
+# ISSUE-17 absolute bar: the exporter may cost at most this much
+# decode throughput (exporter-on vs off, same trace).  Gated as an
+# absolute ceiling, not a vs-committed ratio: the committed value
+# hovers near zero (and can legitimately go negative on a noisy
+# host), where relative comparison is meaningless.
+SERVING_METRICS_OVERHEAD_MAX_PCT = 2.0
 
 
 def _fleet_scaling_tps(full, replicas):
@@ -180,6 +188,15 @@ def headline_metrics(full):
         "serving_fleet_disagg_ttft_p99_ms": (
             _get(full, "extras", "serving_fleet", "disaggregated",
                  "ttft_p99_ms"), "serving_fleet"),
+        # ISSUE-17 live metrics plane: the /metrics scrape tail gates
+        # LOWER_IS_BETTER like the other latencies; the exporter
+        # overhead row gates separately, against the absolute
+        # SERVING_METRICS_OVERHEAD_MAX_PCT bar (see
+        # overhead_regressions), because its committed value sits
+        # near zero where a ratio gate is meaningless
+        "serving_metrics_scrape_p99_ms": (
+            _get(full, "extras", "serving_metrics", "scrape_p99_ms"),
+            "serving_metrics"),
     }
     lc = _get(full, "extras", "long_context") or {}
     if isinstance(lc, dict):
@@ -246,10 +263,30 @@ def ratio_enforced(environ=None) -> bool:
         in ("1", "true", "on", "yes")
 
 
+def overhead_regressions(fresh,
+                         max_pct=SERVING_METRICS_OVERHEAD_MAX_PCT):
+    """Absolute-bar check on the ISSUE-17 exporter-overhead row:
+    fails when extras.serving_metrics.overhead_pct exceeds
+    ``max_pct``.  Absent row (pre-ISSUE-17 artifact, or a budget
+    skip) never fires — the relative machinery already polices
+    silent section loss via the scrape_p99 headline metric."""
+    ovh = _get(fresh, "extras", "serving_metrics", "overhead_pct")
+    if ovh is None:
+        return []
+    if ovh > max_pct:
+        return [f"serving_metrics_overhead_pct: exporter costs "
+                f"{ovh}% decode throughput, over the absolute "
+                f"{max_pct}% bar (live metrics plane must stay "
+                f"out of the tick's way)"]
+    return []
+
+
 def compare(fresh, committed, max_drop=DEFAULT_MAX_DROP):
     """(regressions, notes): regressions is a list of human-readable
     failure lines; notes are informational lines."""
-    regressions, notes = [], []
+    # the exporter-overhead bar is absolute, so it applies on every
+    # tier — including cross-tier structural-only runs
+    regressions, notes = overhead_regressions(fresh), []
     fresh_tier = fresh.get("tier", "full")
     committed_tier = committed.get("tier", "full")
     if fresh_tier != committed_tier:
@@ -520,6 +557,46 @@ def self_test() -> int:
     # artifact WITHOUT the columns never fires
     r, _ = compare(slow_spec, srv)
     assert r == [], r
+    # ISSUE-17 metrics-plane legs: scrape p99 gates LOWER_IS_BETTER
+    # relative to committed; exporter overhead gates against the
+    # absolute 2% bar on the FRESH run regardless of committed value
+    # (even negative committed noise); pre-column artifacts roll
+    # forward; a section skip excuses the scrape row
+    met = json.loads(json.dumps(srv))
+    met["extras"]["serving_metrics"] = {
+        "overhead_pct": 0.9, "scrape_p99_ms": 8.0}
+    r, _ = compare(json.loads(json.dumps(met)), met)
+    assert r == [], r
+    slow_scrape = json.loads(json.dumps(met))
+    slow_scrape["extras"]["serving_metrics"]["scrape_p99_ms"] = 12.0
+    r, _ = compare(slow_scrape, met)
+    assert len(r) == 1 and "serving_metrics_scrape_p99_ms" in r[0] \
+        and "lower is better" in r[0], r
+    heavy = json.loads(json.dumps(met))
+    heavy["extras"]["serving_metrics"]["overhead_pct"] = 3.5
+    r, _ = compare(heavy, met)
+    assert len(r) == 1 \
+        and "serving_metrics_overhead_pct" in r[0] \
+        and "absolute" in r[0], r
+    # the absolute bar fires even when the committed value is noise
+    # (negative overhead) — a ratio gate would be meaningless here
+    noisy_base = json.loads(json.dumps(met))
+    noisy_base["extras"]["serving_metrics"]["overhead_pct"] = -0.4
+    r, _ = compare(heavy, noisy_base)
+    assert any("serving_metrics_overhead_pct" in x for x in r), r
+    # ... and on cross-tier structural runs too
+    heavy_quick = json.loads(json.dumps(heavy))
+    heavy_quick["tier"] = "quick"
+    r, notes = compare(heavy_quick, met)
+    assert any("serving_metrics_overhead_pct" in x for x in r) \
+        and any("cross-tier" in n for n in notes), (r, notes)
+    r, _ = compare(met, srv)          # pre-ISSUE-17 committed artifact
+    assert r == [], r
+    met_skip = json.loads(json.dumps(met))
+    met_skip["extras"]["serving_metrics"] = {"skipped": "budget"}
+    r, notes = compare(met_skip, met)
+    assert r == [] and any("serving_metrics" in n and "skipped" in n
+                           for n in notes), (r, notes)
     # the ratio escalation switch (satellite: WARN -> gate behind
     # APEX_TPU_BENCH_GATE_RATIO=1)
     assert not ratio_enforced({})

@@ -186,23 +186,23 @@ cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/15 default test tier"
+echo "[ci] 1/16 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/15 README drift guard"
+echo "[ci] 2/16 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/15 8-device multichip dryrun"
+echo "[ci] 3/16 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/15 monitor smoke"
+echo "[ci] 4/16 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/15 kill->resume smoke"
+echo "[ci] 5/16 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -222,16 +222,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/15 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/16 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/15 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/16 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/15 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/16 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -240,7 +240,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/15 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/16 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -261,7 +261,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/15 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/16 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -285,7 +285,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/15 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/16 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -409,7 +409,7 @@ grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
-echo "[ci] 12/15 SPMD sharding audit (--check-sharding) + topology drift"
+echo "[ci] 12/16 SPMD sharding audit (--check-sharding) + topology drift"
 # Compile every plan-carrying multichip entry under its mesh on the
 # same 8-device host-platform trick the multichip tests use; fails on
 # APX701-703 findings, per-device-memory drift vs the committed
@@ -421,7 +421,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-sharding
 python __graft_entry__.py --plans 8
 
-echo "[ci] 13/15 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
+echo "[ci] 13/16 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
 FLEET_DIR="$(mktemp -d -t apex_tpu_fleet.XXXXXX)"
 # leg 1: sanitized 2-replica fleet with ONE rolling weight swap
 # mid-serve — zero lost requests fleet-wide, zero compiles after
@@ -477,7 +477,7 @@ echo "$FLEET_OUT" | grep -q "done=8" \
 python tools/trace_check.py "$FLEET_DIR"/crash/serve-*.jsonl --serve
 rm -rf "$FLEET_DIR"
 
-echo "[ci] 14/15 host-concurrency audit (--check-concurrency) + schedule stress"
+echo "[ci] 14/16 host-concurrency audit (--check-concurrency) + schedule stress"
 # static half: APX801-805 over the whole package against the
 # committed EMPTY baseline (a stale entry fails like the linter's)
 python -m apex_tpu.analysis --check-concurrency
@@ -488,7 +488,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis.schedule --seeds 5 --replicas 2 \
     --requests 6 --new-tokens 4
 
-echo "[ci] 15/15 Q8 quantized serving smoke (int8 weight-only decode)"
+echo "[ci] 15/16 Q8 quantized serving smoke (int8 weight-only decode)"
 # kernel half: the quant matmul's interpret-mode parity sweep — GEMV
 # and tiled paths vs the jnp twin, plus the zero-channel round-trip
 python -c "from apex_tpu.ops import quant_matmul; \
@@ -508,5 +508,66 @@ echo "$Q8_OUT" | grep -q "compiles=2 " \
     || { echo "[ci] FAIL: Q8 serve broke the one-compile-per-bucket ladder"; exit 1; }
 echo "$Q8_OUT" | grep -Eq "tokens_s=[1-9]" \
     || { echo "[ci] FAIL: Q8 serve reported zero tokens/s"; exit 1; }
+
+echo "[ci] 16/16 live metrics plane (exporter + /healthz flip + SLO burn)"
+METRICS_DIR="$(mktemp -d -t apex_tpu_metrics.XXXXXX)"
+METRICS_PORT=$((19300 + RANDOM % 500))
+# leg 1: sanitized 2-replica fleet with the exporter attached — the
+# probe (started first, stdlib urllib only) scrapes /metrics while
+# the fleet serves; the last exposition document must carry the
+# per-replica labeled tokens counter AND the fleet queue-depth gauge
+python tools/metrics_probe.py --port "$METRICS_PORT" \
+    --out "$METRICS_DIR/fleet" --timeout 600 &
+PROBE_PID=$!
+FLEET_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet \
+    --replicas 2 --requests 8 --new-tokens 4 --sanitize \
+    --jsonl-dir "$METRICS_DIR/fleet-logs" \
+    --metrics-port "$METRICS_PORT" --metrics-linger 1)"
+echo "$FLEET_OUT"
+wait "$PROBE_PID" \
+    || { echo "[ci] FAIL: metrics probe never scraped the fleet"; exit 1; }
+grep -Eq 'apex_tpu_serve_tokens_total\{replica="r0"\} [1-9]' \
+    "$METRICS_DIR/fleet/metrics.last" \
+    || { echo "[ci] FAIL: no per-replica labeled tokens counter in /metrics"; exit 1; }
+grep -q '^apex_tpu_fleet_queue_depth ' \
+    "$METRICS_DIR/fleet/metrics.last" \
+    || { echo "[ci] FAIL: no fleet queue-depth gauge in /metrics"; exit 1; }
+python tools/trace_check.py "$METRICS_DIR"/fleet-logs/serve-*.jsonl --serve
+# leg 2: /healthz drain flip — a SIGTERM-drained serve must publish
+# the drain before teardown; the probe's status-change log must show
+# the operator-visible 200 -> 503 transition
+python tools/metrics_probe.py --port "$METRICS_PORT" \
+    --out "$METRICS_DIR/drain" --timeout 600 &
+PROBE_PID=$!
+SERVE_OUT="$(python -m apex_tpu.testing.standalone_gpt --serve \
+    --requests 6 --new-tokens 8 --fault sigterm@2 \
+    --metrics-port "$METRICS_PORT" --metrics-linger 1)"
+echo "$SERVE_OUT"
+wait "$PROBE_PID" \
+    || { echo "[ci] FAIL: metrics probe never scraped the drain leg"; exit 1; }
+grep -q '^200 ' "$METRICS_DIR/drain/healthz.log" \
+    || { echo "[ci] FAIL: /healthz never reported healthy"; exit 1; }
+grep -q '^503 .*"draining": true' "$METRICS_DIR/drain/healthz.log" \
+    || { echo "[ci] FAIL: /healthz did not flip to 503 on the drain"; exit 1; }
+# leg 3: forced SLO breach — an absurd TTFT objective trips the
+# multi-window burn tracker: exactly ONE slo_burn episode through
+# the alarm machinery, surfaced in SERVE_DONE, trace-checked back to
+# its objective definition, and rendered by monitor_summary
+SLO_OUT="$(APEX_TPU_SLO_TTFT_P99_MS=0.001 \
+    python -m apex_tpu.testing.standalone_gpt --serve --requests 6 \
+    --new-tokens 6 --jsonl "$METRICS_DIR/slo.jsonl")"
+echo "$SLO_OUT"
+echo "$SLO_OUT" | grep -q "slo_burns=1" \
+    || { echo "[ci] FAIL: forced SLO breach did not emit exactly one burn episode"; exit 1; }
+[ "$(grep -c '"name":"slo_burn"' "$METRICS_DIR/slo.jsonl")" = 1 ] \
+    || { echo "[ci] FAIL: expected exactly one slo_burn alarm in the JSONL"; exit 1; }
+grep -q '"name":"slo_objectives"' "$METRICS_DIR/slo.jsonl" \
+    || { echo "[ci] FAIL: no slo_objectives definition event"; exit 1; }
+python tools/trace_check.py "$METRICS_DIR/slo.jsonl" --serve
+python tools/monitor_summary.py "$METRICS_DIR/slo.jsonl" \
+    | grep "SLO: 1 burn episode" \
+    || { echo "[ci] FAIL: monitor_summary did not render the SLO section"; exit 1; }
+rm -rf "$METRICS_DIR"
 
 echo "[ci] all green"
