@@ -450,6 +450,46 @@ register_flag(
     "the CLI flag picks an ephemeral port (printed in the "
     "metrics_server_started event).", lo=0, hi=65535)
 register_flag(
+    "APEX_TPU_CP_RPC_TIMEOUT_S", "float", 60.0,
+    "Process-isolated control plane (serving/control_plane.py): "
+    "per-attempt socket deadline in seconds for replica RPCs that "
+    "carry work (tick/submit/gather/scatter).  A timed-out "
+    "non-idempotent op escalates to SIGKILL + respawn + journal "
+    "replay rather than a blind resend.  The ProcessFleet "
+    "rpc_timeout_s ctor argument overrides.", lo=0.1)
+register_flag(
+    "APEX_TPU_CP_POLL_TIMEOUT_S", "float", 10.0,
+    "Control plane gauge-poll deadline in seconds for the per-round "
+    "router_snapshot RPC.  A timed-out poll never blocks the tick: "
+    "the replica keeps its stale snapshot, its router score degrades "
+    "(stale replicas sort last), and a heartbeat miss is charged.  "
+    "The ProcessFleet poll_timeout_s ctor argument overrides.",
+    lo=0.1)
+register_flag(
+    "APEX_TPU_CP_RPC_RETRIES", "int", 2,
+    "Control plane retry budget for idempotent replica RPCs "
+    "(snapshot/gather/summary/shutdown).  Each retry re-sends under "
+    "a fresh sequence number after a bounded backoff; non-idempotent "
+    "ops always run with zero retries and escalate to restart+replay "
+    "instead.  The ProcessFleet rpc_retries ctor argument overrides.",
+    lo=0, hi=16)
+register_flag(
+    "APEX_TPU_CP_SPAWN_TIMEOUT_S", "float", 300.0,
+    "Control plane replica spawn deadline in seconds: the supervisor "
+    "waits this long for a freshly spawned subprocess to connect its "
+    "socket and send the hello frame (covers jax import + engine "
+    "build + journal replay).  Exceeding it kills the child and "
+    "counts a restart.  The ProcessFleet spawn_timeout_s ctor "
+    "argument overrides.", lo=1.0)
+register_flag(
+    "APEX_TPU_CP_HEARTBEAT_MISSES", "int", 3,
+    "Control plane liveness threshold: consecutive missed gauge "
+    "polls (rpc_timeout on router_snapshot) a replica may accrue "
+    "before the supervisor declares it hung, SIGKILLs it, and "
+    "restarts it with journal replay under bounded backoff.  The "
+    "ProcessFleet heartbeat_misses ctor argument overrides.",
+    lo=1, hi=100)
+register_flag(
     "APEX_TPU_SLO_TTFT_P99_MS", "float", 0.0,
     "Serving SLO: time-to-first-token p99 objective in milliseconds "
     "for ALL priority classes (serving/metrics.SLOTracker).  >0 arms "

@@ -46,7 +46,8 @@ from typing import Any, Dict, List, Optional, Sequence, Set
 from .flags import flag_int
 
 __all__ = ["DeterministicScheduler", "ScheduleTimeout", "SeedRun",
-           "SweepReport", "fleet_digest", "run_fleet_seed",
+           "SweepReport", "fleet_digest", "process_sweep",
+           "run_fleet_seed", "run_process_fleet_seed",
            "schedule_sweep", "main"]
 
 
@@ -248,6 +249,43 @@ def schedule_sweep(seeds: Sequence[int], **kw) -> SweepReport:
     return SweepReport(runs=[run_fleet_seed(s, **kw) for s in seeds])
 
 
+def run_process_fleet_seed(seed: int, *, replicas: int = 2,
+                           num_requests: int = 4,
+                           new_tokens: int = 3, hidden: int = 16,
+                           num_layers: int = 1,
+                           **fleet_kw) -> SeedRun:
+    """The ISSUE-18 process-boundary twin of :func:`run_fleet_seed`:
+    one fixed request trace (request RNG pinned to 0) served by the
+    PROCESS-isolated fleet, with ``seed`` permuting the supervisor's
+    per-round replica tick order instead of a thread schedule.  The
+    fleet digest (journal-merged, routing-invariant) must not care —
+    crash-reshuffled or seed-reshuffled, greedy decode is
+    interleaving-invariant across process boundaries too.  ``grants``
+    reports supervisor rounds (the closest analogue of schedule
+    hand-offs)."""
+    from ..testing.standalone_gpt import fleet_procs_smoke
+
+    summary = fleet_procs_smoke(
+        num_requests, replicas=replicas, max_new_tokens=new_tokens,
+        hidden=hidden, num_layers=num_layers, num_heads=2,
+        decode_attention="reference", seed=0, tick_seed=int(seed),
+        **fleet_kw)
+    return SeedRun(
+        seed=int(seed), digest=summary.digest,
+        tokens=summary.tokens_generated,
+        requests_done=summary.requests_done,
+        lost=summary.lost_requests, grants=summary.rounds,
+        thread_failures=[])
+
+
+def process_sweep(seeds: Sequence[int], **kw) -> SweepReport:
+    """:func:`schedule_sweep` across the process boundary: every seed
+    drives :func:`run_process_fleet_seed`; same :class:`SweepReport`
+    invariant (identical digest, zero lost) over subprocess fleets."""
+    return SweepReport(runs=[run_process_fleet_seed(s, **kw)
+                             for s in seeds])
+
+
 # ---------------------------------------------------------------------------
 # CLI — ci.sh step 14's stress leg
 # ---------------------------------------------------------------------------
@@ -274,6 +312,12 @@ def main(argv=None) -> int:
     ap.add_argument("--new-tokens", type=int, default=4)
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-gate starvation timeout (seconds)")
+    ap.add_argument("--procs", action="store_true",
+                    help="sweep the PROCESS-isolated fleet instead "
+                         "(ISSUE-18): each seed permutes the "
+                         "supervisor's per-round replica tick order "
+                         "across subprocess boundaries; the journal-"
+                         "merged fleet digest must be identical")
     args = ap.parse_args(argv)
 
     n = args.seeds if args.seeds is not None \
@@ -281,10 +325,16 @@ def main(argv=None) -> int:
     if n < 1:
         ap.error(f"--seeds must be >= 1, got {n} (a zero-seed sweep "
                  f"proves nothing)")
-    report = schedule_sweep(
-        range(args.base_seed, args.base_seed + n),
-        replicas=args.replicas, num_requests=args.requests,
-        new_tokens=args.new_tokens, timeout=args.timeout)
+    if args.procs:
+        report = process_sweep(
+            range(args.base_seed, args.base_seed + n),
+            replicas=args.replicas, num_requests=args.requests,
+            new_tokens=args.new_tokens)
+    else:
+        report = schedule_sweep(
+            range(args.base_seed, args.base_seed + n),
+            replicas=args.replicas, num_requests=args.requests,
+            new_tokens=args.new_tokens, timeout=args.timeout)
     for r in report.runs:
         print(f"[schedule] seed {r.seed}: digest={r.digest} "
               f"done={r.requests_done} tokens={r.tokens} "

@@ -64,7 +64,21 @@ logger = get_logger(__name__)
 
 __all__ = ["MetricsRegistry", "MetricsExporter", "MetricsServer",
            "PublishedState", "FleetAggregator",
-           "registry_from_serve_events"]
+           "registry_from_serve_events", "replica_metrics_port"]
+
+
+def replica_metrics_port(base: int, index: int) -> int:
+    """The multi-replica metrics-port layout (ISSUE-18): the BASE
+    port belongs to the supervisor's aggregated fleet view, replica
+    ``k`` binds ``base + 1 + k``.  One flag
+    (``APEX_TPU_METRICS_PORT``), N+1 non-colliding servers — the
+    second-bind EADDRINUSE this replaces is a regression test."""
+    if int(base) <= 0:
+        raise ValueError(f"replica_metrics_port needs a real base "
+                         f"port, got {base}")
+    if int(index) < 0:
+        raise ValueError(f"replica index must be >= 0, got {index}")
+    return int(base) + 1 + int(index)
 
 # metric-name prefix every serving series uses (the exposition
 # convention: one namespace per exporter)
@@ -424,8 +438,25 @@ class MetricsServer:
             return self.port
         handler = type("_BoundHandler", (_Handler,),
                        {"exporter": self.exporter})
-        self._server = ThreadingHTTPServer(
-            (self.host, self._requested_port), handler)
+        try:
+            self._server = ThreadingHTTPServer(
+                (self.host, self._requested_port), handler)
+        except OSError as e:
+            # the multi-replica foot-gun (ISSUE-18): one
+            # APEX_TPU_METRICS_PORT flag, N replicas each trying to
+            # bind it — the second bind used to die with a bare
+            # EADDRINUSE traceback deep in socketserver.  Name the
+            # port-assignment contract in the error instead.
+            raise OSError(
+                e.errno,
+                f"MetricsServer could not bind "
+                f"{self.host}:{self._requested_port}: {e.strerror}. "
+                f"One port serves ONE exporter; a multi-replica host "
+                f"gives each replica its own port "
+                f"(replica_metrics_port(base, k) = base + 1 + k, the "
+                f"process-fleet supervisor's layout — the base port "
+                f"carries the aggregated fleet view) or binds "
+                f"ephemeral with port=0.") from e
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever,

@@ -219,7 +219,12 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
     # serving (request lifecycle + engine gauges) -------------------------
     srv = [e for e in events if e.kind == "serving"]
     ticks = [e for e in events if e.kind == "serve_tick"]
-    if srv or ticks:
+    fleet = [e for e in events if e.kind == "fleet"]
+    # a supervisor-only log (ISSUE-18: kind='fleet' lifecycle events,
+    # no request traffic of its own) still gets the serving section —
+    # the control-plane ledger below must not require child logs in
+    # the merge
+    if srv or ticks or fleet:
         digest: Dict[str, object] = {}
         done_events = [e for e in srv if e.name == "request_done"]
         digest["submitted"] = sum(1 for e in srv
@@ -248,7 +253,6 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
         if replicas:
             digest["replicas"] = {k: replicas[k]
                                   for k in sorted(replicas)}
-        fleet = [e for e in events if e.kind == "fleet"]
         if fleet:
             digest["fleet"] = {
                 "routed": sum(1 for e in fleet
@@ -261,6 +265,58 @@ def summarize(events: List[Event], malformed: int = 0) -> dict:
                                         if e.name ==
                                         "replica_restart"),
             }
+        # ISSUE-18 distributed control plane: the supervisor's
+        # process-lifecycle ledger (spawn/reap pairing, restarts with
+        # reasons, degraded RPCs, torn-handoff fallbacks, QoS
+        # admission sheds) and the autoscale event trace — every
+        # scaling decision with its round, direction, trigger and
+        # resulting fleet size, in order
+        spawned = [e for e in fleet if e.name == "replica_spawned"]
+        if spawned:
+            cp: Dict[str, object] = {
+                "spawned": len(spawned),
+                "reaped": sum(1 for e in fleet
+                              if e.name == "replica_reaped"),
+                "replayed_requests": sum(
+                    int(e.attrs.get("replayed") or 0)
+                    for e in spawned),
+            }
+            restarts = [e for e in fleet
+                        if e.name == "replica_restart"]
+            if restarts:
+                cp["restarts"] = [
+                    {"round": e.step,
+                     "replica": e.attrs.get("replica"),
+                     "reason": e.attrs.get("reason"),
+                     "backoff_s": e.attrs.get("backoff_s")}
+                    for e in restarts]
+            rpc_to = sum(1 for e in fleet if e.name == "rpc_timeout")
+            if rpc_to:
+                cp["rpc_timeouts"] = rpc_to
+            retries = sum(1 for e in fleet
+                          if e.name == "kv_handoff_retry")
+            if retries:
+                cp["handoff_cold_fallbacks"] = retries
+            sheds = [e for e in fleet
+                     if e.name == "request_shed_admission"]
+            if sheds:
+                by_cls: Dict[str, int] = {}
+                for e in sheds:
+                    k = (f"{e.attrs.get('priority_class')}/"
+                         f"{e.attrs.get('reason')}")
+                    by_cls[k] = by_cls.get(k, 0) + 1
+                cp["shed_admission"] = by_cls
+            scale = [e for e in fleet if e.name == "autoscale"]
+            if scale:
+                cp["autoscale"] = [
+                    {"round": e.step,
+                     "action": e.attrs.get("action"),
+                     "reason": e.attrs.get("reason"),
+                     "replica": e.attrs.get("replica"),
+                     "backlog": e.attrs.get("backlog"),
+                     "replicas": e.attrs.get("replicas")}
+                    for e in scale]
+            digest["control_plane"] = cp
         # ISSUE-13 terminal paths: deadline expiry (queued OR
         # running) and load shedding — rendered so N submitted still
         # visibly reconciles against N terminal
@@ -558,6 +614,43 @@ def render(summary: dict) -> str:
                 f"{fleet['kv_handoffs']} KV handoff(s), "
                 f"{fleet['swaps']} rolling swap(s), "
                 f"{fleet['replica_restarts']} replica restart(s)")
+        cp = srv.get("control_plane")
+        if cp:
+            head = (f"  control plane: {cp['spawned']} spawned / "
+                    f"{cp['reaped']} reaped"
+                    + ("" if cp["spawned"] == cp["reaped"]
+                       else "  [UNPAIRED]"))
+            if cp.get("replayed_requests"):
+                head += (f", {cp['replayed_requests']} request(s) "
+                         f"journal-replayed")
+            if cp.get("rpc_timeouts"):
+                head += f", {cp['rpc_timeouts']} RPC timeout(s)"
+            if cp.get("handoff_cold_fallbacks"):
+                head += (f", {cp['handoff_cold_fallbacks']} cold "
+                         f"prefill fallback(s)")
+            lines.append(head)
+            for r in cp.get("restarts", []):
+                lines.append(
+                    f"    RESTART {r.get('replica')} @ round "
+                    f"{r.get('round')} [{r.get('reason')}] after "
+                    f"{_fmt(r.get('backoff_s'), 3)}s backoff")
+            shed = cp.get("shed_admission")
+            if shed:
+                lines.append(
+                    "    QoS admission shed: "
+                    + " ".join(f"{k}={v}"
+                               for k, v in sorted(shed.items())))
+            scale = cp.get("autoscale")
+            if scale:
+                lines.append(f"  autoscale trace ({len(scale)} "
+                             f"event(s)):")
+                for a in scale:
+                    lines.append(
+                        f"    round {a.get('round')}: "
+                        f"{str(a.get('action')).upper():<4} "
+                        f"{a.get('replica')} [{a.get('reason')}] "
+                        f"backlog {_fmt(a.get('backlog'), 2)} -> "
+                        f"{a.get('replicas')} replica(s)")
         for r in srv.get("journal_replays", []):
             lines.append(f"  JOURNAL REPLAY @ tick {r.get('tick')}: "
                          f"{r.get('replayed')} request(s) re-entered, "

@@ -495,6 +495,14 @@ def check_serve_trace(jsonl_path,
       ``fleet_tick`` steps are monotone non-decreasing per log, and
       ``metrics_server_started`` / ``metrics_server_stopped`` pair
       up (every started server was torn down, and vice versa);
+    * the distributed control plane (ISSUE-18) — when supervisor
+      ``replica_spawned`` events are present, every spawned
+      ``(replica, incarnation)`` pairs with exactly one
+      ``replica_reaped`` and vice versa (a kill-9'd incarnation is
+      reaped before its replay incarnation spawns; a drained
+      scale-down victim is reaped too — nothing leaks), and every
+      ``autoscale`` event carries a valid ``action`` with its
+      subject replica's lifecycle events in the log;
     * the Chrome artifact (when given) parses and carries one lane per
       terminal rid with the canonical queued/prefill/decode phases.
     """
@@ -542,6 +550,49 @@ def check_serve_trace(jsonl_path,
             f"metrics_server_started ({started}) != "
             f"metrics_server_stopped ({stopped}) — every metrics "
             f"server must be torn down")
+    # ISSUE-18: process-isolated fleet lifecycle — checks arm only
+    # when a supervisor log is in the merge (single-process serve
+    # runs have no spawn events and skip this block entirely)
+    fleet = [e for e in events if e.kind == "fleet"]
+    spawned_pairs: Dict[tuple, int] = {}
+    reaped_pairs: Dict[tuple, int] = {}
+    for e in fleet:
+        key = (str(e.attrs.get("replica")),
+               int(e.attrs.get("incarnation") or 0))
+        if e.name == "replica_spawned":
+            spawned_pairs[key] = spawned_pairs.get(key, 0) + 1
+        elif e.name == "replica_reaped":
+            reaped_pairs[key] = reaped_pairs.get(key, 0) + 1
+    if spawned_pairs:
+        for key, n in sorted(spawned_pairs.items()):
+            if n != 1:
+                failures.append(
+                    f"replica {key[0]} incarnation {key[1]}: "
+                    f"{n} replica_spawned events, want exactly 1")
+            if reaped_pairs.get(key, 0) != 1:
+                failures.append(
+                    f"replica {key[0]} incarnation {key[1]}: "
+                    f"spawned but {reaped_pairs.get(key, 0)} "
+                    f"replica_reaped event(s) — every incarnation "
+                    f"must be reaped exactly once")
+        for key in sorted(set(reaped_pairs) - set(spawned_pairs)):
+            failures.append(
+                f"replica {key[0]} incarnation {key[1]}: "
+                f"replica_reaped without a replica_spawned")
+        known = {k[0] for k in spawned_pairs}
+        for e in fleet:
+            if e.name != "autoscale":
+                continue
+            action = e.attrs.get("action")
+            if action not in ("up", "down"):
+                failures.append(
+                    f"autoscale event with invalid action "
+                    f"{action!r} (want 'up' or 'down')")
+            if str(e.attrs.get("replica")) not in known:
+                failures.append(
+                    f"autoscale {action} names replica "
+                    f"{e.attrs.get('replica')!r} with no lifecycle "
+                    f"events in the log")
     # fleet-mode sanity: one rid must live on exactly one replica —
     # its submit and terminal must carry the same replica stamp
     if len(paths) > 1:

@@ -45,12 +45,15 @@ from .escalation import (
     serve_policy,
 )
 from .faults import (
+    PARENT_KINDS,
+    PROCESS_FATAL_KINDS,
     FaultInjector,
     InjectedCrash,
     InjectedFault,
     corrupt_checkpoint,
     corrupt_journal,
     parse_fault,
+    split_fault,
 )
 
 __all__ = [
@@ -59,6 +62,8 @@ __all__ = [
     "EscalationPolicy", "EscalationAbort", "DEFAULT_POLICY",
     "DEFAULT_SERVE_POLICY", "serve_policy",
     "IGNORE", "ABORT", "CHECKPOINT_THEN_ABORT", "SNAPSHOT_THEN_DRAIN",
-    "FaultInjector", "parse_fault", "InjectedFault", "InjectedCrash",
+    "FaultInjector", "parse_fault", "split_fault",
+    "PARENT_KINDS", "PROCESS_FATAL_KINDS",
+    "InjectedFault", "InjectedCrash",
     "corrupt_checkpoint", "corrupt_journal",
 ]

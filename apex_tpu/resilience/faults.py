@@ -42,8 +42,30 @@ from typing import List, Optional
 #: at-least-once replay drill).  Both fire through the serve driver's
 #: :meth:`FaultInjector.before_tick` / the engine's admission poll,
 #: with the same once-semantics as the training kinds.
-KINDS = ("crash", "kill", "sigterm", "sigint", "nan", "inf", "stall",
-         "reject_alloc", "corrupt_journal")
+#: ``kill9`` and ``rpc_timeout`` are PROCESS-fleet injectors
+#: (ISSUE-18): ``kill9@K`` SIGKILLs a live replica *subprocess* at
+#: its engine tick K (fired child-side through ``before_tick`` —
+#: operationally identical to ``kill``, named separately so a fleet
+#: spec reads as the drill it is); ``rpc_timeout@K`` drops ONE
+#: gauge-poll response at supervisor round K (fired parent-side
+#: through :meth:`FaultInjector.drop_rpc` — the supervisor treats the
+#: poll as timed out and degrades that replica's router score).
+KINDS = ("crash", "kill", "kill9", "sigterm", "sigint", "nan", "inf",
+         "stall", "reject_alloc", "corrupt_journal", "rpc_timeout")
+
+#: Kinds the control-plane SUPERVISOR fires (everything else ships to
+#: the replica subprocess) — :func:`split_fault` partitions on this.
+PARENT_KINDS = ("rpc_timeout",)
+
+#: Kinds that take the hosting process down when they fire.  A replica
+#: respawned for journal replay must NOT carry these: the fresh
+#: process's tick counter restarts at 0, so the replay would re-reach
+#: tick K and re-fire forever (in-memory once-semantics cannot survive
+#: a SIGKILL).  The supervisor strips them from the respawn spec —
+#: injected faults are once-per-serve by contract, same as a
+#: ``run_resumable`` attempt sailing past the step that killed its
+#: predecessor.
+PROCESS_FATAL_KINDS = ("crash", "kill", "kill9", "sigterm", "sigint")
 
 
 class InjectedFault(RuntimeError):
@@ -94,7 +116,7 @@ class FaultInjector:
             if s.kind == "crash":
                 s.fired = True
                 raise InjectedCrash(f"injected crash at step {step}")
-            if s.kind == "kill":
+            if s.kind in ("kill", "kill9"):
                 s.fired = True
                 os.kill(os.getpid(), signal.SIGKILL)  # no return
             if s.kind in ("sigterm", "sigint"):
@@ -136,6 +158,23 @@ class FaultInjector:
         for s in self.specs:
             if not s.fired and tick >= s.step \
                     and s.kind == "reject_alloc":
+                s.fired = True
+                return True
+        return False
+
+    def drop_rpc(self, tick: int) -> bool:
+        """True exactly once, at the first gauge poll AT OR AFTER an
+        armed ``rpc_timeout@K`` spec's tick — the process-fleet
+        supervisor polls this before each replica's snapshot RPC and,
+        when it fires, treats that one response as dropped (stale
+        snapshot + router-score penalty, never a blocked tick).
+        At-or-after for the same reason as :meth:`reject_alloc`: the
+        supervisor only polls replicas that are up, so a spec landing
+        on a round spent restarting must defer to the next poll
+        instead of staying armed-but-dead forever."""
+        for s in self.specs:
+            if not s.fired and tick >= s.step \
+                    and s.kind == "rpc_timeout":
                 s.fired = True
                 return True
         return False
@@ -201,6 +240,29 @@ def parse_fault(spec: Optional[str]) -> Optional[FaultInjector]:
                 f"bad fault spec {part!r} (expected kind@step[:arg] "
                 f"with kind in {KINDS}): {e}") from None
     return FaultInjector(out) if out else None
+
+
+def split_fault(spec: Optional[str]
+                ) -> "tuple[Optional[str], Optional[str]]":
+    """Partition a composed fault spec into its ``(child, parent)``
+    halves for the process fleet: :data:`PARENT_KINDS` fire in the
+    supervisor (``rpc_timeout`` — the RPC layer is parent-side code),
+    everything else ships to the replica subprocess and fires at its
+    engine's tick boundaries.  Validates the WHOLE spec up front with
+    :func:`parse_fault`'s strictness — a typo'd kind fails the CLI,
+    not fire time.  Either half may be None."""
+    if not spec:
+        return None, None
+    parse_fault(spec)
+    child: List[str] = []
+    parent: List[str] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind = part.partition("@")[0].strip().lower()
+        (parent if kind in PARENT_KINDS else child).append(part)
+    return (",".join(child) or None, ",".join(parent) or None)
 
 
 # ---------------------------------------------------------------------------

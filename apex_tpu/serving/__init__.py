@@ -30,9 +30,25 @@ The serving counterpart of the training pipeline (ROADMAP item 1):
   (:class:`SpeculationGovernor` auto-disabling a mismatching draft,
   watchdog stall → snapshot-then-drain).
 
+* :mod:`.control_plane` — process-isolated fleet (ISSUE-18): each
+  replica is a supervised subprocess pinned to its device, speaking
+  a length-prefixed JSON+binary protocol over local sockets for
+  submits, gauge polls and KV handoff; heartbeat liveness (missed
+  polls ⇒ SIGKILL + bounded-backoff restart with journal replay,
+  fleet digest token-identical to an uninterrupted run), autoscaling
+  from queue-depth trends and per-class QoS admission
+  (:class:`ProcessFleet`, :class:`ReplicaProcess`,
+  :class:`AutoscalePolicy`, :class:`QoSPolicy`).
+
 Entry point: ``python -m apex_tpu.testing.standalone_gpt --serve``;
 docs/api/serving.md walks the architecture.
 """
+from .control_plane import (AutoscalePolicy, EngineSpec, FleetGiveUp,
+                            ProcessFleet, ProcessFleetSummary,
+                            QoSClass, QoSPolicy, ReplicaDead,
+                            ReplicaProcess, RpcError, RpcRemoteError,
+                            RpcTimeout, fleet_rows_digest, recv_frame,
+                            send_frame)
 from .engine import (BucketLadder, Request, ServeSummary,
                      ServingEngine, default_cache_config)
 from .fleet import FleetRouter, FleetSummary, Replica, transfer_prefix
@@ -57,6 +73,10 @@ from .resilience import (RequestJournal, ServeRunResult, ShedPolicy,
 from .tp import SERVING_TP_AXIS, TPContext, serving_tp_plan
 
 __all__ = [
+    "AutoscalePolicy", "EngineSpec", "FleetGiveUp", "ProcessFleet",
+    "ProcessFleetSummary", "QoSClass", "QoSPolicy", "ReplicaDead",
+    "ReplicaProcess", "RpcError", "RpcRemoteError", "RpcTimeout",
+    "fleet_rows_digest", "recv_frame", "send_frame",
     "BucketLadder", "Request", "ServeSummary", "ServingEngine",
     "default_cache_config",
     "FleetRouter", "FleetSummary", "Replica", "transfer_prefix",

@@ -1676,6 +1676,19 @@ class ServingEngine:
                      f"{','.join(map(str, q.out_tokens))};".encode())
         return h.hexdigest()[:12]
 
+    def digest_rows(self) -> Dict[str, List[int]]:
+        """The raw material of :meth:`tokens_digest` as data —
+        ``{rid: output tokens}`` for every request this engine holds.
+        The process-fleet supervisor (ISSUE-18) merges these rows
+        across replicas AND across a restarted replica's journal
+        terminals into ONE routing-invariant fleet digest: greedy
+        decode is batching/interleaving-invariant (the PR 15 sweep's
+        proof), so the merged digest is identical no matter which
+        replica served which rid or how a crash reshuffled them."""
+        allq = list(self.done) + list(self.active.values())
+        return {str(q.rid): [int(t) for t in q.out_tokens]
+                for q in allq}
+
     def router_snapshot(self) -> Dict[str, Any]:
         """The cheap per-replica struct a fleet router load-balances
         on (ISSUE-14): pool headroom (free + reclaimable-idle blocks,
@@ -1697,6 +1710,11 @@ class ServingEngine:
             "prefilling": len(self.prefilling),
             "shed_engaged": bool(self.shed.engaged
                                  if self.shed is not None else False),
+            # active SLO burn episodes ("class/dimension" strings) —
+            # the per-class QoS admission door (ISSUE-18) gates on
+            # these fleet-wide, so they ride the same poll
+            "slo_burning": (list(self.slo.burning())
+                            if self.slo is not None else []),
             "warm_prefix_keys": self.manager.prefix_keys(),
             "gauges": self.metrics.gauges.router_snapshot(),
             # cumulative counters the FleetAggregator differentiates

@@ -72,7 +72,8 @@ from .resilience import recover_engine, run_serving
 logger = get_logger(__name__)
 
 __all__ = ["FleetRouter", "FleetSummary", "Replica",
-           "transfer_prefix"]
+           "transfer_prefix", "export_prefix_payload",
+           "import_prefix_payload"]
 
 ROUTER_POLICIES = ("gauges", "round_robin")
 # disaggregated prefill probes ride the normal request path under a
@@ -255,6 +256,71 @@ def transfer_prefix(src: ServingEngine, dst: ServingEngine,
                       src=str(src.replica_id),
                       dst=str(dst.replica_id))
     return n
+
+
+def export_prefix_payload(src: ServingEngine, prompt: Sequence[int]
+                          ) -> Optional[tuple]:
+    """The source half of :func:`transfer_prefix` as HOST data — the
+    process-fleet wire format (ISSUE-18).  Gathers ``prompt``'s
+    resident pages exactly as the in-process handoff does (same
+    ``_gather_jit``, same rung padding, int8 rows + fp32 scales
+    verbatim) but lands them as numpy arrays a socket can carry.
+    Returns ``(n, arrays)`` with ``arrays`` mapping ``k``/``v`` (and
+    ``ks``/``vs`` for quantized storage) to host ndarrays padded to
+    ``src.ladder.pick_pages(n)``, or None when ``src`` does not hold
+    the whole prompt (the caller falls back to a cold admission)."""
+    src_blocks = src.manager.resident_prefix(prompt)
+    if src_blocks is None:
+        return None
+    n = len(src_blocks)
+    pn = src.ladder.pick_pages(n)
+    sb = np.full(pn, DUMP_BLOCK, np.int32)
+    sb[:n] = src_blocks
+    k, v, ks, vs = _gather_jit(src.cache, jnp.asarray(sb))
+    arrays = {"k": np.asarray(k), "v": np.asarray(v)}
+    if ks is not None:
+        arrays["ks"] = np.asarray(ks)
+        arrays["vs"] = np.asarray(vs)
+    return n, arrays
+
+
+def import_prefix_payload(dst: ServingEngine, prompt: Sequence[int],
+                          n: int, arrays: Dict[str, Any]) -> int:
+    """The destination half of :func:`transfer_prefix` from HOST data
+    (ISSUE-18 socket handoff): claim ``n`` pool blocks via
+    ``register_external`` and scatter the payload produced by
+    :func:`export_prefix_payload`.  Both replicas must share the
+    cache geometry AND the page ladder (one :class:`EngineSpec` per
+    fleet guarantees it); a payload whose padded page count does not
+    match this side's rung is rejected — the caller treats it as a
+    torn handoff and admits cold.  Returns the page count landed, or
+    0 when the prompt was already resident (no device traffic)."""
+    pn = dst.ladder.pick_pages(int(n))
+    if int(arrays["k"].shape[1]) != pn:
+        raise ValueError(
+            f"KV payload padded to {int(arrays['k'].shape[1])} "
+            f"page(s) but this replica's ladder pads {n} -> {pn}: "
+            f"mismatched page ladders across the fleet")
+    dst_blocks = dst.manager.register_external(prompt, int(n))
+    if dst_blocks is None:
+        return 0                       # already resident — warm as-is
+    db = np.full(pn, DUMP_BLOCK, np.int32)
+    db[:n] = dst_blocks
+    sharding = dst.cache.k.sharding
+    k = jax.device_put(jnp.asarray(arrays["k"]), sharding)
+    v = jax.device_put(jnp.asarray(arrays["v"]), sharding)
+    ks = vs = None
+    if "ks" in arrays:
+        ks_sh = dst.cache.k_scale.sharding
+        ks = jax.device_put(jnp.asarray(arrays["ks"]), ks_sh)
+        vs = jax.device_put(jnp.asarray(arrays["vs"]), ks_sh)
+    with contextlib.ExitStack() as stack:
+        dev = getattr(dst, "device", None)
+        if dev is not None:
+            stack.enter_context(jax.default_device(dev))
+        dst.cache = _scatter_jit(dst.cache, k, v, ks, vs,
+                                 jnp.asarray(db))
+    return int(n)
 
 
 # ---------------------------------------------------------------------------

@@ -94,7 +94,8 @@ class ShedPolicy:
     def __init__(self, *, pool_hw: float = 0.0,
                  pool_lw: Optional[float] = None,
                  queue_hw: int = 0,
-                 queue_lw: Optional[int] = None):
+                 queue_lw: Optional[int] = None,
+                 class_queue_hw: Optional[Dict[str, int]] = None):
         if pool_hw and not 0.0 < pool_hw <= 1.0:
             raise ValueError(f"pool_hw {pool_hw} must be in (0, 1]")
         self.pool_hw = float(pool_hw)
@@ -103,6 +104,19 @@ class ShedPolicy:
         self.queue_hw = int(queue_hw)
         self.queue_lw = (self.queue_hw // 2 if queue_lw is None
                          else int(queue_lw))
+        # per-priority-class high-water overrides (ISSUE-18): the
+        # process-fleet QoS door admits per class, so each class can
+        # carry its own backlog ceiling ("p0" may queue deep, "p2"
+        # sheds early to protect its latency SLO).  Engine-level
+        # hysteresis is untouched — these gate ADMISSION fleet-wide,
+        # before a request ever reaches an engine queue.
+        self.class_queue_hw: Dict[str, int] = {}
+        for cls, hw in (class_queue_hw or {}).items():
+            hw = int(hw)
+            if hw < 1:
+                raise ValueError(
+                    f"class_queue_hw[{cls!r}] must be >= 1, got {hw}")
+            self.class_queue_hw[str(cls)] = hw
         if self.pool_hw and self.pool_lw >= self.pool_hw:
             raise ValueError("pool_lw must sit below pool_hw "
                              "(the hysteresis band)")
@@ -119,6 +133,13 @@ class ShedPolicy:
     @property
     def enabled(self) -> bool:
         return bool(self.pool_hw or self.queue_hw)
+
+    def queue_hw_for(self, priority_class: str) -> int:
+        """The queue high-water mark for one priority class — the
+        per-class override when present, else the global mark (0 =
+        unlimited).  The QoS admission door polls this per submit."""
+        return self.class_queue_hw.get(str(priority_class),
+                                       self.queue_hw)
 
     def _over_high(self, pool_frac: float, queue_depth: int) -> bool:
         return ((self.pool_hw > 0 and pool_frac >= self.pool_hw)

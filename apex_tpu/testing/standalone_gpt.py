@@ -23,6 +23,7 @@ import contextlib
 import functools
 import os
 import signal
+import tempfile
 import time
 from typing import Any, NamedTuple, Optional
 
@@ -1728,6 +1729,205 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     return summary
 
 
+# ---------------------------------------------------------------------------
+# Process-isolated fleet (ISSUE-18) — subprocess builder + driver
+# ---------------------------------------------------------------------------
+
+def build_fleet_engine(spec_dict: dict) -> dict:
+    """Child-side :class:`~apex_tpu.serving.EngineSpec` builder — the
+    default entry point a replica subprocess resolves and calls with
+    its spec as a plain dict.  Runs entirely IN THE CHILD: model init,
+    weight extraction, cache allocation, warmup, the JSONL monitor and
+    the crash journal all live here; the supervising parent only ever
+    sees the socket.  The model kwargs mirror :func:`fleet_smoke`'s
+    member construction, so a process fleet and an in-process fleet
+    built from the same seed serve token-identical greedy output.
+
+    Returns ``{"engine", "monitor", "journal", "close"}`` per the
+    builder contract.  ``close`` pops the ``jax.default_device`` scope
+    that pins this replica's staging to its own device for the life of
+    the process (the fleet-scaling discipline from ISSUE-14)."""
+    import contextlib as _ctx
+
+    from ..serving import (BucketLadder, RequestJournal,
+                           ServingEngine, ServingModelConfig,
+                           default_cache_config,
+                           extract_serving_weights)
+
+    m = dict(spec_dict.get("model") or {})
+    vocab = int(m.get("vocab", 64))
+    hidden = int(m.get("hidden", 32))
+    num_heads = int(m.get("num_heads", 4))
+    num_layers = int(m.get("num_layers", 2))
+    max_seq = int(m.get("max_seq", 64))
+    seed = int(m.get("seed", 0))
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    key = jax.random.PRNGKey(seed)
+    probe = jnp.zeros((1, min(8, max_seq)), jnp.int32)
+    params = jax.jit(model.init)(key, probe)["params"]
+    cfg = ServingModelConfig.from_model(
+        model, decode_attention=m.get("decode_attention", "kernel"))
+    weights = extract_serving_weights(params, num_layers)
+    cache_cfg = default_cache_config(
+        cfg, num_blocks=m.get("num_blocks"),
+        block_size=m.get("block_size"),
+        kv_dtype=m.get("kv_dtype"))
+    devices = jax.devices()
+    di = spec_dict.get("device_index")
+    device = (devices[int(di) % len(devices)]
+              if di is not None else None)
+    rid = str(spec_dict["replica_id"])
+    monitor = make_smoke_monitor(
+        spec_dict.get("jsonl_path"), None, tokens_per_step=None,
+        flops_per_step=None,
+        stall_timeout=float(m.get("stall_timeout", 300.0)),
+        run_attrs={"driver": "standalone_gpt.build_fleet_engine",
+                   "replica": rid, "role": spec_dict.get("role"),
+                   "pid": os.getpid()})
+    journal = (RequestJournal(spec_dict["journal_path"])
+               if spec_dict.get("journal_path") else None)
+    scope = _ctx.ExitStack()
+    if device is not None:
+        scope.enter_context(jax.default_device(device))
+    engine = ServingEngine(
+        weights, cfg, cache_cfg,
+        ladder=BucketLadder.from_flags(), monitor=monitor,
+        prefix_share=m.get("prefix_share"), device=device,
+        replica_id=rid, journal=journal)
+    engine.warmup()
+    return {"engine": engine, "monitor": monitor,
+            "journal": journal, "close": scope.close}
+
+
+def fleet_procs_smoke(num_requests: int = 8, *, replicas: int = 2,
+                      disaggregate: bool = False,
+                      jsonl_dir: Optional[str] = None,
+                      journal_dir: Optional[str] = None,
+                      vocab: int = 64, hidden: int = 32,
+                      num_heads: int = 4, num_layers: int = 2,
+                      max_seq: int = 64, max_new_tokens: int = 4,
+                      seed: int = 0,
+                      decode_attention: str = "kernel",
+                      num_blocks: Optional[int] = None,
+                      block_size: Optional[int] = None,
+                      kv_dtype: Optional[str] = None,
+                      prefix_share: Optional[bool] = None,
+                      fault=None, fault_replica: str = "r0",
+                      max_restarts: int = 3,
+                      autoscale: Optional[str] = None,
+                      qos=None,
+                      metrics_port: Optional[int] = None,
+                      freerun: bool = False,
+                      stall_timeout: float = 300.0,
+                      tick_seed: int = 0,
+                      rpc_timeout_s: Optional[float] = None,
+                      poll_timeout_s: Optional[float] = None,
+                      heartbeat_misses: Optional[int] = None,
+                      return_fleet: bool = False):
+    """Process-isolated fleet smoke (``--serve-fleet --procs``,
+    tools/ci.sh step 17): ``replicas`` supervised subprocesses, each
+    a full :func:`build_fleet_engine` replica on its own device,
+    driven over local sockets by :class:`~apex_tpu.serving.
+    ProcessFleet` — heartbeat liveness, ``fault="kill9@K"`` SIGKILL
+    drills recovered by journal replay (fleet digest token-identical
+    to an uninterrupted run), ``fault="rpc_timeout@K"`` degraded
+    gauge polls, disaggregated prefill KV handoff over the socket,
+    and ``autoscale="MIN:MAX"`` queue-depth-trend scaling with
+    drain-then-reap scale-down.  ``freerun=True`` posts one ``run``
+    RPC per replica instead of the stepped round loop (the scaling
+    bench mode).  Returns the :class:`~apex_tpu.serving.
+    ProcessFleetSummary` (with ``return_fleet=True``, ``(summary,
+    fleet)`` — the fleet is already closed)."""
+    import numpy as np
+
+    from ..serving import (AutoscalePolicy, BucketLadder, EngineSpec,
+                           ProcessFleet, ServingModelConfig,
+                           default_cache_config)
+
+    if jsonl_dir:
+        os.makedirs(jsonl_dir, exist_ok=True)
+    if journal_dir is None:
+        # the kill-9 drill is only recoverable through the on-disk
+        # journal, so a journal is not optional in process mode
+        journal_dir = tempfile.mkdtemp(prefix="apexcp-journal-")
+    os.makedirs(journal_dir, exist_ok=True)
+
+    model_kwargs = {
+        "vocab": vocab, "hidden": hidden, "num_heads": num_heads,
+        "num_layers": num_layers, "max_seq": max_seq, "seed": seed,
+        "decode_attention": decode_attention,
+        "num_blocks": num_blocks, "block_size": block_size,
+        "kv_dtype": kv_dtype, "stall_timeout": stall_timeout,
+        "prefix_share": (True if disaggregate else prefix_share),
+    }
+
+    def make_spec(rid: str, idx: int, role: str = "serve"
+                  ) -> EngineSpec:
+        return EngineSpec(
+            replica_id=rid, role=role, model=model_kwargs,
+            device_index=idx,
+            jsonl_path=(os.path.join(jsonl_dir,
+                                     f"serve-{rid}.jsonl")
+                        if jsonl_dir else None),
+            journal_path=os.path.join(journal_dir,
+                                      f"{rid}.journal.jsonl"))
+
+    specs = [make_spec(f"r{i}", i) for i in range(replicas)]
+    if disaggregate:
+        specs.append(make_spec("pf0", replicas, "prefill"))
+
+    policy = None
+    if autoscale:
+        lo, _, hi = str(autoscale).partition(":")
+        policy = AutoscalePolicy(min_replicas=int(lo),
+                                 max_replicas=int(hi or lo))
+
+    # the same deterministic prompt mix as fleet_smoke — cfg/ladder
+    # construction here is host-side math only (no device arrays in
+    # the parent)
+    model = GPTModel(
+        vocab_size=vocab, hidden_size=hidden, num_layers=num_layers,
+        num_attention_heads=num_heads, max_sequence_length=max_seq,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    cfg = ServingModelConfig.from_model(
+        model, decode_attention=decode_attention)
+    cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
+                                     block_size=block_size,
+                                     kv_dtype=kv_dtype)
+    ladder = BucketLadder.from_flags()
+    rng = np.random.RandomState(seed)
+    span = ladder.max_pages * cache_cfg.block_size
+    max_prompt = max(1, min(max_seq, span) - max_new_tokens)
+    requests = []
+    for i in range(num_requests):
+        n = 1 + (int(rng.randint(1, 10 ** 6)) % max_prompt)
+        requests.append({
+            "rid": f"req{i:03d}",
+            "prompt": [int(t) for t in rng.randint(0, vocab, n)],
+            "max_new_tokens": max_new_tokens})
+
+    fleet = ProcessFleet(
+        specs,
+        jsonl_path=(os.path.join(jsonl_dir, "supervisor.jsonl")
+                    if jsonl_dir else None),
+        qos=qos, autoscale=policy,
+        spec_factory=make_spec,
+        metrics_port=metrics_port, fault=fault,
+        fault_replica=fault_replica, max_restarts=max_restarts,
+        rpc_timeout_s=rpc_timeout_s, poll_timeout_s=poll_timeout_s,
+        heartbeat_misses=heartbeat_misses, tick_seed=tick_seed)
+    with fleet:
+        summary = fleet.serve(requests, freerun=freerun)
+    if return_fleet:
+        return summary, fleet
+    return summary
+
+
 def add_resilience_cli(p) -> None:
     """The shared GPT/BERT smoke-driver resilience flags."""
     p.add_argument("--ckpt-dir", default=None,
@@ -1901,6 +2101,24 @@ def _main(argv=None):
                    help="(--serve-fleet) one thread per replica "
                         "(the aggregate tokens/s scaling mode); "
                         "default is the deterministic stepped loop")
+    p.add_argument("--procs", action="store_true",
+                   help="(--serve-fleet) process-isolated fleet "
+                        "(ISSUE-18): each replica is a supervised "
+                        "SUBPROCESS on its own device, driven over "
+                        "local sockets by the control plane — "
+                        "heartbeat liveness, kill-9 restart with "
+                        "journal replay, socket KV handoff; "
+                        "--fleet-threads selects the freerun drive "
+                        "mode (one run RPC per replica) instead of "
+                        "the stepped round loop; prints a "
+                        "FLEETP_DONE row")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                   help="(--procs) autoscale the serve-replica count "
+                        "between MIN and MAX from the fleet "
+                        "aggregator's queue-depth trend (scale-up on "
+                        "backlog, drain-then-reap scale-down); the "
+                        "autoscale event trace lands in the "
+                        "supervisor JSONL")
     p.add_argument("--jsonl-dir", default=None, metavar="DIR",
                    help="(--serve-fleet) per-replica event logs "
                         "DIR/serve-<rid>.jsonl (replica-stamped; "
@@ -1936,6 +2154,46 @@ def _main(argv=None):
                         "/healthz before teardown")
     add_resilience_cli(p)
     args = p.parse_args(argv)
+    if args.serve_fleet and args.procs:
+        s = fleet_procs_smoke(
+            args.requests,
+            replicas=(args.replicas if args.replicas is not None
+                      else 2),
+            disaggregate=bool(args.disaggregate),
+            jsonl_dir=args.jsonl_dir, journal_dir=args.journal_dir,
+            max_new_tokens=args.new_tokens,
+            max_seq=args.serve_max_seq, hidden=args.fleet_hidden,
+            num_layers=args.fleet_layers, vocab=args.fleet_vocab,
+            decode_attention=("reference" if args.decode_reference
+                              else "kernel"),
+            fault=args.fault, max_restarts=args.max_restarts,
+            autoscale=args.autoscale,
+            metrics_port=args.metrics_port,
+            freerun=args.fleet_threads,
+            stall_timeout=args.stall_timeout)
+        print(f"FLEETP_DONE replicas={s.replicas} "
+              f"prefill_replicas={s.prefill_replicas} "
+              f"offered={s.offered} "
+              f"submitted={s.submitted} "
+              f"shed_admission={s.shed_admission} "
+              f"rejected={s.rejected} "
+              f"done={s.requests_done} "
+              f"lost={s.lost_requests} "
+              f"tokens={s.tokens_generated} "
+              f"tokens_s={s.tokens_per_sec} "
+              f"rounds={s.rounds} "
+              f"restarts={s.restarts} "
+              f"rpc_timeouts={s.rpc_timeouts} "
+              f"handoffs={s.handoffs} "
+              f"handoff_retries={s.handoff_retries} "
+              f"autoscale_ups={s.autoscale_ups} "
+              f"autoscale_downs={s.autoscale_downs} "
+              f"replayed={s.replayed_requests} "
+              f"digest={s.digest} "
+              f"freerun={int(s.freerun)}"
+              + (f" jsonl_dir={args.jsonl_dir}"
+                 if args.jsonl_dir else ""))
+        return
     if args.serve_fleet:
         s = fleet_smoke(
             args.requests, replicas=args.replicas, tp=args.tp,

@@ -110,6 +110,18 @@ def _fleet_scaling_tps(full, replicas):
     return None
 
 
+def _procs_scaling_tps(full, replicas):
+    """Aggregate tokens/s of the ``replicas``-count PROCESS-fleet
+    scaling row (ISSUE-18), or None when absent."""
+    rows = _get(full, "extras", "serving_fleet_procs", "scaling")
+    if not isinstance(rows, list):
+        return None
+    for row in rows:
+        if isinstance(row, dict) and row.get("replicas") == replicas:
+            return row.get("tokens_per_sec")
+    return None
+
+
 def headline_metrics(full):
     """{metric name: (value or None, owning section)} for every named
     headline metric.  Sections are bench.py SECTION_NAMES members so
@@ -188,6 +200,16 @@ def headline_metrics(full):
         "serving_fleet_disagg_ttft_p99_ms": (
             _get(full, "extras", "serving_fleet", "disaggregated",
                  "ttft_p99_ms"), "serving_fleet"),
+        # ISSUE-18 process-isolated fleet: aggregate 8-process
+        # throughput and its weak-scaling efficiency vs the
+        # core-bounded linear ceiling min(8, host cores) x 1r gate
+        # upward (the exit bar is efficiency >= 0.85); both roll
+        # forward on artifacts predating the section
+        "serving_fleet_procs_tokens_per_sec_8r": (
+            _procs_scaling_tps(full, 8), "serving_fleet_procs"),
+        "serving_fleet_procs_scaling_8r": (
+            _get(full, "extras", "serving_fleet_procs",
+                 "scaling_efficiency_8r"), "serving_fleet_procs"),
         # ISSUE-17 live metrics plane: the /metrics scrape tail gates
         # LOWER_IS_BETTER like the other latencies; the exporter
         # overhead row gates separately, against the absolute

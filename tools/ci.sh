@@ -181,28 +181,54 @@
 #                            per bucket, zero post-warmup recompiles,
 #                            tokens/s > 0 (docs/api/serving.md
 #                            #weight-quantization)
+#  16. live metrics plane   — the ISSUE-17 exporter end to end: a
+#                            live probe scrapes /metrics off a
+#                            serving fleet (per-replica labeled
+#                            counters + fleet gauges), a SIGTERM
+#                            drain flips /healthz 200 -> 503 before
+#                            teardown, and a forced TTFT breach emits
+#                            exactly one slo_burn episode traced back
+#                            to its objective definition
+#  17. process-isolated fleet — the ISSUE-18 control plane: a
+#                            2-process supervised fleet run twice,
+#                            uninterrupted and with replica r0
+#                            SIGKILL'd mid-serve (kill9@2); the
+#                            kill -9 leg must restart (restarts>=1),
+#                            journal-replay into the fresh process
+#                            (replayed>=1), lose ZERO requests, and
+#                            reproduce the uninterrupted run's fleet
+#                            digest token for token; trace_check
+#                            --serve over supervisor + child logs
+#                            proves every spawned (replica,
+#                            incarnation) reaped exactly once; then a
+#                            1-replica floor under a 10-request burst
+#                            must autoscale up on the backlog trend
+#                            with the autoscale event trace rendered
+#                            by monitor_summary
+#                            (docs/api/resilience.md
+#                            #distributed-control-plane)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/16 default test tier"
+echo "[ci] 1/17 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/16 README drift guard"
+echo "[ci] 2/17 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/16 8-device multichip dryrun"
+echo "[ci] 3/17 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/16 monitor smoke"
+echo "[ci] 4/17 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/16 kill->resume smoke"
+echo "[ci] 5/17 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -222,16 +248,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/16 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/17 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/16 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/17 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/16 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/17 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -240,7 +266,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/16 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/17 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -261,7 +287,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/16 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/17 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -285,7 +311,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/16 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/17 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -409,7 +435,7 @@ grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
-echo "[ci] 12/16 SPMD sharding audit (--check-sharding) + topology drift"
+echo "[ci] 12/17 SPMD sharding audit (--check-sharding) + topology drift"
 # Compile every plan-carrying multichip entry under its mesh on the
 # same 8-device host-platform trick the multichip tests use; fails on
 # APX701-703 findings, per-device-memory drift vs the committed
@@ -421,7 +447,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-sharding
 python __graft_entry__.py --plans 8
 
-echo "[ci] 13/16 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
+echo "[ci] 13/17 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
 FLEET_DIR="$(mktemp -d -t apex_tpu_fleet.XXXXXX)"
 # leg 1: sanitized 2-replica fleet with ONE rolling weight swap
 # mid-serve — zero lost requests fleet-wide, zero compiles after
@@ -477,7 +503,7 @@ echo "$FLEET_OUT" | grep -q "done=8" \
 python tools/trace_check.py "$FLEET_DIR"/crash/serve-*.jsonl --serve
 rm -rf "$FLEET_DIR"
 
-echo "[ci] 14/16 host-concurrency audit (--check-concurrency) + schedule stress"
+echo "[ci] 14/17 host-concurrency audit (--check-concurrency) + schedule stress"
 # static half: APX801-805 over the whole package against the
 # committed EMPTY baseline (a stale entry fails like the linter's)
 python -m apex_tpu.analysis --check-concurrency
@@ -488,7 +514,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis.schedule --seeds 5 --replicas 2 \
     --requests 6 --new-tokens 4
 
-echo "[ci] 15/16 Q8 quantized serving smoke (int8 weight-only decode)"
+echo "[ci] 15/17 Q8 quantized serving smoke (int8 weight-only decode)"
 # kernel half: the quant matmul's interpret-mode parity sweep — GEMV
 # and tiled paths vs the jnp twin, plus the zero-channel round-trip
 python -c "from apex_tpu.ops import quant_matmul; \
@@ -509,7 +535,7 @@ echo "$Q8_OUT" | grep -q "compiles=2 " \
 echo "$Q8_OUT" | grep -Eq "tokens_s=[1-9]" \
     || { echo "[ci] FAIL: Q8 serve reported zero tokens/s"; exit 1; }
 
-echo "[ci] 16/16 live metrics plane (exporter + /healthz flip + SLO burn)"
+echo "[ci] 16/17 live metrics plane (exporter + /healthz flip + SLO burn)"
 METRICS_DIR="$(mktemp -d -t apex_tpu_metrics.XXXXXX)"
 METRICS_PORT=$((19300 + RANDOM % 500))
 # leg 1: sanitized 2-replica fleet with the exporter attached — the
@@ -569,5 +595,67 @@ python tools/monitor_summary.py "$METRICS_DIR/slo.jsonl" \
     | grep "SLO: 1 burn episode" \
     || { echo "[ci] FAIL: monitor_summary did not render the SLO section"; exit 1; }
 rm -rf "$METRICS_DIR"
+
+echo "[ci] 17/17 process-isolated fleet (kill -9 drill + journal replay + autoscale trace)"
+CP_DIR="$(mktemp -d -t apex_tpu_cp.XXXXXX)"
+# leg 1: the uninterrupted 2-process reference — every replica is a
+# supervised subprocess behind the socket control plane; its digest
+# is the bar the kill-9 leg must reproduce token-identically
+REF_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet --procs \
+    --replicas 2 --requests 4 --new-tokens 3 --fleet-hidden 16 \
+    --fleet-layers 1 --decode-reference \
+    --journal-dir "$CP_DIR/ref-journals")"
+echo "$REF_OUT"
+echo "$REF_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: reference process fleet lost requests"; exit 1; }
+echo "$REF_OUT" | grep -q "done=4 " \
+    || { echo "[ci] FAIL: reference process fleet did not finish all 4 requests"; exit 1; }
+REF_DIGEST="$(echo "$REF_OUT" | grep -Eo 'digest=[0-9a-f]+' | head -1)"
+# leg 2: the kill -9 drill — replica r0's engine process is
+# SIGKILL'd at its 2nd decode step (no handler can run), the
+# supervisor reaps it, respawns with replay, and the fresh process
+# re-enters every non-terminal rid from the on-disk journal; the
+# fleet digest must equal the uninterrupted run's — exactly-once
+# across a hard process death
+KILL_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet --procs \
+    --replicas 2 --requests 4 --new-tokens 3 --fleet-hidden 16 \
+    --fleet-layers 1 --decode-reference --fault kill9@2 \
+    --journal-dir "$CP_DIR/kill-journals" \
+    --jsonl-dir "$CP_DIR/kill-logs")"
+echo "$KILL_OUT"
+echo "$KILL_OUT" | grep -Eq "restarts=[1-9]" \
+    || { echo "[ci] FAIL: kill -9'd replica did not restart"; exit 1; }
+echo "$KILL_OUT" | grep -Eq "replayed=[1-9]" \
+    || { echo "[ci] FAIL: journal replay re-entered no requests after kill -9"; exit 1; }
+echo "$KILL_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: kill -9 leg lost requests"; exit 1; }
+echo "$KILL_OUT" | grep -q "done=4 " \
+    || { echo "[ci] FAIL: kill -9 leg did not finish all 4 requests"; exit 1; }
+echo "$KILL_OUT" | grep -q "$REF_DIGEST" \
+    || { echo "[ci] FAIL: kill -9 digest differs from the uninterrupted run"; exit 1; }
+# the supervisor + per-replica child logs must pass the distributed
+# lifecycle checks: every spawned (replica, incarnation) reaped
+# exactly once, N submitted => N terminal fleet-wide across the crash
+python tools/trace_check.py "$CP_DIR"/kill-logs/*.jsonl --serve
+# leg 3: autoscale — a 1-replica floor under a 10-request burst must
+# scale up on the backlog trend and render the autoscale event trace
+# in monitor_summary (drain-then-reap scale-down is exercised by the
+# fleet teardown path and asserted via the spawn/reap pairing above)
+SCALE_OUT="$(XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python -m apex_tpu.testing.standalone_gpt --serve-fleet --procs \
+    --replicas 1 --autoscale 1:2 --requests 10 --new-tokens 3 \
+    --fleet-hidden 16 --fleet-layers 1 --decode-reference \
+    --jsonl-dir "$CP_DIR/scale-logs")"
+echo "$SCALE_OUT"
+echo "$SCALE_OUT" | grep -Eq "autoscale_ups=[1-9]" \
+    || { echo "[ci] FAIL: autoscale never scaled up under the burst"; exit 1; }
+echo "$SCALE_OUT" | grep -q "lost=0" \
+    || { echo "[ci] FAIL: autoscale leg lost requests"; exit 1; }
+python tools/monitor_summary.py "$CP_DIR"/scale-logs/*.jsonl \
+    | grep -q "autoscale trace" \
+    || { echo "[ci] FAIL: monitor_summary did not render the autoscale trace"; exit 1; }
+rm -rf "$CP_DIR"
 
 echo "[ci] all green"

@@ -181,6 +181,28 @@ def render(full: dict, artifact_name: str, topo: list = None) -> str:
                 "vs colocated (probe + KV handoff counted)",
                 f"{dg['ttft_p99_ms']} vs "
                 f"{dg['ttft_p99_ms_colocated']} ms")
+    flp = ex.get("serving_fleet_procs", {})
+    if isinstance(flp, dict) and flp.get("scaling"):
+        tps = {r.get("replicas"): r.get("tokens_per_sec")
+               for r in flp["scaling"] if isinstance(r, dict)}
+        if tps.get(1) is not None and tps.get(8) is not None:
+            shape = flp.get("shape") or {}
+            denom = shape.get("linear_denominator_replicas", 8)
+            row("serving fleet: process-isolated aggregate tokens/s "
+                "1 -> 8 replica subprocesses (socket control plane)",
+                f"{tps[1]} -> {tps[8]} tok/s "
+                f"({flp.get('scaling_efficiency_8r')}x vs "
+                f"min(8, {shape.get('host_cores', '?')}-core host) "
+                f"= {denom}x linear ceiling)")
+        k9 = flp.get("kill9") or {}
+        if k9.get("restarts") is not None:
+            row("serving fleet: kill -9 drill (journal replay into a "
+                "fresh process)",
+                f"{k9['restarts']} restart(s), "
+                f"{k9.get('lost_requests')} lost, digest "
+                + ("identical" if k9.get(
+                    "digest_matches_uninterrupted")
+                   else "DIVERGED"))
     z = ex.get("zero_sharded_adam", {})
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
