@@ -202,6 +202,24 @@ register_flag(
     "(adam_step/sgd_step) dispatches the Pallas kernels; 0 keeps the "
     "measured-faster XLA fusion path.", lo=0)
 register_flag(
+    "APEX_TPU_MOE_FUSED_DISPATCH", "bool", True,
+    "Route MoE token dispatch through the fused Pallas routing + "
+    "capacity-drop kernel (apex_tpu/ops/moe_routing.py: softmax -> "
+    "top-k -> cumsum slotting -> buffer scatter in one VMEM pass, jnp "
+    "twin off TPU) instead of the legacy one-hot einsum/scatter "
+    "formulation.  Routing decisions are bit-identical either way; "
+    "`0` is the escape hatch back to the unfused path.")
+register_flag(
+    "APEX_TPU_MOE_A2A_CHUNKS", "int", 2,
+    "Capacity-chunk count for the expert-parallel all-to-all overlap "
+    "(transformer/expert_parallel.py): N>=2 splits the dispatch "
+    "buffer along capacity and double-buffers chunk i+1's all_to_all "
+    "against chunk i's expert matmul, hiding dispatch latency behind "
+    "compute (the APX704 overlap advisory goes quiet).  1 restores "
+    "the legacy single-shot exchange.  Clamped to the capacity; "
+    "ExpertParallelMLP.mesh_plan re-prices the collective budget "
+    "accordingly.", lo=1, hi=64)
+register_flag(
     "APEX_TPU_DIRECT_MIN_ELEMS", "int", 0,
     "Element-count threshold below which multi-tensor ops pack leaves "
     "into flat buffers (legacy per-step packed path); 0 keeps every "
@@ -419,6 +437,16 @@ register_flag(
     "gpt_decode_step_tp topology), greedy output token-identical to "
     "the single-chip engine.  0/1 keeps single-chip replicas.  The "
     "--tp CLI flag overrides.", lo=0, hi=64)
+register_flag(
+    "APEX_TPU_SERVE_EP", "int", 0,
+    "Expert-parallel decode width for the serving engine "
+    "(serving/ep.py): E>=2 shards a MoE model's expert weights along "
+    "a MeshPlan `expert` axis (attention and the paged KV cache "
+    "replicated, per-rank token slices routed through the overlapped "
+    "all-to-all exchange — the audited gpt_decode_step_ep topology), "
+    "greedy output token-identical to the dense single-chip engine "
+    "on a 1-expert config.  0/1 keeps single-chip decode.  The --ep "
+    "CLI flag overrides.", lo=0, hi=64)
 register_flag(
     "APEX_TPU_SERVE_DISAGGREGATE", "bool", False,
     "Disaggregated prefill/decode for the serving fleet: prefill-role "

@@ -102,6 +102,12 @@ KERNEL_TWINS: Dict[Tuple[str, str], TwinSpec] = {
         "quant_matmul", "quant_matmul_reference",
         "apex_tpu/ops/quant_matmul.py", "tests/test_quant_matmul.py")
        for fn in ("_quant_gemv", "_quant_tiled")},
+    # fused MoE routing + dispatch (ISSUE-19): softmax/top-k/capacity
+    # slotting/scatter in one pass, specified by the GShard cumsum
+    # reference (bit-identical keep/slot decisions across backends)
+    ("moe_routing.py", "_route_dispatch_pallas"): _spec(
+        "moe_route_dispatch", "moe_route_dispatch_reference",
+        "apex_tpu/ops/moe_routing.py", "tests/test_moe_routing.py"),
 }
 
 

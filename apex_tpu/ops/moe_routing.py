@@ -1,0 +1,389 @@
+"""Fused Pallas MoE routing + capacity-drop dispatch.
+
+The MoE dispatch pipeline — router softmax, top-k expert select, GShard
+choice-major capacity slotting, and the scatter into the
+``(experts, capacity, H)`` dispatch buffer — was four separate XLA
+stages, the last of which (the one-hot einsum in
+:mod:`~apex_tpu.transformer.layers_moe`) materializes a ``(T, E, C)``
+dispatch tensor in HBM whose bytes dwarf the tokens being routed.  This
+module fuses the whole pipeline into one VMEM-resident Pallas pass: the
+routing probabilities, slot arithmetic, and buffer scatter never leave
+the core, and the dispatch tensor is never built.
+
+Semantics contract (bit-identical to
+:func:`~apex_tpu.transformer.expert_parallel._dispatch_indices` — the
+spec the tests pin both backends to):
+
+* top-1 (Switch) or top-2 (GShard Algorithm 1) routing; top-2 gates are
+  renormalized over the pair, ``second_policy="random"`` keeps the
+  second choice with probability ``min(1, 2 * gate2)`` and a dropped
+  second choice claims NO capacity slot;
+* slotting is choice-major cumsum: all first choices outrank all second
+  choices, overflow beyond ``capacity`` is dropped (``keep=False``);
+* the auxiliary load-balancing loss is the Switch/GShard
+  ``E * sum(frac * mean_prob)`` over FIRST choices only.
+
+The jnp twin is :func:`moe_route_dispatch_reference` — the CPU oracle
+the parity audit (APX401/402) pins the kernel to, the XLA fallback
+:func:`moe_route_dispatch` dispatches to off TPU, and the function the
+custom VJP differentiates (routing decisions are bit-identical across
+backends, so the reference's gradient IS the kernel's gradient).
+
+Integer outputs (``slot``/``keep``/``expert_index``) are exact across
+backends; float outputs (``gate``/``buf``/aux) may differ in the last
+bit only through summation-order effects of the kernel's lane padding.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import _interpret
+
+__all__ = ["RouteDispatch", "moe_route_dispatch",
+           "moe_route_dispatch_reference", "moe_combine", "self_check"]
+
+# TPU grains: dims that land on lanes pad to 128; the capacity dim is a
+# sublane-only dim and pads to 8.
+_LANE = 128
+_SUB = 8
+
+# Finite column mask for expert padding: softmax of a row whose masked
+# entries sit at -1e30 underflows them to exactly 0.0; an all-masked
+# (padded-token) row softmaxes to uniform — finite, never 0/0 NaN.
+_NEG_INF = -1e30
+
+
+class RouteDispatch(NamedTuple):
+    """Everything the combine (and the router loss) needs downstream."""
+
+    buf: jnp.ndarray            # (E, capacity, H) dispatched tokens
+    expert_index: jnp.ndarray   # (k, T) int32 chosen expert per choice
+    gate: jnp.ndarray           # (k, T) f32 gates (top-2: renormalized)
+    slot: jnp.ndarray           # (k*T,) int32 capacity slot, clipped
+    keep: jnp.ndarray           # (k*T,) bool False = overflow/no-dispatch
+    load_balancing_loss: jnp.ndarray  # scalar f32 aux loss
+
+
+def _pad_to(v: int, grain: int) -> int:
+    return -(-v // grain) * grain
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _route_kernel(x_ref, logits_ref, u_ref, idx_ref, gate_ref,
+                  slot_ref, keep_ref, mp_ref, buf_ref, *, top_k: int,
+                  second_policy: str, capacity: int, t_true: int):
+    """Single-program pass: softmax -> top-k select -> choice-major
+    cumsum slotting -> row scatter into the dispatch buffer.  Padded
+    token rows (>= ``t_true``) are carried as invalid — they claim no
+    slot, and integer cumsum over their all-zero one-hot rows leaves
+    every real token's position untouched (the bit-identity argument)."""
+    tp = logits_ref.shape[0]
+    probs = jax.nn.softmax(logits_ref[...].astype(jnp.float32), axis=-1)
+    tok_valid = (jax.lax.broadcasted_iota(jnp.int32, (tp, 1), 0)
+                 < t_true)                                   # (Tp, 1)
+    mp_ref[...] = (jnp.sum(jnp.where(tok_valid, probs, 0.0),
+                           axis=0, keepdims=True) / t_true)
+    ep = probs.shape[1]
+    idx1 = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    # max IS the argmax'd element — same bits as take_along_axis
+    gate1 = jnp.max(probs, axis=-1)
+    if top_k == 2:
+        masked = probs * (1.0 - jax.nn.one_hot(idx1, ep,
+                                               dtype=probs.dtype))
+        idx2 = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+        gate2 = jnp.max(masked, axis=-1)
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        g1n, g2n = gate1 / denom, gate2 / denom
+        if second_policy == "random":
+            keep2 = u_ref[0, :] < 2.0 * g2n
+            g2n = jnp.where(keep2, g2n, 0.0)
+        idx = jnp.stack([idx1, idx2])
+        gates = jnp.stack([g1n, g2n])
+    else:
+        idx = idx1[None]
+        gates = gate1[None]
+    idx_ref[...] = idx
+    gate_ref[...] = gates
+
+    k = idx.shape[0]
+    # gate == 0 marks a choice the router decided not to dispatch
+    valid = (gates > 0.0) & tok_valid[:, 0][None, :]
+    one_hot = (jax.nn.one_hot(idx.reshape(-1), ep, dtype=jnp.int32)
+               * valid.reshape(-1).astype(jnp.int32)[:, None])
+    position = jnp.cumsum(one_hot, axis=0) * one_hot         # 1-based
+    slot = jnp.sum(position, axis=1) - 1                     # (k*Tp,)
+    keep = (slot >= 0) & (slot < capacity)
+    slot = jnp.clip(slot, 0, capacity - 1)
+    slot_ref[...] = slot.reshape(k, tp)
+    keep_ref[...] = keep.reshape(k, tp).astype(jnp.int32)
+
+    buf_ref[...] = jnp.zeros_like(buf_ref)
+
+    def body(i, carry):
+        c = i // tp
+        t = i - c * tp
+
+        # each kept (expert, slot) pair is unique, so a row store is
+        # the scatter-add with the zero-initialized buffer
+        @pl.when(keep_ref[c, t] > 0)
+        def _store():
+            buf_ref[idx_ref[c, t], slot_ref[c, t], :] = x_ref[t, :]
+
+        return carry
+
+    jax.lax.fori_loop(0, k * tp, body, 0)
+
+
+def _route_dispatch_pallas(x: jnp.ndarray, logits: jnp.ndarray,
+                           u: jnp.ndarray, *, capacity: int,
+                           top_k: int, second_policy: str
+                           ) -> RouteDispatch:
+    """Pad to TPU grains, run the fused kernel, slice back.  ``keep``
+    is evaluated against the TRUE capacity before padding, so padded
+    capacity rows stay zero and drop decisions match the reference."""
+    t, h = x.shape
+    e = logits.shape[1]
+    tp = _pad_to(t, _LANE)       # lane dim of the (k, Tp) outputs
+    ep = _pad_to(e, _LANE)
+    hp = _pad_to(h, _LANE)
+    cp = _pad_to(capacity, _SUB)
+    x_p = jnp.pad(x, ((0, tp - t), (0, hp - h)))
+    logits_p = jnp.pad(logits.astype(jnp.float32),
+                       ((0, tp - t), (0, ep - e)),
+                       constant_values=_NEG_INF)
+    u_p = jnp.pad(u.astype(jnp.float32).reshape(1, t),
+                  ((0, 0), (0, tp - t)))
+    out_shapes = (
+        jax.ShapeDtypeStruct((top_k, tp), jnp.int32),    # expert_index
+        jax.ShapeDtypeStruct((top_k, tp), jnp.float32),  # gate
+        jax.ShapeDtypeStruct((top_k, tp), jnp.int32),    # slot
+        jax.ShapeDtypeStruct((top_k, tp), jnp.int32),    # keep
+        jax.ShapeDtypeStruct((1, ep), jnp.float32),      # mean_prob
+        jax.ShapeDtypeStruct((e, cp, hp), x.dtype),      # buf
+    )
+    idx, gates, slot, keep, mp, buf = pl.pallas_call(
+        functools.partial(_route_kernel, top_k=top_k,
+                          second_policy=second_policy,
+                          capacity=capacity, t_true=t),
+        out_shape=out_shapes,
+        interpret=_interpret())(x_p, logits_p, u_p)
+    idx = idx[:, :t]
+    gates = gates[:, :t]
+    frac = jnp.mean(jax.nn.one_hot(idx[0], e, dtype=jnp.float32),
+                    axis=0)
+    aux = e * jnp.sum(frac * mp[0, :e])
+    return RouteDispatch(
+        buf=buf[:, :capacity, :h], expert_index=idx, gate=gates,
+        slot=slot[:, :t].reshape(-1), keep=keep[:, :t].reshape(-1) > 0,
+        load_balancing_loss=aux)
+
+
+# ---------------------------------------------------------------------------
+# jnp twin
+# ---------------------------------------------------------------------------
+
+def moe_route_dispatch_reference(x: jnp.ndarray, logits: jnp.ndarray,
+                                 u: Optional[jnp.ndarray] = None, *,
+                                 capacity: int, top_k: int = 1,
+                                 second_policy: str = "all"
+                                 ) -> RouteDispatch:
+    """The jnp twin: the same router math as
+    :func:`~apex_tpu.transformer.expert_parallel.top1_router` /
+    ``top2_router`` followed by the ``_dispatch_indices`` cumsum and a
+    scatter-add — the spec both the parity audit and the custom VJP
+    differentiate.  ``u``: the (T,) uniform draw for
+    ``second_policy="random"`` (drawn by the public wrapper so kernel
+    and twin consume identical randomness)."""
+    t, h = x.shape
+    e = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    gate1 = jnp.take_along_axis(probs, idx1[:, None], axis=1)[:, 0]
+    if top_k == 2:
+        masked = probs * (1.0 - jax.nn.one_hot(idx1, e,
+                                               dtype=probs.dtype))
+        idx2 = jnp.argmax(masked, axis=-1)
+        gate2 = jnp.take_along_axis(masked, idx2[:, None],
+                                    axis=1)[:, 0]
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        g1n, g2n = gate1 / denom, gate2 / denom
+        if second_policy == "random":
+            if u is None:
+                raise ValueError(
+                    "second_policy='random' requires the uniform "
+                    "draw u")
+            # the Bernoulli draw is a dispatch decision, not a gate
+            # transformation (GShard): no gradient through the
+            # threshold
+            keep2 = u < jax.lax.stop_gradient(2.0 * g2n)
+            g2n = jnp.where(keep2, g2n, 0.0)
+        idx = jnp.stack([idx1, idx2]).astype(jnp.int32)
+        gates = jnp.stack([g1n, g2n])
+    else:
+        idx = idx1[None].astype(jnp.int32)
+        gates = gate1[None]
+    # aux loss over the FIRST choice (GShard load estimator)
+    frac = jnp.mean(jax.nn.one_hot(idx1, e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+    valid = gates.reshape(-1) > 0.0
+    one_hot = (jax.nn.one_hot(idx.reshape(-1), e, dtype=jnp.int32)
+               * valid.astype(jnp.int32)[:, None])
+    position = jnp.cumsum(one_hot, axis=0) * one_hot         # 1-based
+    slot = jnp.sum(position, axis=1) - 1
+    keep = (slot >= 0) & (slot < capacity)
+    slot = jnp.clip(slot, 0, capacity - 1)
+
+    k = idx.shape[0]
+    xk = jnp.broadcast_to(x[None], (k, t, h)).reshape(k * t, h)
+    buf = jnp.zeros((e, capacity, h), x.dtype)
+    buf = buf.at[idx.reshape(-1), slot].add(
+        jnp.where(keep[:, None], xk, 0))
+    return RouteDispatch(buf=buf, expert_index=idx, gate=gates,
+                         slot=slot, keep=keep,
+                         load_balancing_loss=aux)
+
+
+# ---------------------------------------------------------------------------
+# public entry point
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _fused(capacity: int, top_k: int, second_policy: str,
+           backend: str):
+    """custom_vjp closure per static config: the forward runs the
+    requested backend; the backward differentiates the jnp twin at the
+    saved residuals — exact for both backends because the routing
+    decisions (idx/slot/keep) are bit-identical, and the float paths
+    they select are the same math."""
+    if backend == "pallas":
+        run = functools.partial(_route_dispatch_pallas,
+                                capacity=capacity, top_k=top_k,
+                                second_policy=second_policy)
+    else:
+        run = functools.partial(moe_route_dispatch_reference,
+                                capacity=capacity, top_k=top_k,
+                                second_policy=second_policy)
+    ref = functools.partial(moe_route_dispatch_reference,
+                            capacity=capacity, top_k=top_k,
+                            second_policy=second_policy)
+
+    @jax.custom_vjp
+    def routed(x, logits, u):
+        return run(x, logits, u)
+
+    def fwd(x, logits, u):
+        return run(x, logits, u), (x, logits, u)
+
+    def bwd(res, ct):
+        x, logits, u = res
+        _, pull = jax.vjp(lambda xx, ll: ref(xx, ll, u), x, logits)
+        dx, dl = pull(ct)
+        return dx, dl, jnp.zeros_like(u)
+
+    routed.defvjp(fwd, bwd)
+    return routed
+
+
+def moe_route_dispatch(x: jnp.ndarray, logits: jnp.ndarray, *,
+                       capacity: int, top_k: int = 1,
+                       second_policy: str = "all",
+                       rng: Optional[jax.Array] = None,
+                       backend: Optional[str] = None) -> RouteDispatch:
+    """Fused route + dispatch: ``x`` (T, H) tokens, ``logits`` (T, E)
+    router scores -> :class:`RouteDispatch`.
+
+    ``backend``: ``None`` picks the Pallas kernel on TPU and the jnp
+    twin elsewhere (the XLA-fallback discipline the parity registry
+    sanctions); ``"pallas"`` / ``"xla"`` force a side for parity
+    tests.  ``rng`` is required only for ``top_k=2`` with
+    ``second_policy="random"`` — the (T,) uniform draw happens here so
+    both backends consume identical randomness.  Differentiable in
+    ``x`` and ``logits`` (custom VJP through the twin)."""
+    x = jnp.asarray(x)
+    logits = jnp.asarray(logits)
+    if x.ndim != 2 or logits.ndim != 2 \
+            or logits.shape[0] != x.shape[0]:
+        raise ValueError(f"x (T, H) / logits (T, E) mismatch: "
+                         f"{x.shape} vs {logits.shape}")
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1|2, got {top_k}")
+    if second_policy not in ("all", "random"):
+        raise ValueError(f"second_policy must be 'all'|'random', got "
+                         f"{second_policy!r}")
+    if backend not in (None, "pallas", "xla"):
+        raise ValueError(f"backend {backend!r} not in "
+                         f"(None, 'pallas', 'xla')")
+    if backend is None:
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    t = x.shape[0]
+    if top_k == 2 and second_policy == "random":
+        if rng is None:
+            raise ValueError("second_policy='random' requires rng")
+        u = jax.random.uniform(rng, (t,))
+    else:
+        u = jnp.zeros((t,), jnp.float32)
+    return _fused(int(capacity), int(top_k), second_policy,
+                  backend)(x, logits, u)
+
+
+def moe_combine(out: jnp.ndarray, expert_index: jnp.ndarray,
+                slot: jnp.ndarray, keep: jnp.ndarray,
+                gate: jnp.ndarray, out_dtype=None) -> jnp.ndarray:
+    """Gather each choice's slot output from the expert result buffer
+    ``out`` (E, capacity, H), weight by its gate (dropped choices
+    contribute 0), sum over choices -> (T, H).  Plain jnp: the combine
+    is a gather XLA already does well, and keeping it out of the
+    kernel keeps the kernel inference/training agnostic."""
+    k, t = expert_index.shape
+    tok = out[expert_index.reshape(-1), slot]            # (k*T, H)
+    g = jnp.where(keep, gate.reshape(-1), 0.0).astype(jnp.float32)
+    y = (tok.astype(jnp.float32) * g[:, None]).reshape(k, t, -1).sum(0)
+    return y.astype(out_dtype if out_dtype is not None else out.dtype)
+
+
+def self_check() -> None:
+    """Interpret-mode kernel-vs-twin parity on CI-sized shapes (the
+    :mod:`.quant_matmul` ``self_check`` pattern): integer routing
+    decisions must match EXACTLY, float outputs to fp32 tolerance.
+    Raises on divergence."""
+    import numpy as np
+
+    key = jax.random.PRNGKey(0)
+    for t, h, e, cap, k, pol in (
+            (16, 8, 4, 5, 1, "all"),
+            (16, 8, 4, 3, 2, "all"),
+            (24, 16, 6, 1, 2, "random"),
+            (3, 8, 8, 2, 1, "all")):
+        kx, kl, kr = jax.random.split(jax.random.fold_in(key, t), 3)
+        x = jax.random.normal(kx, (t, h), jnp.float32)
+        logits = jax.random.normal(kl, (t, e), jnp.float32)
+        a = moe_route_dispatch(x, logits, capacity=cap, top_k=k,
+                               second_policy=pol, rng=kr,
+                               backend="pallas")
+        b = moe_route_dispatch(x, logits, capacity=cap, top_k=k,
+                               second_policy=pol, rng=kr,
+                               backend="xla")
+        for name in ("expert_index", "slot", "keep"):
+            ga, gb = getattr(a, name), getattr(b, name)
+            if not bool(jnp.all(ga == gb)):
+                raise AssertionError(
+                    f"{name} diverged (T={t} E={e} cap={cap} "
+                    f"top_k={k} {pol})")
+        np.testing.assert_allclose(np.asarray(a.gate),
+                                   np.asarray(b.gate), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(a.buf),
+                                   np.asarray(b.buf), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(a.load_balancing_loss),
+            np.asarray(b.load_balancing_loss), rtol=1e-5)

@@ -60,7 +60,9 @@ from .kv_cache import (DUMP_BLOCK, CachePoolExhausted, KVCacheConfig,
 from .metrics import (EngineGauges, ReplicaMonitor, RequestTrace,
                       ServeMetrics, SLObjective, SLOTracker,
                       SnapshotTrigger)
-from .model import (GPTServingWeights, LayerWeights,
+from .ep import (SERVING_EP_AXIS, EPContext, expand_moe_weights,
+                 serving_ep_plan)
+from .model import (GPTServingWeights, LayerWeights, MoELayerWeights,
                     QuantGPTServingWeights, QuantLayerWeights,
                     ServingModelConfig, copy_cache_block,
                     extract_serving_weights, gather_cache_blocks,
@@ -84,8 +86,9 @@ __all__ = [
     "KVCacheManager", "PagedKVCache", "PrefixMatch", "init_cache",
     "prefix_chain_keys", "quantize_kv_rows", "write_prefill_kv",
     "write_token_kv",
-    "GPTServingWeights", "LayerWeights", "QuantGPTServingWeights",
-    "QuantLayerWeights", "ServingModelConfig",
+    "GPTServingWeights", "LayerWeights", "MoELayerWeights",
+    "QuantGPTServingWeights", "QuantLayerWeights",
+    "ServingModelConfig",
     "copy_cache_block", "extract_serving_weights",
     "gather_cache_blocks", "gpt_decode_step", "gpt_extend_step",
     "gpt_prefill_step", "gpt_sequence_logits", "quantize_weights",
@@ -95,4 +98,6 @@ __all__ = [
     "RequestJournal", "ServeRunResult", "ShedPolicy",
     "SpeculationGovernor", "recover_engine", "run_serving",
     "SERVING_TP_AXIS", "TPContext", "serving_tp_plan",
+    "SERVING_EP_AXIS", "EPContext", "expand_moe_weights",
+    "serving_ep_plan",
 ]

@@ -311,7 +311,8 @@ class ServingEngine:
                  journal: Optional[RequestJournal] = None,
                  escalation=None, fault=None,
                  spec_governor="auto",
-                 tp=None, replica_id: Optional[str] = None,
+                 tp=None, ep=None,
+                 replica_id: Optional[str] = None,
                  device=None,
                  slo="auto", exporter=None,
                  clock: Callable[[], float] = time.perf_counter):
@@ -327,11 +328,16 @@ class ServingEngine:
         # CONCURRENTLY — without it every replica's arrays land on
         # device 0 and the fleet serializes behind one stream (mutually
         # exclusive with ``tp``, whose mesh already places the shards).
+        # ``ep`` is a serving.ep.EPContext (ISSUE-19): same swap, but
+        # the expert stacks shard and attention/cache replicate — the
+        # MoE decode fast path.  tp/ep/device are mutually exclusive;
+        # a context owns its device slice.
         self.tp = tp
+        self.ep = ep
         self.device = device
-        if tp is not None and device is not None:
-            raise ValueError("pass either tp (a TPContext owns its "
-                             "device slice) or device, not both")
+        if sum(x is not None for x in (tp, ep, device)) > 1:
+            raise ValueError("pass at most one of tp, ep, device — a "
+                             "context owns its device slice")
         self.replica_id = (str(replica_id) if replica_id is not None
                            else None)
         if self.replica_id is not None and monitor is not None:
@@ -357,6 +363,23 @@ class ServingEngine:
                     weight_quantized=is_quantized_weights(weights))
             model_cfg = tp.model_cfg       # tp_axis armed
             weights = tp.shard_weights(weights)
+        elif ep is not None:
+            if speculate_k or draft_weights is not None:
+                raise ValueError(
+                    "expert-parallel serving does not compose with "
+                    "speculative decoding yet — run the draft on its "
+                    "own replica or drop one of the two")
+            if ep.cache_cfg != cache_cfg:
+                raise ValueError(
+                    "EPContext was built for a different cache "
+                    "config than the engine's")
+            if is_quantized_weights(weights):
+                raise ValueError(
+                    "expert-parallel serving does not take int8 "
+                    "weights yet — the Q8 kernel has no expert-stack "
+                    "layout; serve bf16 or use tp")
+            model_cfg = ep.model_cfg       # ep_axis armed
+            weights = ep.shard_weights(weights)
         elif device is not None:
             weights = jax.device_put(weights, device)
         self.weights = weights
@@ -507,22 +530,29 @@ class ServingEngine:
 
     # --- compiled-program cache ---------------------------------------
 
+    def _ctx(self):
+        """The engine's parallel serving context, if any — a
+        TPContext or EPContext (mutually exclusive); both expose the
+        same init_cache/shard_weights/jit_* surface."""
+        return self.tp if self.tp is not None else self.ep
+
     def _fresh_cache(self):
         """A zeroed device cache — TP-sharded under a TPContext (the
-        head axis committed to the plan), pinned to the replica's
+        head axis committed to the plan), replicated across the
+        expert axis under an EPContext, pinned to the replica's
         device when one was given, default placement otherwise.  Used
         at construction and by :meth:`swap_weights` (new weights mean
         every cached k/v row is stale)."""
-        if self.tp is not None:
-            return self.tp.init_cache()
+        if self._ctx() is not None:
+            return self._ctx().init_cache()
         cache = init_cache(self.cache_cfg)
         if self.device is not None:
             cache = jax.device_put(cache, self.device)
         return cache
 
     def _jit_decode(self, draft: bool = False):
-        if self.tp is not None and not draft:
-            return self.tp.jit_decode(self.weights)
+        if self._ctx() is not None and not draft:
+            return self._ctx().jit_decode(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -536,8 +566,8 @@ class ServingEngine:
         return step
 
     def _jit_prefill(self, draft: bool = False):
-        if self.tp is not None and not draft:
-            return self.tp.jit_prefill(self.weights)
+        if self._ctx() is not None and not draft:
+            return self._ctx().jit_prefill(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -549,8 +579,8 @@ class ServingEngine:
         return step
 
     def _jit_extend(self, draft: bool = False):
-        if self.tp is not None and not draft:
-            return self.tp.jit_extend(self.weights)
+        if self._ctx() is not None and not draft:
+            return self._ctx().jit_extend(self.weights)
         cfg = self.draft_cfg if draft else self.model_cfg
         ccfg = self.draft_cache_cfg if draft else self.cache_cfg
 
@@ -1783,6 +1813,12 @@ class ServingEngine:
                 self.tp = self.tp.rebind(
                     weight_quantized=is_quantized_weights(weights))
             weights = self.tp.shard_weights(weights)
+        elif self.ep is not None:
+            if requantized:
+                raise ValueError(
+                    "expert-parallel serving does not take int8 "
+                    "weights — requantization swap refused")
+            weights = self.ep.shard_weights(weights)
         elif self.device is not None:
             weights = jax.device_put(weights, self.device)
         self.weights = weights

@@ -40,7 +40,8 @@ from ..ops.quant_matmul import (QuantGPTServingWeights,
 from .kv_cache import (KVCacheConfig, PagedKVCache, write_prefill_kv,
                        write_token_kv)
 
-__all__ = ["GPTServingWeights", "LayerWeights", "ServingModelConfig",
+__all__ = ["GPTServingWeights", "LayerWeights", "MoELayerWeights",
+           "ServingModelConfig",
            "QuantGPTServingWeights", "QuantLayerWeights",
            "quantize_weights", "extract_serving_weights",
            "gpt_prefill_step", "gpt_decode_step", "gpt_extend_step",
@@ -63,6 +64,29 @@ class LayerWeights(NamedTuple):
     fc1_b: jnp.ndarray
     fc2_k: jnp.ndarray        # (F, H)
     fc2_b: jnp.ndarray
+
+
+class MoELayerWeights(NamedTuple):
+    """A transformer layer whose MLP is a Switch-style MoE (ISSUE-19).
+
+    Attention/LN leaves match :class:`LayerWeights`; the dense fc1/fc2
+    pair is replaced by a top-1 router and per-expert bias-free FFN
+    stacks (the training-side :class:`~apex_tpu.transformer.
+    layers_moe.MoEMLP` convention).  The step functions duck-type on
+    ``router`` (like Q8 duck-types on the ``*_s`` scale rows), so
+    dense and MoE layers mix freely in one model."""
+
+    ln1_w: jnp.ndarray
+    ln1_b: jnp.ndarray
+    qkv_k: jnp.ndarray        # (H, 3H)
+    qkv_b: jnp.ndarray
+    dense_k: jnp.ndarray      # (H, H)
+    dense_b: jnp.ndarray
+    ln2_w: jnp.ndarray
+    ln2_b: jnp.ndarray
+    router: jnp.ndarray       # (H, E) fp32 — routing is precision-
+    wi: jnp.ndarray           # (E, H, F)      # sensitive, stays fp32
+    wo: jnp.ndarray           # (E, F, H)
 
 
 class GPTServingWeights(NamedTuple):
@@ -102,6 +126,21 @@ class ServingModelConfig:
     # None (single chip) elides the collectives entirely, so the same
     # programs serve both topologies.
     tp_axis: Optional[str] = None
+    # expert-parallel axis name (serving/ep.py): when set, MoE layers
+    # (``MoELayerWeights``) run with the global experts sharded over
+    # that axis — each rank routes its slice of the replicated token
+    # rows, dispatch/return ride the capacity-chunked overlapped
+    # all_to_all exchange, and the combined slice replicates through
+    # one masked psum per MoE layer.  None runs all experts locally.
+    ep_axis: Optional[str] = None
+    # MoE geometry/knobs (ignored for all-dense weights): expert count
+    # is recorded for context validation/describe (the math reads it
+    # off the router leaf), capacity factor sizes the per-rank
+    # dispatch buffer, a2a_chunks is the overlap depth (ISSUE-19;
+    # 1 = legacy single-shot exchange)
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_a2a_chunks: int = 2
 
     def __post_init__(self):
         if self.hidden_size % self.num_heads:
@@ -112,6 +151,12 @@ class ServingModelConfig:
             raise ValueError(
                 f"decode_attention {self.decode_attention!r} not in "
                 f"('kernel', 'reference')")
+        if self.moe_a2a_chunks < 1:
+            raise ValueError(
+                f"moe_a2a_chunks {self.moe_a2a_chunks} must be >= 1")
+        if self.num_experts < 0:
+            raise ValueError(
+                f"num_experts {self.num_experts} must be >= 0")
 
     @property
     def head_dim(self) -> int:
@@ -203,13 +248,84 @@ def _row_linear(x, kernel, bias, dtype, tp_axis, scale=None):
     return y + bias.astype(dtype)
 
 
+def _moe_mlp(m_in, lw: MoELayerWeights, cfg):
+    """Switch-style MoE FFN for serving: top-1 router (greedy serving
+    is deterministic — no stochastic second-choice policy), the fused
+    routing front (:func:`~apex_tpu.ops.moe_routing.
+    moe_route_dispatch`), bias-free expert stacks.
+
+    Single chip (``cfg.ep_axis`` None): every expert is local — route,
+    batch the expert einsums over the ``(E, capacity, H)`` buffer,
+    gate-combine.  Under ``cfg.ep_axis`` (serving/ep.py) the experts
+    are weight-sharded over the axis while tokens/attention/cache stay
+    replicated: each rank routes its ``T/n`` slice of the token rows,
+    dispatch/return ride the capacity-chunked overlapped all_to_all
+    exchange (``cfg.moe_a2a_chunks`` — the ISSUE-19 schedule APX704
+    stays quiet on), and the combined slice replicates through ONE
+    masked psum per MoE layer, so downstream math (residual, next
+    layer, argmax) is shard-invariant exactly like the TP forward's
+    post-psum activations.  Buckets whose row count doesn't divide the
+    axis fall back to every rank routing the full batch (redundant
+    expert FLOPs, weights still sharded — correctness never depends
+    on bucket/axis alignment)."""
+    from ..transformer.expert_parallel import moe_dispatch_combine_fused
+
+    hdim = m_in.shape[-1]
+    x2d = m_in.reshape(-1, hdim)
+    t = x2d.shape[0]
+    e = lw.router.shape[-1]
+    dt = cfg.dtype
+    logits = x2d.astype(jnp.float32) @ lw.router.astype(jnp.float32)
+
+    def expert_fn(d):
+        # d: (local_experts, rows, H) — the dispatched buffer (or its
+        # arrived exchange chunk); wi/wo are the local expert stacks
+        h1 = jax.nn.gelu(jnp.einsum(
+            "ech,ehf->ecf", d.astype(dt), lw.wi.astype(dt),
+            preferred_element_type=jnp.float32))
+        return jnp.einsum(
+            "ecf,efh->ech", h1.astype(dt), lw.wo.astype(dt),
+            preferred_element_type=jnp.float32).astype(dt)
+
+    axis = cfg.ep_axis
+    if axis is None or t % _axis_size(axis) != 0:
+        y, _ = moe_dispatch_combine_fused(
+            x2d.astype(dt), logits, expert_fn, e,
+            capacity_factor=cfg.moe_capacity_factor, axis_name=axis,
+            a2a_chunks=cfg.moe_a2a_chunks)
+        return y.reshape(m_in.shape)
+    n = _axis_size(axis)
+    tl = t // n
+    r = jax.lax.axis_index(axis)
+    xs = jax.lax.dynamic_slice_in_dim(x2d, r * tl, tl, axis=0)
+    ls = jax.lax.dynamic_slice_in_dim(logits, r * tl, tl, axis=0)
+    y_local, _ = moe_dispatch_combine_fused(
+        xs.astype(dt), ls, expert_fn, e,
+        capacity_factor=cfg.moe_capacity_factor, axis_name=axis,
+        a2a_chunks=cfg.moe_a2a_chunks)
+    pad = jnp.zeros((t, hdim), y_local.dtype)
+    y = jax.lax.psum(
+        jax.lax.dynamic_update_slice_in_dim(pad, y_local, r * tl,
+                                            axis=0), axis)
+    return y.reshape(m_in.shape)
+
+
+def _axis_size(axis) -> int:
+    from .._compat import axis_size
+
+    return axis_size(axis) if axis is not None else 1
+
+
 def _layer_tail(x, lw: LayerWeights, attn_out, cfg):
     """residual + LN + MLP + residual — shared by prefill and decode.
     fc1 is column-split under TP (local gelu), fc2 row-split (the
-    layer's second all-reduce)."""
+    layer's second all-reduce); an ``MoELayerWeights`` layer routes
+    through the MoE FFN instead (duck-typed on ``router``)."""
     x = x + attn_out.astype(x.dtype)
     m_in = layer_norm(x, lw.ln2_w, lw.ln2_b,
                       cfg.layernorm_eps).astype(cfg.dtype)
+    if getattr(lw, "router", None) is not None:
+        return x + _moe_mlp(m_in, lw, cfg).astype(x.dtype)
     h1 = jax.nn.gelu(_linear(m_in, lw.fc1_k, lw.fc1_b, cfg.dtype,
                              getattr(lw, "fc1_s", None)))
     mlp_out = _row_linear(h1, lw.fc2_k, lw.fc2_b, cfg.dtype,

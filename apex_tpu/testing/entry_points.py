@@ -287,6 +287,59 @@ def _serving_tp_plan():
     return serving_tp_plan(2, num_layers=2, quantized=False)
 
 
+def _build_gpt_decode_step_ep():
+    """The ISSUE-19 expert-parallel serving decode step: the smoke
+    GPT's MLPs expanded to a 4-expert Switch MoE
+    (:func:`~apex_tpu.serving.ep.expand_moe_weights`) and the
+    continuous-batching decode program shard-mapped over a 2-way
+    MeshPlan ``expert`` axis — expert stacks split, attention and the
+    paged cache replicated.  Per MoE layer the trace carries the
+    fused routing front (:func:`~apex_tpu.ops.moe_routing.
+    moe_route_dispatch`), the capacity-chunked OVERLAPPED all_to_all
+    exchange (``moe_a2a_chunks=2`` — the schedule APX704 certifies
+    quiet on the training entry), and one masked psum replicating the
+    combined token slice.  ``moe_capacity_factor=8.0`` keeps the
+    per-rank capacity ≥ chunks at the 2-token decode bucket so the
+    chunked exchange actually engages.  The plan is the runtime's own
+    :func:`~apex_tpu.serving.ep.serving_ep_plan`, so APX701/703/705
+    guard the MoE serving topology like training; APX601 proves the
+    replicated cache still donates end to end, APX604 that the
+    engine's one fetch per tick stays the only host transfer."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving import (BucketLadder, EPContext, ServingEngine,
+                           ServingModelConfig, default_cache_config,
+                           expand_moe_weights, extract_serving_weights)
+    from .standalone_gpt import make_smoke_setup
+
+    setup = make_smoke_setup(opt_level="O5", dtype=jnp.bfloat16)
+    cfg = ServingModelConfig.from_model(setup.model)
+    cfg = dataclasses.replace(cfg, num_experts=4,
+                              moe_capacity_factor=8.0, moe_a2a_chunks=2)
+    weights = expand_moe_weights(
+        extract_serving_weights(setup.params, cfg.num_layers), 4,
+        jax.random.PRNGKey(0))
+    cache_cfg = default_cache_config(cfg, num_blocks=8, block_size=4)
+    ep = EPContext(cfg, cache_cfg, 2)
+    engine = ServingEngine(weights, cfg, cache_cfg,
+                           ladder=BucketLadder(batch=(2,), pages=(2,)),
+                           ep=ep)
+    return engine._jit_decode(), engine._decode_args(2, 2)
+
+
+def _serving_ep_plan():
+    """gpt_decode_step_ep's contract = the serving stack's own
+    :func:`~apex_tpu.serving.ep.serving_ep_plan` (ep=2 over the
+    2-layer 4-expert smoke MoE GPT): wi/wo expert-sharded, everything
+    else replicated, 2·chunks all_to_all + 1 psum per layer."""
+    from ..serving.ep import serving_ep_plan
+
+    return serving_ep_plan(2, num_layers=2, a2a_chunks=2)
+
+
 def _build_fused_pipeline_step():
     """The PR-4 persistent packed optimizer pipeline as its own entry:
     one full amp post-backward step (pack -> norm/finite sweep ->
@@ -605,8 +658,9 @@ def _build_moe_ep8_train_step():
     """Top-2 (GShard) expert-parallel MoE train step over an 8-way
     ``expert`` mesh: the layer's OWN :meth:`ExpertParallelMLP.
     mesh_plan` supplies the axes, the wi/wo-sharded + router-replicated
-    specs, and the all_to_all budget (2 dispatch hops forward, their
-    transposes backward) the census is held to."""
+    specs, and the all_to_all budget (2 hops per capacity chunk of the
+    overlapped exchange forward, their transposes backward) the census
+    is held to."""
     import functools
 
     import jax
@@ -693,17 +747,19 @@ def _zero_adam_entry_plan():
 def _moe_ep8_plan():
     """moe_ep8_train_step's contract = the LAYER's own
     :meth:`ExpertParallelMLP.mesh_plan` (wi/wo expert-sharded, router
-    replicated, 4 all_to_all with the backward) specialized with the
-    entry's token sharding and its loss/grad psum pair."""
+    replicated, 2 all_to_all per capacity chunk with the backward —
+    8 at the default ``APEX_TPU_MOE_A2A_CHUNKS=2``) specialized with
+    the entry's token sharding and its loss/grad psum pair."""
     from ..transformer.expert_parallel import ExpertParallelMLP
 
     layer = ExpertParallelMLP(hidden_size=16, ffn_hidden_size=32,
                               num_experts=8, capacity_factor=4.0,
                               router="top2")
     # psum: the forward loss psum + its per-operand backward partials
-    # as this jax transposes them (measured 5 on the pre-vma stack)
+    # as this jax transposes them (measured 7 on the pre-vma stack
+    # with the fused routing front)
     return layer.mesh_plan(8).with_specs(
-        {r"^in1$": ("expert",)}, budget={"psum": 5})
+        {r"^in1$": ("expert",)}, budget={"psum": 7})
 
 
 register_entry_point(
@@ -735,6 +791,23 @@ register_entry_point(
         "2 psums per layer, cache donated through the sharded carry "
         "— the serving topology audited like training "
         "(what --serve-fleet --tp runs per tick)")
+register_entry_point(
+    "gpt_decode_step_ep", _build_gpt_decode_step_ep, policy="O5",
+    dead_args=(1,), min_devices=2, plan=_serving_ep_plan,
+    # the MoE combine accumulates gate-weighted expert outputs in
+    # fp32 on purpose (router probabilities are fp32, and a bf16 sum
+    # across chunks/experts would break the bit-exact single-buffer
+    # equivalence the routing tests pin) — same sanctioned class as
+    # the softmax/layer-norm statistics
+    allow_upcast=("apex_tpu/transformer/expert_parallel.py",
+                  "apex_tpu/ops/moe_routing.py"),
+    doc="expert-parallel MoE serving decode step (ep=2, 4 experts): "
+        "fused top-1 routing + capacity-chunked overlapped "
+        "all_to_all exchange + one masked psum per layer under "
+        "shard_map, expert stacks sharded and attention/cache "
+        "replicated, cache donated through the carry — the ISSUE-19 "
+        "MoE decode fast path audited like training "
+        "(what --serve --ep runs per tick)")
 
 
 # ---------------------------------------------------------------------------

@@ -1159,6 +1159,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                 backoff_base: float = 0.05,
                 metrics_port: Optional[int] = None,
                 metrics_linger: float = 0.0,
+                ep: Optional[int] = None,
+                moe_experts: Optional[int] = None,
                 return_engine: bool = False):
     """Continuous-batched serving smoke: a tiny GPT serves
     ``num_requests`` mixed-length prompts through the
@@ -1225,6 +1227,17 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     weight to per-channel int8 (:func:`apex_tpu.ops.quant_matmul.
     quantize_weights`), so the serve exercises the quantized decode
     path end to end — the ``--policy Q8`` CI smoke.
+
+    ``ep=N`` (flag: ``APEX_TPU_SERVE_EP``) serves expert-parallel
+    (ISSUE-19): the model's MLPs expand to a ``moe_experts``-way
+    Switch MoE (:func:`~apex_tpu.serving.expand_moe_weights`;
+    default ``2*ep`` experts) and the engine runs under an
+    :class:`~apex_tpu.serving.EPContext` — expert stacks sharded over
+    N devices, attention and cache replicated, the fused routing +
+    capacity-chunked overlapped all_to_all exchange per MoE layer.
+    The same ladder/warmup/sanitize discipline applies: the EP serve
+    holds a post-warmup recompile budget of ZERO.  Does not compose
+    with ``--policy Q8`` or speculative decoding.
 
     The live metrics plane (ISSUE-17) arms with ``metrics_port``
     (flag: ``APEX_TPU_METRICS_PORT``; an explicit ``0`` picks an
@@ -1305,6 +1318,27 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
         else:
             raise ValueError(f"draft {draft!r} not in "
                              f"('self', 'narrow')")
+    ep_width = ep if ep is not None else _flag_int("APEX_TPU_SERVE_EP")
+    ep_ctx = None
+    if ep_width and ep_width > 0:
+        import dataclasses as _dc
+
+        from ..serving import EPContext, expand_moe_weights
+
+        if pol is not None and pol.quantize_weights == "int8":
+            raise ValueError(
+                "--ep does not compose with the Q8 tier: the int8 "
+                "kernel has no expert-stack layout")
+        n_exp = moe_experts if moe_experts else 2 * ep_width
+        # capacity_factor 8.0 keeps per-rank capacity >= the chunk
+        # count at decode's 1-token-per-sequence buckets, so the
+        # overlapped exchange engages even on the tiny smoke shapes
+        cfg = _dc.replace(
+            cfg, num_experts=n_exp, moe_capacity_factor=8.0,
+            moe_a2a_chunks=max(1, _flag_int("APEX_TPU_MOE_A2A_CHUNKS")))
+        weights = expand_moe_weights(weights, n_exp,
+                                     jax.random.PRNGKey(seed + 2))
+        ep_ctx = EPContext(cfg, cache_cfg, ep_width)
     if escalation == "auto":
         # serve watchdog policy: a stalled decode snapshots the live
         # engine state then drains cleanly, instead of the training
@@ -1320,7 +1354,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
                    "kv_dtype": cache_cfg.kv_dtype,
                    "block_size": cache_cfg.block_size,
                    "decode_attention": decode_attention,
-                   "policy": policy or "none"})
+                   "policy": policy or "none",
+                   "ep": ep_width or 0})
     if metrics_port is None:
         _fp = _flag_int("APEX_TPU_METRICS_PORT")
         metrics_port = _fp if _fp > 0 else None
@@ -1363,7 +1398,7 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
     engine = ServingEngine(weights, cfg, cache_cfg, ladder=ladder,
                            monitor=monitor, autoresume=autoresume,
                            tick_every=tick_every, snapshot=snapshot,
-                           speculate_k=spec_k,
+                           ep=ep_ctx, speculate_k=spec_k,
                            draft_weights=draft_weights,
                            draft_cfg=draft_cfg,
                            prefill_chunk=prefill_chunk,
@@ -1482,6 +1517,8 @@ def serve_smoke(num_requests: int = 6, *, jsonl: Optional[str] = None,
 
 def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
                 tp: Optional[int] = None,
+                ep: Optional[int] = None,
+                moe_experts: Optional[int] = None,
                 disaggregate: Optional[bool] = None,
                 policy: Optional[str] = None,
                 jsonl_dir: Optional[str] = None,
@@ -1558,6 +1595,10 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     replicas = replicas if replicas is not None \
         else flag_int("APEX_TPU_SERVE_REPLICAS")
     tp = tp if tp is not None else flag_int("APEX_TPU_SERVE_TP")
+    ep = ep if ep is not None else flag_int("APEX_TPU_SERVE_EP")
+    if ep and ep > 1 and tp and tp > 1:
+        raise ValueError("a replica is tensor-parallel OR expert-"
+                         "parallel, not both — pass --tp or --ep")
     disaggregate = disaggregate if disaggregate is not None \
         else flag_bool("APEX_TPU_SERVE_DISAGGREGATE")
     policy = policy if policy is not None \
@@ -1580,6 +1621,17 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
     cfg = ServingModelConfig.from_model(
         model, decode_attention=decode_attention)
     weights = extract_serving_weights(params, num_layers)
+    if ep and ep > 1:
+        import dataclasses as _dc
+
+        from ..serving import expand_moe_weights
+
+        n_exp = moe_experts if moe_experts else 2 * ep
+        cfg = _dc.replace(
+            cfg, num_experts=n_exp, moe_capacity_factor=8.0,
+            moe_a2a_chunks=max(1, flag_int("APEX_TPU_MOE_A2A_CHUNKS")))
+        weights = expand_moe_weights(weights, n_exp,
+                                     jax.random.PRNGKey(seed + 2))
     swap_weights = None
     if swap:
         # a REAL weight change (fresh init): the swap leg proves the
@@ -1587,6 +1639,12 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
         swap_params = jax.jit(model.init)(
             jax.random.PRNGKey(seed + 101), probe)["params"]
         swap_weights = extract_serving_weights(swap_params, num_layers)
+        if ep and ep > 1:
+            from ..serving import expand_moe_weights
+
+            swap_weights = expand_moe_weights(
+                swap_weights, cfg.num_experts,
+                jax.random.PRNGKey(seed + 2))
     if ladder is None:
         ladder = BucketLadder.from_flags()
     devices = jax.devices()
@@ -1605,6 +1663,10 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
         raise ValueError(
             f"{total} replica(s) x tp={tp} needs {total * tp} "
             f"devices, host has {len(devices)}")
+    if ep and ep > 1 and total * ep > len(devices):
+        raise ValueError(
+            f"{total} replica(s) x ep={ep} needs {total * ep} "
+            f"devices, host has {len(devices)}")
 
     if jsonl_dir:
         os.makedirs(jsonl_dir, exist_ok=True)
@@ -1618,21 +1680,29 @@ def fleet_smoke(num_requests: int = 8, *, replicas: Optional[int] = None,
             run_attrs={"driver": "standalone_gpt.fleet_smoke",
                        "replica": rid, "role": role,
                        "replicas": replicas, "tp": tp or 0,
+                       "ep": ep or 0,
                        "disaggregate": bool(disaggregate)})
         monitors.append(monitor)
         cache_cfg = make_cache_cfg()
         tp_ctx = None
+        ep_ctx = None
         device = None
         if tp and tp > 1:
             tp_ctx = TPContext(cfg, cache_cfg, tp,
                                devices=devices[idx * tp:
                                                (idx + 1) * tp])
+        elif ep and ep > 1:
+            from ..serving import EPContext
+
+            ep_ctx = EPContext(cfg, cache_cfg, ep,
+                               devices=devices[idx * ep:
+                                               (idx + 1) * ep])
         else:
             device = devices[idx % len(devices)]
         engine = ServingEngine(
             weights, cfg, cache_cfg, ladder=ladder, monitor=monitor,
-            prefix_share=prefix_share, tp=tp_ctx, device=device,
-            replica_id=rid)
+            prefix_share=prefix_share, tp=tp_ctx, ep=ep_ctx,
+            device=device, replica_id=rid)
         journal = None
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
@@ -2082,6 +2152,16 @@ def _main(argv=None):
                         "replica; each replica takes its own "
                         "TP-device slice (default: "
                         "APEX_TPU_SERVE_TP; 0 = single-chip)")
+    p.add_argument("--ep", type=int, default=None,
+                   help="(--serve / --serve-fleet) expert-parallel "
+                        "width: expand the "
+                        "MLPs to a Switch MoE and shard the expert "
+                        "stacks over this many devices (default: "
+                        "APEX_TPU_SERVE_EP; 0 = single-chip)")
+    p.add_argument("--moe-experts", type=int, default=None,
+                   help="(--serve --ep) expert count for the MoE "
+                        "expansion (default: 2*ep; must divide by "
+                        "ep)")
     p.add_argument("--disaggregate", action="store_true",
                    default=None,
                    help="(--serve-fleet) add a prefill-role replica "
@@ -2197,6 +2277,7 @@ def _main(argv=None):
     if args.serve_fleet:
         s = fleet_smoke(
             args.requests, replicas=args.replicas, tp=args.tp,
+            ep=args.ep, moe_experts=args.moe_experts,
             disaggregate=args.disaggregate,
             policy=args.router_policy, jsonl_dir=args.jsonl_dir,
             max_new_tokens=args.new_tokens,
@@ -2264,6 +2345,7 @@ def _main(argv=None):
             max_restarts=args.max_restarts,
             metrics_port=args.metrics_port,
             metrics_linger=args.metrics_linger,
+            ep=args.ep, moe_experts=args.moe_experts,
             return_engine=True)
         spec = "" if s.spec_accept_rate is None else (
             f" spec_accept_rate={s.spec_accept_rate}"

@@ -4,9 +4,13 @@ Complements :mod:`apex_tpu.transformer.expert_parallel` (the explicit
 shard_map layer): here the MoE FFN is a flax module whose expert weights
 carry a leading ``(num_experts, ...)`` axis — under pjit, annotate that
 axis with the ``expert`` mesh axis (``jax.sharding``) and XLA inserts
-the all-to-alls; on one device it runs dense.  Dispatch uses the GShard
-one-hot einsum formulation (static shapes, capacity drops), which GSPMD
-partitions cleanly.
+the all-to-alls; on one device it runs dense.  Dispatch rides the fused
+routing path (:mod:`apex_tpu.ops.moe_routing`: softmax -> top-1 ->
+capacity slotting -> scatter, static shapes, capacity drops) in its jnp
+form — plain gather/scatter algebra GSPMD partitions cleanly, without
+the legacy formulation's ``(T, E, capacity)`` one-hot dispatch tensor.
+``APEX_TPU_MOE_FUSED_DISPATCH=0`` restores the one-hot einsum
+formulation (bit-identical routing decisions either way).
 
 The reference has no MoE (SURVEY §2.10); this is capability beyond it.
 """
@@ -18,6 +22,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..analysis.flags import flag_bool
+from ..ops.moe_routing import moe_combine, moe_route_dispatch
 from .enums import AttnMaskType
 from .expert_parallel import _dispatch_indices, top1_router
 from .layers import Dtype, ParallelTransformerLayer
@@ -55,10 +61,30 @@ class MoEMLP(nn.Module):
         wo = self.param("wo", nn.initializers.variance_scaling(
             2.0, "fan_in", "normal"), (e, f, h), jnp.float32)
 
+        if flag_bool("APEX_TPU_MOE_FUSED_DISPATCH"):
+            # fused routing front (jnp-twin form: XLA-native gather/
+            # scatter that GSPMD partitions — a Pallas custom call
+            # would wall off propagation under pjit); no (T, e,
+            # capacity) dispatch tensor is ever built
+            rd = moe_route_dispatch(
+                tokens.astype(cdt),
+                tokens.astype(jnp.float32) @ router_w,
+                capacity=capacity, backend="xla")
+            hmid = jax.nn.gelu(jnp.einsum(
+                "ech,ehf->ecf", rd.buf.astype(cdt), wi.astype(cdt),
+                preferred_element_type=jnp.float32))
+            out = jnp.einsum("ecf,efh->ech", hmid.astype(cdt),
+                             wo.astype(cdt),
+                             preferred_element_type=jnp.float32)
+            y = moe_combine(out, rd.expert_index, rd.slot, rd.keep,
+                            rd.gate, out_dtype=jnp.float32)
+            return (y.reshape(b, s, h).astype(x.dtype),
+                    rd.load_balancing_loss)
+
+        # legacy one-hot einsum formulation (GShard): (T, e, capacity)
         router = top1_router(tokens.astype(jnp.float32) @ router_w)
         slot, keep = _dispatch_indices(router.expert_index, e, capacity)
 
-        # one-hot dispatch/combine tensors (GShard): (T, e, capacity)
         disp = (jax.nn.one_hot(router.expert_index, e)[:, :, None]
                 * jax.nn.one_hot(slot, capacity)[:, None, :]
                 * keep[:, None, None]).astype(cdt)

@@ -1709,6 +1709,181 @@ def bench_serving_metrics():
     return out
 
 
+def bench_moe_ep():
+    """The ISSUE-19 MoE fast path measured at three levels:
+
+    * ``routing`` — the fused route+dispatch pass
+      (:func:`apex_tpu.ops.moe_routing.moe_route_dispatch`: softmax,
+      top-1 select, cumulative-position slotting, buffer scatter in
+      one pass) and the gate-weighted combine, µs per call;
+    * ``moe_layer`` — one full top-1 MoE FFN layer, fused front end
+      vs (a) the four-stage GShard one-hot-einsum formulation it
+      replaced (the (T, E, C) dispatch-matrix einsums) and (b) a
+      dense FLOP-matched single H->F->H MLP — top-1 routes every
+      token through exactly ONE expert of the same F, so per-token
+      useful matmul FLOPs match the dense MLP exactly and the
+      fused/dense ratio prices the whole routing machinery.  At the
+      bench capacity_factor 1.25 the padded (E, capacity, H) buffer
+      carries 1.25x the dense compute, so a ratio near 1.25 means
+      routing itself became ~free;
+    * ``ep_decode`` — expert-parallel serving decode tokens/s: the
+      audited ``gpt_decode_step_ep`` program (wi/wo sharded over the
+      expert axis, capacity-chunked overlapped all-to-all, one masked
+      psum per MoE layer) via a ``standalone_gpt --serve --ep 2``
+      subprocess on the 8-device host mesh, next to the dense
+      single-chip serve leg.
+
+    Substrate note (the PR-16/18 discipline): on this host the
+    "8-device mesh" is ONE CPU core stepping 8 virtual devices, so
+    the EP decode row is a topology/correctness row — it prices the
+    per-layer exchange against a dense model that does no collectives
+    at all, and EP parallelism can only win where expert shards run
+    on their own hardware.  The EP leg also serves a 4-expert model
+    at the drop-free capacity_factor 8.0 (the serving parity
+    setting), so its padded expert compute is deliberately ~8x the
+    useful per-token FLOPs — honest for correctness, pessimal for
+    tokens/s."""
+    import re
+    import subprocess
+
+    import numpy as np
+
+    from apex_tpu.ops.moe_routing import (moe_combine,
+                                          moe_route_dispatch)
+
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    t, h, f, e = (512, 128, 512, 8) if smoke else (4096, 256, 1024, 8)
+    cf = 1.25
+    capacity = max(1, int(cf * t / e))
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (t, h), jnp.float32)
+    router_w = 0.02 * jax.random.normal(jax.random.fold_in(key, 1),
+                                        (h, e), jnp.float32)
+    wi = 0.02 * jax.random.normal(jax.random.fold_in(key, 2),
+                                  (e, h, f), jnp.float32)
+    wo = 0.02 * jax.random.normal(jax.random.fold_in(key, 3),
+                                  (e, f, h), jnp.float32)
+    logits = x @ router_w
+
+    def _experts(buf):
+        mid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", buf, wi))
+        return jnp.einsum("ecf,efh->ech", mid, wo)
+
+    dispatch = jax.jit(lambda x, lg: moe_route_dispatch(
+        x, lg, capacity=capacity))
+    rd = dispatch(x, logits)
+    expert_out = jax.jit(_experts)(rd.buf)
+    combine = jax.jit(lambda o, rd: moe_combine(
+        o, rd.expert_index, rd.slot, rd.keep, rd.gate))
+    dispatch_us = round(_timeit(dispatch, x, logits) * 1e6, 1)
+    combine_us = round(_timeit(combine, expert_out, rd) * 1e6, 1)
+
+    @jax.jit
+    def moe_fused(x, lg):
+        rd = moe_route_dispatch(x, lg, capacity=capacity)
+        return moe_combine(_experts(rd.buf), rd.expert_index,
+                           rd.slot, rd.keep, rd.gate)
+
+    @jax.jit
+    def moe_onehot(x, lg):
+        # the legacy four-stage XLA dispatch this PR replaced:
+        # softmax/argmax routing, position-in-expert cumsum, then the
+        # (T, E, C) one-hot dispatch-matrix einsum each way (GShard)
+        probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+        idx = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        slot = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1
+        keep = slot < capacity
+        dmat = ((oh * keep[:, None]).astype(x.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1),
+                                 capacity, dtype=x.dtype)[:, None, :])
+        out = _experts(jnp.einsum("tec,th->ech", dmat, x))
+        return jnp.einsum("tec,ech->th",
+                          dmat * gate.astype(x.dtype)[:, None, None],
+                          out)
+
+    wi0, wo0 = wi[0], wo[0]
+    dense_mlp = jax.jit(lambda x: jax.nn.gelu(x @ wi0) @ wo0)
+
+    np.testing.assert_allclose(np.asarray(moe_fused(x, logits)),
+                               np.asarray(moe_onehot(x, logits)),
+                               rtol=2e-5, atol=2e-5)
+    fused_ms = round(_timeit(moe_fused, x, logits) * 1e3, 3)
+    onehot_ms = round(_timeit(moe_onehot, x, logits) * 1e3, 3)
+    dense_ms = round(_timeit(dense_mlp, x) * 1e3, 3)
+
+    env = dict(os.environ)
+    flags = [fl for fl in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in fl]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env.update(XLA_FLAGS=" ".join(flags),
+               JAX_PLATFORMS=env.get("JAX_PLATFORMS", "cpu"),
+               APEX_TPU_SERVE_BATCH_BUCKETS="4",
+               APEX_TPU_SERVE_PAGE_BUCKETS="2")
+    reqs, new_tok = ("4", "8") if smoke else ("8", "16")
+    base = [sys.executable, "-m",
+            "apex_tpu.testing.standalone_gpt", "--serve",
+            "--requests", reqs, "--new-tokens", new_tok]
+
+    def serve_leg(extra):
+        proc = subprocess.run(base + extra, env=env,
+                              capture_output=True, text=True,
+                              timeout=900,
+                              cwd=os.path.dirname(
+                                  os.path.abspath(__file__)))
+        m = re.search(r"^SERVE_DONE (.+)$", proc.stdout, re.M)
+        if proc.returncode != 0 or m is None:
+            raise RuntimeError(
+                f"serve leg {extra} failed (rc={proc.returncode}): "
+                f"{proc.stdout[-400:]} {proc.stderr[-400:]}")
+        row = {}
+        for kv in m.group(1).split():
+            k, _, v = kv.partition("=")
+            try:
+                row[k] = json.loads(v)
+            except (ValueError, json.JSONDecodeError):
+                row[k] = None if v == "None" else v
+        return row
+
+    dense_leg = serve_leg([])
+    ep_leg = serve_leg(["--ep", "2", "--moe-experts", "4"])
+
+    out = {
+        "shape": {"tokens": t, "hidden": h, "ffn": f, "experts": e,
+                  "capacity_factor": cf, "capacity": capacity,
+                  "tier": "smoke" if smoke else "full",
+                  "backend": jax.default_backend()},
+        "routing": {"dispatch_us": dispatch_us,
+                    "combine_us": combine_us},
+        "moe_layer": {
+            "fused_ms": fused_ms,
+            "onehot_dispatch_ms": onehot_ms,
+            "dense_flop_matched_ms": dense_ms,
+            "fused_vs_onehot": round(onehot_ms / fused_ms, 3),
+            "fused_vs_dense": round(fused_ms / dense_ms, 3)},
+        "ep_decode": {
+            "ep": 2, "experts": 4, "capacity_factor": 8.0,
+            "tokens_per_sec": ep_leg["tokens_s"],
+            "p99_ms": ep_leg["p99_ms"],
+            "compiles": ep_leg["compiles"],
+            "dense_tokens_per_sec": dense_leg["tokens_s"],
+            "mesh": "8-device host platform"},
+        "substrate_note": (
+            "single-core host mesh: the EP decode row prices the "
+            "per-layer exchange topology (and drop-free cf=8.0 "
+            "padding), not EP's parallel win — see bench_moe_ep "
+            "docstring"),
+    }
+    print(f"[bench] moe_ep: dispatch {dispatch_us} us / combine "
+          f"{combine_us} us, layer fused {fused_ms} ms vs onehot "
+          f"{onehot_ms} ms ({out['moe_layer']['fused_vs_onehot']}x) "
+          f"vs dense-FLOP {dense_ms} ms, ep2 decode "
+          f"{ep_leg['tokens_s']} tok/s (dense "
+          f"{dense_leg['tokens_s']})", file=sys.stderr)
+    return out
+
+
 def bench_collective():
     n_dev = jax.device_count()
     out = {"devices": n_dev}
@@ -2532,6 +2707,7 @@ SECTION_ESTIMATES_S = {
     "scan_driver": 120, "serving": 420, "serving_fleet": 480,
     "serving_fleet_procs": 600,
     "serving_metrics": 240,
+    "moe_ep": 300,
     "collective": 240,
     "long_context": 900, "ring_flash": 360, "gpt2_345m": 600,
     "gpt2_345m_s2048": 480, "gpt2_345m_dropout": 480,
@@ -2594,7 +2770,7 @@ def _run_section(extras, name, fn, writer, sink=None, budget=None,
 SECTION_NAMES = ("resnet50", "optimizer_step",
                  "optimizer_pipeline", "scan_driver", "serving",
                  "serving_fleet", "serving_fleet_procs",
-                 "serving_metrics",
+                 "serving_metrics", "moe_ep",
                  "collective", "long_context", "ring_flash",
                  "gpt2_345m", "gpt2_345m_s2048", "gpt2_345m_dropout",
                  "bert_large", "zero_sharded_adam")
@@ -2735,6 +2911,7 @@ def main(argv=None):
                 ("serving_fleet", bench_serving_fleet),
                 ("serving_fleet_procs", bench_serving_fleet_procs),
                 ("serving_metrics", bench_serving_metrics),
+                ("moe_ep", bench_moe_ep),
                 ("collective", bench_collective),
                 ("long_context", bench_long_context),
                 ("ring_flash", bench_ring_flash),

@@ -263,6 +263,47 @@ class TestRuleFixtures:
         _, adv2 = sharding._chain_findings("fx", jaxpr2.jaxpr, REPO)
         assert adv2 == []
 
+    def test_apx704_moe_overlapped_exchange_goes_quiet(self):
+        """ISSUE-19 regression: the chunked expert exchange issues
+        the dispatch a2a's back-to-back and trails each return a2a
+        with the NEXT chunk's expert matmul, so the overlap advisory
+        is silent; ``a2a_chunks=1`` restores the legacy single-shot
+        trace — expert matmul consuming the dispatch a2a immediately
+        — and with it the advisory."""
+        from apex_tpu.transformer.expert_parallel import (
+            moe_dispatch_combine_fused)
+
+        mesh = _mesh8()
+        e, h = 8, 16
+        x = jnp.ones((256, h))
+        logits = jnp.ones((256, e))
+        w = jnp.ones((e, h, h))
+
+        def prog(chunks):
+            def f(x, logits, w):
+                y, _ = moe_dispatch_combine_fused(
+                    x, logits,
+                    lambda d: jnp.einsum(
+                        "ech,ehf->ecf", d, w,
+                        preferred_element_type=jnp.float32),
+                    e, capacity_factor=4.0, axis_name="zero",
+                    a2a_chunks=chunks)
+                return y
+
+            return shard_map(
+                f, mesh=mesh,
+                in_specs=(P("zero"), P("zero"), P("zero")),
+                out_specs=P("zero"), check_vma=False)
+
+        jaxpr = jax.make_jaxpr(prog(2))(x, logits, w)
+        _, adv = sharding._chain_findings("fx", jaxpr.jaxpr, REPO)
+        assert [f.rule for f in adv] == []
+
+        jaxpr1 = jax.make_jaxpr(prog(1))(x, logits, w)
+        _, adv1 = sharding._chain_findings("fx", jaxpr1.jaxpr, REPO)
+        assert any(f.rule == "APX704" and "all_to_all" in f.message
+                   for f in adv1)
+
     def test_apx705_memory_gate_and_plan_drift(self):
         plan_json = _plan8().to_json()
         audit = sharding.ShardingAudit(
@@ -355,10 +396,11 @@ class TestRepoSharded:
         assert {"gpt_dp8_train_step", "zero_dp8_update_step",
                 "zero_dp8_adam_step", "moe_ep8_train_step"} \
             <= set(audits)
-        # the MoE dispatch's overlap precondition is an advisory
-        # today (ROADMAP item 3's a2a/compute overlap will clear it)
-        assert any(f.rule == "APX704" and "moe_ep8" in f.message
-                   for f in advisories)
+        # ISSUE-19 closed ROADMAP item 3's a2a/compute overlap: the
+        # chunked expert exchange leaves the MoE entry advisory-free
+        # (the legacy a2a_chunks=1 fixture above still fires it)
+        assert not any(f.rule == "APX704" and "moe_ep8" in f.message
+                       for f in advisories)
 
     def test_baseline_commits_the_plans(self):
         base = sharding.load_sharding_baseline(repo_root=REPO)
@@ -558,6 +600,42 @@ class TestTopologyColumn:
         assert "| multichip topology — gpt_3d | `pipe=2(pipeline)` |" \
             in block
 
+    def test_moe_perf_rows_from_multichip_tail(self, tmp_path):
+        """ISSUE-19: the '[dryrun] perf moe_ep <topology>: ...' lines
+        parse into (topology, step_ms, tokens_s) triples and render as
+        README rows; artifacts predating the perf lines yield none."""
+        rn = self._readme_numbers()
+        (tmp_path / "MULTICHIP_r07.json").write_text(json.dumps({
+            "n_devices": 8, "tail":
+                "[dryrun] expert-parallel MoE OK over expert=4\n"
+                "[dryrun] perf moe_ep expert=2: step_ms=3.821 "
+                "tokens_s=268015 (fused dispatch, a2a_chunks=2)\n"
+                "[dryrun] perf moe_ep expert=4: step_ms=4.787 "
+                "tokens_s=213927 (fused dispatch, a2a_chunks=2)\n"}))
+        rows = rn.moe_perf_rows(str(tmp_path))
+        assert rows == [("expert=2", "3.821", "268015"),
+                        ("expert=4", "4.787", "213927")]
+        block = rn.render({}, "X.json", moe_perf=rows)
+        assert ("| multichip MoE layer — expert=2 (host substrate) | "
+                "3.821 ms/step, 268015 tok/s |") in block
+        # pre-perf-line artifact: no rows, no crash
+        (tmp_path / "MULTICHIP_r07.json").write_text(json.dumps({
+            "n_devices": 8, "tail": "[dryrun] OK on 8 devices\n"}))
+        assert rn.moe_perf_rows(str(tmp_path)) == []
+
+    def test_render_includes_moe_ep_bench_rows(self):
+        """The bench moe_ep section's headline rows render from the
+        artifact: fused-vs-onehot speedup and EP decode tokens/s."""
+        rn = self._readme_numbers()
+        block = rn.render({"extras": {"moe_ep": {
+            "shape": {"capacity_factor": 1.25},
+            "moe_layer": {"fused_vs_onehot": 4.487,
+                          "fused_vs_dense": 1.332},
+            "ep_decode": {"tokens_per_sec": 600.67}}}}, "X.json")
+        assert "4.487x faster" in block
+        assert "600.67 tok/s" in block
+        assert "cf 1.25 padding" in block
+
     def test_dryrun_prints_one_plan_line_per_leg(self):
         """The stdout contract the MULTICHIP_rNN.json tail records:
         sorted '[dryrun] plan <leg>: <axes>' lines derived from the
@@ -573,7 +651,7 @@ class TestTopologyColumn:
         assert set(plans) == {
             "gpt_3d", "interleaved_pp", "sequence_ring", "ulysses",
             "expert_parallel", "tp_x_ep", "zero_adam", "resnet_dp",
-            "serving_tp"}
+            "serving_tp", "serving_ep"}
         for plan in plans.values():
             assert plan.axes  # every leg records real axes
         # kinds cover the full parallelism alphabet
@@ -608,8 +686,13 @@ class TestPlanAdoption:
         from apex_tpu.transformer.sequence_parallel import (
             SequenceParallelTransformerLayer)
 
+        # 2 a2a hops per capacity chunk, x2 for the backward
+        # transposes (default APEX_TPU_MOE_A2A_CHUNKS=2 -> 8)
         ep = ExpertParallelMLP(16, 32, num_experts=8).mesh_plan(4)
-        assert ep.budget() == {"all_to_all": 4}
+        assert ep.budget() == {"all_to_all": 8}
+        ep1 = ExpertParallelMLP(16, 32, num_experts=8,
+                                a2a_chunks=1).mesh_plan(4)
+        assert ep1.budget() == {"all_to_all": 4}
         assert ep.spec_for("in0['wi']") == ("expert",)
         assert ep.spec_for("in0['router']") == ()
         ring = SequenceParallelTransformerLayer(

@@ -1,0 +1,207 @@
+"""Expert-parallel serving tests (ISSUE-19): the MoE decode fast
+path's serving layer.
+
+The EP anchor mirrors ISSUE-14's TP bar: an ep=2
+:class:`~apex_tpu.serving.ServingEngine` (the shard_map-wrapped
+decode/prefill/extend programs under ``serving_ep_plan`` — expert
+stacks sharded, attention and the paged cache replicated, the
+capacity-chunked overlapped exchange + one masked psum per MoE layer)
+must emit greedy output **token-identical** to the single-chip engine
+on the same request trace.  The dense anchor underneath it: a
+1-expert MoE (softmax of one logit = gate 1.0, capacity ≥ tokens so
+nothing drops) must match the DENSE engine token for token — the MoE
+serving math is the dense math plus routing, not a different model.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.serving import (BucketLadder, EPContext, Request,
+                              ServingEngine, ServingModelConfig,
+                              default_cache_config, expand_moe_weights,
+                              extract_serving_weights, serving_ep_plan)
+from apex_tpu.serving.model import MoELayerWeights
+from apex_tpu.testing.standalone_gpt import GPTModel
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="expert-parallel tests need >= 2 "
+                                   "devices (host platform count)")
+
+VOCAB, HIDDEN, HEADS, LAYERS, MAX_SEQ = 64, 32, 4, 2, 64
+
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    """(cfg, dense_weights, moe4_weights) on the fp32 smoke GPT.
+
+    The dense weights get ZERO fc biases first — the MoE expert
+    stacks are bias-free, so this is the config under which 1-expert
+    MoE == dense exactly.  The 4-expert expansion then perturbs each
+    expert's wi by a distinct scale so routing decisions MATTER in
+    the ep-vs-single-chip comparison (identical experts would hide a
+    broken route)."""
+    model = GPTModel(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=LAYERS,
+        num_attention_heads=HEADS, max_sequence_length=MAX_SEQ,
+        attention_dropout=0.0, hidden_dropout=0.0, use_flash=False,
+        dtype=jnp.float32)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = ServingModelConfig.from_model(model)
+    weights = extract_serving_weights(params, LAYERS)
+    weights = weights._replace(layers=tuple(
+        lw._replace(fc1_b=jnp.zeros_like(lw.fc1_b),
+                    fc2_b=jnp.zeros_like(lw.fc2_b))
+        for lw in weights.layers))
+    moe4 = expand_moe_weights(weights, 4, jax.random.PRNGKey(3))
+    scale = (1.0 + 0.05 * jnp.arange(4, dtype=jnp.float32)
+             )[:, None, None]
+    moe4 = moe4._replace(layers=tuple(
+        lw._replace(wi=lw.wi * scale) for lw in moe4.layers))
+    return cfg, weights, moe4
+
+
+def moe_cfg(cfg, num_experts, capacity_factor=8.0, chunks=2):
+    return dataclasses.replace(
+        cfg, num_experts=num_experts,
+        moe_capacity_factor=capacity_factor, moe_a2a_chunks=chunks)
+
+
+def make_engine(cfg, weights, *, ep=None, num_blocks=32, warm=False):
+    cache_cfg = default_cache_config(cfg, num_blocks=num_blocks,
+                                     block_size=4)
+    ep_ctx = EPContext(cfg, cache_cfg, ep) if ep else None
+    e = ServingEngine(weights, cfg, cache_cfg,
+                      ladder=BucketLadder(batch=(2, 4), pages=(2, 4)),
+                      ep=ep_ctx)
+    if warm:
+        e.warmup()
+    return e
+
+
+def make_requests(n, *, seed=3, max_new=4):
+    rng = np.random.RandomState(seed)
+    return [Request(rid=f"r{i}",
+                    prompt=[int(t) for t in rng.randint(
+                        0, VOCAB, 1 + rng.randint(6))],
+                    max_new_tokens=max_new)
+            for i in range(n)]
+
+
+def run_trace(engine, n=5, seed=11):
+    for r in make_requests(n, seed=seed):
+        engine.submit(r)
+    summary = engine.run()
+    return {q.rid: q.out_tokens for q in engine.done}, summary
+
+
+# ---------------------------------------------------------------------------
+# plan + weight expansion
+# ---------------------------------------------------------------------------
+
+class TestEPPlan:
+    def test_plan_budget_and_specs(self):
+        plan = serving_ep_plan(2, num_layers=3, a2a_chunks=2)
+        assert plan.budget() == {"all_to_all": 12, "psum": 3}
+        ax = plan.axis("expert")
+        assert ax.size == 2 and ax.kind == "expert"
+        assert plan.spec_for("in0.layers[0].wi") == ("expert",)
+        assert plan.spec_for("in0.layers[1].wo") == ("expert",)
+        # router / attention / cache replicated by omission
+        assert plan.spec_for("in0.layers[0].router") is None
+        assert plan.spec_for("in0.layers[0].qkv_k") is None
+        assert plan.spec_for("in1.k") is None
+
+    def test_plan_rejects_bad_chunks(self):
+        with pytest.raises(ValueError, match="a2a_chunks"):
+            serving_ep_plan(2, num_layers=2, a2a_chunks=0)
+
+    def test_expand_moe_weights(self, moe_setup):
+        cfg, dense, _ = moe_setup
+        moe = expand_moe_weights(dense, 4, jax.random.PRNGKey(0))
+        for lw, dlw in zip(moe.layers, dense.layers):
+            assert isinstance(lw, MoELayerWeights)
+            assert lw.router.shape == (HIDDEN, 4)
+            assert lw.router.dtype == jnp.float32
+            assert lw.wi.shape == (4,) + dlw.fc1_k.shape
+            assert lw.wo.shape == (4,) + dlw.fc2_k.shape
+            # all experts start as the dense FFN
+            np.testing.assert_array_equal(lw.wi[0], dlw.fc1_k)
+            np.testing.assert_array_equal(lw.wi[3], dlw.fc1_k)
+        # rng=None: zero router (uniform routing), deterministic
+        flat = expand_moe_weights(dense, 2)
+        assert not flat.layers[0].router.any()
+
+
+class TestEPContextValidation:
+    def test_context_validation(self, moe_setup):
+        cfg, _, _ = moe_setup
+        cc = default_cache_config(moe_cfg(cfg, 4), num_blocks=8,
+                                  block_size=4)
+        with pytest.raises(ValueError, match="ep 1 must be >= 2"):
+            EPContext(moe_cfg(cfg, 4), cc, 1)
+        with pytest.raises(ValueError, match="num_experts=0"):
+            EPContext(cfg, cc, 2)                # dense config
+        with pytest.raises(ValueError, match="not divisible"):
+            EPContext(moe_cfg(cfg, 3), cc, 2)
+        with pytest.raises(ValueError, match="tp_axis"):
+            EPContext(dataclasses.replace(moe_cfg(cfg, 4),
+                                          tp_axis="tensor"), cc, 2)
+
+    def test_engine_rejects_ep_device_combo(self, moe_setup):
+        cfg, _, moe4 = moe_setup
+        mc = moe_cfg(cfg, 4)
+        cc = default_cache_config(mc, num_blocks=8, block_size=4)
+        ep = EPContext(mc, cc, 2)
+        with pytest.raises(ValueError, match="at most one"):
+            ServingEngine(moe4, mc, cc, ep=ep,
+                          device=jax.devices()[0])
+
+    def test_ep_rejects_dense_weights(self, moe_setup):
+        cfg, dense, _ = moe_setup
+        mc = moe_cfg(cfg, 4)
+        cc = default_cache_config(mc, num_blocks=8, block_size=4)
+        ep = EPContext(mc, cc, 2)
+        with pytest.raises(ValueError, match="expand_moe_weights"):
+            ServingEngine(dense, mc, cc, ep=ep)
+
+
+# ---------------------------------------------------------------------------
+# token parity
+# ---------------------------------------------------------------------------
+
+class TestEPParity:
+    def test_e1_single_chip_matches_dense(self, moe_setup):
+        """The dense anchor: a 1-expert MoE (gate 1.0, capacity ≥
+        tokens) is the dense model — greedy output token-identical
+        to the dense engine on the same trace."""
+        cfg, dense, _ = moe_setup
+        want, _ = run_trace(make_engine(cfg, dense))
+        moe1 = expand_moe_weights(dense, 1, jax.random.PRNGKey(3))
+        got, _ = run_trace(make_engine(moe_cfg(cfg, 1), moe1))
+        assert got == want
+
+    def test_ep2_greedy_token_identical(self, moe_setup):
+        """The acceptance bar: ep=2 greedy output == the single-chip
+        MoE engine, token for token, across mixed-length requests
+        and bucket changes — the token slicing, overlapped exchange
+        and masked psum are numerically invisible."""
+        cfg, _, moe4 = moe_setup
+        mc = moe_cfg(cfg, 4)
+        want, _ = run_trace(make_engine(mc, moe4))
+        got, s = run_trace(make_engine(mc, moe4, ep=2))
+        assert got == want
+        assert s.requests_done == 5
+
+    def test_ep_zero_steady_state_recompiles(self, moe_setup):
+        """The warmed bucket ladder covers every EP step shape: a
+        second trace through the same buckets compiles nothing."""
+        cfg, _, moe4 = moe_setup
+        e = make_engine(moe_cfg(cfg, 4), moe4, ep=2, warm=True)
+        _, s1 = run_trace(e, n=3, seed=5)
+        _, s2 = run_trace(e, n=3, seed=6)
+        assert s2.compiles == s1.compiles

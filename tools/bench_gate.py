@@ -219,6 +219,16 @@ def headline_metrics(full):
         "serving_metrics_scrape_p99_ms": (
             _get(full, "extras", "serving_metrics", "scrape_p99_ms"),
             "serving_metrics"),
+        # ISSUE-19 MoE fast path: the fused-routing speedup over the
+        # one-hot einsum dispatch it replaced and the expert-parallel
+        # decode throughput both gate upward; both roll forward on
+        # artifacts predating the section
+        "moe_fused_vs_onehot": (
+            _get(full, "extras", "moe_ep", "moe_layer",
+                 "fused_vs_onehot"), "moe_ep"),
+        "moe_ep_decode_tokens_per_sec": (
+            _get(full, "extras", "moe_ep", "ep_decode",
+                 "tokens_per_sec"), "moe_ep"),
     }
     lc = _get(full, "extras", "long_context") or {}
     if isinstance(lc, dict):

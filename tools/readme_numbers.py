@@ -22,6 +22,9 @@ START = "<!-- BENCH_NUMBERS_START (tools/readme_numbers.py) -->"
 END = "<!-- BENCH_NUMBERS_END -->"
 
 _PLAN_LINE = re.compile(r"^\[dryrun\] plan (\S+): (.+)$", re.M)
+_MOE_PERF_LINE = re.compile(
+    r"^\[dryrun\] perf moe_ep (\S+): step_ms=(\S+) tokens_s=(\S+)",
+    re.M)
 
 
 def topology_rows(repo: str = REPO) -> list:
@@ -35,22 +38,9 @@ def topology_rows(repo: str = REPO) -> list:
     rendering), so the column is stable across the transition and only
     drifts when a topology really changes — which is exactly when the
     README drift guard SHOULD demand a reviewed regeneration."""
-    def _run_number(path):
-        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
-        return (int(m.group(1)) if m else -1, path)
-
-    # numeric key: lexicographic sort would pin r99 above r100
-    latest = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")),
-                    key=_run_number)
-    if latest:
-        try:
-            with open(latest[-1]) as f:
-                tail = json.load(f).get("tail", "") or ""
-        except (OSError, ValueError):
-            tail = ""
-        pairs = _PLAN_LINE.findall(tail)
-        if pairs:
-            return sorted(pairs)
+    pairs = _PLAN_LINE.findall(_latest_multichip_tail(repo))
+    if pairs:
+        return sorted(pairs)
     topo = os.path.join(repo, "MULTICHIP_TOPOLOGY.json")
     if os.path.exists(topo):
         with open(topo) as f:
@@ -60,7 +50,37 @@ def topology_rows(repo: str = REPO) -> list:
     return []
 
 
-def render(full: dict, artifact_name: str, topo: list = None) -> str:
+def _latest_multichip_tail(repo: str = REPO) -> str:
+    """The captured stdout of the newest MULTICHIP_rNN.json (empty
+    string when none exists or it is unreadable)."""
+    def _run_number(path):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        return (int(m.group(1)) if m else -1, path)
+
+    # numeric key: lexicographic sort would pin r99 above r100
+    latest = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")),
+                    key=_run_number)
+    if not latest:
+        return ""
+    try:
+        with open(latest[-1]) as f:
+            return json.load(f).get("tail", "") or ""
+    except (OSError, ValueError):
+        return ""
+
+
+def moe_perf_rows(repo: str = REPO) -> list:
+    """(topology, step_ms, tokens_s) triples from the ISSUE-19
+    ``[dryrun] perf moe_ep <topology>: ...`` lines in the newest
+    MULTICHIP_rNN.json tail — the MoE fast path's measured multichip
+    rows (tokens/s and step-ms per expert-axis width), rendered so a
+    dispatch-path regression is a README diff, not a buried number.
+    Empty for artifacts predating the perf lines."""
+    return sorted(_MOE_PERF_LINE.findall(_latest_multichip_tail(repo)))
+
+
+def render(full: dict, artifact_name: str, topo: list = None,
+           moe_perf: list = None) -> str:
     ex = full.get("extras", {})
     rows = []
 
@@ -203,6 +223,32 @@ def render(full: dict, artifact_name: str, topo: list = None) -> str:
                 + ("identical" if k9.get(
                     "digest_matches_uninterrupted")
                    else "DIVERGED"))
+    # ISSUE-19 MoE fast path: the fused-routing speedup, its overhead
+    # vs a dense FLOP-matched MLP, and the expert-parallel decode row
+    # (host substrate — see the artifact's substrate_note)
+    moe = ex.get("moe_ep", {})
+    if isinstance(moe, dict):
+        ml = moe.get("moe_layer") or {}
+        if ml.get("fused_vs_onehot") is not None:
+            row("MoE layer: fused routing kernel vs the one-hot "
+                "einsum dispatch it replaced",
+                f"{ml['fused_vs_onehot']}x faster")
+        if ml.get("fused_vs_dense") is not None:
+            sh = moe.get("shape") or {}
+            row("MoE layer vs dense FLOP-matched MLP (whole routing "
+                f"price; cf {sh.get('capacity_factor', '?')} padding "
+                "is the floor)", f"{ml['fused_vs_dense']}x")
+        epd = moe.get("ep_decode") or {}
+        if epd.get("tokens_per_sec") is not None:
+            row("serving: expert-parallel decode (ep=2, 4 experts, "
+                "audited topology, host substrate)",
+                f"{epd['tokens_per_sec']} tok/s")
+    # multichip MoE perf rows: the fused-dispatch MoE layer timed per
+    # expert-axis width on the dryrun harness (single-core host
+    # substrate — topology pricing, not parallel speedup)
+    for topology, step_ms, tokens_s in (moe_perf or []):
+        row(f"multichip MoE layer — {topology} (host substrate)",
+            f"{step_ms} ms/step, {tokens_s} tok/s")
     z = ex.get("zero_sharded_adam", {})
     if "sharded_vs_dense_device" in z:
         row("ZeRO sharded-vs-dense Adam step at 355M (1-chip, device)",
@@ -246,9 +292,10 @@ def main(argv=None):
 
     with open(args.artifact) as f:
         full = json.load(f)
+    repo = os.path.dirname(args.readme) or REPO
     block = render(full, os.path.basename(args.artifact),
-                   topo=topology_rows(os.path.dirname(args.readme)
-                                      or REPO))
+                   topo=topology_rows(repo),
+                   moe_perf=moe_perf_rows(repo))
 
     with open(args.readme) as f:
         readme = f.read()
