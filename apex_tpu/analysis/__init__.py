@@ -33,6 +33,12 @@ generated from it):
   lock-acquisition-order cycles aggregated cross-module, flag-only
   signal handlers, blocking-under-lock, and thread-target jit
   dispatch outside a device pin.
+* :mod:`.protocol` — wire-protocol + resource-lifecycle auditor
+  (APX901-905): ``serving/`` + ``resilience/`` audited against the
+  declared ``ProtocolSpec`` registry in ``serving/control_plane.py``
+  — deadline discipline, op and header-field drift matched across
+  the parent/child modules, socket/subprocess/tempdir lifecycle,
+  and retry-safety.
 * :mod:`.schedule` — the dynamic half: a seeded deterministic-
   interleaving scheduler that steps the threaded serving fleet under
   permuted thread orderings and asserts the terminal digest is
@@ -67,6 +73,10 @@ _LAZY = {
     "lint_concurrency_paths": "concurrency",
     "run_concurrency_check": "concurrency",
     "write_concurrency_baseline": "concurrency",
+    "lint_protocol_source": "protocol",
+    "lint_protocol_paths": "protocol",
+    "run_protocol_check": "protocol",
+    "write_protocol_baseline": "protocol",
     "DeterministicScheduler": "schedule",
     "fleet_digest": "schedule", "schedule_sweep": "schedule",
 }

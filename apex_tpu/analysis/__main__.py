@@ -5,6 +5,7 @@
     python -m apex_tpu.analysis --check-hlo      # compiled-graph audit
     python -m apex_tpu.analysis --check-sharding # SPMD plan audit
     python -m apex_tpu.analysis --check-concurrency  # APX8xx lock/signal audit
+    python -m apex_tpu.analysis --check-protocol # APX9xx wire-protocol audit
     python -m apex_tpu.analysis --update-baseline
     python -m apex_tpu.analysis --update-hlo-baseline
     python -m apex_tpu.analysis --update-sharding-baseline
@@ -102,6 +103,21 @@ def main(argv=None) -> int:
                     action="store_true",
                     help="rewrite tools/concurrency_baseline.txt to "
                          "accept all current APX8xx findings (the "
+                         "repo commits it EMPTY: fix, don't "
+                         "baseline)")
+    ap.add_argument("--check-protocol", action="store_true",
+                    help="wire-protocol + resource-lifecycle audit "
+                         "(APX901-905): serving/ + resilience/ "
+                         "checked against the ProtocolSpec registry "
+                         "in serving/control_plane.py — deadline "
+                         "discipline, op/header-field drift matched "
+                         "across parent and child, socket/subprocess/"
+                         "tempdir lifecycle, retry-safety — against "
+                         "tools/protocol_baseline.txt (committed "
+                         "empty; stale entries fail)")
+    ap.add_argument("--update-protocol-baseline", action="store_true",
+                    help="rewrite tools/protocol_baseline.txt to "
+                         "accept all current APX9xx findings (the "
                          "repo commits it EMPTY: fix, don't "
                          "baseline)")
     ap.add_argument("--update-sharding-baseline", action="store_true",
@@ -301,6 +317,40 @@ def main(argv=None) -> int:
               f"(baseline {CONC_BASELINE} empty-current)")
         return 0
 
+    if args.check_protocol or args.update_protocol_baseline:
+        from .protocol import (DEFAULT_BASELINE as PROTO_BASELINE,
+                               lint_protocol_paths,
+                               run_protocol_check,
+                               write_protocol_baseline)
+
+        if args.update_protocol_baseline:
+            findings, _ = lint_protocol_paths(repo_root=args.root)
+            write_protocol_baseline(findings, repo_root=args.root)
+            print(f"[analysis] protocol baseline rewritten with "
+                  f"{len(set(f.key for f in findings))} entries")
+            return 0
+        unsuppressed, stale, n_ops = run_protocol_check(
+            repo_root=args.root)
+        for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
+            if args.json:
+                print(json.dumps(dataclasses.asdict(f)))
+            else:
+                print(f.render())
+        for k in sorted(stale):
+            print(f"[analysis] stale protocol baseline entry "
+                  f"(finding no longer fires — delete the line): {k}",
+                  file=sys.stderr)
+        if unsuppressed or stale:
+            print(f"[analysis] FAIL: {len(unsuppressed)} unsuppressed "
+                  f"protocol finding(s), {len(stale)} stale "
+                  f"baseline entr(ies)", file=sys.stderr)
+            return 1
+        print(f"[analysis] protocol clean: {n_ops} declared op(s) "
+              f"audited across serving/ + resilience/, 0 "
+              f"unsuppressed APX9xx findings (baseline "
+              f"{PROTO_BASELINE} empty-current)")
+        return 0
+
     if args.smoke:
         from .sanitizer import sanitize_smoke
 
@@ -321,6 +371,22 @@ def main(argv=None) -> int:
     unsuppressed, stale = run_check(baseline=args.baseline,
                                     repo_root=args.root,
                                     paths=args.paths)
+    if args.paths:
+        # the changed-file fast path also covers the APX9xx protocol
+        # rules for any named file inside the protocol trees (full
+        # CI keeps the dedicated --check-protocol walk with its own
+        # staleness judgment)
+        from .protocol import (DEFAULT_BASELINE as PROTO_BASELINE,
+                               lint_protocol_paths)
+
+        proto, _ = lint_protocol_paths(repo_root=args.root,
+                                       paths=args.paths)
+        from .linter import load_baseline as _load_baseline
+
+        proto_base = _load_baseline(PROTO_BASELINE,
+                                    repo_root=args.root)
+        unsuppressed = list(unsuppressed) + [
+            f for f in proto if f.key not in proto_base]
     for f in sorted(unsuppressed, key=lambda x: (x.path, x.line)):
         if args.json:
             print(json.dumps(dataclasses.asdict(f)))

@@ -510,6 +510,16 @@ register_flag(
     "counts a restart.  The ProcessFleet spawn_timeout_s ctor "
     "argument overrides.", lo=1.0)
 register_flag(
+    "APEX_TPU_CP_CONNECT_TIMEOUT_S", "float", 300.0,
+    "Control plane child-side rendezvous deadline in seconds: how "
+    "long a freshly spawned replica keeps retrying its AF_UNIX "
+    "connect before giving up.  Normally unused — begin_spawn stamps "
+    "the listener's own spawn_timeout_s into EngineSpec."
+    "connect_timeout_s so both halves of the handshake run on one "
+    "clock — this flag is the fallback for a worker entered outside "
+    "ReplicaProcess (its default matches "
+    "APEX_TPU_CP_SPAWN_TIMEOUT_S for the same reason).", lo=1.0)
+register_flag(
     "APEX_TPU_CP_HEARTBEAT_MISSES", "int", 3,
     "Control plane liveness threshold: consecutive missed gauge "
     "polls (rpc_timeout on router_snapshot) a replica may accrue "

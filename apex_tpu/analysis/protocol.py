@@ -1,0 +1,1094 @@
+"""APX9xx wire-protocol + resource-lifecycle auditor.
+
+PR 18's control plane made every fleet boundary a hand-rolled socket
+protocol: string-dispatched ops, per-call timeout floats, ad-hoc
+header dicts on both sides of an AF_UNIX frame.  The contract now
+lives as data — :data:`~apex_tpu.serving.control_plane.PROTOCOL`, a
+registry of :class:`~apex_tpu.serving.control_plane.ProtocolSpec`
+entries (op → direction, required/optional header fields, blob
+shape, timeout class, idempotency) that the child dispatch table and
+the parent retry/timeout policy are derived from at runtime.  This
+module is the STATIC half: an AST audit of ``serving/`` +
+``resilience/`` against that registry, on the same machinery as the
+PR-5 linter and the PR-15 concurrency auditor (structured
+:class:`~.linter.Finding` s, reasoned inline suppressions, a
+committed baseline with stale-entry-fails semantics, rule-registry
+docs generation).
+
+Rules (docs/api/analysis.md for the long-form table):
+
+==========  ================================================================
+APX901      RPC send/recv without an explicit deadline, or with a
+            literal one: ``.call(op)`` / ``.post(op)`` missing a
+            ``timeout=`` keyword, ``.wait(seq)`` missing one, or any
+            of them (and ``.settimeout``) passing a NUMERIC LITERAL
+            instead of a value routed through the registry's timeout
+            class (``_op_timeout`` / the ``APEX_TPU_CP_*_TIMEOUT_S``
+            flags).  Applies to modules that speak the protocol —
+            ones that define or import the control-plane surface
+            (``ReplicaProcess`` / ``ProcessFleet`` / ``send_frame``
+            / ``recv_frame`` / a ``ProtocolSpec`` registry).
+APX902      op drift, matched across every scanned module: an op
+            sent (``.call``/``.post`` with a constant op, or a
+            child→parent ``send_frame`` dict literal) that no
+            receiving dispatch handles; a handler (``*_HANDLERS``
+            dict key or ``op == "..."`` compare) for an op no sender
+            emits — the dead branch; either side using an op the
+            ``ProtocolSpec`` registry never declared; and a declared
+            op with no sender or no handler (a stale spec entry).
+APX903      header-field drift — the KeyError-at-3am class: a sender
+            header literal carrying a field the op's spec doesn't
+            declare (or missing a required one); a receiver
+            ``.get()``/index on a reply or request header for a
+            field the spec doesn't declare (reply reads are tracked
+            through ``reply, _ = rp.call("op", ...)`` assignments,
+            request reads through the handler table's functions, the
+            hello handshake through ``hello``-named frames); a
+            handler returning reply fields off-spec; and binary-blob
+            shape — blobs passed on an op whose spec declares none.
+APX904      resource lifecycle: a socket / accepted conn /
+            subprocess / tempdir / journal sink acquired into a
+            local and not guaranteed released on ALL paths — no
+            release at all, or risky statements between the
+            acquisition and the ``try``/``with``/ownership-transfer
+            that protects it (finally/context-manager/close-on-error
+            discipline).  Also: ``os.kill(pid, SIGKILL)`` in a
+            function with no ``.join`` — SIGKILLed children must be
+            reaped, not zombied (killing yourself via ``os.getpid()``
+            is exempt; nothing runs after).
+APX905      retry-safety: a ``retries=``>0 on an op whose spec is
+            not marked idempotent (a blind re-send can double-apply
+            work — escalate to restart + journal replay instead),
+            and retry loops (``while``/``for range`` re-entering
+            after catching an RPC/OS error) without a bound
+            (``for range`` / a ``raise``/``break`` escape) or
+            without backoff (``backoff_delay``/``sleep``/a
+            ``*restart*`` escalation, which backs off internally).
+==========  ================================================================
+
+Suppression: the linter's inline form
+(``# apex-lint: disable=APX904 -- <reason>``) or the committed
+baseline ``tools/protocol_baseline.txt`` (same
+``path:RULE:symbol  # reason`` format and the same stale-entry-fails
+semantics as the other baselines; committed EMPTY — every finding at
+introduction was fixed).  CI runs
+``python -m apex_tpu.analysis --check-protocol`` self-hosted.
+
+Import-light on purpose (stdlib ``ast`` only), like :mod:`.linter`:
+the registry is read out of ``serving/control_plane.py``'s AST, not
+imported — the auditor never pulls jax into the process.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .linter import (Finding, _iter_py, _suppressions, load_baseline,
+                     write_baseline)
+
+__all__ = ["lint_protocol_source", "lint_protocol_paths",
+           "run_protocol_check", "write_protocol_baseline",
+           "DEFAULT_BASELINE", "PROTOCOL_SCAN_TREES"]
+
+DEFAULT_BASELINE = "tools/protocol_baseline.txt"
+
+#: package-relative trees the auditor walks — the modules that speak
+#: (or supervise) the control-plane wire protocol
+PROTOCOL_SCAN_TREES = ("serving", "resilience")
+
+#: framing-layer fields every op may carry (mirrors
+#: ``control_plane.FRAME_FIELDS`` — kept literal here so the auditor
+#: never imports the serving package)
+_FRAME_FIELDS = {"op", "seq", "blobs", "error", "message"}
+
+#: names whose presence marks a module as protocol-speaking (APX901's
+#: scope gate)
+_PROTOCOL_MARKERS = {"ReplicaProcess", "ProcessFleet", "send_frame",
+                     "recv_frame", "ProtocolSpec"}
+
+#: constructor/call tails whose result is an owned OS resource
+_ACQUIRE_TAILS = {"socket", "accept", "mkdtemp", "mkstemp", "Popen",
+                  "Process", "JsonlSink"}
+
+#: attribute calls that release/retire a resource
+_RELEASE_ATTRS = {"close", "kill", "terminate", "join", "stop",
+                  "shutdown", "cleanup", "release", "unlink"}
+
+#: free functions that release when handed the resource
+_RELEASE_FUNCS = {"rmtree", "unlink", "remove", "closing"}
+
+#: exception tails whose catch-and-continue marks a retry loop
+_RETRYABLE_ERRORS = {"RpcError", "RpcTimeout", "ReplicaDead",
+                     "RpcRemoteError", "OSError", "ConnectionError",
+                     "TimeoutError", "timeout"}
+
+#: call tails that count as backoff inside a retry loop (a
+#: ``*restart*`` escalation counts: the restart path sleeps its own
+#: bounded backoff before respawning)
+_BACKOFF_TAILS = {"sleep", "backoff_delay"}
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _const_strs(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            s = _const_str(e)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def _is_num(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool))
+
+
+# ---------------------------------------------------------------------------
+# per-module facts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _OpSpec:
+    """One ``ProtocolSpec(...)`` call, read out of the AST."""
+
+    op: str
+    direction: str = "parent_to_child"
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    reply: Tuple[str, ...] = ()
+    request_blobs: bool = False
+    idempotent: bool = False
+    path: str = ""
+    line: int = 0
+    col: int = 0
+
+    @property
+    def request_fields(self) -> Set[str]:
+        return set(self.required) | set(self.optional) | _FRAME_FIELDS
+
+    @property
+    def reply_fields(self) -> Set[str]:
+        return set(self.reply) | _FRAME_FIELDS
+
+
+@dataclasses.dataclass
+class _Sender:
+    """One op send site: ``X.call("op", {...})`` / ``X.post`` on the
+    parent side, ``send_frame(conn, {"op": ..., ...})`` on the child
+    side."""
+
+    op: str
+    path: str
+    line: int
+    col: int
+    func: str                       # enclosing function name
+    direction: str                  # 'parent' | 'child'
+    keys: Optional[Tuple[str, ...]]  # header literal keys, if visible
+    complete: bool                  # keys are the WHOLE header
+    has_blobs: bool
+    has_timeout: bool
+    literal_timeout: bool
+    retries_nonzero: bool
+
+
+@dataclasses.dataclass
+class _Handler:
+    op: str
+    path: str
+    line: int
+    col: int
+    func: Optional[str]             # dispatch target, if a dict entry
+
+
+@dataclasses.dataclass
+class _FieldRead:
+    op: str
+    field: str
+    side: str                       # 'reply' | 'request'
+    path: str
+    line: int
+    col: int
+    func: str
+
+
+@dataclasses.dataclass
+class _ReplyLiteral:
+    op: str
+    keys: Tuple[str, ...]
+    path: str
+    line: int
+    col: int
+    func: str
+
+
+@dataclasses.dataclass
+class _ModuleInfo:
+    path: str
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    suppressed: Dict[int, Set[str]] = dataclasses.field(
+        default_factory=dict)
+    spec: Dict[str, _OpSpec] = dataclasses.field(default_factory=dict)
+    senders: List[_Sender] = dataclasses.field(default_factory=list)
+    handlers: List[_Handler] = dataclasses.field(default_factory=list)
+    reads: List[_FieldRead] = dataclasses.field(default_factory=list)
+    reply_literals: List[_ReplyLiteral] = dataclasses.field(
+        default_factory=list)
+    #: dispatch-table func name → op (for request-side field reads)
+    handler_funcs: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _extract_spec(tree: ast.Module, path: str) -> Dict[str, _OpSpec]:
+    out: Dict[str, _OpSpec] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _tail(node.func) == "ProtocolSpec"):
+            continue
+        op = _const_str(node.args[0]) if node.args else None
+        kw: Dict[str, Any] = {}
+        for k in node.keywords:
+            if k.arg == "op" and op is None:
+                op = _const_str(k.value)
+            elif k.arg == "direction":
+                kw["direction"] = _const_str(k.value) or \
+                    "parent_to_child"
+            elif k.arg in ("required", "optional", "reply"):
+                kw[k.arg] = _const_strs(k.value) or ()
+            elif k.arg in ("request_blobs", "idempotent"):
+                kw[k.arg] = bool(isinstance(k.value, ast.Constant)
+                                 and k.value.value)
+        if op is not None and op not in out:
+            out[op] = _OpSpec(op=op, path=path, line=node.lineno,
+                              col=node.col_offset, **kw)
+    return out
+
+
+def _speaks_protocol(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and "control_plane" in node.module:
+                return True
+            if any(a.name in _PROTOCOL_MARKERS
+                   for a in node.names):
+                return True
+        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            if node.name in _PROTOCOL_MARKERS:
+                return True
+        elif isinstance(node, ast.Call):
+            if _tail(node.func) == "ProtocolSpec":
+                return True
+    return False
+
+
+def _kwarg(call: ast.Call, *names: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg in names:
+            return k.value
+    return None
+
+
+def _func_defs(tree: ast.Module):
+    """Every (qualname-ish function name, FunctionDef) in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _enclosing_funcs(tree: ast.Module) -> Dict[ast.AST, str]:
+    """stmt/expr node → name of the innermost enclosing function."""
+    owner: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, fn: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            here = fn
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                here = child.name
+            owner[child] = here
+            visit(child, here)
+
+    owner[tree] = "<module>"
+    visit(tree, "<module>")
+    return owner
+
+
+def _collect_senders(tree: ast.Module, path: str,
+                     owner: Dict[ast.AST, str]) -> List[_Sender]:
+    out: List[_Sender] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(node.func)
+        fn = owner.get(node, "<module>")
+        if tail in ("call", "post") and isinstance(node.func,
+                                                   ast.Attribute):
+            op = _const_str(node.args[0]) if node.args else None
+            if op is None:
+                continue
+            header = (node.args[1] if len(node.args) > 1
+                      else _kwarg(node, "header"))
+            keys: Optional[Tuple[str, ...]] = ()
+            complete = True
+            if isinstance(header, ast.Dict):
+                ks = []
+                complete = True
+                for k in header.keys:
+                    s = _const_str(k) if k is not None else None
+                    if s is None:
+                        complete = False   # ** / computed key
+                        continue
+                    ks.append(s)
+                keys = tuple(ks)
+            elif header is not None and not (
+                    isinstance(header, ast.Constant)
+                    and header.value is None):
+                keys, complete = None, False
+            blobs = (node.args[2] if len(node.args) > 2
+                     else _kwarg(node, "blobs"))
+            has_blobs = blobs is not None and not (
+                isinstance(blobs, (ast.Tuple, ast.List))
+                and not blobs.elts)
+            timeout = _kwarg(node, "timeout", "timeout_s")
+            retries = _kwarg(node, "retries")
+            out.append(_Sender(
+                op=op, path=path, line=node.lineno,
+                col=node.col_offset, func=fn, direction="parent",
+                keys=keys, complete=complete, has_blobs=has_blobs,
+                has_timeout=timeout is not None,
+                literal_timeout=(timeout is not None
+                                 and _is_num(timeout)),
+                retries_nonzero=(retries is not None and not (
+                    isinstance(retries, ast.Constant)
+                    and not retries.value))))
+        elif tail == "send_frame" and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Dict):
+            d = node.args[1]
+            fields: Dict[str, ast.expr] = {}
+            complete = True
+            for k, v in zip(d.keys, d.values):
+                s = _const_str(k) if k is not None else None
+                if s is None:
+                    complete = False
+                    continue
+                fields[s] = v
+            op = (_const_str(fields["op"])
+                  if "op" in fields else None)
+            if op is None:
+                continue
+            out.append(_Sender(
+                op=op, path=path, line=node.lineno,
+                col=node.col_offset, func=fn, direction="child",
+                keys=tuple(fields), complete=complete,
+                has_blobs=len(node.args) > 2
+                or _kwarg(node, "blobs") is not None,
+                has_timeout=True, literal_timeout=False,
+                retries_nonzero=False))
+    return out
+
+
+def _collect_handlers(tree: ast.Module, path: str,
+                      owner: Dict[ast.AST, str]
+                      ) -> Tuple[List[_Handler], Dict[str, str]]:
+    handlers: List[_Handler] = []
+    funcs: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if not (isinstance(value, ast.Dict)
+                    and any(isinstance(t, ast.Name)
+                            and t.id.endswith("_HANDLERS")
+                            for t in targets)):
+                continue
+            for k, v in zip(value.keys, value.values):
+                op = _const_str(k) if k is not None else None
+                if op is None:
+                    continue
+                fname = v.id if isinstance(v, ast.Name) else None
+                handlers.append(_Handler(
+                    op=op, path=path, line=k.lineno,
+                    col=k.col_offset, func=fname))
+                if fname:
+                    funcs[fname] = op
+        elif isinstance(node, ast.Compare):
+            # the `op == "shutdown"` dispatch shape (and if/elif
+            # chains in general)
+            if (isinstance(node.left, ast.Name)
+                    and node.left.id == "op"
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], ast.Eq)):
+                op = _const_str(node.comparators[0])
+                if op is not None:
+                    handlers.append(_Handler(
+                        op=op, path=path, line=node.lineno,
+                        col=node.col_offset, func=None))
+    return handlers, funcs
+
+
+def _reads_of(body: ast.AST, var: str, op: str, side: str,
+              path: str, fn: str) -> List[_FieldRead]:
+    out: List[_FieldRead] = []
+    for node in ast.walk(body):
+        field = None
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == var and node.args):
+            field = _const_str(node.args[0])
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.value, ast.Name)
+              and node.value.id == var):
+            field = _const_str(node.slice)
+        if field is not None:
+            out.append(_FieldRead(
+                op=op, field=field, side=side, path=path,
+                line=node.lineno, col=node.col_offset, func=fn))
+    return out
+
+
+def _collect_reads(tree: ast.Module, path: str,
+                   handler_funcs: Dict[str, str]
+                   ) -> Tuple[List[_FieldRead], List[_ReplyLiteral]]:
+    reads: List[_FieldRead] = []
+    literals: List[_ReplyLiteral] = []
+    for fdef in _func_defs(tree):
+        # parent side: `reply, blobs = X.call("op", ...)` binds the
+        # reply var to the op; `hello, _ = recv_frame(...)` (and a
+        # parameter literally named `hello`) binds the handshake
+        bound: Dict[str, str] = {}
+        for node in ast.walk(fdef):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            tgt = node.targets[0]
+            name = None
+            if isinstance(tgt, ast.Tuple) and tgt.elts \
+                    and isinstance(tgt.elts[0], ast.Name):
+                name = tgt.elts[0].id
+            elif isinstance(tgt, ast.Name):
+                name = tgt.id
+            if name is None:
+                continue
+            tail = _tail(node.value.func)
+            if tail == "call" and node.value.args:
+                op = _const_str(node.value.args[0])
+                if op is not None:
+                    bound[name] = op
+            elif tail == "recv_frame" and name == "hello":
+                bound[name] = "hello"
+        for arg in fdef.args.args:
+            if arg.arg == "hello":
+                bound["hello"] = "hello"
+        for var, op in bound.items():
+            side = "request" if op == "hello" else "reply"
+            reads.extend(_reads_of(fdef, var, op, side, path,
+                                   fdef.name))
+        # child side: a dispatch-table handler's header param
+        op = handler_funcs.get(fdef.name)
+        if op is not None:
+            args = [a.arg for a in fdef.args.args]
+            hdr = ("header" if "header" in args
+                   else args[1] if len(args) > 1 else None)
+            if hdr:
+                reads.extend(_reads_of(fdef, hdr, op, "request",
+                                       path, fdef.name))
+            for node in ast.walk(fdef):
+                if not isinstance(node, ast.Return) \
+                        or node.value is None:
+                    continue
+                d = node.value
+                if isinstance(d, ast.Tuple) and d.elts:
+                    d = d.elts[0]
+                if isinstance(d, ast.Dict):
+                    ks = tuple(s for s in (
+                        _const_str(k) for k in d.keys
+                        if k is not None) if s is not None)
+                    literals.append(_ReplyLiteral(
+                        op=op, keys=ks, path=path, line=d.lineno,
+                        col=d.col_offset, func=fdef.name))
+    return reads, literals
+
+
+# ---------------------------------------------------------------------------
+# APX901 — explicit, registry-routed deadlines
+# ---------------------------------------------------------------------------
+
+def _timeout_findings(tree: ast.Module, path: str,
+                      owner: Dict[ast.AST, str],
+                      emit) -> None:
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        tail = node.func.attr
+        fn = owner.get(node, "<module>")
+        if tail == "settimeout" and node.args \
+                and _is_num(node.args[0]):
+            emit("APX901", node.lineno, node.col_offset,
+                 f"settimeout with the literal deadline "
+                 f"{node.args[0].value!r} — route it through the "
+                 f"registry's timeout class (a configured "
+                 f"*_TIMEOUT_S value)", f"{fn}.settimeout")
+        elif tail in ("call", "post") and node.args \
+                and _const_str(node.args[0]) is not None:
+            op = _const_str(node.args[0])
+            timeout = _kwarg(node, "timeout", "timeout_s")
+            if timeout is None:
+                emit("APX901", node.lineno, node.col_offset,
+                     f"{tail}({op!r}) without an explicit timeout= "
+                     f"— every RPC carries its op's deadline",
+                     f"{fn}.{op}")
+            elif _is_num(timeout):
+                emit("APX901", node.lineno, node.col_offset,
+                     f"{tail}({op!r}) with the literal deadline "
+                     f"{timeout.value!r} — route it through the "
+                     f"registry's timeout class", f"{fn}.{op}")
+        elif tail == "wait" and node.args:
+            timeout = _kwarg(node, "timeout", "timeout_s")
+            if timeout is None:
+                emit("APX901", node.lineno, node.col_offset,
+                     "wait() without an explicit timeout= — a lost "
+                     "reply must surface as RpcTimeout, not a hang",
+                     f"{fn}.wait")
+            elif _is_num(timeout):
+                emit("APX901", node.lineno, node.col_offset,
+                     f"wait() with the literal deadline "
+                     f"{timeout.value!r} — route it through the "
+                     f"registry's timeout class", f"{fn}.wait")
+
+
+# ---------------------------------------------------------------------------
+# APX904 — resource lifecycle
+# ---------------------------------------------------------------------------
+
+def _releases(node: ast.AST, var: str) -> bool:
+    """Does ``node``'s subtree release ``var``?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in _RELEASE_ATTRS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == var):
+                return True
+            if _tail(f) in _RELEASE_FUNCS and any(
+                    isinstance(a, ast.Name) and a.id == var
+                    for a in n.args):
+                return True
+        elif isinstance(n, ast.withitem):
+            for m in ast.walk(n.context_expr):
+                if isinstance(m, ast.Name) and m.id == var:
+                    return True
+    return False
+
+
+def _transfers(fdef: ast.AST, var: str) -> bool:
+    """Ownership leaves the function: returned, stored on an object
+    attribute, or appended to a container."""
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Return) and n.value is not None:
+            for m in ast.walk(n.value):
+                if isinstance(m, ast.Name) and m.id == var:
+                    return True
+        elif isinstance(n, ast.Assign):
+            if any(isinstance(t, ast.Attribute)
+                   for t in n.targets) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == var:
+                return True
+        elif isinstance(n, ast.Call):
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "append"
+                    and any(isinstance(a, ast.Name) and a.id == var
+                            for a in n.args)):
+                return True
+    return False
+
+
+def _stmt_frames(fdef: ast.AST):
+    """Every (statement, owning body list, index, parent statement)
+    in the function, parents first."""
+    frames = []
+
+    def visit(stmt_list, parent):
+        for i, s in enumerate(stmt_list):
+            frames.append((s, stmt_list, i, parent))
+            for name in ("body", "orelse", "finalbody"):
+                visit(getattr(s, name, []) or [], s)
+            for h in getattr(s, "handlers", []) or []:
+                visit(h.body, s)
+
+    visit(getattr(fdef, "body", []), None)
+    return frames
+
+
+def _is_protection(stmt: ast.AST, var: str) -> bool:
+    if isinstance(stmt, ast.Try):
+        if any(_releases(h, var) for h in stmt.handlers) \
+                or (stmt.finalbody
+                    and any(_releases(s, var)
+                            for s in stmt.finalbody)):
+            return True
+        return False
+    if isinstance(stmt, ast.Assign):
+        return (any(isinstance(t, ast.Attribute)
+                    for t in stmt.targets)
+                and isinstance(stmt.value, ast.Name)
+                and stmt.value.id == var)
+    if isinstance(stmt, ast.Return):
+        return (stmt.value is not None and any(
+            isinstance(m, ast.Name) and m.id == var
+            for m in ast.walk(stmt.value)))
+    if isinstance(stmt, ast.With):
+        return any(_releases(w, var) for w in stmt.items)
+    if isinstance(stmt, ast.Expr):
+        return _releases(stmt, var)
+    return False
+
+
+def _lifecycle_findings(tree: ast.Module, path: str, emit) -> None:
+    for fdef in _func_defs(tree):
+        frames = _stmt_frames(fdef)
+        by_stmt = {id(s): (lst, i, parent)
+                   for s, lst, i, parent in frames}
+        for stmt, lst, i, parent in frames:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = stmt.value
+            if not (isinstance(value, ast.Call)
+                    and _tail(value.func) in _ACQUIRE_TAILS):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            tgt = targets[0]
+            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                tgt = tgt.elts[0]
+            if isinstance(tgt, ast.Attribute):
+                continue              # self.x = acquire(): owned
+            if not isinstance(tgt, ast.Name):
+                continue
+            var = tgt.id
+            kind = _tail(value.func)
+            released = _releases(fdef, var)
+            transferred = _transfers(fdef, var)
+            if not released and not transferred:
+                emit("APX904", stmt.lineno, stmt.col_offset,
+                     f"{kind}() acquired into {var!r} and never "
+                     f"released — close it in a finally / context "
+                     f"manager / on the error path",
+                     f"{fdef.name}.{var}")
+                continue
+            # guaranteed-on-all-paths check: an enclosing try whose
+            # finally/handler releases it, or the very next
+            # statement protects/transfers — anything between the
+            # acquire and the protection can raise and leak
+            enclosed = False
+            node, owner_stmt = stmt, parent
+            while owner_stmt is not None:
+                if isinstance(owner_stmt, ast.Try) \
+                        and _is_protection(owner_stmt, var):
+                    enclosed = True
+                    break
+                node = owner_stmt
+                owner_stmt = by_stmt.get(id(owner_stmt),
+                                         (None, 0, None))[2]
+            if enclosed:
+                continue
+            cur, cur_list, cur_i = stmt, lst, i
+            protected = False
+            while True:
+                if cur_i + 1 < len(cur_list):
+                    protected = _is_protection(
+                        cur_list[cur_i + 1], var)
+                    break
+                up = by_stmt.get(id(cur), (None, 0, None))[2]
+                if up is None:
+                    break
+                up_list, up_i, _ = by_stmt.get(
+                    id(up), (None, 0, None))
+                if up_list is None:
+                    break
+                cur, cur_list, cur_i = up, up_list, up_i
+            if not protected:
+                emit("APX904", stmt.lineno, stmt.col_offset,
+                     f"{kind}() acquired into {var!r} without "
+                     f"guaranteed release on all paths — wrap the "
+                     f"statements between the acquisition and its "
+                     f"release/handoff in try/finally (or close on "
+                     f"the error path)", f"{fdef.name}.{var}")
+        # SIGKILL without a reap
+        for node in ast.walk(fdef):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "kill"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"
+                    and len(node.args) >= 2
+                    and _tail(node.args[1]) == "SIGKILL"):
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Call) \
+                    and _tail(target.func) == "getpid":
+                continue              # killing yourself: no reap
+            joins = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join"
+                for n in ast.walk(fdef))
+            if not joins:
+                emit("APX904", node.lineno, node.col_offset,
+                     "os.kill(pid, SIGKILL) with no join in the "
+                     "same function — SIGKILLed children must be "
+                     "reaped, not left as zombies",
+                     f"{fdef.name}.sigkill")
+
+
+# ---------------------------------------------------------------------------
+# APX905 — retry loops
+# ---------------------------------------------------------------------------
+
+def _catches_retryable(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    if not any(_tail(x) in _RETRYABLE_ERRORS for x in types):
+        return False
+    # only a handler that SWALLOWS the error re-enters the loop — a
+    # handler whose last statement unconditionally raises/returns/
+    # breaks is translation or escape, not retry
+    last = handler.body[-1] if handler.body else None
+    return not isinstance(last, (ast.Raise, ast.Return, ast.Break))
+
+
+def _retry_findings(tree: ast.Module, path: str,
+                    owner: Dict[ast.AST, str], emit) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.While):
+            bounded_by_shape = False
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, ast.Call) \
+                and _tail(node.iter.func) == "range":
+            bounded_by_shape = True
+        else:
+            continue
+        retryish = any(
+            isinstance(n, ast.Try)
+            and any(_catches_retryable(h) for h in n.handlers)
+            for n in ast.walk(node))
+        if not retryish:
+            continue
+        fn = owner.get(node, "<module>")
+        bounded = bounded_by_shape or any(
+            isinstance(n, (ast.Raise, ast.Break))
+            for n in ast.walk(node))
+        backoff = any(
+            isinstance(n, ast.Call) and (
+                (_tail(n.func) or "") in _BACKOFF_TAILS
+                or "restart" in (_tail(n.func) or "")
+                or "backoff" in (_tail(n.func) or ""))
+            for n in ast.walk(node))
+        if not bounded:
+            emit("APX905", node.lineno, node.col_offset,
+                 "retry loop without a bound — a wedged peer spins "
+                 "this forever; count attempts or raise past a "
+                 "deadline", f"{fn}.retry_bound")
+        if not backoff:
+            emit("APX905", node.lineno, node.col_offset,
+                 "retry loop without backoff — re-sending at full "
+                 "rate hammers a struggling peer; sleep a "
+                 "backoff_delay (or escalate through a restart "
+                 "path, which backs off internally)",
+                 f"{fn}.retry_backoff")
+
+
+# ---------------------------------------------------------------------------
+# per-module collection + cross-module drift
+# ---------------------------------------------------------------------------
+
+def _collect_module(source: str, path: str) -> Optional[_ModuleInfo]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None                   # the main linter owns APX000
+    info = _ModuleInfo(path=path)
+    info.suppressed, _ = _suppressions(source, path)
+
+    def emit(rule: str, line: int, col: int, message: str,
+             symbol: str) -> None:
+        if rule in info.suppressed.get(line, ()):
+            return
+        info.findings.append(Finding(
+            path=path, line=line, col=col, rule=rule,
+            severity="error", message=message, symbol=symbol))
+
+    owner = _enclosing_funcs(tree)
+    info.spec = _extract_spec(tree, path)
+    info.senders = _collect_senders(tree, path, owner)
+    info.handlers, info.handler_funcs = _collect_handlers(
+        tree, path, owner)
+    info.reads, info.reply_literals = _collect_reads(
+        tree, path, info.handler_funcs)
+    if _speaks_protocol(tree):
+        _timeout_findings(tree, path, owner, emit)
+    _lifecycle_findings(tree, path, emit)
+    _retry_findings(tree, path, owner, emit)
+    return info
+
+
+def _drift_findings(modules: Sequence[_ModuleInfo]) -> List[Finding]:
+    spec: Dict[str, _OpSpec] = {}
+    for m in modules:
+        for op, s in m.spec.items():
+            spec.setdefault(op, s)
+    if not spec:
+        return []                     # no registry in scope: no drift
+    out: List[Finding] = []
+    sup = {m.path: m.suppressed for m in modules}
+
+    def emit(path: str, line: int, col: int, rule: str,
+             message: str, symbol: str) -> None:
+        if rule in sup.get(path, {}).get(line, ()):
+            return
+        out.append(Finding(path=path, line=line, col=col, rule=rule,
+                           severity="error", message=message,
+                           symbol=symbol))
+
+    senders = [s for m in modules for s in m.senders]
+    handlers = [h for m in modules for h in m.handlers]
+    parent_sent = {s.op for s in senders if s.direction == "parent"}
+    child_sent = {s.op for s in senders if s.direction == "child"}
+    handled = {h.op for h in handlers}
+    p2c = {op for op, s in spec.items()
+           if s.direction == "parent_to_child"}
+    c2p = {op for op, s in spec.items()
+           if s.direction == "child_to_parent"}
+
+    # APX902: op drift
+    for s in senders:
+        if s.direction == "parent" and s.op not in spec:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"op {s.op!r} sent but not declared in the "
+                 f"ProtocolSpec registry", f"{s.func}.{s.op}.sent")
+        elif s.direction == "parent" and handlers \
+                and s.op not in handled:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"op {s.op!r} sent but no receiving dispatch "
+                 f"handles it — the child will answer with an "
+                 f"unknown-op error",
+                 f"{s.func}.{s.op}.unhandled")
+        elif s.direction == "child" and s.op not in spec:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"child sends op {s.op!r} the ProtocolSpec "
+                 f"registry never declared",
+                 f"{s.func}.{s.op}.sent")
+    for h in handlers:
+        if h.op not in spec:
+            emit(h.path, h.line, h.col, "APX902",
+                 f"handler for op {h.op!r} not declared in the "
+                 f"ProtocolSpec registry", f"handler.{h.op}.spec")
+        elif senders and h.op in p2c and h.op not in parent_sent:
+            emit(h.path, h.line, h.col, "APX902",
+                 f"dead branch: handler for op {h.op!r} that no "
+                 f"sender emits", f"handler.{h.op}.dead")
+    for op in sorted(p2c):
+        s = spec[op]
+        if handlers and op not in handled:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"op {op!r} declared but no dispatch handles it",
+                 f"spec.{op}.unhandled")
+        if senders and op not in parent_sent:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"op {op!r} declared but no sender emits it",
+                 f"spec.{op}.unsent")
+    for op in sorted(c2p):
+        s = spec[op]
+        if senders and op not in child_sent:
+            emit(s.path, s.line, s.col, "APX902",
+                 f"child->parent op {op!r} declared but never "
+                 f"sent", f"spec.{op}.unsent")
+
+    # APX903: header-field drift + blob shape
+    for s in senders:
+        sp = spec.get(s.op)
+        if sp is None or s.keys is None:
+            continue
+        declared = sp.request_fields
+        for field in s.keys:
+            if field not in declared:
+                emit(s.path, s.line, s.col, "APX903",
+                     f"sender sets header field {field!r} the "
+                     f"{s.op!r} spec doesn't declare",
+                     f"{s.func}.{s.op}.{field}")
+        if s.complete and s.direction == "parent":
+            for field in sp.required:
+                if field not in s.keys:
+                    emit(s.path, s.line, s.col, "APX903",
+                         f"sender omits required {s.op!r} header "
+                         f"field {field!r}",
+                         f"{s.func}.{s.op}.missing.{field}")
+        if s.direction == "parent" and s.has_blobs \
+                and not sp.request_blobs:
+            emit(s.path, s.line, s.col, "APX903",
+                 f"op {s.op!r} sent with binary blobs but its spec "
+                 f"declares none", f"{s.func}.{s.op}.blobs")
+    for m in modules:
+        for r in m.reads:
+            sp = spec.get(r.op)
+            if sp is None:
+                continue
+            declared = (sp.request_fields if r.side == "request"
+                        else sp.reply_fields)
+            if r.field not in declared:
+                emit(r.path, r.line, r.col, "APX903",
+                     f"receiver reads {r.side} field {r.field!r} "
+                     f"the {r.op!r} spec doesn't declare — the "
+                     f"KeyError-at-3am class",
+                     f"{r.func}.{r.op}.{r.field}")
+        for lit in m.reply_literals:
+            sp = spec.get(lit.op)
+            if sp is None:
+                continue
+            for field in lit.keys:
+                if field not in sp.reply_fields:
+                    emit(lit.path, lit.line, lit.col, "APX903",
+                         f"handler replies with field {field!r} "
+                         f"the {lit.op!r} spec doesn't declare",
+                         f"{lit.func}.{lit.op}.{field}")
+
+    # APX905 (spec half): retries on a non-idempotent op
+    for s in senders:
+        sp = spec.get(s.op)
+        if sp is not None and s.retries_nonzero \
+                and not sp.idempotent:
+            f = Finding(
+                path=s.path, line=s.line, col=s.col, rule="APX905",
+                severity="error",
+                message=(f"op {s.op!r} is retried but its spec is "
+                         f"not marked idempotent — a blind re-send "
+                         f"can double-apply work; escalate to "
+                         f"restart + journal replay instead"),
+                symbol=f"{s.func}.{s.op}.retries")
+            if "APX905" not in sup.get(s.path, {}).get(s.line, ()):
+                out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def lint_protocol_source(source: str, path: str) -> List[Finding]:
+    """Audit ONE module (fixture/test surface): the per-file rules
+    plus whatever drift is provable against a ``ProtocolSpec``
+    registry defined in the same source."""
+    info = _collect_module(source, path)
+    if info is None:
+        return []
+    return info.findings + _drift_findings([info])
+
+
+def _scan_roots(repo: Path, package_root: str) -> List[Path]:
+    return [repo / package_root / tree
+            for tree in PROTOCOL_SCAN_TREES]
+
+
+def lint_protocol_paths(package_root: str = "apex_tpu", *,
+                        repo_root: str = ".",
+                        paths: Optional[Sequence[str]] = None
+                        ) -> Tuple[List[Finding], int]:
+    """Audit the protocol trees (``serving/`` + ``resilience/``
+    under ``package_root``).  Op/field drift aggregates across every
+    scanned module before judgment — no single file has to show both
+    sides.  ``paths`` restricts to the named repo-relative files
+    (the ``--check --paths`` fast path): each named file in scope
+    gets the per-file rules, and drift is judged only against specs
+    visible in the named set (a partial view proves presence, never
+    absence).  Returns ``(findings, declared_op_count)``."""
+    repo = Path(repo_root).resolve()
+    scope = [(repo / package_root / t).resolve()
+             for t in PROTOCOL_SCAN_TREES]
+
+    def in_scope(p: Path) -> bool:
+        rp = p.resolve()
+        return any(rp == s or s in rp.parents for s in scope)
+
+    files: List[Path] = []
+    if paths is not None:
+        for name in paths:
+            p = repo / name
+            if p.exists() and p.suffix == ".py" and in_scope(p):
+                files.append(p)
+    else:
+        for root in scope:
+            if root.exists():
+                files.extend(_iter_py(root))
+    modules: List[_ModuleInfo] = []
+    for p in files:
+        rel = p.resolve().relative_to(repo).as_posix()
+        info = _collect_module(p.read_text(), rel)
+        if info is not None:
+            modules.append(info)
+    findings = [f for m in modules for f in m.findings]
+    findings.extend(_drift_findings(modules))
+    n_ops = len({op for m in modules for op in m.spec})
+    return findings, n_ops
+
+
+def run_protocol_check(package_root: str = "apex_tpu", *,
+                       baseline: str = DEFAULT_BASELINE,
+                       repo_root: str = "."
+                       ) -> Tuple[List[Finding], List[str], int]:
+    """(unsuppressed findings, stale baseline keys, declared ops) —
+    the ``--check-protocol`` body, with the linter baseline's
+    semantics: a baseline entry whose finding no longer fires is
+    stale and fails until deleted (baselines only shrink)."""
+    findings, n_ops = lint_protocol_paths(package_root,
+                                          repo_root=repo_root)
+    base = load_baseline(baseline, repo_root=repo_root)
+    live = {f.key for f in findings}
+    unsuppressed = [f for f in findings if f.key not in base]
+    stale = [k for k in base if k not in live]
+    return unsuppressed, stale, n_ops
+
+
+_PROTO_BASELINE_HEADER = (
+    "# apex_tpu.analysis.protocol baseline — APX9xx findings",
+    "# accepted with a reason.  New findings do NOT belong here:",
+    "# fix them or suppress inline with '# apex-lint: disable=...'.",
+    "# Committed EMPTY: every finding at introduction was fixed.",
+    "# Format: <path>:<rule>:<symbol>  # <reason>",
+)
+
+
+def write_protocol_baseline(findings: Sequence[Finding],
+                            path: str = DEFAULT_BASELINE, *,
+                            repo_root: str = ".") -> None:
+    write_baseline(findings, path, repo_root=repo_root,
+                   header=_PROTO_BASELINE_HEADER)

@@ -26,6 +26,12 @@ Layers:
   (:mod:`.concurrency`): lock discipline, lock-order cycles,
   signal-handler safety, blocking-under-lock, and off-main-thread
   device dispatch over the threaded serving/monitor host layer.
+* ``protocol`` — the wire-protocol + resource-lifecycle auditor
+  (:mod:`.protocol`): audits ``serving/`` + ``resilience/`` against
+  the declared ``ProtocolSpec`` registry in
+  ``serving/control_plane.py`` — deadline discipline, op and
+  header-field drift matched across the parent/child modules,
+  socket/subprocess/tempdir lifecycle, and retry-safety.
 
 Import-light on purpose (stdlib only), like :mod:`.flags`.
 """
@@ -52,7 +58,7 @@ RULES: Dict[str, Rule] = {}
 
 def register_rule(id: str, layer: str, scope: str, doc: str) -> Rule:
     if layer not in ("source", "kernel", "compiled", "sharding",
-                     "concurrency"):
+                     "concurrency", "protocol"):
         raise ValueError(f"unknown rule layer {layer!r}")
     if id in RULES:
         raise ValueError(f"duplicate rule registration: {id}")
@@ -225,3 +231,47 @@ register_rule(
 register_rule(
     "APX900", "source", "everywhere",
     "suppression comment without a reason")
+register_rule(
+    "APX901", "protocol", "serving/ + resilience/",
+    "RPC send/recv without an explicit deadline, or with a numeric "
+    "literal one: `.call(op)`/`.post(op)`/`.wait(seq)` missing "
+    "`timeout=`, or any of them (and `.settimeout`) passing a "
+    "literal instead of a value routed through the ProtocolSpec "
+    "registry's timeout class (`_op_timeout` / the "
+    "`APEX_TPU_CP_*_TIMEOUT_S` flags); applies to modules that "
+    "define or import the control-plane surface")
+register_rule(
+    "APX902", "protocol", "serving/ + resilience/ (cross-module)",
+    "op drift, matched across every scanned module: an op sent "
+    "(`.call`/`.post` constant, or a child->parent `send_frame` "
+    "header literal) that no dispatch handles; a handler "
+    "(`*_HANDLERS` key or `op == ...` compare) for an op no sender "
+    "emits — the dead branch; either side using an op the "
+    "ProtocolSpec registry never declared; a declared op with no "
+    "sender or no handler")
+register_rule(
+    "APX903", "protocol", "serving/ + resilience/ (cross-module)",
+    "header-field drift against the op's ProtocolSpec: a sender "
+    "header literal carrying an undeclared field or omitting a "
+    "required one; a receiver `.get()`/index on a reply, handler "
+    "request header, or the hello handshake for an undeclared "
+    "field (the KeyError-at-3am class); a handler replying "
+    "off-spec fields; blobs passed on an op whose spec declares "
+    "none")
+register_rule(
+    "APX904", "protocol", "serving/ + resilience/",
+    "resource lifecycle: a socket / accepted conn / subprocess / "
+    "tempdir / journal sink acquired into a local without "
+    "guaranteed release on all paths (no release at all, or risky "
+    "statements between the acquisition and the try/with/ownership "
+    "transfer that protects it); and `os.kill(pid, SIGKILL)` in a "
+    "function with no `.join` — SIGKILLed children must be reaped "
+    "(self-kill via `os.getpid()` is exempt)")
+register_rule(
+    "APX905", "protocol", "serving/ + resilience/",
+    "retry-safety: `retries=` > 0 on an op whose ProtocolSpec is "
+    "not marked idempotent (a blind re-send can double-apply work "
+    "— escalate to restart + journal replay instead); and a retry "
+    "loop (a `while`/`for range` that swallows an RPC/OS error and "
+    "re-enters) without a bound or without backoff (a `*restart*` "
+    "escalation counts: it backs off internally)")

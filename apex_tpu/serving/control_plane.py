@@ -79,10 +79,11 @@ from .resilience import RequestJournal
 logger = get_logger(__name__)
 
 __all__ = [
-    "AutoscalePolicy", "EngineSpec", "FleetGiveUp", "ProcessFleet",
-    "ProcessFleetSummary", "QoSClass", "QoSPolicy", "ReplicaProcess",
-    "RpcError", "RpcRemoteError", "RpcTimeout", "ReplicaDead",
-    "fleet_rows_digest", "recv_frame", "send_frame",
+    "AutoscalePolicy", "EngineSpec", "FleetGiveUp", "FrameError",
+    "PROTOCOL", "ProcessFleet", "ProcessFleetSummary",
+    "ProtocolSpec", "ProtocolViolation", "QoSClass", "QoSPolicy",
+    "ReplicaProcess", "RpcError", "RpcRemoteError", "RpcTimeout",
+    "ReplicaDead", "fleet_rows_digest", "recv_frame", "send_frame",
 ]
 
 # disaggregated prefill probes ride the normal request path under this
@@ -120,6 +121,22 @@ class RpcRemoteError(RpcError):
     """The child executed the op and reported a Python-level error.
     The connection is still healthy — this is a REQUEST-level failure
     (e.g. an engine admission reject), not a replica failure."""
+
+
+class FrameError(RpcError):
+    """The length prefix was honest but the header inside it was not
+    JSON (or not a JSON object).  Crucially the stream is still
+    FRAME-ALIGNED — exactly the declared bytes were consumed — so a
+    receiver may answer with a structured error frame and keep
+    serving instead of tearing the socket down."""
+
+
+class ProtocolViolation(RpcError):
+    """A frame that decodes fine but violates :data:`PROTOCOL`: an
+    op nobody declared, a missing required header field, or a retry
+    requested for a non-idempotent op.  Raised on the side that can
+    see the violation — locally before a send, or remotely as a
+    structured error reply."""
 
 
 def send_frame(sock: socket.socket, header: Dict[str, Any],
@@ -167,7 +184,11 @@ def recv_frame(sock: socket.socket
                ) -> Tuple[Dict[str, Any], List[bytes]]:
     """Receive one frame; returns ``(header, blobs)``.  Raises
     :class:`RpcTimeout` on the socket timeout, :class:`ReplicaDead`
-    on EOF/reset, :class:`RpcError` on a malformed frame."""
+    on EOF/reset, :class:`FrameError` on an undecodable header (the
+    stream stays frame-aligned — the worker loop answers and keeps
+    serving), and plain :class:`RpcError` when the framing itself is
+    untrustworthy (corrupt length prefix, junk blob lengths — the
+    only cure is a new socket)."""
     (n,) = struct.unpack(">I", _recv_exact(sock, 4))
     if n > MAX_HEADER_BYTES:
         raise RpcError(f"frame header of {n} bytes exceeds "
@@ -175,13 +196,108 @@ def recv_frame(sock: socket.socket
     try:
         header = json.loads(_recv_exact(sock, n).decode())
     except ValueError as e:
-        raise RpcError(f"malformed frame header: {e}") from e
+        raise FrameError(f"malformed frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise FrameError(f"frame header is "
+                         f"{type(header).__name__}, not an object")
     blobs = []
-    for m in header.get("blobs", []):
-        if not 0 <= int(m) <= MAX_BLOB_BYTES:
-            raise RpcError(f"blob length {m} out of range")
-        blobs.append(_recv_exact(sock, int(m)))
+    lengths = header.get("blobs", [])
+    if not isinstance(lengths, list):
+        raise RpcError(f"blob lengths {lengths!r} are not a list")
+    for m in lengths:
+        if not isinstance(m, int) or not 0 <= m <= MAX_BLOB_BYTES:
+            raise RpcError(f"blob length {m!r} out of range")
+        blobs.append(_recv_exact(sock, m))
     return header, blobs
+
+
+# ---------------------------------------------------------------------------
+# The protocol, as data: every op both sides are generated from
+# ---------------------------------------------------------------------------
+
+#: Header fields the FRAMING layer owns on every message — senders
+#: may always set them, receivers may always read them, and no
+#: :class:`ProtocolSpec` re-declares them: ``op``/``seq`` address the
+#: frame, ``blobs`` carries the binary lengths (``send_frame`` adds
+#: it), ``error``/``message`` are the structured error-reply shape.
+FRAME_FIELDS = ("op", "seq", "blobs", "error", "message")
+
+#: The timeout classes call sites must route through (never literal
+#: floats): ``rpc`` = APEX_TPU_CP_RPC_TIMEOUT_S, ``poll`` =
+#: APEX_TPU_CP_POLL_TIMEOUT_S, ``spawn`` = APEX_TPU_CP_SPAWN_TIMEOUT_S.
+TIMEOUT_CLASSES = ("rpc", "poll", "spawn")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One wire op's declared contract.  The child's dispatch table,
+    the parent's retry policy, and the per-op timeouts are all
+    derived from these — and ``apex_tpu.analysis.protocol`` audits
+    both sides against them statically (APX901–APX905).
+
+    ``required``/``optional`` are the request header fields beyond
+    :data:`FRAME_FIELDS`; ``reply`` the success-reply fields.
+    ``request_blobs``/``reply_blobs`` declare which direction may
+    carry binary payloads.  ``idempotent`` gates in-place retry:
+    a non-idempotent op never re-sends — it escalates to
+    SIGKILL + respawn + journal replay."""
+
+    op: str
+    direction: str = "parent_to_child"
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    reply: Tuple[str, ...] = ()
+    request_blobs: bool = False
+    reply_blobs: bool = False
+    timeout_class: str = "rpc"
+    idempotent: bool = False
+
+    def __post_init__(self):
+        if self.direction not in ("parent_to_child",
+                                  "child_to_parent"):
+            raise ValueError(f"bad direction {self.direction!r}")
+        if self.timeout_class not in TIMEOUT_CLASSES:
+            raise ValueError(
+                f"timeout class {self.timeout_class!r} not in "
+                f"{TIMEOUT_CLASSES}")
+        clash = (set(self.required) | set(self.optional)
+                 | set(self.reply)) & set(FRAME_FIELDS)
+        if clash:
+            raise ValueError(f"op {self.op!r} re-declares framing "
+                             f"field(s) {sorted(clash)}")
+
+
+#: op name → spec.  THE registry: ``_OP_HANDLERS`` must cover exactly
+#: the parent→child ops here (checked at import), ``ReplicaProcess``
+#: refuses undeclared ops and non-idempotent retries, and
+#: ``ProcessFleet`` maps ``timeout_class``/``idempotent`` to its
+#: configured deadlines and retry budgets.
+PROTOCOL: Dict[str, ProtocolSpec] = {s.op: s for s in (
+    ProtocolSpec("hello", direction="child_to_parent",
+                 required=("rid", "pid"),
+                 optional=("replayed", "tick", "block_size"),
+                 timeout_class="spawn"),
+    ProtocolSpec("snapshot", reply=("snapshot",),
+                 timeout_class="poll", idempotent=True),
+    ProtocolSpec("tick", reply=("tick", "busy", "finished")),
+    ProtocolSpec("submit", required=("req",), reply=("ok",)),
+    ProtocolSpec("gather_kv", required=("prompt",),
+                 reply=("resident", "names", "shapes", "dtypes",
+                        "geometry"),
+                 reply_blobs=True, idempotent=True),
+    ProtocolSpec("scatter_kv",
+                 required=("names", "shapes", "dtypes", "prompt",
+                           "n"),
+                 optional=("geometry",), reply=("landed",),
+                 request_blobs=True),
+    ProtocolSpec("run", reply=("summary", "finished", "busy"),
+                 timeout_class="spawn"),
+    ProtocolSpec("summary",
+                 reply=("summary", "digest", "rows", "replayed",
+                        "tick"),
+                 idempotent=True),
+    ProtocolSpec("shutdown", idempotent=True),
+)}
 
 
 # ---------------------------------------------------------------------------
@@ -199,7 +315,13 @@ class EngineSpec:
     kwargs verbatim.  ``fault`` is a child-side injector spec string
     (``kill9@K`` etc.) fired at the engine's tick boundaries;
     ``replay`` makes the fresh process re-enter its journal's open
-    rids before serving (the crash-recovery spawn)."""
+    rids before serving (the crash-recovery spawn).
+    ``connect_timeout_s`` is how long the child keeps retrying its
+    rendezvous connect — :meth:`ReplicaProcess.begin_spawn` stamps
+    it with the SAME ``spawn_timeout_s`` deadline the listener
+    honors, so the two sides of the handshake can never race two
+    different clocks (None falls back to the registered
+    ``APEX_TPU_CP_CONNECT_TIMEOUT_S`` flag)."""
 
     replica_id: str
     role: str = "serve"                   # 'serve' | 'prefill'
@@ -212,6 +334,7 @@ class EngineSpec:
     metrics_port: Optional[int] = None
     fault: Optional[str] = None
     replay: bool = False
+    connect_timeout_s: Optional[float] = None
 
     def __post_init__(self):
         if self.role not in ("serve", "prefill"):
@@ -384,8 +507,7 @@ def _np_dtype(name: str):
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def _connect_child(path: str, timeout_s: float = 30.0
-                   ) -> socket.socket:
+def _connect_child(path: str, timeout_s: float) -> socket.socket:
     deadline = time.monotonic() + timeout_s
     attempt = 0
     while True:
@@ -478,7 +600,9 @@ def _worker_publish(state: _WorkerState, tick: int) -> None:
                        str(e)[:160])
 
 
-def _op_snapshot(state: _WorkerState) -> Dict[str, Any]:
+def _op_snapshot(state: _WorkerState, header: Dict[str, Any],
+                 blobs: List[bytes]
+                 ) -> Tuple[Dict[str, Any], List[bytes]]:
     snap = dict(state.engine.router_snapshot())
     # chain keys are bytes digests; hex them for the JSON header
     snap["warm_prefix_keys"] = [k.hex()
@@ -486,25 +610,29 @@ def _op_snapshot(state: _WorkerState) -> Dict[str, Any]:
     e = state.engine
     snap["busy"] = bool(e.queue or e.active or e.prefilling)
     _worker_publish(state, e.steps)
-    return snap
+    return {"snapshot": snap}, []
 
 
-def _op_tick(state: _WorkerState) -> Dict[str, Any]:
+def _op_tick(state: _WorkerState, header: Dict[str, Any],
+             blobs: List[bytes]
+             ) -> Tuple[Dict[str, Any], List[bytes]]:
     e = state.engine
     if state.fault is not None:
         state.fault.before_tick(e.steps,
                                 journal_path=state.spec.journal_path)
     if e.queue or e.active or e.prefilling:
         e.step()
-    return {"tick": e.steps,
-            "busy": bool(e.queue or e.active or e.prefilling),
-            "finished": state.new_finished()}
+    return ({"tick": e.steps,
+             "busy": bool(e.queue or e.active or e.prefilling),
+             "finished": state.new_finished()}, [])
 
 
-def _op_submit(state: _WorkerState, req: Dict[str, Any]
-               ) -> Dict[str, Any]:
+def _op_submit(state: _WorkerState, header: Dict[str, Any],
+               blobs: List[bytes]
+               ) -> Tuple[Dict[str, Any], List[bytes]]:
     from .engine import Request
 
+    req = header["req"]
     state.engine.submit(Request(
         rid=str(req["rid"]),
         prompt=[int(t) for t in req["prompt"]],
@@ -512,15 +640,16 @@ def _op_submit(state: _WorkerState, req: Dict[str, Any]
         eos_token=req.get("eos_token"),
         deadline_ms=req.get("deadline_ms"),
         priority=int(req.get("priority", 0) or 0)))
-    return {"ok": 1}
+    return {"ok": 1}, []
 
 
-def _op_gather_kv(state: _WorkerState, prompt: List[int]
+def _op_gather_kv(state: _WorkerState, header: Dict[str, Any],
+                  blobs: List[bytes]
                   ) -> Tuple[Dict[str, Any], List[bytes]]:
     from .fleet import _geometry_key, export_prefix_payload
 
-    out = export_prefix_payload(state.engine,
-                                [int(t) for t in prompt])
+    out = export_prefix_payload(
+        state.engine, [int(t) for t in header["prompt"]])
     if out is None:
         return {"resident": -1}, []
     n, arrays = out
@@ -534,7 +663,8 @@ def _op_gather_kv(state: _WorkerState, prompt: List[int]
 
 
 def _op_scatter_kv(state: _WorkerState, header: Dict[str, Any],
-                   blobs: List[bytes]) -> Dict[str, Any]:
+                   blobs: List[bytes]
+                   ) -> Tuple[Dict[str, Any], List[bytes]]:
     import numpy as np
 
     from .fleet import _geometry_key, import_prefix_payload
@@ -553,10 +683,12 @@ def _op_scatter_kv(state: _WorkerState, header: Dict[str, Any],
     landed = import_prefix_payload(
         state.engine, [int(t) for t in header["prompt"]],
         int(header["n"]), arrays)
-    return {"landed": int(landed)}
+    return {"landed": int(landed)}, []
 
 
-def _op_run(state: _WorkerState) -> Dict[str, Any]:
+def _op_run(state: _WorkerState, header: Dict[str, Any],
+            blobs: List[bytes]
+            ) -> Tuple[Dict[str, Any], List[bytes]]:
     e = state.engine
 
     def before_tick(tick):
@@ -566,18 +698,71 @@ def _op_run(state: _WorkerState) -> Dict[str, Any]:
 
     summary = e.run(before_tick=before_tick)
     _worker_publish(state, e.steps)
-    return {"summary": summary.as_dict(),
-            "finished": state.new_finished(),
-            "busy": bool(e.queue or e.active or e.prefilling)}
+    return ({"summary": summary.as_dict(),
+             "finished": state.new_finished(),
+             "busy": bool(e.queue or e.active or e.prefilling)}, [])
 
 
-def _op_summary(state: _WorkerState) -> Dict[str, Any]:
+def _op_summary(state: _WorkerState, header: Dict[str, Any],
+                blobs: List[bytes]
+                ) -> Tuple[Dict[str, Any], List[bytes]]:
     e = state.engine
-    return {"summary": e.summary().as_dict(),
-            "digest": e.tokens_digest(),
-            "rows": e.digest_rows(),
-            "replayed": state.replayed,
-            "tick": e.steps}
+    return ({"summary": e.summary().as_dict(),
+             "digest": e.tokens_digest(),
+             "rows": e.digest_rows(),
+             "replayed": state.replayed,
+             "tick": e.steps}, [])
+
+
+def _op_shutdown(state: _WorkerState, header: Dict[str, Any],
+                 blobs: List[bytes]
+                 ) -> Tuple[Dict[str, Any], List[bytes]]:
+    # the loop special-cases shutdown (reply THEN return); the
+    # handler exists so the dispatch table covers the whole registry
+    return {}, []
+
+
+#: The child dispatch, generated against :data:`PROTOCOL` — every
+#: parent→child op maps to one uniform ``(state, header, blobs) →
+#: (reply_fields, reply_blobs)`` handler.  ``_validate_protocol``
+#: fails the import on any drift between this table and the registry.
+_OP_HANDLERS: Dict[str, Callable[
+    [_WorkerState, Dict[str, Any], List[bytes]],
+    Tuple[Dict[str, Any], List[bytes]]]] = {
+    "snapshot": _op_snapshot,
+    "tick": _op_tick,
+    "submit": _op_submit,
+    "gather_kv": _op_gather_kv,
+    "scatter_kv": _op_scatter_kv,
+    "run": _op_run,
+    "summary": _op_summary,
+    "shutdown": _op_shutdown,
+}
+
+
+def _validate_protocol() -> None:
+    """Import-time drift check: the dispatch table and the declared
+    registry must cover exactly the same parent→child op set."""
+    declared = {op for op, s in PROTOCOL.items()
+                if s.direction == "parent_to_child"}
+    handled = set(_OP_HANDLERS)
+    if declared != handled:
+        raise AssertionError(
+            f"PROTOCOL/_OP_HANDLERS drift: declared-not-handled="
+            f"{sorted(declared - handled)} handled-not-declared="
+            f"{sorted(handled - declared)}")
+
+
+_validate_protocol()
+
+
+def _check_required(spec: ProtocolSpec,
+                    header: Dict[str, Any]) -> None:
+    missing = [f for f in spec.required if f not in header]
+    if missing:
+        raise ProtocolViolation(
+            f"op {spec.op!r} frame is missing required header "
+            f"field(s) {missing}")
 
 
 def _worker_loop(conn: socket.socket, state: _WorkerState) -> None:
@@ -588,33 +773,30 @@ def _worker_loop(conn: socket.socket, state: _WorkerState) -> None:
             header, blobs = recv_frame(conn)
         except ReplicaDead:
             return                      # supervisor went away
+        except FrameError as e:
+            # undecodable header inside an honest length prefix: the
+            # stream is still frame-aligned, so answer structurally
+            # and keep serving — never tear the socket on a request
+            # that merely failed to decode
+            logger.warning("worker dropped malformed frame: %s", e)
+            send_frame(conn, {"seq": None,
+                              "error": type(e).__name__,
+                              "message": str(e)[:500]})
+            continue
         op = header.get("op")
         seq = header.get("seq")
         reply: Dict[str, Any] = {"seq": seq}
         rblobs: List[bytes] = []
         try:
-            if op == "ping":
-                reply["tick"] = state.engine.steps
-            elif op == "tick":
-                reply.update(_op_tick(state))
-            elif op == "snapshot":
-                reply["snapshot"] = _op_snapshot(state)
-            elif op == "submit":
-                reply.update(_op_submit(state, header["req"]))
-            elif op == "gather_kv":
-                out, rblobs = _op_gather_kv(state, header["prompt"])
-                reply.update(out)
-            elif op == "scatter_kv":
-                reply.update(_op_scatter_kv(state, header, blobs))
-            elif op == "run":
-                reply.update(_op_run(state))
-            elif op == "summary":
-                reply.update(_op_summary(state))
-            elif op == "shutdown":
+            spec = PROTOCOL.get(op)
+            if spec is None or spec.direction != "parent_to_child":
+                raise ProtocolViolation(f"unknown op {op!r}")
+            _check_required(spec, header)
+            out, rblobs = _OP_HANDLERS[op](state, header, blobs)
+            reply.update(out)
+            if op == "shutdown":
                 send_frame(conn, reply)
                 return
-            else:
-                raise ValueError(f"unknown op {op!r}")
         except (InjectedFault, KeyboardInterrupt, SystemExit):
             # an injected crash kills the PROCESS — that is the
             # drill.  The socket dies with us; the supervisor's
@@ -638,7 +820,12 @@ def _worker_entry(spec_dict: Dict[str, Any],
     (jax import + warmup — the slow part the spawn timeout covers),
     then says hello and serves RPCs until shutdown or parent exit."""
     spec = EngineSpec.from_dict(spec_dict)
-    conn = _connect_child(socket_path)
+    # the connect deadline is the LISTENER's deadline (begin_spawn
+    # stamps spawn_timeout_s into the spec) — one clock, two sides
+    connect_timeout = (float(spec.connect_timeout_s)
+                       if spec.connect_timeout_s is not None
+                       else flag_float("APEX_TPU_CP_CONNECT_TIMEOUT_S"))
+    conn = _connect_child(socket_path, timeout_s=connect_timeout)
     try:
         try:
             state = _build_worker(spec)
@@ -733,23 +920,37 @@ class ReplicaProcess:
         with contextlib.suppress(OSError):
             os.unlink(path)
         lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        lst.bind(path)
-        lst.listen(1)
-        lst.settimeout(self.spawn_timeout_s)
-        # a respawn-for-replay strips the fault spec entirely:
-        # injected faults are once-per-serve by contract, and a fresh
-        # process's tick counter would otherwise re-reach K and
-        # re-fire forever (see faults.PROCESS_FATAL_KINDS)
-        spec = dataclasses.replace(
-            self.spec, replay=replay,
-            fault=None if replay else self.spec.fault)
-        ctx = mp.get_context("spawn")
-        self.proc = ctx.Process(
-            target=_worker_entry, args=(spec.as_dict(), path),
-            name=f"apex-replica-{self.spec.replica_id}", daemon=True)
-        self.proc.start()
+        try:
+            lst.bind(path)
+            lst.listen(1)
+            lst.settimeout(self.spawn_timeout_s)
+            spec = self._spawn_spec(replay)
+            ctx = mp.get_context("spawn")
+            self.proc = ctx.Process(
+                target=_worker_entry, args=(spec.as_dict(), path),
+                name=f"apex-replica-{self.spec.replica_id}",
+                daemon=True)
+            self.proc.start()
+        except BaseException:
+            # a failed bind/spawn must not leak the listener fd
+            lst.close()
+            raise
         self._listener = lst
         self._sock_path = path
+
+    def _spawn_spec(self, replay: bool) -> EngineSpec:
+        """The spec one incarnation actually receives: a
+        respawn-for-replay strips the fault spec entirely (injected
+        faults are once-per-serve by contract, and a fresh process's
+        tick counter would otherwise re-reach K and re-fire forever
+        — see faults.PROCESS_FATAL_KINDS), and the child's connect
+        deadline is stamped with the listener's own
+        ``spawn_timeout_s`` so both halves of the rendezvous run on
+        one clock."""
+        return dataclasses.replace(
+            self.spec, replay=replay,
+            fault=None if replay else self.spec.fault,
+            connect_timeout_s=self.spawn_timeout_s)
 
     def finish_spawn(self) -> Dict[str, Any]:
         """Phase 2: accept + hello.  Raises :class:`RpcError` when
@@ -767,8 +968,15 @@ class ReplicaProcess:
             lst.close()
             with contextlib.suppress(OSError):
                 os.unlink(path)
-        conn.settimeout(self.spawn_timeout_s)
-        hello, _ = recv_frame(conn)
+        try:
+            conn.settimeout(self.spawn_timeout_s)
+            hello, _ = recv_frame(conn)
+        except BaseException:
+            # a child that died mid-hello must not leak the accepted
+            # socket: close it, reap the corpse, then escalate
+            conn.close()
+            self.kill()
+            raise
         if hello.get("error"):
             conn.close()
             self.kill()
@@ -798,6 +1006,14 @@ class ReplicaProcess:
              timeout: float) -> int:
         """Send one request without waiting (the freerun fan-out);
         returns the sequence number for :meth:`wait`."""
+        spec = PROTOCOL.get(op)
+        if spec is None or spec.direction != "parent_to_child":
+            raise ProtocolViolation(
+                f"op {op!r} is not a declared parent->child op")
+        if blobs and not spec.request_blobs:
+            raise ProtocolViolation(
+                f"op {op!r} does not carry request blobs")
+        _check_required(spec, header or {})
         if self.conn is None:
             raise ReplicaDead(f"replica {self.replica_id} has no "
                               f"connection")
@@ -836,10 +1052,15 @@ class ReplicaProcess:
              retries: int = 0
              ) -> Tuple[Dict[str, Any], List[bytes]]:
         """One RPC with explicit timeout and bounded-backoff retry.
-        Retries re-SEND under a fresh seq (safe only for idempotent
-        ops — the callers pass ``retries=0`` for tick/submit/scatter
-        and escalate those to restart+replay instead, which the
-        journal makes exactly-once)."""
+        Retries re-SEND under a fresh seq — safe only for ops the
+        registry marks idempotent, and refused otherwise: the
+        callers escalate tick/submit/scatter to restart+replay
+        instead, which the journal makes exactly-once."""
+        spec = PROTOCOL.get(op)
+        if retries and spec is not None and not spec.idempotent:
+            raise ProtocolViolation(
+                f"op {op!r} is not idempotent — it may not retry "
+                f"in place (escalate to restart + journal replay)")
         last: Optional[RpcError] = None
         for attempt in range(int(retries) + 1):
             try:
@@ -1189,6 +1410,22 @@ class ProcessFleet:
         self._routed[rid] = rp.replica_id
         self._class_open.setdefault(cls, set()).add(rid)
 
+    # -- per-op RPC policy, derived from the PROTOCOL registry ----------
+
+    def _op_timeout(self, op: str) -> float:
+        """The configured deadline for ``op``'s declared timeout
+        class — call sites never carry their own float."""
+        cls = PROTOCOL[op].timeout_class
+        return {"rpc": self.rpc_timeout_s,
+                "poll": self.poll_timeout_s,
+                "spawn": self.spawn_timeout_s}[cls]
+
+    def _op_retries(self, op: str) -> int:
+        """The retry budget ``op`` is allowed: the configured budget
+        when the registry marks it idempotent, zero otherwise (those
+        escalate to restart + journal replay)."""
+        return self.rpc_retries if PROTOCOL[op].idempotent else 0
+
     # -- restart (the heartbeat ⇒ SIGKILL ⇒ replay discipline) ----------
 
     def _restart(self, rp: ReplicaProcess, *, reason: str,
@@ -1257,9 +1494,10 @@ class ProcessFleet:
                     snaps[rp.replica_id] = rp.last_snap
                 continue
             try:
-                reply, _ = rp.call("snapshot",
-                                   timeout=self.poll_timeout_s,
-                                   retries=self.rpc_retries)
+                reply, _ = rp.call(
+                    "snapshot",
+                    timeout=self._op_timeout("snapshot"),
+                    retries=self._op_retries("snapshot"))
                 rp.last_snap = reply["snapshot"]
                 rp.suspect = 0
                 rp.stale = False
@@ -1360,7 +1598,7 @@ class ProcessFleet:
         for _ in range(self.max_restarts + 2):
             try:
                 rp.call("submit", {"req": req},
-                        timeout=self.rpc_timeout_s)
+                        timeout=self._op_timeout("submit"))
                 rp.inflight += 1
                 if track:
                     self._mark_routed(rid, rp, cls)
@@ -1472,8 +1710,8 @@ class ProcessFleet:
             try:
                 reply, blobs = pf.call(
                     "gather_kv", {"prompt": h.req["prompt"]},
-                    timeout=self.rpc_timeout_s,
-                    retries=self.rpc_retries)
+                    timeout=self._op_timeout("gather_kv"),
+                    retries=self._op_retries("gather_kv"))
             except RpcError:
                 self._submit_cold(h.req, cls, round_idx,
                                   stage="gather")
@@ -1489,11 +1727,15 @@ class ProcessFleet:
                                   stage="no_dst")
                 continue
             try:
-                scatter = {k: reply[k] for k in
-                           ("names", "shapes", "dtypes", "geometry")}
-                scatter.update(prompt=h.req["prompt"], n=n)
+                # a literal header — the auditor checks these keys
+                # against PROTOCOL["scatter_kv"] field for field
+                scatter = {"names": reply["names"],
+                           "shapes": reply["shapes"],
+                           "dtypes": reply["dtypes"],
+                           "geometry": reply["geometry"],
+                           "prompt": h.req["prompt"], "n": n}
                 dst.call("scatter_kv", scatter, blobs,
-                         timeout=self.rpc_timeout_s)
+                         timeout=self._op_timeout("scatter_kv"))
             except RpcError:
                 self._submit_cold(h.req, cls, round_idx,
                                   stage="scatter")
@@ -1521,7 +1763,7 @@ class ProcessFleet:
                 continue
             try:
                 reply, _ = rp.call("tick",
-                                   timeout=self.rpc_timeout_s)
+                                   timeout=self._op_timeout("tick"))
             except RpcError as e:
                 self._restart(
                     rp, reason=f"tick_failed:{type(e).__name__}",
@@ -1734,14 +1976,15 @@ class ProcessFleet:
         for rp in self.replicas:
             if not rp.reaped:
                 pending_seq[rp.replica_id] = rp.post(
-                    "run", timeout=self.rpc_timeout_s)
+                    "run", timeout=self._op_timeout("run"))
         for rp in list(self.replicas):
             if rp.reaped:
                 continue
             for attempt in range(self.max_restarts + 1):
                 try:
-                    reply, _ = rp.wait(pending_seq[rp.replica_id],
-                                       timeout=self.spawn_timeout_s)
+                    reply, _ = rp.wait(
+                        pending_seq[rp.replica_id],
+                        timeout=self._op_timeout("run"))
                     for rid, reason in reply.get("finished", []):
                         if not str(rid).startswith(
                                 PREFILL_RID_PREFIX):
@@ -1754,7 +1997,7 @@ class ProcessFleet:
                         reason=f"run_failed:{type(e).__name__}",
                         round_idx=attempt)
                     pending_seq[rp.replica_id] = rp.post(
-                        "run", timeout=self.rpc_timeout_s)
+                        "run", timeout=self._op_timeout("run"))
         snaps = self._poll_round(1)
         self._observe(1, snaps)
         return 1
@@ -1770,9 +2013,10 @@ class ProcessFleet:
             if rp.reaped or not rp.alive():
                 continue
             try:
-                reply, _ = rp.call("summary",
-                                   timeout=self.rpc_timeout_s,
-                                   retries=self.rpc_retries)
+                reply, _ = rp.call(
+                    "summary",
+                    timeout=self._op_timeout("summary"),
+                    retries=self._op_retries("summary"))
             except RpcError:
                 continue
             for rid, toks in reply.get("rows", {}).items():
@@ -1788,9 +2032,10 @@ class ProcessFleet:
             if rp.reaped or not rp.alive():
                 continue
             try:
-                reply, _ = rp.call("summary",
-                                   timeout=self.rpc_timeout_s,
-                                   retries=self.rpc_retries)
+                reply, _ = rp.call(
+                    "summary",
+                    timeout=self._op_timeout("summary"),
+                    retries=self._op_retries("summary"))
             except RpcError:
                 continue
             per_replica[rp.replica_id] = reply.get("summary", {})

@@ -29,6 +29,12 @@ from apex_tpu.serving import (AutoscalePolicy, EngineSpec, QoSClass,
                               QoSPolicy, ReplicaDead, RpcError,
                               RpcTimeout, fleet_rows_digest,
                               recv_frame, send_frame)
+from apex_tpu.serving import control_plane as cp
+from apex_tpu.serving.control_plane import (FrameError, PROTOCOL,
+                                            ProcessFleet,
+                                            ProtocolSpec,
+                                            ProtocolViolation,
+                                            ReplicaProcess)
 from apex_tpu.serving.resilience import ShedPolicy
 
 
@@ -115,6 +121,242 @@ class TestWireProtocol:
         finally:
             a.close()
             b.close()
+
+
+# ---------------------------------------------------------------------------
+# adversarial frames (ISSUE-20 satellite: every malformed input maps
+# to the right taxonomy error — never a hang or a raw OSError)
+# ---------------------------------------------------------------------------
+
+class TestAdversarialFrames:
+    def _pair(self):
+        a, b = socket.socketpair()
+        b.settimeout(0.5)              # any stall surfaces as RpcTimeout
+        return a, b
+
+    def _raw(self, a, payload):
+        a.sendall(struct.pack(">I", len(payload)) + payload)
+
+    def test_truncated_length_prefix(self):
+        a, b = self._pair()
+        try:
+            a.sendall(b"\x00\x00")     # 2 of the 4 prefix bytes
+            a.close()
+            with pytest.raises(ReplicaDead):
+                recv_frame(b)
+        finally:
+            b.close()
+
+    def test_oversized_declared_blob_length(self):
+        a, b = self._pair()
+        try:
+            self._raw(a, json.dumps(
+                {"op": "x", "blobs": [cp.MAX_BLOB_BYTES + 1]}
+            ).encode())
+            with pytest.raises(RpcError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_negative_blob_length(self):
+        a, b = self._pair()
+        try:
+            self._raw(a, b'{"op": "x", "blobs": [-1]}')
+            with pytest.raises(RpcError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_list_blob_lengths(self):
+        a, b = self._pair()
+        try:
+            self._raw(a, b'{"op": "x", "blobs": 5}')
+            with pytest.raises(RpcError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_blob_count_mismatch_times_out_not_hangs(self):
+        # header promises 5 blob bytes the sender never delivers:
+        # the bounded recv must surface RpcTimeout, not block forever
+        a, b = self._pair()
+        try:
+            self._raw(a, b'{"op": "x", "seq": 1, "blobs": [5]}')
+            with pytest.raises(RpcTimeout):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_junk_json_is_frame_error(self):
+        # honest prefix + undecodable header: the stream stays
+        # frame-aligned, so this is the RECOVERABLE class
+        a, b = self._pair()
+        try:
+            self._raw(a, b"not json at all")
+            with pytest.raises(FrameError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_object_json_header_is_frame_error(self):
+        a, b = self._pair()
+        try:
+            self._raw(a, b"[1, 2, 3]")
+            with pytest.raises(FrameError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_frame_error_is_rpc_error(self):
+        # parent-side callers that catch RpcError keep working
+        assert issubclass(FrameError, RpcError)
+        assert issubclass(ProtocolViolation, RpcError)
+
+
+# ---------------------------------------------------------------------------
+# worker-loop resilience (ISSUE-20 satellite: decodable-but-invalid
+# requests get a structured error reply and the loop stays alive)
+# ---------------------------------------------------------------------------
+
+class _StubWorkerState:
+    fault = None
+
+
+class TestWorkerLoopResilience:
+    def _start_worker(self):
+        import threading
+
+        a, b = socket.socketpair()
+        a.settimeout(5.0)
+        t = threading.Thread(target=cp._worker_loop,
+                             args=(b, _StubWorkerState()),
+                             daemon=True)
+        t.start()
+        return a, b, t
+
+    def test_malformed_then_invalid_then_served(self):
+        a, b, t = self._start_worker()
+        try:
+            # 1) undecodable header: structured error, loop alive
+            payload = b"{this is not json"
+            a.sendall(struct.pack(">I", len(payload)) + payload)
+            reply, _ = recv_frame(a)
+            assert reply["seq"] is None
+            assert reply["error"] == "FrameError"
+            # 2) unknown op: structured error, loop alive
+            send_frame(a, {"op": "bogus", "seq": 1})
+            reply, _ = recv_frame(a)
+            assert reply["seq"] == 1
+            assert reply["error"] == "ProtocolViolation"
+            assert "unknown op" in reply["message"]
+            # 3) declared op missing a required field: same contract
+            send_frame(a, {"op": "submit", "seq": 2})
+            reply, _ = recv_frame(a)
+            assert reply["seq"] == 2
+            assert reply["error"] == "ProtocolViolation"
+            assert "req" in reply["message"]
+            # 4) a child->parent op on the wrong side is refused too
+            send_frame(a, {"op": "hello", "seq": 3})
+            reply, _ = recv_frame(a)
+            assert reply["seq"] == 3
+            assert reply["error"] == "ProtocolViolation"
+            # 5) the SAME socket still serves a valid op afterwards
+            send_frame(a, {"op": "shutdown", "seq": 4})
+            reply, _ = recv_frame(a)
+            assert reply == {"seq": 4}
+            t.join(5.0)
+            assert not t.is_alive()
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# the protocol registry (protocol-as-data: both runtime sides derive
+# from PROTOCOL, and drift fails at import)
+# ---------------------------------------------------------------------------
+
+class TestProtocolRegistry:
+    def test_dispatch_covers_registry_exactly(self):
+        declared = {op for op, s in PROTOCOL.items()
+                    if s.direction == "parent_to_child"}
+        assert declared == set(cp._OP_HANDLERS)
+        cp._validate_protocol()        # idempotent re-check
+
+    def test_hello_is_child_to_parent(self):
+        assert PROTOCOL["hello"].direction == "child_to_parent"
+        assert "rid" in PROTOCOL["hello"].required
+        assert "pid" in PROTOCOL["hello"].required
+
+    def test_spec_validates_direction_and_timeout_class(self):
+        with pytest.raises(ValueError):
+            ProtocolSpec("x", direction="sideways")
+        with pytest.raises(ValueError):
+            ProtocolSpec("x", timeout_class="eventually")
+        with pytest.raises(ValueError):
+            ProtocolSpec("x", required=("seq",))   # frame-layer field
+
+    def test_post_refuses_undeclared_op(self):
+        rp = ReplicaProcess(EngineSpec(replica_id="r0"), "/tmp")
+        with pytest.raises(ProtocolViolation):
+            rp.post("bogus", timeout=1.0)
+
+    def test_post_refuses_blobs_on_blobless_op(self):
+        rp = ReplicaProcess(EngineSpec(replica_id="r0"), "/tmp")
+        with pytest.raises(ProtocolViolation):
+            rp.post("tick", None, [b"x"], timeout=1.0)
+
+    def test_post_refuses_missing_required_field(self):
+        rp = ReplicaProcess(EngineSpec(replica_id="r0"), "/tmp")
+        with pytest.raises(ProtocolViolation):
+            rp.post("submit", {}, timeout=1.0)
+
+    def test_call_refuses_retry_on_non_idempotent_op(self):
+        rp = ReplicaProcess(EngineSpec(replica_id="r0"), "/tmp")
+        assert not PROTOCOL["submit"].idempotent
+        with pytest.raises(ProtocolViolation):
+            rp.call("submit", {"req": {}}, timeout=1.0, retries=1)
+
+    def test_fleet_per_op_policy_derives_from_registry(self):
+        fleet = ProcessFleet([EngineSpec(replica_id="r0")],
+                             rpc_timeout_s=7.0, poll_timeout_s=3.0,
+                             spawn_timeout_s=11.0, rpc_retries=2)
+        assert fleet._op_timeout("snapshot") == 3.0   # poll class
+        assert fleet._op_timeout("submit") == 7.0     # rpc class
+        assert fleet._op_timeout("run") == 11.0       # spawn class
+        assert fleet._op_retries("snapshot") == 2     # idempotent
+        assert fleet._op_retries("submit") == 0       # escalates
+        assert fleet._op_retries("scatter_kv") == 0   # escalates
+
+    def test_spawn_spec_stamps_connect_timeout(self):
+        # one clock, two sides: the child's connect deadline IS the
+        # listener's spawn deadline (the 30s-vs-300s race fix)
+        rp = ReplicaProcess(EngineSpec(replica_id="r0"), "/tmp",
+                            spawn_timeout_s=123.0)
+        spec = rp._spawn_spec(False)
+        assert spec.connect_timeout_s == 123.0
+        assert spec.replay is False
+
+    def test_spawn_spec_replay_strips_fault(self):
+        rp = ReplicaProcess(
+            EngineSpec(replica_id="r0", fault="kill9@2"), "/tmp",
+            spawn_timeout_s=9.0)
+        spec = rp._spawn_spec(True)
+        assert spec.replay is True and spec.fault is None
+        assert spec.connect_timeout_s == 9.0
+        # the first spawn keeps the fault (the drill must fire once)
+        assert rp._spawn_spec(False).fault == "kill9@2"
+
+    def test_engine_spec_round_trips_connect_timeout(self):
+        spec = EngineSpec(replica_id="r0", connect_timeout_s=42.0)
+        assert EngineSpec.from_dict(
+            spec.as_dict()).connect_timeout_s == 42.0
 
 
 # ---------------------------------------------------------------------------

@@ -221,28 +221,42 @@
 #                            bucket, zero post-warmup recompiles)
 #                            and tokens/s > 0 (docs/api/serving.md
 #                            #expert-parallel-decode)
+#  19. wire-protocol audit  — `--check-protocol` (APX901-905):
+#                            serving/ + resilience/ audited against
+#                            the ProtocolSpec registry in
+#                            serving/control_plane.py — deadline
+#                            discipline, op/header-field drift
+#                            matched across the parent post/wait
+#                            paths and the child dispatch table,
+#                            socket/subprocess/tempdir lifecycle,
+#                            retry-safety — with the linter's
+#                            baseline semantics against the
+#                            committed-EMPTY
+#                            tools/protocol_baseline.txt (stale
+#                            entries fail; docs/api/analysis.md
+#                            #wire-protocol)
 set -euo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "[ci] 1/18 default test tier"
+echo "[ci] 1/19 default test tier"
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 
-echo "[ci] 2/18 README drift guard"
+echo "[ci] 2/19 README drift guard"
 python tools/readme_numbers.py --check
 
-echo "[ci] 3/18 8-device multichip dryrun"
+echo "[ci] 3/19 8-device multichip dryrun"
 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
 
-echo "[ci] 4/18 monitor smoke"
+echo "[ci] 4/19 monitor smoke"
 MONITOR_SMOKE_JSONL="$(mktemp -t apex_tpu_monitor_smoke.XXXXXX.jsonl)"
 python -m apex_tpu.testing.standalone_gpt --steps 3 \
     --jsonl "$MONITOR_SMOKE_JSONL"
 python tools/monitor_summary.py "$MONITOR_SMOKE_JSONL"
 rm -f "$MONITOR_SMOKE_JSONL"
 
-echo "[ci] 5/18 kill->resume smoke"
+echo "[ci] 5/19 kill->resume smoke"
 RESIL_DIR="$(mktemp -d -t apex_tpu_resilience.XXXXXX)"
 RESIL_JSONL="$RESIL_DIR/events.jsonl"
 # leg 1: preempted at step 4 — must exit 0 via the graceful path
@@ -262,16 +276,16 @@ grep -q '"name":"preempt_exit"' "$RESIL_JSONL" \
 python tools/monitor_summary.py "$RESIL_JSONL"
 rm -rf "$RESIL_DIR"
 
-echo "[ci] 6/18 fused-pipeline kernel parity (Pallas interpret mode)"
+echo "[ci] 6/19 fused-pipeline kernel parity (Pallas interpret mode)"
 python -c "from apex_tpu.ops import fused_pipeline; \
 fused_pipeline.self_check()"
 
-echo "[ci] 7/18 static analysis (self-hosted lint + docs drift + sanitizer)"
+echo "[ci] 7/19 static analysis (self-hosted lint + docs drift + sanitizer)"
 python -m apex_tpu.analysis --check
 python -m apex_tpu.analysis --check-docs
 python -m apex_tpu.analysis --smoke
 
-echo "[ci] 8/18 compiled-graph audit (--check-hlo) + bench gate"
+echo "[ci] 8/19 compiled-graph audit (--check-hlo) + bench gate"
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-hlo
 python tools/bench_gate.py --self-test
@@ -280,7 +294,7 @@ if [ "${APEX_TPU_BENCH_GATE:-0}" = "1" ]; then
     python tools/bench_gate.py
 fi
 
-echo "[ci] 9/18 trace smoke (waterfall + chrome + deferred telemetry)"
+echo "[ci] 9/19 trace smoke (waterfall + chrome + deferred telemetry)"
 TRACE_DIR="$(mktemp -d -t apex_tpu_trace.XXXXXX)"
 # leg 1: traced run — canonical spans, waterfall rows summing to
 # wall_ms, and a parseable Chrome artifact
@@ -301,7 +315,7 @@ grep -q '"name":"loss"' "$TRACE_DIR/deferred.jsonl" \
          exit 1; }
 rm -rf "$TRACE_DIR"
 
-echo "[ci] 10/18 scan-driver smoke (K-batched steps + AOT compile cache)"
+echo "[ci] 10/19 scan-driver smoke (K-batched steps + AOT compile cache)"
 SCAN_DIR="$(mktemp -d -t apex_tpu_scan.XXXXXX)"
 # leg 1: 6 steps as 2 windows of K=3 under the sanitizer — one compile
 # after warmup, d->h transfer guard armed (scan mode is deferred-
@@ -325,7 +339,7 @@ APEX_TPU_COMPILE_CACHE_DIR="$SCAN_DIR/cc" \
     --expect-cache-hits
 rm -rf "$SCAN_DIR"
 
-echo "[ci] 11/18 serving smoke (continuous batching + clean drain)"
+echo "[ci] 11/19 serving smoke (continuous batching + clean drain)"
 SERVE_DIR="$(mktemp -d -t apex_tpu_serve.XXXXXX)"
 # leg 1: sanitized serve — a pinned 2x1 ladder AOT-compiles in warmup
 # (2 decode buckets + 1 prefill = 3 programs) and the whole run holds
@@ -449,7 +463,7 @@ grep -q '"name":"escalation_drain"' "$SERVE_DIR/stall.jsonl" \
 python tools/trace_check.py "$SERVE_DIR/stall.jsonl" --serve
 rm -rf "$SERVE_DIR"
 
-echo "[ci] 12/18 SPMD sharding audit (--check-sharding) + topology drift"
+echo "[ci] 12/19 SPMD sharding audit (--check-sharding) + topology drift"
 # Compile every plan-carrying multichip entry under its mesh on the
 # same 8-device host-platform trick the multichip tests use; fails on
 # APX701-703 findings, per-device-memory drift vs the committed
@@ -461,7 +475,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis --check-sharding
 python __graft_entry__.py --plans 8
 
-echo "[ci] 13/18 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
+echo "[ci] 13/19 fleet serving smoke (multi-replica + swap + disagg + crash replay)"
 FLEET_DIR="$(mktemp -d -t apex_tpu_fleet.XXXXXX)"
 # leg 1: sanitized 2-replica fleet with ONE rolling weight swap
 # mid-serve — zero lost requests fleet-wide, zero compiles after
@@ -517,7 +531,7 @@ echo "$FLEET_OUT" | grep -q "done=8" \
 python tools/trace_check.py "$FLEET_DIR"/crash/serve-*.jsonl --serve
 rm -rf "$FLEET_DIR"
 
-echo "[ci] 14/18 host-concurrency audit (--check-concurrency) + schedule stress"
+echo "[ci] 14/19 host-concurrency audit (--check-concurrency) + schedule stress"
 # static half: APX801-805 over the whole package against the
 # committed EMPTY baseline (a stale entry fails like the linter's)
 python -m apex_tpu.analysis --check-concurrency
@@ -528,7 +542,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python -m apex_tpu.analysis.schedule --seeds 5 --replicas 2 \
     --requests 6 --new-tokens 4
 
-echo "[ci] 15/18 Q8 quantized serving smoke (int8 weight-only decode)"
+echo "[ci] 15/19 Q8 quantized serving smoke (int8 weight-only decode)"
 # kernel half: the quant matmul's interpret-mode parity sweep — GEMV
 # and tiled paths vs the jnp twin, plus the zero-channel round-trip
 python -c "from apex_tpu.ops import quant_matmul; \
@@ -549,7 +563,7 @@ echo "$Q8_OUT" | grep -q "compiles=2 " \
 echo "$Q8_OUT" | grep -Eq "tokens_s=[1-9]" \
     || { echo "[ci] FAIL: Q8 serve reported zero tokens/s"; exit 1; }
 
-echo "[ci] 16/18 live metrics plane (exporter + /healthz flip + SLO burn)"
+echo "[ci] 16/19 live metrics plane (exporter + /healthz flip + SLO burn)"
 METRICS_DIR="$(mktemp -d -t apex_tpu_metrics.XXXXXX)"
 METRICS_PORT=$((19300 + RANDOM % 500))
 # leg 1: sanitized 2-replica fleet with the exporter attached — the
@@ -610,7 +624,7 @@ python tools/monitor_summary.py "$METRICS_DIR/slo.jsonl" \
     || { echo "[ci] FAIL: monitor_summary did not render the SLO section"; exit 1; }
 rm -rf "$METRICS_DIR"
 
-echo "[ci] 17/18 process-isolated fleet (kill -9 drill + journal replay + autoscale trace)"
+echo "[ci] 17/19 process-isolated fleet (kill -9 drill + journal replay + autoscale trace)"
 CP_DIR="$(mktemp -d -t apex_tpu_cp.XXXXXX)"
 # leg 1: the uninterrupted 2-process reference — every replica is a
 # supervised subprocess behind the socket control plane; its digest
@@ -672,7 +686,7 @@ python tools/monitor_summary.py "$CP_DIR"/scale-logs/*.jsonl \
     || { echo "[ci] FAIL: monitor_summary did not render the autoscale trace"; exit 1; }
 rm -rf "$CP_DIR"
 
-echo "[ci] 18/18 expert-parallel serving smoke (MoE decode fast path)"
+echo "[ci] 18/19 expert-parallel serving smoke (MoE decode fast path)"
 # kernel half: the fused routing kernel's interpret-mode parity sweep
 # — Pallas top-k route/dispatch vs the jnp twin, keep/slot bit-exact
 python -c "from apex_tpu.ops import moe_routing; \
@@ -694,5 +708,12 @@ echo "$EP_OUT" | grep -q "compiles=2 " \
     || { echo "[ci] FAIL: EP serve broke the one-compile-per-bucket ladder"; exit 1; }
 echo "$EP_OUT" | grep -Eq "tokens_s=[1-9]" \
     || { echo "[ci] FAIL: EP serve reported zero tokens/s"; exit 1; }
+
+echo "[ci] 19/19 wire-protocol audit (--check-protocol)"
+# the APX9xx family: serving/ + resilience/ audited against the
+# declared ProtocolSpec registry — the baseline is committed EMPTY
+# (every finding at introduction was fixed), so any output here is a
+# new drift between the parent and child sides of the control plane
+python -m apex_tpu.analysis --check-protocol
 
 echo "[ci] all green"
